@@ -1,0 +1,28 @@
+"""misaka_tpu — a TPU-native rebuild of the Misaka Net distributed TIS-100 system.
+
+The reference (jasmaa/misaka-net, mounted at /root/reference) is a MIMD actor
+network: one OS process per node, gRPC+TLS unary RPC per transferred integer.
+This package re-designs the same system TPU-first: the entire node graph is
+compiled into ONE jitted SPMD superstep kernel in which
+
+  * every program node  = a lane of a vmapped register file (ACC, BAK, PC, ports)
+  * every stack node    = an HBM-resident (array, top) pair updated by scatter/gather
+  * every inter-node MOV= dense one-hot routing (all arbitration is data-parallel)
+  * master IN/OUT queues= device-resident ring buffers synced with the host in chunks
+  * a batch axis vmaps N independent network instances for throughput
+  * multi-chip scaling  = jax.sharding Mesh + shard_map with XLA collectives
+
+Component map vs. the reference (SURVEY.md §2):
+  C1 process entrypoint -> misaka_tpu.runtime.app
+  C2 MasterNode         -> misaka_tpu.runtime.master
+  C3 ProgramNode        -> lanes of misaka_tpu.core.step
+  C4 StackNode          -> stack arrays in misaka_tpu.core.step
+  C5 tokenizer          -> misaka_tpu.tis.parser (+ .lower, new)
+  C6 IntStack           -> misaka_tpu.core.state stack arrays
+  C7 gRPC transport     -> in-kernel routing + XLA collectives (misaka_tpu.parallel)
+  C8 math utils         -> misaka_tpu.utils.intmath
+  C9/C10 build/deploy   -> pyproject-less pure package; topology config in runtime.topology
+  C11 docs              -> README.md
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+"""TIS assembly frontend: parser (reference-parity) and dense-table lowering."""
+
+from misaka_tpu.tis.parser import TISParseError, generate_label_map, tokenize, parse
+from misaka_tpu.tis import isa
+from misaka_tpu.tis.lower import lower_program, LoweredProgram
+
+__all__ = [
+    "TISParseError",
+    "generate_label_map",
+    "tokenize",
+    "parse",
+    "isa",
+    "lower_program",
+    "LoweredProgram",
+]

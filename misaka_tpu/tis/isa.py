@@ -1,0 +1,83 @@
+"""Dense ISA encoding for the TIS superstep kernel.
+
+The reference interprets token rows (strings) with a 24-case switch per step
+(/root/reference/internal/nodes/program.go:219-432).  On TPU we cannot branch
+per lane, so the frontend lowers every instruction to a fixed-width row of
+int32 fields and the kernel evaluates all semantic classes as dense masked
+vector ops.  The 24 surface forms collapse to 18 semantic opcodes because
+"VAL vs SRC" variants differ only in the source selector field.
+
+Instruction word layout (one int32[NFIELDS] row per program line; every source
+line, including comments/labels, occupies one slot so that label indices equal
+raw line numbers — parity with program.go:429 wrap semantics):
+
+  F_OP    semantic opcode (OP_*)
+  F_SRC   source selector (SRC_*): immediate / ACC / NIL / inbound port R0-R3
+  F_IMM   immediate operand (int32; reference locals are 64-bit Go ints but the
+          wire is sint32, messenger.proto:34-41 — we use int32 end to end)
+  F_DST   local destination selector (DST_*): ACC or NIL
+  F_TGT   target index: program-lane id for OP_MOV_NET, stack id for PUSH/POP
+  F_PORT  target port 0-3 for OP_MOV_NET
+  F_JMP   absolute jump target line for OP_JMP..OP_JLZ
+"""
+
+# --- semantic opcodes -------------------------------------------------------
+OP_NOP = 0        # no-op (also blank/comment/label-only lines, tokenizer.go:41-46)
+OP_SWP = 1        # acc <-> bak                      (program.go:276-280)
+OP_SAV = 2        # bak <- acc                       (program.go:281-283)
+OP_NEG = 3        # acc <- -acc                      (program.go:312-314)
+OP_MOV_LOCAL = 4  # read src, write ACC/NIL          (program.go:228-241, :252-265)
+OP_MOV_NET = 5    # read src, send to lane:port      (program.go:242-251, :266-275)
+OP_ADD = 6        # acc += src                       (program.go:284-290, :298-304)
+OP_SUB = 7        # acc -= src                       (program.go:291-297, :305-311)
+OP_JMP = 8        # pc <- target                     (program.go:315-319)
+OP_JEZ = 9        # if acc == 0                      (program.go:320-326)
+OP_JNZ = 10       # if acc != 0                      (program.go:327-333)
+OP_JGZ = 11       # if acc > 0                       (program.go:334-340)
+OP_JLZ = 12       # if acc < 0                       (program.go:341-347)
+OP_JRO = 13       # pc <- clamp(pc+src, 0, len-1)    (program.go:348-363)
+OP_PUSH = 14      # push src onto stack tgt          (program.go:364-383)
+OP_POP = 15       # pop stack tgt into ACC/NIL       (program.go:384-394)
+OP_IN = 16        # read master input into ACC/NIL   (program.go:395-405)
+OP_OUT = 17       # send src to master output        (program.go:406-423)
+
+NUM_OPS = 18
+
+# --- source selectors -------------------------------------------------------
+SRC_IMM = 0
+SRC_ACC = 1
+SRC_NIL = 2   # reads as 0 (program.go:439-440)
+SRC_R0 = 3    # SRC_R0 + k selects inbound port Rk; reading a port stalls the
+SRC_R1 = 4    # lane until a peer's send lands (getFromSrc, program.go:441-468)
+SRC_R2 = 5
+SRC_R3 = 6
+
+# --- local destination selectors -------------------------------------------
+DST_ACC = 0
+DST_NIL = 1   # writes discard (program.go:237-239)
+
+# --- field indices ----------------------------------------------------------
+F_OP = 0
+F_SRC = 1
+F_IMM = 2
+F_DST = 3
+F_TGT = 4
+F_PORT = 5
+F_JMP = 6
+NFIELDS = 7
+
+# Opcodes whose semantics read the source operand (and therefore stall when the
+# source is an empty inbound port).  OP_POP / OP_IN write ACC but their "source"
+# is the stack / master queue, handled by dedicated feasibility logic.
+READS_SRC = (OP_MOV_LOCAL, OP_MOV_NET, OP_ADD, OP_SUB, OP_JRO, OP_PUSH, OP_OUT)
+
+# Number of inbound ports per program node (r0..r3, program.go:29-32).
+NUM_PORTS = 4
+
+OP_NAMES = {
+    OP_NOP: "NOP", OP_SWP: "SWP", OP_SAV: "SAV", OP_NEG: "NEG",
+    OP_MOV_LOCAL: "MOV_LOCAL", OP_MOV_NET: "MOV_NET",
+    OP_ADD: "ADD", OP_SUB: "SUB",
+    OP_JMP: "JMP", OP_JEZ: "JEZ", OP_JNZ: "JNZ", OP_JGZ: "JGZ", OP_JLZ: "JLZ",
+    OP_JRO: "JRO", OP_PUSH: "PUSH", OP_POP: "POP", OP_IN: "IN", OP_OUT: "OUT",
+}

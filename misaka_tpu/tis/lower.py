@@ -1,0 +1,177 @@
+"""Lowering: token rows -> dense int32 instruction tables for the kernel.
+
+This stage has no counterpart in the reference (which interprets token strings
+directly, program.go:219-432); it is the TPU-native step that turns a parsed
+program plus the network's name->index maps into the fixed-shape arrays the
+superstep kernel consumes.
+
+Symbol resolution happens here, at compile time:
+  * `name:Rk` network targets (parsed per-send at program.go:476 in the
+    reference) become (lane id, port) pairs.  Sending to a non-program node is
+    a compile error here; the reference would dial it and fatally error at
+    runtime (program.go:494) — documented divergence, strictly better.
+  * PUSH/POP stack targets become stack ids.  Same divergence note.
+  * Jump labels were validated by the parser; here they become absolute line
+    indices (the reference looks them up per-execution, program.go:318).
+
+Immediates are wrapped to int32.  The reference holds locals as 64-bit Go ints
+but every wire transfer truncates to sint32 (messenger.proto:34-41,
+program.go:498); we use int32 end-to-end.  Documented divergence: local
+overflow wraps at 2^31 instead of 2^63.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from misaka_tpu.tis import isa
+from misaka_tpu.tis.parser import TISParseError, parse
+
+_SRC_SEL = {
+    "ACC": isa.SRC_ACC,
+    "NIL": isa.SRC_NIL,
+    "R0": isa.SRC_R0,
+    "R1": isa.SRC_R1,
+    "R2": isa.SRC_R2,
+    "R3": isa.SRC_R3,
+}
+
+_DST_SEL = {"ACC": isa.DST_ACC, "NIL": isa.DST_NIL}
+
+_JUMP_OPS = {
+    "JMP": isa.OP_JMP,
+    "JEZ": isa.OP_JEZ,
+    "JNZ": isa.OP_JNZ,
+    "JGZ": isa.OP_JGZ,
+    "JLZ": isa.OP_JLZ,
+}
+
+
+class TISLowerError(ValueError):
+    """Raised when a parsed program references unknown nodes/stacks."""
+
+
+@dataclass(frozen=True)
+class LoweredProgram:
+    """One node's program as a dense [prog_len, NFIELDS] int32 table."""
+
+    code: np.ndarray  # [L, NFIELDS] int32
+    length: int       # true program length before padding
+    source: str       # original program text (for /load round-trips & debug)
+
+
+def _i32(text: str) -> int:
+    """Parse a decimal immediate and wrap to int32."""
+    v = int(text) & 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def _src_of(tok: str, row: list[str]) -> tuple[int, int]:
+    """Return (src_sel, imm) for a VAL-or-SRC operand token."""
+    if tok in _SRC_SEL:
+        return _SRC_SEL[tok], 0
+    return isa.SRC_IMM, _i32(tok)
+
+
+def lower_tokens(
+    tokens: list[list[str]],
+    label_map: dict[str, int],
+    lane_ids: dict[str, int],
+    stack_ids: dict[str, int],
+) -> np.ndarray:
+    """Lower token rows to a [len(tokens), NFIELDS] int32 table."""
+    code = np.zeros((len(tokens), isa.NFIELDS), dtype=np.int32)
+    for i, row in enumerate(tokens):
+        kind = row[0]
+        f = code[i]
+        if kind == "NOP":
+            f[isa.F_OP] = isa.OP_NOP
+        elif kind == "SWP":
+            f[isa.F_OP] = isa.OP_SWP
+        elif kind == "SAV":
+            f[isa.F_OP] = isa.OP_SAV
+        elif kind == "NEG":
+            f[isa.F_OP] = isa.OP_NEG
+        elif kind in ("MOV_VAL_LOCAL", "MOV_SRC_LOCAL"):
+            f[isa.F_OP] = isa.OP_MOV_LOCAL
+            f[isa.F_SRC], f[isa.F_IMM] = _src_of(row[1], row)
+            f[isa.F_DST] = _DST_SEL[row[2]]
+        elif kind in ("MOV_VAL_NETWORK", "MOV_SRC_NETWORK"):
+            f[isa.F_OP] = isa.OP_MOV_NET
+            f[isa.F_SRC], f[isa.F_IMM] = _src_of(row[1], row)
+            name, port = row[2].split(":")
+            if name not in lane_ids:
+                raise TISLowerError(
+                    f"line {i}, '{name}' is not a program node on this network"
+                )
+            f[isa.F_TGT] = lane_ids[name]
+            f[isa.F_PORT] = int(port[1])
+        elif kind in ("ADD_VAL", "ADD_SRC"):
+            f[isa.F_OP] = isa.OP_ADD
+            f[isa.F_SRC], f[isa.F_IMM] = _src_of(row[1], row)
+        elif kind in ("SUB_VAL", "SUB_SRC"):
+            f[isa.F_OP] = isa.OP_SUB
+            f[isa.F_SRC], f[isa.F_IMM] = _src_of(row[1], row)
+        elif kind in _JUMP_OPS:
+            f[isa.F_OP] = _JUMP_OPS[kind]
+            f[isa.F_JMP] = label_map[row[1]]
+        elif kind in ("JRO_VAL", "JRO_SRC"):
+            f[isa.F_OP] = isa.OP_JRO
+            f[isa.F_SRC], f[isa.F_IMM] = _src_of(row[1], row)
+        elif kind in ("PUSH_VAL", "PUSH_SRC"):
+            f[isa.F_OP] = isa.OP_PUSH
+            f[isa.F_SRC], f[isa.F_IMM] = _src_of(row[1], row)
+            if row[2] not in stack_ids:
+                raise TISLowerError(
+                    f"line {i}, '{row[2]}' is not a stack node on this network"
+                )
+            f[isa.F_TGT] = stack_ids[row[2]]
+        elif kind == "POP":
+            f[isa.F_OP] = isa.OP_POP
+            if row[1] not in stack_ids:
+                raise TISLowerError(
+                    f"line {i}, '{row[1]}' is not a stack node on this network"
+                )
+            f[isa.F_TGT] = stack_ids[row[1]]
+            f[isa.F_DST] = _DST_SEL[row[2]]
+        elif kind == "IN":
+            f[isa.F_OP] = isa.OP_IN
+            f[isa.F_DST] = _DST_SEL[row[1]]
+        elif kind in ("OUT_VAL", "OUT_SRC"):
+            f[isa.F_OP] = isa.OP_OUT
+            f[isa.F_SRC], f[isa.F_IMM] = _src_of(row[1], row)
+        else:  # pragma: no cover — parser emits only the kinds above
+            raise TISLowerError(f"line {i}, unknown token kind '{kind}'")
+    return code
+
+
+def lower_program(
+    program: str,
+    lane_ids: dict[str, int],
+    stack_ids: dict[str, int],
+) -> LoweredProgram:
+    """Parse + lower one node's program text."""
+    tokens, label_map = parse(program)
+    code = lower_tokens(tokens, label_map, lane_ids, stack_ids)
+    return LoweredProgram(code=code, length=len(tokens), source=program)
+
+
+def pad_programs(programs: list[LoweredProgram]) -> tuple[np.ndarray, np.ndarray]:
+    """Stack per-lane tables into [N, L, NFIELDS] plus prog_len [N].
+
+    Padding rows are NOP, but they are unreachable: the PC wraps modulo the
+    true per-lane length (program.go:429), never the padded length.
+    """
+    max_len = max(p.length for p in programs)
+    n = len(programs)
+    code = np.zeros((n, max_len, isa.NFIELDS), dtype=np.int32)
+    lengths = np.zeros((n,), dtype=np.int32)
+    for i, p in enumerate(programs):
+        code[i, : p.length] = p.code
+        lengths[i] = p.length
+    return code, lengths
+
+
+DEFAULT_PROGRAM = "NOP"  # a fresh node's program (program.go:64)

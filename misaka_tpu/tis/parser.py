@@ -1,0 +1,115 @@
+r"""TIS-100 dialect parser with grammar parity to the reference tokenizer.
+
+Reproduces the exact two-pass compile of /root/reference/internal/tis/tokenizer.go:
+pass 1 builds the label->line map (GenerateLabelMap, tokenizer.go:11-26), pass 2
+regex-dispatches every line to a token row (Tokenize, tokenizer.go:29-106).
+
+Parity notes (each deliberate):
+  * Labels are uppercased (tokenizer.go:18,:70); duplicates rejected with the
+    reference's message (tokenizer.go:19-21).
+  * Every source line — blank, comment, label-only — becomes one NOP slot, so
+    label indices equal raw line numbers (tokenizer.go:41-46) and the PC wrap
+    `(ptr+1) % len(asm)` (program.go:429) sees the same program length.
+  * The grammar requires a comma followed by whitespace: `MOV 1,ACC` is a
+    syntax error exactly as in the reference (`\s*,\s+` at tokenizer.go:50).
+  * `\w` is matched ASCII-only (Go's regexp \w is ASCII; Python's defaults to
+    Unicode, hence re.ASCII below).
+  * Jump labels are validated at compile time (tokenizer.go:71-75).
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class TISParseError(ValueError):
+    """Raised on any parse failure; messages mirror the reference's errors."""
+
+
+_LABEL_RE = re.compile(r"^\s*(\w+):", re.ASCII)
+_PREFIX_RE = re.compile(r"^(\s*\w+:)?\s*", re.ASCII)
+
+# Ordered regex cascade — one entry per branch of tokenizer.go:41-101, in the
+# same priority order.  Each maps match groups -> token row.
+_RULES = [
+    (re.compile(r"^#.*$", re.ASCII), lambda m: ["NOP"]),
+    (re.compile(r"^(NOP|SWP|SAV|NEG)\s*$", re.ASCII), lambda m: [m.group(1)]),
+    (re.compile(r"^MOV\s+(-?\d+)\s*,\s+(ACC|NIL)\s*$", re.ASCII),
+     lambda m: ["MOV_VAL_LOCAL", m.group(1), m.group(2)]),
+    (re.compile(r"^MOV\s+(-?\d+)\s*,\s+(\w+:R[0123])\s*$", re.ASCII),
+     lambda m: ["MOV_VAL_NETWORK", m.group(1), m.group(2)]),
+    (re.compile(r"^MOV\s+(ACC|NIL|R[0123])\s*,\s+(ACC|NIL)\s*$", re.ASCII),
+     lambda m: ["MOV_SRC_LOCAL", m.group(1), m.group(2)]),
+    (re.compile(r"^MOV\s+(ACC|NIL|R[0123])\s*,\s+(\w+:R[0123])\s*$", re.ASCII),
+     lambda m: ["MOV_SRC_NETWORK", m.group(1), m.group(2)]),
+    (re.compile(r"^(ADD|SUB)\s+(-?\d+)\s*$", re.ASCII),
+     lambda m: [f"{m.group(1)}_VAL", m.group(2)]),
+    (re.compile(r"^(ADD|SUB)\s+(ACC|NIL|R[0123])\s*$", re.ASCII),
+     lambda m: [f"{m.group(1)}_SRC", m.group(2)]),
+    # JMP/JEZ/JNZ/JGZ/JLZ handled separately (needs label validation).
+    (re.compile(r"^JRO\s+(-?\d+)\s*$", re.ASCII), lambda m: ["JRO_VAL", m.group(1)]),
+    (re.compile(r"^JRO\s+(ACC|NIL|R[0123])\s*$", re.ASCII),
+     lambda m: ["JRO_SRC", m.group(1)]),
+    (re.compile(r"^PUSH\s+(-?\d+)\s*,\s+(\w+)\s*$", re.ASCII),
+     lambda m: ["PUSH_VAL", m.group(1), m.group(2)]),
+    (re.compile(r"^PUSH\s+(ACC|NIL|R[0123])\s*,\s+(\w+)\s*$", re.ASCII),
+     lambda m: ["PUSH_SRC", m.group(1), m.group(2)]),
+    (re.compile(r"^POP\s+(\w+)\s*,\s+(ACC|NIL)\s*$", re.ASCII),
+     lambda m: ["POP", m.group(1), m.group(2)]),
+    (re.compile(r"^IN\s+(ACC|NIL)\s*$", re.ASCII), lambda m: ["IN", m.group(1)]),
+    (re.compile(r"^OUT\s+(-?\d+)\s*$", re.ASCII), lambda m: ["OUT_VAL", m.group(1)]),
+    (re.compile(r"^OUT\s+(ACC|NIL|R[0123])\s*$", re.ASCII),
+     lambda m: ["OUT_SRC", m.group(1)]),
+]
+
+_JUMP_RE = re.compile(r"^(JMP|JEZ|JNZ|JGZ|JLZ)\s+(\w+)\s*$", re.ASCII)
+
+
+def generate_label_map(lines: list[str]) -> dict[str, int]:
+    """Pass 1: map uppercased labels to their raw line index."""
+    label_map: dict[str, int] = {}
+    for i, line in enumerate(lines):
+        m = _LABEL_RE.match(line)
+        if m:
+            label = m.group(1).upper()
+            if label in label_map:
+                raise TISParseError("Cannot repeat label")
+            label_map[label] = i
+    return label_map
+
+
+def tokenize(lines: list[str], label_map: dict[str, int]) -> list[list[str]]:
+    """Pass 2: convert each line into a token row, validating jump labels."""
+    asm: list[list[str]] = []
+    for i, line in enumerate(lines):
+        m = _PREFIX_RE.match(line)
+        instr = line[m.end():] if m else line
+
+        if len(instr) == 0:
+            asm.append(["NOP"])
+            continue
+
+        jm = _JUMP_RE.match(instr)
+        if jm:
+            label = jm.group(2).upper()
+            if label not in label_map:
+                raise TISParseError(f"line {i}, label '{label}' was not declared")
+            asm.append([jm.group(1), label])
+            continue
+
+        for pattern, build in _RULES:
+            rm = pattern.match(instr)
+            if rm:
+                asm.append(build(rm))
+                break
+        else:
+            raise TISParseError(f"line {i}, '{instr}' not a valid instruction")
+
+    return asm
+
+
+def parse(program: str) -> tuple[list[list[str]], dict[str, int]]:
+    """Full compile of a program string (the LoadProgram path, program.go:178-193)."""
+    lines = program.split("\n")
+    label_map = generate_label_map(lines)
+    return tokenize(lines, label_map), label_map

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip hardware is unavailable in CI, so sharding tests run against
+XLA's host-platform device virtualization (8 CPU devices), exactly as the
+driver's dryrun does.  This must run before any module imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

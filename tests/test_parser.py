@@ -1,0 +1,194 @@
+"""Golden tests for the TIS frontend.
+
+Each case pins one branch of the reference grammar
+(/root/reference/internal/tis/tokenizer.go:41-101) or one error path
+(:19-21, :74, :101).
+"""
+
+import numpy as np
+import pytest
+
+from misaka_tpu.tis import isa
+from misaka_tpu.tis.lower import TISLowerError, lower_program, pad_programs
+from misaka_tpu.tis.parser import (
+    TISParseError,
+    generate_label_map,
+    parse,
+    tokenize,
+)
+
+
+def toks(program):
+    rows, _ = parse(program)
+    return rows
+
+
+# --- label map (tokenizer.go:11-26) ----------------------------------------
+
+def test_label_map_basic():
+    assert generate_label_map(["start:", "NOP", "loop: ADD 1"]) == {
+        "START": 0,
+        "LOOP": 2,
+    }
+
+
+def test_label_map_uppercases():
+    assert generate_label_map(["lOoP: NOP"]) == {"LOOP": 0}
+
+
+def test_label_map_duplicate_rejected():
+    with pytest.raises(TISParseError, match="Cannot repeat label"):
+        generate_label_map(["a:", "A:"])
+
+
+def test_label_indices_are_raw_line_numbers():
+    # comments and blanks occupy slots, so labels later in the file keep
+    # their raw line index (tokenizer.go:41-46 + program.go:429).
+    program = "# header\n\nhere: NOP"
+    _, label_map = parse(program)
+    assert label_map == {"HERE": 2}
+
+
+# --- token rows: every grammar branch --------------------------------------
+
+@pytest.mark.parametrize(
+    "line,row",
+    [
+        ("", ["NOP"]),
+        ("   ", ["NOP"]),
+        ("# a comment", ["NOP"]),
+        ("lbl:", ["NOP"]),
+        ("lbl: # trailing comment", ["NOP"]),
+        ("NOP", ["NOP"]),
+        ("SWP", ["SWP"]),
+        ("SAV", ["SAV"]),
+        ("NEG", ["NEG"]),
+        ("MOV 5, ACC", ["MOV_VAL_LOCAL", "5", "ACC"]),
+        ("MOV -3, NIL", ["MOV_VAL_LOCAL", "-3", "NIL"]),
+        ("MOV 7, misaka2:R0", ["MOV_VAL_NETWORK", "7", "misaka2:R0"]),
+        ("MOV ACC, NIL", ["MOV_SRC_LOCAL", "ACC", "NIL"]),
+        ("MOV R2, ACC", ["MOV_SRC_LOCAL", "R2", "ACC"]),
+        ("MOV ACC, misaka1:R3", ["MOV_SRC_NETWORK", "ACC", "misaka1:R3"]),
+        ("MOV R0, n:R1", ["MOV_SRC_NETWORK", "R0", "n:R1"]),
+        ("ADD 4", ["ADD_VAL", "4"]),
+        ("SUB -9", ["SUB_VAL", "-9"]),
+        ("ADD R1", ["ADD_SRC", "R1"]),
+        ("SUB ACC", ["SUB_SRC", "ACC"]),
+        ("JRO 2", ["JRO_VAL", "2"]),
+        ("JRO -1", ["JRO_VAL", "-1"]),
+        ("JRO ACC", ["JRO_SRC", "ACC"]),
+        ("PUSH 3, st", ["PUSH_VAL", "3", "st"]),
+        ("PUSH ACC, st", ["PUSH_SRC", "ACC", "st"]),
+        ("POP st, ACC", ["POP", "st", "ACC"]),
+        ("POP st, NIL", ["POP", "st", "NIL"]),
+        ("IN ACC", ["IN", "ACC"]),
+        ("IN NIL", ["IN", "NIL"]),
+        ("OUT 12", ["OUT_VAL", "12"]),
+        ("OUT ACC", ["OUT_SRC", "ACC"]),
+        ("OUT R3", ["OUT_SRC", "R3"]),
+    ],
+)
+def test_tokenize_branches(line, row):
+    assert toks(line) == [row]
+
+
+def test_jumps_resolve_and_uppercase():
+    program = "start: NOP\nJMP start\nJEZ START\nJNZ start\nJGZ start\nJLZ start"
+    rows, _ = parse(program)
+    assert rows[1:] == [
+        ["JMP", "START"],
+        ["JEZ", "START"],
+        ["JNZ", "START"],
+        ["JGZ", "START"],
+        ["JLZ", "START"],
+    ]
+
+
+def test_label_prefix_with_instruction():
+    assert toks("loop: ADD 1") == [["ADD_VAL", "1"]]
+
+
+# --- error paths ------------------------------------------------------------
+
+def test_undeclared_jump_label():
+    with pytest.raises(TISParseError, match="label 'NOWHERE' was not declared"):
+        parse("JMP nowhere")
+
+
+def test_invalid_instruction():
+    with pytest.raises(TISParseError, match="not a valid instruction"):
+        parse("FROB 1")
+
+
+def test_comma_requires_trailing_whitespace():
+    # `\s*,\s+` (tokenizer.go:50): no space after comma is a syntax error.
+    with pytest.raises(TISParseError, match="not a valid instruction"):
+        parse("MOV 1,ACC")
+
+
+def test_mov_immediate_destination_must_be_local_or_port():
+    with pytest.raises(TISParseError, match="not a valid instruction"):
+        parse("MOV 1, R0")  # inbound ports are read-only locally
+
+
+# --- lowering ---------------------------------------------------------------
+
+LANES = {"misaka1": 0, "misaka2": 1}
+STACKS = {"misaka3": 0}
+
+
+def test_lower_add2_sender():
+    p = lower_program(
+        "IN ACC\nADD 1\nMOV ACC, misaka2:R0\nMOV R0, ACC\nOUT ACC",
+        LANES,
+        STACKS,
+    )
+    assert p.length == 5
+    np.testing.assert_array_equal(
+        p.code[:, isa.F_OP],
+        [isa.OP_IN, isa.OP_ADD, isa.OP_MOV_NET, isa.OP_MOV_LOCAL, isa.OP_OUT],
+    )
+    assert p.code[2, isa.F_TGT] == 1
+    assert p.code[2, isa.F_PORT] == 0
+    assert p.code[2, isa.F_SRC] == isa.SRC_ACC
+    assert p.code[3, isa.F_SRC] == isa.SRC_R0
+    assert p.code[1, isa.F_SRC] == isa.SRC_IMM
+    assert p.code[1, isa.F_IMM] == 1
+
+
+def test_lower_stack_ops():
+    p = lower_program("PUSH ACC, misaka3\nPOP misaka3, ACC", LANES, STACKS)
+    assert p.code[0, isa.F_OP] == isa.OP_PUSH
+    assert p.code[0, isa.F_TGT] == 0
+    assert p.code[1, isa.F_OP] == isa.OP_POP
+    assert p.code[1, isa.F_DST] == isa.DST_ACC
+
+
+def test_lower_jump_targets_are_line_indices():
+    p = lower_program("# hdr\nloop: ADD 1\nJMP loop", LANES, STACKS)
+    assert p.code[2, isa.F_OP] == isa.OP_JMP
+    assert p.code[2, isa.F_JMP] == 1
+
+
+def test_lower_unknown_network_target():
+    with pytest.raises(TISLowerError, match="not a program node"):
+        lower_program("MOV ACC, ghost:R0", LANES, STACKS)
+
+
+def test_lower_unknown_stack_target():
+    with pytest.raises(TISLowerError, match="not a stack node"):
+        lower_program("PUSH 1, ghost", LANES, STACKS)
+
+
+def test_lower_immediate_wraps_to_int32():
+    p = lower_program("ADD 2147483650", LANES, STACKS)
+    assert p.code[0, isa.F_IMM] == -2147483646
+
+
+def test_pad_programs():
+    a = lower_program("NOP", LANES, STACKS)
+    b = lower_program("ADD 1\nSUB 2\nNEG", LANES, STACKS)
+    code, lengths = pad_programs([a, b])
+    assert code.shape == (2, 3, isa.NFIELDS)
+    np.testing.assert_array_equal(lengths, [1, 3])
+    assert code[0, 1, isa.F_OP] == isa.OP_NOP  # padding
