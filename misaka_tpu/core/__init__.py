@@ -1,0 +1,7 @@
+"""Superstep kernel and execution engine."""
+
+from misaka_tpu.core.state import NetworkState, init_state
+from misaka_tpu.core.step import step
+from misaka_tpu.core.engine import CompiledNetwork
+
+__all__ = ["NetworkState", "init_state", "step", "CompiledNetwork"]

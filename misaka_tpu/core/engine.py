@@ -1,0 +1,179 @@
+"""Execution engine: jitted chunk runner + host-side I/O for a compiled network.
+
+The reference's execution model is one free-running goroutine per node
+(program.go:78-92) with the master's HTTP thread feeding cap-1 channels
+(master.go:216-219).  Here the whole network advances in jitted chunks of K
+supersteps (lax.scan), with the host touching device state only at chunk
+boundaries: refill the input ring, drain the output ring.  A leading batch
+axis runs B independent network instances in lockstep (vmap) — the data
+parallelism the reference lacks entirely (SURVEY.md §2 taxonomy).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from misaka_tpu.core.state import NetworkState, init_state
+from misaka_tpu.core.step import step
+
+_I32 = jnp.int32
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+def _run_chunk(tables, state: NetworkState, num_steps: int) -> NetworkState:
+    code, prog_len = tables
+
+    def body(s, _):
+        return step(code, prog_len, s), None
+
+    out, _ = jax.lax.scan(body, state, None, length=num_steps)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+def _run_chunk_batched(tables, state: NetworkState, num_steps: int) -> NetworkState:
+    code, prog_len = tables
+    step_b = jax.vmap(step, in_axes=(None, None, 0))
+
+    def body(s, _):
+        return step_b(code, prog_len, s), None
+
+    out, _ = jax.lax.scan(body, state, None, length=num_steps)
+    return out
+
+
+@jax.jit
+def _feed(state: NetworkState, values: jnp.ndarray, count: jnp.ndarray) -> NetworkState:
+    """Append `count` leading entries of `values` to the input ring.
+
+    Caller guarantees count <= free space and len(values) <= in_cap, so the
+    scatter indices are distinct.
+    """
+    in_cap = state.in_buf.shape[0]
+    k = values.shape[0]
+    idx = (state.in_wr + jnp.arange(k, dtype=_I32)) % in_cap
+    mask = jnp.arange(k) < count
+    new_buf = state.in_buf.at[idx].set(jnp.where(mask, values, state.in_buf[idx]))
+    return state._replace(in_buf=new_buf, in_wr=state.in_wr + count.astype(_I32))
+
+
+@dataclass
+class CompiledNetwork:
+    """A lowered network bound to the jitted superstep engine.
+
+    code/prog_len come from tis.lower.pad_programs.  `batch=None` runs one
+    network instance; an integer B runs B independent instances in lockstep
+    (state arrays gain a leading batch axis).
+    """
+
+    code: np.ndarray          # [N, L, NFIELDS] int32
+    prog_len: np.ndarray      # [N] int32
+    num_stacks: int = 1
+    stack_cap: int = 1024     # reference stacks are unbounded (intStack.go:9);
+                              # bounded here — a full stack parks the pusher.
+                              # Documented divergence, config knob.
+    in_cap: int = 1024
+    out_cap: int = 1024
+    batch: int | None = None
+    _tables: tuple = field(init=False, repr=False)
+
+    def __post_init__(self):
+        # At least one (possibly phantom) stack keeps kernel shapes nonempty.
+        self.num_stacks = max(1, self.num_stacks)
+        self._tables = (
+            jnp.asarray(self.code, dtype=_I32),
+            jnp.asarray(self.prog_len, dtype=_I32),
+        )
+
+    @property
+    def num_lanes(self) -> int:
+        return self.code.shape[0]
+
+    def init_state(self) -> NetworkState:
+        s = init_state(
+            self.num_lanes, self.num_stacks, self.stack_cap, self.in_cap, self.out_cap
+        )
+        if self.batch is not None:
+            s = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (self.batch,) + x.shape).copy(), s
+            )
+        return s
+
+    def run(self, state: NetworkState, num_steps: int) -> NetworkState:
+        """Advance `num_steps` supersteps in one jitted scan (donated state)."""
+        runner = _run_chunk if self.batch is None else _run_chunk_batched
+        return runner(self._tables, state, num_steps)
+
+    # --- host-side I/O (chunk-boundary only) -------------------------------
+
+    def feed(self, state: NetworkState, values) -> tuple[NetworkState, int]:
+        """Enqueue up to len(values) inputs; returns (state, accepted_count).
+
+        Unbatched networks only — batched I/O is driven by the bench/runtime
+        with its own jitted feeders.
+        """
+        if self.batch is not None:
+            raise ValueError(
+                "feed/drain/compute_stream drive a single network instance; "
+                "for batch mode write the I/O rings directly (see bench.py)"
+            )
+        values = np.asarray(values, dtype=np.int32)
+        free = self.in_cap - int(state.in_wr - state.in_rd)
+        k = min(len(values), free)
+        if k == 0:
+            return state, 0
+        buf = np.zeros((self.in_cap,), np.int32)
+        buf[:k] = values[:k]
+        return _feed(state, jnp.asarray(buf), jnp.asarray(k, _I32)), k
+
+    def drain(self, state: NetworkState) -> tuple[NetworkState, list[int]]:
+        """Collect all pending outputs in order; advances out_rd."""
+        if self.batch is not None:
+            raise ValueError(
+                "feed/drain/compute_stream drive a single network instance; "
+                "for batch mode write the I/O rings directly (see bench.py)"
+            )
+        rd = int(state.out_rd)
+        wr = int(state.out_wr)
+        if wr == rd:
+            return state, []
+        buf = np.asarray(state.out_buf)
+        vals = [int(buf[i % self.out_cap]) for i in range(rd, wr)]
+        return state._replace(out_rd=jnp.asarray(wr, _I32)), vals
+
+    def compute_stream(
+        self,
+        state: NetworkState,
+        values,
+        chunk: int = 64,
+        max_steps: int = 1_000_000,
+    ) -> tuple[NetworkState, list[int]]:
+        """Feed a value stream and run until one output per input arrives.
+
+        The serialized-workload oracle mode: equivalent to the reference's
+        /compute called sequentially (master.go:197-224), where pairing is
+        unambiguous.
+        """
+        pending = list(values)
+        outputs: list[int] = []
+        expected = len(pending)
+        steps = 0
+        while len(outputs) < expected:
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"network made no full progress after {steps} supersteps "
+                    f"({len(outputs)}/{expected} outputs) — deadlock or starvation"
+                )
+            if pending:
+                state, took = self.feed(state, pending)
+                pending = pending[took:]
+            state = self.run(state, chunk)
+            steps += chunk
+            state, got = self.drain(state)
+            outputs.extend(got)
+        return state, outputs

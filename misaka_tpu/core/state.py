@@ -1,0 +1,90 @@
+"""Network state: the whole distributed system as one pytree of arrays.
+
+The reference scatters this state across OS processes: per-node registers
+(program.go:27-35), per-node cap-1 port channels (program.go:29-32,:60-63),
+per-stack-node mutex-guarded slices (intStack.go:9-45), and the master's cap-1
+I/O channels (master.go:31-32,:58-59).  Here it is one NamedTuple of int32
+arrays; a whole-network snapshot is therefore a checkpoint for free
+(SURVEY.md §5), and reset (program.go:207-216) is just `init_state`.
+
+Shapes below are for ONE network instance; the engine vmaps a leading batch
+axis over independent instances for throughput.
+
+Ring-buffer convention: `rd`/`wr` are monotonically increasing int32 counters;
+the slot index is `counter % capacity`; occupancy is `wr - rd`.  The device
+consumes inputs (IN) and produces outputs (OUT); the host refills `in_buf` /
+advances `out_rd` between jitted chunks.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from misaka_tpu.tis import isa
+
+
+class NetworkState(NamedTuple):
+    """All mutable state of one Misaka network instance."""
+
+    # program-node lanes
+    acc: jnp.ndarray        # [N] int32   (program.go:27)
+    bak: jnp.ndarray        # [N] int32   (program.go:28)
+    pc: jnp.ndarray         # [N] int32   (program.go:34)
+    port_val: jnp.ndarray   # [N, 4] int32 — inbound ports r0..r3 (program.go:29-32)
+    port_full: jnp.ndarray  # [N, 4] bool — cap-1 occupancy (bufferSize=1, program.go:21)
+    # The hold latch models the reference's two-phase blocking ops: getFromSrc
+    # CONSUMES the port (program.go:441-468) and only then the delivery RPC
+    # blocks (sendValue/outputValue, :475-506/:554-566).  A lane whose port
+    # source is ready therefore consumes it into the latch immediately and
+    # parks with `holding` set until its delivery commits.
+    hold_val: jnp.ndarray   # [N] int32 — consumed-but-undelivered source value
+    holding: jnp.ndarray    # [N] bool
+
+    # stack nodes
+    stack_mem: jnp.ndarray  # [S, CAP] int32 (intStack.go:9; bounded here, see engine)
+    stack_top: jnp.ndarray  # [S] int32
+
+    # master I/O rings (inChan/outChan, master.go:31-32)
+    in_buf: jnp.ndarray     # [QI] int32
+    in_rd: jnp.ndarray      # int32 scalar — device-advanced
+    in_wr: jnp.ndarray      # int32 scalar — host-advanced
+    out_buf: jnp.ndarray    # [QO] int32
+    out_rd: jnp.ndarray     # int32 scalar — host-advanced
+    out_wr: jnp.ndarray     # int32 scalar — device-advanced
+
+    # metrics
+    tick: jnp.ndarray       # int32 scalar — supersteps executed
+    retired: jnp.ndarray    # [N] int32 — committed instructions per lane
+
+
+def init_state(
+    num_lanes: int,
+    num_stacks: int,
+    stack_cap: int,
+    in_cap: int,
+    out_cap: int,
+) -> NetworkState:
+    """Fresh all-zeros state (the reference's resetNode, program.go:207-216)."""
+    i32 = np.int32
+    return NetworkState(
+        acc=jnp.zeros((num_lanes,), i32),
+        bak=jnp.zeros((num_lanes,), i32),
+        pc=jnp.zeros((num_lanes,), i32),
+        port_val=jnp.zeros((num_lanes, isa.NUM_PORTS), i32),
+        port_full=jnp.zeros((num_lanes, isa.NUM_PORTS), bool),
+        hold_val=jnp.zeros((num_lanes,), i32),
+        holding=jnp.zeros((num_lanes,), bool),
+        stack_mem=jnp.zeros((num_stacks, stack_cap), i32),
+        stack_top=jnp.zeros((num_stacks,), i32),
+        in_buf=jnp.zeros((in_cap,), i32),
+        in_rd=jnp.zeros((), i32),
+        in_wr=jnp.zeros((), i32),
+        out_buf=jnp.zeros((out_cap,), i32),
+        out_rd=jnp.zeros((), i32),
+        out_wr=jnp.zeros((), i32),
+        tick=jnp.zeros((), i32),
+        retired=jnp.zeros((num_lanes,), i32),
+    )

@@ -1,0 +1,236 @@
+"""The superstep kernel: one lockstep tick of the whole node network.
+
+This replaces the reference's per-node free-running interpreter loop
+(program.go:78-92 + the 24-case switch at :219-432) and its gRPC data plane
+(one TLS dial per transferred integer, program.go:492-565) with a single dense
+SPMD function: every program node is a lane, every semantic decision is a
+masked vector op, every inter-node transfer is one-hot routing resolved inside
+the step.  No data-dependent control flow — the function jits once and runs
+under lax.scan.
+
+Stall discipline (SURVEY.md §7): each lane either COMMITS its current
+instruction (state effects + PC advance) or PARKS with PC unchanged, exactly
+mirroring the reference's "error => retry same instruction" loop
+(program.go:80-92,:429-431) and its blocking primitives:
+
+  * reading an empty inbound port parks        (getFromSrc, program.go:441-468)
+  * sending to a full cap-1 port parks         (Send handler, program.go:160-175)
+  * popping an empty stack parks               (waitPop, stack.go:133-155)
+  * IN with no queued master input parks       (GetInput, master.go:233-242)
+  * OUT with a full output ring parks          (outChan send, master.go:246)
+
+Two-phase port reads (the hold latch): the reference's blocking ops consume
+their source FIRST and only then block on delivery — getFromSrc drains the
+channel (program.go:441-468) before sendValue/outputValue blocks in the RPC.
+An atomic "source ready AND destination free" commit would deadlock programs
+the reference completes (e.g. `MOV R0, self:R0` with the port full).  So
+phase A of every tick consumes any ready port source into the lane's hold
+latch (clearing the port), and phase B retries delivery from the latch until
+it commits.  Consequences, all matching Go: a parked sender's inbound port can
+refill behind it, and a send can target a port freed by a phase-A consume in
+the same tick (consume-then-send interleaving).
+
+Determinism where the Go scheduler was racy (SURVEY.md quirks #2-#5): all
+same-tick conflicts (two sends to one port, two ops on one stack, two INs,
+two OUTs) are arbitrated by LOWEST LANE INDEX; losers park and retry.  At most
+one push or pop commits per stack per tick, one IN and one OUT per network per
+tick.  Visibility rule: consumers (port reads, pops, IN) see begin-of-tick
+state; producers (sends, pushes, OUT) require begin-of-tick free space.  Every
+superstep therefore corresponds to one legal interleaving of the reference's
+concurrent semantics — parity tests exploit this.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.tis import isa
+
+_I32 = jnp.int32
+
+
+def _first_true_per_column(contender: jnp.ndarray) -> jnp.ndarray:
+    """[N, K] bool -> same shape with at most one True per column: the lowest
+    row (= lane) index among contenders.  The deterministic arbiter."""
+    return contender & (jnp.cumsum(contender.astype(_I32), axis=0) == 1)
+
+
+def step(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState) -> NetworkState:
+    """Advance one network instance by one superstep.
+
+    code:     [N, L, NFIELDS] int32 — lowered per-lane programs (padded)
+    prog_len: [N] int32 — true per-lane program lengths (PC wrap modulus,
+              program.go:429)
+    """
+    n_lanes, _, _ = code.shape
+    n_ports = isa.NUM_PORTS
+    n_dests = n_lanes * n_ports
+    n_stacks, stack_cap = state.stack_mem.shape
+    in_cap = state.in_buf.shape[0]
+    out_cap = state.out_buf.shape[0]
+    lane = jnp.arange(n_lanes)
+
+    # --- fetch & decode ----------------------------------------------------
+    fields = code[lane, state.pc]  # [N, NFIELDS]
+    op = fields[:, isa.F_OP]
+    src = fields[:, isa.F_SRC]
+    imm = fields[:, isa.F_IMM]
+    dst = fields[:, isa.F_DST]
+    tgt = fields[:, isa.F_TGT]
+    tport = fields[:, isa.F_PORT]
+    jmp = fields[:, isa.F_JMP]
+
+    # --- phase A: source resolution + port consume into the hold latch -----
+    is_port_src = src >= isa.SRC_R0
+    pidx = jnp.clip(src - isa.SRC_R0, 0, n_ports - 1)
+    port_v = state.port_val[lane, pidx]
+    port_f = state.port_full[lane, pidx]
+    reads_src = jnp.isin(op, jnp.asarray(isa.READS_SRC, dtype=_I32))
+    reads_port = reads_src & is_port_src
+    consume_now = reads_port & ~state.holding & port_f
+    holding = state.holding | consume_now
+    hold_val = jnp.where(consume_now, port_v, state.hold_val)
+    src_val = jnp.where(
+        src == isa.SRC_IMM,
+        imm,
+        jnp.where(
+            src == isa.SRC_ACC,
+            state.acc,
+            jnp.where(src == isa.SRC_NIL, jnp.zeros_like(imm), hold_val),
+        ),
+    )
+    src_ok = ~reads_port | holding
+
+    # Ports cleared by this tick's consumes are visible to this tick's sends
+    # (consume-then-send is a legal interleaving; improves pipelining to one
+    # tick per hop).
+    consume_onehot = consume_now[:, None] & (pidx[:, None] == jnp.arange(n_ports)[None, :])
+    port_full_after_reads = state.port_full & ~consume_onehot
+
+    # --- phase B: network sends (OP_MOV_NET): one-hot routing + arbitration
+    want_send = (op == isa.OP_MOV_NET) & src_ok
+    dest = tgt * n_ports + tport
+    dest_onehot = want_send[:, None] & (dest[:, None] == jnp.arange(n_dests)[None, :])
+    dest_free = ~port_full_after_reads.reshape(n_dests)
+    send_win = _first_true_per_column(dest_onehot & dest_free[None, :])  # [N, D]
+    send_won = send_win.any(axis=1)
+    delivered = send_win.any(axis=0)                                    # [D]
+    deliver_val = (send_win.astype(_I32) * src_val[:, None]).sum(axis=0)
+
+    # --- stack ops: at most ONE op (push or pop) per stack per tick --------
+    is_push = op == isa.OP_PUSH
+    is_pop = op == isa.OP_POP
+    tgt_stack = jnp.clip(tgt, 0, n_stacks - 1)
+    top_at_tgt = state.stack_top[tgt_stack]
+    want_sop = (is_push & src_ok & (top_at_tgt < stack_cap)) | (
+        is_pop & (top_at_tgt > 0)
+    )
+    stack_onehot = want_sop[:, None] & (
+        tgt_stack[:, None] == jnp.arange(n_stacks)[None, :]
+    )
+    stack_win = _first_true_per_column(stack_onehot)  # [N, S]
+    sop_won = stack_win.any(axis=1)
+    push_win = stack_win & is_push[:, None]
+    pop_win = stack_win & is_pop[:, None]
+    push_per_stack = push_win.any(axis=0)  # [S]
+    pop_per_stack = pop_win.any(axis=0)
+    push_val = (push_win.astype(_I32) * src_val[:, None]).sum(axis=0)
+    pop_val_lane = state.stack_mem[tgt_stack, jnp.clip(top_at_tgt - 1, 0, stack_cap - 1)]
+
+    # --- master I/O rings --------------------------------------------------
+    in_avail = (state.in_wr - state.in_rd) > 0
+    want_in = (op == isa.OP_IN) & in_avail
+    in_win = _first_true_per_column(want_in[:, None])[:, 0]
+    in_any = in_win.any()
+    in_val = state.in_buf[state.in_rd % in_cap]
+
+    out_free = (state.out_wr - state.out_rd) < out_cap
+    want_out = (op == isa.OP_OUT) & src_ok & out_free
+    out_win = _first_true_per_column(want_out[:, None])[:, 0]
+    out_any = out_win.any()
+    out_val = (out_win.astype(_I32) * src_val).sum()
+
+    # --- commit decision ---------------------------------------------------
+    dst_ok = jnp.where(
+        op == isa.OP_MOV_NET,
+        send_won,
+        jnp.where(
+            is_push | is_pop,
+            sop_won,
+            jnp.where(op == isa.OP_IN, in_win, jnp.where(op == isa.OP_OUT, out_win, True)),
+        ),
+    )
+    commit = src_ok & dst_ok
+
+    # --- register file updates (all read begin-of-tick state) --------------
+    incoming = jnp.where(is_pop, pop_val_lane, jnp.where(op == isa.OP_IN, in_val, src_val))
+    writes_acc = ((op == isa.OP_MOV_LOCAL) | is_pop | (op == isa.OP_IN)) & (
+        dst == isa.DST_ACC
+    )
+    acc = state.acc
+    new_acc = jnp.where(commit & writes_acc, incoming, acc)
+    new_acc = jnp.where(commit & (op == isa.OP_ADD), acc + src_val, new_acc)
+    new_acc = jnp.where(commit & (op == isa.OP_SUB), acc - src_val, new_acc)
+    new_acc = jnp.where(commit & (op == isa.OP_NEG), -acc, new_acc)
+    new_acc = jnp.where(commit & (op == isa.OP_SWP), state.bak, new_acc)
+    new_bak = jnp.where(commit & ((op == isa.OP_SWP) | (op == isa.OP_SAV)), acc, state.bak)
+
+    # --- port updates: phase-A consumes cleared, winning sends fill --------
+    flat_full = port_full_after_reads.reshape(n_dests)
+    new_port_full = (flat_full | delivered).reshape(n_lanes, n_ports)
+    new_port_val = jnp.where(delivered, deliver_val, state.port_val.reshape(n_dests)).reshape(
+        n_lanes, n_ports
+    )
+
+    # --- stack updates -----------------------------------------------------
+    stack_ids = jnp.arange(n_stacks)
+    push_slot = jnp.clip(state.stack_top, 0, stack_cap - 1)
+    cur_slot_val = state.stack_mem[stack_ids, push_slot]
+    new_stack_mem = state.stack_mem.at[stack_ids, push_slot].set(
+        jnp.where(push_per_stack, push_val, cur_slot_val)
+    )
+    new_stack_top = (
+        state.stack_top + push_per_stack.astype(_I32) - pop_per_stack.astype(_I32)
+    )
+
+    # --- I/O ring updates --------------------------------------------------
+    new_in_rd = state.in_rd + in_any.astype(_I32)
+    out_slot = state.out_wr % out_cap
+    new_out_buf = state.out_buf.at[out_slot].set(
+        jnp.where(out_any, out_val, state.out_buf[out_slot])
+    )
+    new_out_wr = state.out_wr + out_any.astype(_I32)
+
+    # --- PC update ---------------------------------------------------------
+    jump_taken = (
+        (op == isa.OP_JMP)
+        | ((op == isa.OP_JEZ) & (acc == 0))
+        | ((op == isa.OP_JNZ) & (acc != 0))
+        | ((op == isa.OP_JGZ) & (acc > 0))
+        | ((op == isa.OP_JLZ) & (acc < 0))
+    )
+    pc_inc = (state.pc + 1) % prog_len                       # program.go:429
+    pc_jro = jnp.clip(state.pc + src_val, 0, prog_len - 1)   # program.go:354
+    new_pc = jnp.where(jump_taken, jmp, jnp.where(op == isa.OP_JRO, pc_jro, pc_inc))
+    new_pc = jnp.where(commit, new_pc, state.pc)
+
+    return NetworkState(
+        acc=new_acc,
+        bak=new_bak,
+        pc=new_pc,
+        port_val=new_port_val,
+        port_full=new_port_full,
+        hold_val=hold_val,
+        holding=holding & ~commit,
+        stack_mem=new_stack_mem,
+        stack_top=new_stack_top,
+        in_buf=state.in_buf,
+        in_rd=new_in_rd,
+        in_wr=state.in_wr,
+        out_buf=new_out_buf,
+        out_rd=state.out_rd,
+        out_wr=new_out_wr,
+        tick=state.tick + 1,
+        retired=state.retired + commit.astype(_I32),
+    )

@@ -1,0 +1,361 @@
+"""Kernel semantics tests: every opcode class, stall rule, and arbiter.
+
+Single-node cases mirror the reference's documented instruction semantics
+(program.go:225-432); multi-node cases pin the rendezvous/backpressure rules
+(program.go:160-175, getFromSrc :441-468; stack.go:95-155) under the
+deterministic superstep discipline documented in core/step.py.
+"""
+
+import numpy as np
+import pytest
+
+from misaka_tpu.core import CompiledNetwork
+from misaka_tpu.tis.lower import lower_program, pad_programs
+
+
+def build(programs: dict[str, str], stacks: list[str] | None = None, **kw) -> CompiledNetwork:
+    stacks = stacks or []
+    lane_ids = {name: i for i, name in enumerate(programs)}
+    stack_ids = {name: i for i, name in enumerate(stacks)}
+    lowered = [lower_program(p, lane_ids, stack_ids) for p in programs.values()]
+    code, lengths = pad_programs(lowered)
+    return CompiledNetwork(code=code, prog_len=lengths, num_stacks=max(1, len(stacks)), **kw)
+
+
+def run_collect(programs, stacks, inputs, **kw):
+    net = build(programs, stacks, **kw)
+    state = net.init_state()
+    state, outs = net.compute_stream(state, inputs, max_steps=100_000)
+    return outs
+
+
+# --- single-lane local semantics -------------------------------------------
+
+def test_acc_arithmetic_pipeline():
+    # MOV/ADD/SUB/NEG over an input stream.
+    prog = "IN ACC\nADD 5\nSUB 2\nNEG\nOUT ACC"
+    assert run_collect({"n": prog}, [], [0, 10, -4]) == [-3, -13, 1]
+
+
+def test_sav_swp():
+    # acc=in+1, bak=acc (SAV), acc=-acc (NEG), SWP -> acc=in+1 again
+    prog = "IN ACC\nADD 1\nSAV\nNEG\nSWP\nOUT ACC"
+    assert run_collect({"n": prog}, [], [41]) == [42]
+
+
+def test_swp_swaps_both_ways():
+    # bak starts 0: SWP gives acc=0, bak=in; second SWP restores.
+    prog = "IN ACC\nSWP\nSWP\nOUT ACC"
+    assert run_collect({"n": prog}, [], [7]) == [7]
+
+
+def test_mov_val_local_and_nil_discard():
+    prog = "IN NIL\nMOV 9, ACC\nMOV 5, NIL\nOUT ACC"
+    assert run_collect({"n": prog}, [], [123]) == [9]
+
+
+def test_nil_reads_as_zero():
+    prog = "IN ACC\nADD NIL\nMOV NIL, ACC\nSUB 1\nOUT ACC"
+    # ADD NIL is +0; MOV NIL, ACC zeroes; SUB 1 -> -1
+    assert run_collect({"n": prog}, [], [55]) == [-1]
+
+
+def test_out_immediate():
+    prog = "IN NIL\nOUT 77"
+    assert run_collect({"n": prog}, [], [0, 0]) == [77, 77]
+
+
+def test_program_wraps_around():
+    # After OUT (last line), PC wraps to line 0 (program.go:429).
+    prog = "IN ACC\nADD 1\nOUT ACC"
+    assert run_collect({"n": prog}, [], [1, 2, 3]) == [2, 3, 4]
+
+
+# --- jumps ------------------------------------------------------------------
+
+def test_jez_taken_and_not_taken():
+    prog = (
+        "IN ACC\n"
+        "JEZ zero\n"
+        "OUT 1\n"
+        "JMP end\n"
+        "zero: OUT 0\n"
+        "end: NOP"
+    )
+    assert run_collect({"n": prog}, [], [0, 5, 0]) == [0, 1, 0]
+
+
+def test_jnz_jgz_jlz():
+    prog = (
+        "IN ACC\n"
+        "JGZ pos\n"
+        "JLZ neg\n"
+        "OUT 0\n"
+        "JMP end\n"
+        "pos: OUT 1\n"
+        "JMP end\n"
+        "neg: OUT -1\n"
+        "end: NOP"
+    )
+    assert run_collect({"n": prog}, [], [3, -3, 0]) == [1, -1, 0]
+
+
+def test_jmp_skips_pc_increment():
+    # Tight self-loop at a label: JMP back to IN forever.
+    prog = "loop: IN ACC\nOUT ACC\nJMP loop\nOUT 999"  # OUT 999 unreachable
+    assert run_collect({"n": prog}, [], [4, 5]) == [4, 5]
+
+
+def test_jro_forward_and_clamp():
+    # JRO 2 skips the next line; JRO 99 clamps to the last line
+    # (program.go:354, utils.IntClamp).
+    prog = "IN ACC\nJRO 2\nOUT 111\nOUT ACC\nJRO 99\nNOP"
+    # flow: IN, JRO 2 -> line 3 (OUT ACC), JRO 99 -> clamp to line 5 (NOP), wrap
+    assert run_collect({"n": prog}, [], [8, 9]) == [8, 9]
+
+
+def test_jro_negative_clamps_to_zero():
+    prog = "IN ACC\nOUT ACC\nJRO -99"
+    assert run_collect({"n": prog}, [], [1, 2]) == [1, 2]
+
+
+def test_jro_src_uses_acc():
+    # ACC=2 -> JRO ACC jumps 2 lines forward from the JRO line.
+    prog = "IN ACC\nJRO ACC\nOUT 111\nOUT 222\nJMP 0".replace("JMP 0", "JRO -99")
+    # inputs fixed at 2: JRO ACC from line1 -> line3 -> OUT 222
+    assert run_collect({"n": prog}, [], [2, 2]) == [222, 222]
+
+
+# --- multi-lane port rendezvous --------------------------------------------
+
+def test_two_lane_ping_pong():
+    # a sends in+1 to b, b adds 1, sends back; a outputs.
+    progs = {
+        "a": "IN ACC\nADD 1\nMOV ACC, b:R0\nMOV R0, ACC\nOUT ACC",
+        "b": "MOV R0, ACC\nADD 1\nMOV ACC, a:R0",
+    }
+    assert run_collect(progs, [], [5, 10]) == [7, 12]
+
+
+def test_port_read_blocks_until_send():
+    # b reads R1 before anyone sends: must stall, not read garbage.
+    progs = {
+        "a": "IN ACC\nNOP\nNOP\nNOP\nMOV ACC, b:R1",
+        "b": "MOV R1, ACC\nOUT ACC",
+    }
+    assert run_collect(progs, [], [33]) == [33]
+
+
+def test_cap1_port_backpressure():
+    # a tries to send twice before b consumes; the second send must park
+    # until b's read frees the buffer (Send handler blocking, program.go:160-175).
+    progs = {
+        "a": "IN ACC\nMOV ACC, b:R0\nMOV 100, b:R0\nIN NIL",
+        "b": "NOP\nNOP\nNOP\nNOP\nNOP\nMOV R0, ACC\nOUT ACC\nMOV R0, ACC\nOUT ACC",
+    }
+    # First output is the original value, second is 100 — order preserved.
+    assert run_collect(progs, [], [6, 0]) == [6, 100]
+
+
+def test_send_arbitration_lowest_lane_wins():
+    # Lanes a and b both send to c:R0 on the same tick; a (lower index) must
+    # win, b parks and delivers second.
+    progs = {
+        "a": "MOV 1, c:R0\nJRO 0",   # JRO 0 self-loop: park forever after send
+        "b": "MOV 2, c:R0\nJRO 0",
+        "c": "MOV R0, ACC\nOUT ACC\nMOV R0, ACC\nOUT ACC\nJRO 0",
+    }
+    net = build(progs, [])
+    state = net.init_state()
+    state = net.run(state, 64)
+    _, outs = net.drain(state)
+    assert outs == [1, 2]
+
+
+def test_port_forward_consume_then_send():
+    # `MOV R0, n:R0` with R0 full must complete: the reference CONSUMES the
+    # port (getFromSrc) before the send blocks, so the slot frees itself.
+    # An atomic src+dst commit would deadlock here (hold-latch regression).
+    prog = "IN ACC\nMOV ACC, n:R0\nMOV R0, n:R0\nMOV R0, ACC\nOUT ACC"
+    assert run_collect({"n": prog}, [], [64]) == [64]
+
+
+def test_mutual_port_swap_makes_progress():
+    # Both lanes' R0 full, both run `MOV R0, other:R0`: each consumes first,
+    # so both sends find free slots — values swap instead of deadlocking.
+    # (The Go reference makes progress here for the same reason: getFromSrc
+    # drains the channel before the send RPC blocks.)
+    progs = {
+        "a": "MOV R0, b:R0\nMOV R0, ACC\nOUT ACC\nJRO 0",
+        "b": "MOV R0, a:R0\nMOV R0, ACC\nOUT ACC\nJRO 0",
+    }
+    net = build(progs, [])
+    state = net.init_state()
+    state = state._replace(
+        port_full=state.port_full.at[:, 0].set(True),
+        port_val=state.port_val.at[0, 0].set(7).at[1, 0].set(8),
+    )
+    state = net.run(state, 32)
+    _, outs = net.drain(state)
+    assert outs == [8, 7]  # swapped; a (lane 0) wins the OUT arbiter first
+
+
+def test_parked_sender_port_refills_behind_latch():
+    # After a consumes R0 into its latch and parks on a full destination, a
+    # second value can land in a's R0 behind it (Go: channel refills while the
+    # handler blocks in the send RPC).
+    progs = {
+        # a forwards two values to b; b only consumes after a delay
+        "a": "MOV R0, b:R0\nMOV R0, b:R0\nJRO 0",
+        "b": "NOP\nNOP\nNOP\nNOP\nNOP\nNOP\nMOV R0, ACC\nOUT ACC\nMOV R0, ACC\nOUT ACC\nJRO 0",
+        "c": "MOV 1, a:R0\nMOV 2, a:R0\nJRO 0",
+    }
+    net = build(progs, [])
+    state = net.init_state()
+    state = net.run(state, 64)
+    _, outs = net.drain(state)
+    assert outs == [1, 2]
+
+
+def test_self_send():
+    # A lane may send to its own port (the reference would self-dial).
+    prog = "IN ACC\nMOV ACC, n:R2\nMOV R2, ACC\nOUT ACC"
+    assert run_collect({"n": prog}, [], [13]) == [13]
+
+
+# --- stacks -----------------------------------------------------------------
+
+def test_stack_push_pop_roundtrip():
+    progs = {"n": "IN ACC\nPUSH ACC, st\nMOV 0, ACC\nPOP st, ACC\nOUT ACC"}
+    assert run_collect(progs, ["st"], [17, -4]) == [17, -4]
+
+
+def test_stack_is_lifo():
+    progs = {
+        "n": (
+            "IN ACC\nPUSH ACC, st\n"
+            "IN ACC\nPUSH ACC, st\n"
+            "POP st, ACC\nOUT ACC\n"
+            "POP st, ACC\nOUT ACC"
+        )
+    }
+    assert run_collect(progs, ["st"], [1, 2, 3, 4]) == [2, 1, 4, 3]
+
+
+def test_pop_blocks_until_push():
+    # b pops before a pushes; must park (waitPop, stack.go:133-155).
+    progs = {
+        "a": "IN ACC\nNOP\nNOP\nNOP\nNOP\nPUSH ACC, st\nIN NIL",
+        "b": "POP st, ACC\nOUT ACC",
+    }
+    assert run_collect(progs, ["st"], [21]) == [21]
+
+
+def test_push_immediate_and_pop_nil():
+    progs = {"n": "IN NIL\nPUSH 55, st\nPOP st, NIL\nPUSH 66, st\nPOP st, ACC\nOUT ACC"}
+    assert run_collect(progs, ["st"], [0]) == [66]
+
+
+def test_two_stacks_independent():
+    progs = {
+        "n": (
+            "IN ACC\nPUSH ACC, s1\nIN ACC\nPUSH ACC, s2\n"
+            "POP s1, ACC\nOUT ACC\nPOP s2, ACC\nOUT ACC"
+        )
+    }
+    assert run_collect(progs, ["s1", "s2"], [10, 20]) == [10, 20]
+
+
+def test_stack_capacity_backpressure():
+    # cap-2 stack: third push parks until a pop frees a slot.
+    progs = {
+        "a": "PUSH 1, st\nPUSH 2, st\nPUSH 3, st\nJRO 0",
+        "b": (
+            "NOP\nNOP\nNOP\nNOP\nNOP\nNOP\nNOP\nNOP\n"
+            "POP st, ACC\nOUT ACC\nPOP st, ACC\nOUT ACC\nPOP st, ACC\nOUT ACC\nJRO 0"
+        ),
+    }
+    net = build(progs, ["st"], stack_cap=2)
+    state = net.init_state()
+    state = net.run(state, 128)
+    _, outs = net.drain(state)
+    # first pop frees a slot -> the parked PUSH 3 lands immediately, so LIFO
+    # order is 2, then 3, then 1
+    assert outs == [2, 3, 1]
+
+
+# --- the add-2 network (BASELINE config #1) ---------------------------------
+
+ADD2 = {
+    "misaka1": "IN ACC\nADD 1\nMOV ACC, misaka2:R0\nMOV R0, ACC\nOUT ACC\n",
+    "misaka2": "MOV R0, ACC\nADD 1\nPUSH ACC, misaka3\nPOP misaka3, ACC\nMOV ACC, misaka1:R0\n",
+}
+
+
+def test_add2_network_parity():
+    # The docker-compose example: every input comes back +2, in order
+    # (docker-compose.yml:35-59).
+    inputs = [0, 1, 5, -7, 2147483646]
+    # 2147483646 + 2 wraps to INT32_MIN: int32 end-to-end is our documented
+    # divergence from the reference's 64-bit Go locals (tis/lower.py).
+    assert run_collect(ADD2, ["misaka3"], inputs) == [2, 3, 7, -5, -2147483648]
+
+
+def test_add2_sequential_stream():
+    inputs = list(range(50))
+    assert run_collect(ADD2, ["misaka3"], inputs) == [v + 2 for v in inputs]
+
+
+# --- I/O rings ---------------------------------------------------------------
+
+def test_out_ring_backpressure():
+    # out_cap=2: producer parks after 2 un-drained outputs, no loss.
+    net = build({"n": "OUT 1\nADD 1\nOUT ACC\nJRO -99"}, [], out_cap=2)
+    state = net.init_state()
+    state = net.run(state, 64)
+    state, outs = net.drain(state)
+    assert len(outs) == 2
+    state = net.run(state, 64)
+    state, outs2 = net.drain(state)
+    assert len(outs2) == 2
+    assert outs + outs2 == [1, 1, 1, 2]
+
+
+def test_in_ring_order_preserved():
+    prog = "IN ACC\nOUT ACC"
+    inputs = list(range(30))
+    assert run_collect({"n": prog}, [], inputs) == inputs
+
+
+def test_retired_and_tick_metrics():
+    net = build({"n": "NOP"}, [])
+    state = net.init_state()
+    state = net.run(state, 10)
+    assert int(state.tick) == 10
+    assert int(state.retired[0]) == 10
+
+
+def test_parked_lane_does_not_retire():
+    # IN with no input parks forever.
+    net = build({"n": "IN ACC"}, [])
+    state = net.init_state()
+    state = net.run(state, 10)
+    assert int(state.retired[0]) == 0
+    assert int(state.pc[0]) == 0
+
+
+# --- batch axis --------------------------------------------------------------
+
+def test_batched_instances_are_independent():
+    net = build({"n": "IN ACC\nADD 1\nOUT ACC"}, [], batch=4)
+    state = net.init_state()
+    # feed different values to each instance via direct ring writes
+    import jax.numpy as jnp
+
+    vals = jnp.asarray([[10], [20], [30], [40]], dtype=jnp.int32)
+    in_buf = state.in_buf.at[:, 0].set(vals[:, 0])
+    state = state._replace(in_buf=in_buf, in_wr=state.in_wr + 1)
+    state = net.run(state, 16)
+    out = np.asarray(state.out_buf[:, 0])
+    np.testing.assert_array_equal(out, [11, 21, 31, 41])
+    np.testing.assert_array_equal(np.asarray(state.out_wr), [1, 1, 1, 1])
