@@ -1,0 +1,106 @@
+"""Benchmark harness: add-2 /compute throughput on the current JAX platform.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "inputs/sec", "vs_baseline": N}
+
+The metric is BASELINE.json's headline: values computed per second through
+the docker-compose "add-2" network with output parity against the Go
+interpreter.  The reference publishes no numbers (BASELINE.md); vs_baseline
+is measured against the driver's north-star target of 1e6 inputs/sec.
+
+Method: B independent network instances run in lockstep (vmap batch axis);
+each instance's input ring is preloaded with Q values, and we time jitted
+scan chunks until every instance has emitted all Q outputs.  Outputs are
+verified (v+2) before the number is reported — a fast-but-wrong kernel
+prints nothing.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR = 1_000_000.0  # BASELINE.json north_star target, inputs/sec
+
+
+def bench_add2(batch=8192, per_instance=128, chunk=512, max_chunks=200):
+    import jax
+    import jax.numpy as jnp
+
+    from misaka_tpu import networks
+
+    top = networks.add2(in_cap=per_instance, out_cap=per_instance, stack_cap=16)
+    net = top.compile(batch=batch)
+
+    rng = np.random.default_rng(0)
+    vals = rng.integers(-1000, 1000, size=(batch, per_instance)).astype(np.int32)
+
+    def fresh_state():
+        state = net.init_state()
+        return state._replace(
+            in_buf=jnp.asarray(vals),
+            in_wr=state.in_wr + np.int32(per_instance),
+        )
+
+    # Warm-up: compile the chunk runner (state is donated, so rebuild after).
+    s = net.run(fresh_state(), chunk)
+    jax.block_until_ready(s)
+
+    state = fresh_state()
+    total = batch * per_instance
+    t0 = time.perf_counter()
+    chunks = 0
+    while chunks < max_chunks:
+        state = net.run(state, chunk)
+        chunks += 1
+        done = int(np.asarray(state.out_wr).min())
+        if done >= per_instance:
+            break
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    out = np.asarray(state.out_buf)
+    if not (np.asarray(state.out_wr) == per_instance).all():
+        raise RuntimeError(
+            f"benchmark did not complete: min out_wr "
+            f"{int(np.asarray(state.out_wr).min())}/{per_instance}"
+        )
+    if not (out == vals + 2).all():
+        raise RuntimeError("output parity FAILED: results are not input+2")
+
+    ticks = int(np.asarray(state.tick)[0])
+    return {
+        "throughput": total / elapsed,
+        "elapsed_s": elapsed,
+        "ticks": ticks,
+        "values": total,
+        "ticks_per_value": ticks * batch / total,
+    }
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    r = bench_add2()
+    print(
+        f"# platform={platform} batch=8192 q=128 values={r['values']} "
+        f"elapsed={r['elapsed_s']:.3f}s ticks={r['ticks']} "
+        f"ticks/value={r['ticks_per_value']:.2f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "add2_compute_throughput",
+                "value": round(r["throughput"], 1),
+                "unit": "inputs/sec",
+                "vs_baseline": round(r["throughput"] / NORTH_STAR, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

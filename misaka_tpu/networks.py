@@ -1,0 +1,114 @@
+"""Predefined networks: the five BASELINE.json benchmark configurations.
+
+These are the rebuild's "model zoo" — each returns a runtime.Topology ready to
+compile.  Config #1 is the reference's own docker-compose deployment
+(docker-compose.yml:26-74); the rest are the driver-specified coverage
+configs (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from misaka_tpu.runtime.topology import Topology
+
+ADD2_PROGRAMS = {
+    # docker-compose.yml:35-40 / :54-59, verbatim (trailing newline included —
+    # YAML block scalars end with one, and it costs a NOP slot, parity).
+    "misaka1": "IN ACC\nADD 1\nMOV ACC, misaka2:R0\nMOV R0, ACC\nOUT ACC\n",
+    "misaka2": "MOV R0, ACC\nADD 1\nPUSH ACC, misaka3\nPOP misaka3, ACC\nMOV ACC, misaka1:R0\n",
+}
+
+
+def add2(**kw) -> Topology:
+    """Config #1: the compose 'add-2' network — 2 program nodes + 1 stack."""
+    return Topology(
+        node_info={"misaka1": "program", "misaka2": "program", "misaka3": "stack"},
+        programs=dict(ADD2_PROGRAMS),
+        **kw,
+    )
+
+
+def acc_loop(**kw) -> Topology:
+    """Config #2: single program node, ADD/SUB/NEG/SAV/SWP coverage.
+
+    Flow per value: acc=v+3, bak=v+3 (SAV), acc=-(v+3) (NEG), acc+=1,
+    SWP restores acc=v+3, OUT v+3, SUB ACC zeroes, wrap.  Output = v + 3.
+    """
+    program = "IN ACC\nADD 3\nSAV\nNEG\nADD 1\nSWP\nNOP\nOUT ACC\nSUB ACC\n"
+    return Topology(node_info={"solo": "program"}, programs={"solo": program}, **kw)
+
+
+def ring(n: int = 4, **kw) -> Topology:
+    """Config #3: n-node MOV ring — pure port-routing pipeline, no stack.
+
+    node0 ingests and adds 1, each hop adds 1, node0 emits after a full lap:
+    output = input + n.
+    """
+    if n < 2:
+        raise ValueError(f"ring needs at least 2 nodes, got {n}")
+    names = [f"ring{i}" for i in range(n)]
+    programs = {}
+    programs[names[0]] = (
+        f"IN ACC\nADD 1\nMOV ACC, {names[1]}:R0\nMOV R0, ACC\nOUT ACC\n"
+    )
+    for i in range(1, n):
+        nxt = names[(i + 1) % n]
+        programs[names[i]] = f"MOV R0, ACC\nADD 1\nMOV ACC, {nxt}:R0\n"
+    return Topology(
+        node_info={name: "program" for name in names}, programs=programs, **kw
+    )
+
+
+def sorter(**kw) -> Topology:
+    """Config #4: branch-heavy JEZ/JNZ/JGZ/JLZ/JRO classifier.
+
+    Emits sign(v)*10 + (|v| clamped to 1 if nonzero): -11 / 0 / 11 mapped as:
+    v>0 -> 11, v<0 -> -11, v==0 -> 0.  Exercises every conditional jump and a
+    computed JRO dispatch per value.
+    """
+    program = (
+        "IN ACC\n"
+        "JGZ pos\n"
+        "JLZ neg\n"
+        "JEZ zero\n"
+        "pos: MOV 11, ACC\n"
+        "JMP emit\n"
+        "neg: MOV -11, ACC\n"
+        "JMP emit\n"
+        "zero: MOV 0, ACC\n"
+        "JRO 1\n"
+        "emit: OUT ACC\n"
+    )
+    return Topology(node_info={"sorter": "program"}, programs={"sorter": program}, **kw)
+
+
+def mesh8(**kw) -> Topology:
+    """Config #5: 8 program nodes in a 2-wide/4-deep mesh + 2 stack nodes.
+
+    Two parallel 4-stage pipelines (a-lane and b-lane) sharing the input
+    stream; each stage adds 1; stage 2 round-trips its value through a stack
+    node.  Output per value: v + 4.  Exercises concurrent IN arbitration,
+    cross-lane sends, and two stacks under contention.
+    """
+    programs = {
+        "a0": "IN ACC\nADD 1\nMOV ACC, a1:R0\n",
+        "a1": "MOV R0, ACC\nADD 1\nPUSH ACC, sa\nPOP sa, ACC\nMOV ACC, a2:R1\n",
+        "a2": "MOV R1, ACC\nADD 1\nMOV ACC, a3:R2\n",
+        "a3": "MOV R2, ACC\nADD 1\nOUT ACC\n",
+        "b0": "IN ACC\nADD 1\nMOV ACC, b1:R0\n",
+        "b1": "MOV R0, ACC\nADD 1\nPUSH ACC, sb\nPOP sb, ACC\nMOV ACC, b2:R1\n",
+        "b2": "MOV R1, ACC\nADD 1\nMOV ACC, b3:R2\n",
+        "b3": "MOV R2, ACC\nADD 1\nOUT ACC\n",
+    }
+    node_info = {name: "program" for name in programs}
+    node_info["sa"] = "stack"
+    node_info["sb"] = "stack"
+    return Topology(node_info=node_info, programs=programs, **kw)
+
+
+BASELINE_CONFIGS = {
+    "add2": add2,
+    "acc_loop": acc_loop,
+    "ring4": lambda **kw: ring(4, **kw),
+    "sorter": sorter,
+    "mesh8": mesh8,
+}

@@ -1,0 +1,20 @@
+"""Multi-chip execution: mesh/shardings + collective-routed superstep."""
+
+from misaka_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    shard_state,
+    state_specs,
+)
+from misaka_tpu.parallel.sharded import make_sharded_runner, step_local
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "shard_state",
+    "state_specs",
+    "make_sharded_runner",
+    "step_local",
+]

@@ -1,0 +1,67 @@
+"""Device mesh + sharding specs for multi-chip execution.
+
+The reference's "distribution" is one Docker container per node wired by
+gRPC/TLS (SURVEY.md §1 L1).  The TPU build distributes over a
+jax.sharding.Mesh with two named axes:
+
+  data   — lockstep batch of independent network instances (pure DP; the
+           throughput axis; no cross-shard traffic at all)
+  model  — program-node lanes sharded across chips (the TP/PP analogue: the
+           lane graph IS the pipeline, so sharding lanes shards the pipeline
+           stages; inter-lane MOV traffic rides ICI collectives)
+
+Stacks and master I/O rings are replicated over `model` and kept consistent
+by having every shard apply the identical (collectively agreed) update.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from misaka_tpu.core.state import NetworkState
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_devices: int | None = None, model_parallel: int = 1) -> Mesh:
+    """A (data, model) mesh over the first n_devices."""
+    devices = jax.devices()[: n_devices or len(jax.devices())]
+    n = len(devices)
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def state_specs(batched: bool = True) -> NetworkState:
+    """PartitionSpec pytree for NetworkState (leading batch axis if batched).
+
+    Lane-major arrays shard over `model`; stacks/rings replicate over `model`
+    and shard over `data` with the batch.
+    """
+    d = (DATA_AXIS,) if batched else ()
+    lane = P(*d, MODEL_AXIS)
+    lane_port = P(*d, MODEL_AXIS, None)
+    repl1 = P(*d, None)
+    repl2 = P(*d, None, None)
+    scalar = P(*d)
+    return NetworkState(
+        acc=lane, bak=lane, pc=lane,
+        port_val=lane_port, port_full=lane_port,
+        hold_val=lane, holding=lane,
+        stack_mem=repl2, stack_top=repl1,
+        in_buf=repl1, in_rd=scalar, in_wr=scalar,
+        out_buf=repl1, out_rd=scalar, out_wr=scalar,
+        tick=scalar, retired=lane,
+    )
+
+
+def shard_state(state: NetworkState, mesh: Mesh, batched: bool = True) -> NetworkState:
+    """Place a state pytree onto the mesh with the canonical shardings."""
+    specs = state_specs(batched)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), state, specs
+    )

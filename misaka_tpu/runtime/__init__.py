@@ -1,0 +1,6 @@
+"""Host runtime: topology config, master HTTP control surface, entrypoint."""
+
+from misaka_tpu.runtime.topology import Topology, TopologyError
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+__all__ = ["Topology", "TopologyError", "MasterNode", "make_http_server"]

@@ -1,0 +1,72 @@
+"""Process entrypoint: env-var bootstrap compatible with the reference's cmd/app.go.
+
+The reference starts one process per node, dispatched on NODE_TYPE
+(cmd/app.go:12-40).  The TPU build fuses the whole network into one process,
+so the master's env surface is what survives:
+
+  NODE_INFO        {"name": {"type": "program"|"stack"}, ...}  (app.go:30-35)
+  MISAKA_PROGRAMS  {"name": "<TIS source>", ...}   per-program-node source —
+                   replaces the per-container PROGRAM env (app.go:20-25)
+  MISAKA_TOPOLOGY  path to a single declarative JSON file
+                   {"nodes": ..., "programs": ...} (alternative to the above)
+  MISAKA_PORT      HTTP port (default 8000 = clientPort, master.go:19)
+  MISAKA_AUTORUN   "1" to start running immediately (default: wait for /run)
+
+NODE_TYPE=program / NODE_TYPE=stack have no fused-mode meaning: those
+processes' entire job (interpret asm / hold a stack) lives inside the jitted
+kernel.  Setting them exits with an explanatory error rather than pretending.
+
+Run: python -m misaka_tpu.runtime.app
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.runtime.topology import Topology
+
+
+def build_topology_from_env(environ=os.environ) -> Topology:
+    path = environ.get("MISAKA_TOPOLOGY")
+    if path:
+        with open(path) as f:
+            return Topology.from_json(f.read())
+    node_info = environ.get("NODE_INFO")
+    if not node_info:
+        raise SystemExit(
+            "set NODE_INFO (reference JSON shape) or MISAKA_TOPOLOGY (file path)"
+        )
+    programs = json.loads(environ.get("MISAKA_PROGRAMS", "{}"))
+    return Topology.from_node_info_json(node_info, programs)
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    node_type = os.environ.get("NODE_TYPE", "master")
+    if node_type != "master":
+        raise SystemExit(
+            f"NODE_TYPE={node_type!r}: program/stack nodes are lanes of the "
+            "fused TPU kernel, not processes; run the master (NODE_TYPE=master)"
+        )
+    topology = build_topology_from_env()
+    master = MasterNode(topology)
+    if os.environ.get("MISAKA_AUTORUN") == "1":
+        master.run()
+    port = int(os.environ.get("MISAKA_PORT", "8000"))
+    server = make_http_server(master, port)
+    logging.getLogger("misaka_tpu.app").info("starting http server on :%d", port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        master.pause()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,289 @@
+"""Master node: the reference's HTTP control surface over the TPU engine.
+
+Route-for-route and message-for-message compatible with the Go master
+(master.go:90-230): POST /run /pause /reset /load /compute, form-encoded
+bodies, "Success" / JSON `{"value": N}` responses, 400 on errors, 405 with
+"method GET not allowed" on non-POST.  What changes is everything beneath:
+instead of broadcasting gRPC commands to node processes (master.go:269-351),
+control toggles a host flag around a jitted device loop; instead of cap-1
+channels bridged by per-value RPC (master.go:233-249), I/O moves through
+device-resident rings synced each chunk.
+
+Deliberate divergences (SURVEY.md quirks, each strictly better and test-pinned):
+  * /compute responses are correlated — a lock serializes request pairing,
+    fixing the reference's response-swap race (quirk #2, master.go:216-219).
+  * /load targets the node directly in-process — the reference dials the
+    wrong port and cannot actually live-load (quirk #1, master.go:178).
+  * pause preserves in-flight state exactly (the reference cancels blocked
+    ops with errors, program.go:196-204); resume continues where it stopped.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
+
+from misaka_tpu.runtime.topology import Topology, TopologyError
+from misaka_tpu.tis.parser import TISParseError
+from misaka_tpu.tis.lower import TISLowerError
+
+log = logging.getLogger("misaka_tpu.master")
+
+
+class ComputeTimeout(RuntimeError):
+    """The network produced no output for a /compute value in time."""
+
+
+class MasterNode:
+    """Control plane + I/O gateway for one fused network."""
+
+    def __init__(self, topology: Topology, chunk_steps: int = 128):
+        self._topology = topology
+        self._chunk = chunk_steps
+        self._net = topology.compile()
+        self._state = self._net.init_state()
+        self._running = False
+        self._loop: threading.Thread | None = None
+        self._state_lock = threading.Lock()      # guards _state/_net swaps
+        self._lifecycle_lock = threading.RLock() # serializes run/pause/reset/load
+        self._compute_lock = threading.Lock()    # serializes /compute pairing
+        self._in_q: queue.Queue[int] = queue.Queue()
+        self._out_q: queue.Queue[int] = queue.Queue()
+        # Outputs orphaned by /compute timeouts; discarded on arrival so the
+        # request/response pairing stays correlated (quirk #2 stays fixed).
+        self._stale_outputs = 0
+
+    # --- lifecycle (the broadcastCommand surface, master.go:269-351) -------
+
+    def run(self) -> None:
+        with self._lifecycle_lock:
+            if self._running:
+                log.info("network is already running")
+                return
+            self._running = True
+            self._loop = threading.Thread(target=self._device_loop, daemon=True)
+            self._loop.start()
+            log.info("network was run")
+
+    def pause(self) -> None:
+        with self._lifecycle_lock:
+            if not self._running:
+                log.info("network is already paused")
+                return
+            self._running = False
+            if self._loop:
+                self._loop.join()
+            log.info("network was paused")
+
+    def reset(self) -> None:
+        """Stop + zero all state and queues (stopNode/resetNode, master.go:252-266)."""
+        with self._lifecycle_lock:
+            self.pause()
+            with self._state_lock:
+                self._state = self._net.init_state()
+            self._drain_queues()
+            log.info("network was reset")
+
+    def load(self, target: str, program: str) -> None:
+        """Reprogram one node; resets the whole network (master.go:145-195).
+
+        Ordering parity: target validation happens BEFORE anything stops
+        (master.go:158-163 — a bad target leaves the network running), while a
+        program that fails to compile is discovered after the reset, leaving
+        the network stopped with its old programs (LoadProgram errors before
+        overwriting p.asm, program.go:178-193).
+        """
+        with self._lifecycle_lock:
+            new_topology = self._topology.with_program(target, program)  # validates target
+            self.pause()
+            try:
+                new_net = new_topology.compile()  # may raise parse/lower errors
+            except Exception:
+                with self._state_lock:
+                    self._state = self._net.init_state()
+                self._drain_queues()
+                raise
+            with self._state_lock:
+                self._topology = new_topology
+                self._net = new_net
+                self._state = new_net.init_state()
+            self._drain_queues()
+            log.info("successfully loaded program")
+
+    def compute(self, value: int, timeout: float = 30.0) -> int:
+        """One value in, one value out — correlated (fixes quirk #2).
+
+        On timeout the in-flight value's eventual output is recorded as stale
+        and discarded when it surfaces, so later calls stay correctly paired.
+        """
+        with self._compute_lock:
+            self._in_q.put(value)
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._stale_outputs += 1
+                    raise ComputeTimeout(f"no output for value {value} after {timeout}s")
+                try:
+                    out = self._out_q.get(timeout=remaining)
+                except queue.Empty:
+                    self._stale_outputs += 1
+                    raise ComputeTimeout(f"no output for value {value} after {timeout}s")
+                if self._stale_outputs:
+                    self._stale_outputs -= 1
+                    continue  # a previously timed-out request's output; drop it
+                return out
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    def snapshot(self):
+        """Whole-network state as one pytree — checkpointing for free.
+
+        Deep-copied: the device loop donates its state buffers into each
+        jitted chunk, which would invalidate a live reference.
+        """
+        import jax
+
+        with self._state_lock:
+            return jax.tree.map(lambda x: x.copy(), self._state)
+
+    def restore(self, state) -> None:
+        import jax
+
+        with self._state_lock:
+            self._state = jax.tree.map(lambda x: x.copy(), state)
+
+    # --- the device loop ----------------------------------------------------
+
+    def _drain_queues(self) -> None:
+        for q in (self._in_q, self._out_q):
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        self._stale_outputs = 0  # reset/load wipe the rings: nothing stale survives
+
+    def _device_loop(self) -> None:
+        """Run jitted chunks; sync rings with host queues at the boundaries."""
+        try:
+            self._device_loop_inner()
+        except Exception:
+            # A crashed loop must not strand /compute callers in a silent
+            # 30s timeout; stop cleanly and leave the log trail.
+            log.exception("device loop crashed; network stopped")
+            self._running = False
+
+    def _device_loop_inner(self) -> None:
+        while self._running:
+            busy = False
+            with self._state_lock:
+                state = self._state
+                pending = []
+                free = self._net.in_cap - int(state.in_wr - state.in_rd)
+                while len(pending) < free:
+                    try:
+                        pending.append(self._in_q.get_nowait())
+                    except queue.Empty:
+                        break
+                if pending:
+                    state, _ = self._net.feed(state, pending)
+                    busy = True
+                state = self._net.run(state, self._chunk)
+                state, outs = self._net.drain(state)
+                self._state = state
+            for v in outs:
+                self._out_q.put(v)
+            if outs:
+                busy = True
+            if not busy:
+                # Nothing moved: the network is parked on empty queues.  Idle
+                # gently instead of burning host CPU on no-op chunks.
+                time.sleep(0.001)
+
+
+def make_http_server(master: MasterNode, port: int = 8000) -> ThreadingHTTPServer:
+    """The five client routes (master.go:90-224), byte-compatible."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through logging, not stderr
+            log.debug(fmt, *args)
+
+        def _text(self, code: int, body: str) -> None:
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _form(self) -> dict[str, str]:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length).decode()
+            return {k: v[0] for k, v in parse_qs(raw, keep_blank_values=True).items()}
+
+        def do_GET(self):  # parity: "method GET not allowed" (master.go:104)
+            self._text(405, "method GET not allowed")
+
+        def do_POST(self):
+            try:
+                if self.path == "/run":
+                    master.run()
+                    self._text(200, "Success")
+                elif self.path == "/pause":
+                    master.pause()
+                    self._text(200, "Success")
+                elif self.path == "/reset":
+                    master.reset()
+                    self._text(200, "Success")
+                elif self.path == "/load":
+                    form = self._form()
+                    target = form.get("targetURI", "")
+                    try:
+                        master.load(target, form.get("program", ""))
+                    except (TopologyError, TISParseError, TISLowerError) as e:
+                        self._text(
+                            400, f"error loading program on node {target}: {e}"
+                        )
+                        return
+                    self._text(200, "Success")
+                elif self.path == "/compute":
+                    if not master.is_running:
+                        self._text(400, "network is not running")
+                        return
+                    form = self._form()
+                    try:
+                        value = int(form.get("value", ""))
+                    except ValueError:
+                        self._text(400, "cannot parse value")
+                        return
+                    try:
+                        result = master.compute(value)
+                    except ComputeTimeout as e:
+                        self._text(500, str(e))
+                        return
+                    data = (json.dumps({"value": result}) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    self._text(404, "not found")
+            except Exception as e:  # defensive: a handler crash must not kill the server
+                log.exception("handler error")
+                try:
+                    self._text(500, f"internal error: {e}")
+                except Exception:
+                    pass
+
+    return ThreadingHTTPServer(("0.0.0.0", port), Handler)

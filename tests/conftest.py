@@ -7,9 +7,14 @@ driver's dryrun does.  This must run before any module imports jax.
 
 import os
 
+# The axon sitecustomize may have initialized JAX backends at interpreter
+# start (it runs before conftest), which makes env-var routes (XLA_FLAGS /
+# JAX_PLATFORMS) unreliable here.  The config API works post-import as long
+# as no computation has run yet.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,42 @@
+"""The five BASELINE benchmark configs compute their specified functions."""
+
+import pytest
+
+from misaka_tpu import networks
+
+
+def stream(topology, inputs, **kw):
+    net = topology.compile()
+    state = net.init_state()
+    state, outs = net.compute_stream(state, inputs, **kw)
+    return outs
+
+
+def test_add2():
+    assert stream(networks.add2(), [0, 5, -3]) == [2, 7, -1]
+
+
+def test_acc_loop():
+    assert stream(networks.acc_loop(), [0, 10, -10]) == [3, 13, -7]
+
+
+def test_ring4():
+    assert stream(networks.ring(4), [0, 100]) == [4, 104]
+
+
+def test_ring8():
+    assert stream(networks.ring(8), [1]) == [9]
+
+
+def test_sorter():
+    assert stream(networks.sorter(), [5, -9, 0, 1, -1]) == [11, -11, 0, 11, -11]
+
+
+def test_mesh8_serialized():
+    assert stream(networks.mesh8(), [0, 6, 20]) == [4, 10, 24]
+
+
+@pytest.mark.parametrize("name", sorted(networks.BASELINE_CONFIGS))
+def test_all_configs_compile(name):
+    net = networks.BASELINE_CONFIGS[name]().compile()
+    assert net.num_lanes >= 1

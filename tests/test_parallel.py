@@ -1,0 +1,94 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh.
+
+The gold standard: the lane-sharded shard_map kernel (explicit all_gather/
+pmin/psum collectives over ICI) must produce BIT-IDENTICAL state to the
+single-chip kernel for any program, any mesh factorization.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from misaka_tpu import networks
+from misaka_tpu.parallel import make_mesh, make_sharded_runner, shard_state
+
+
+def assert_states_equal(a, b):
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)),
+            np.asarray(getattr(b, name)),
+            err_msg=f"state field '{name}' diverged",
+        )
+
+
+def run_both(topology, mp, dp, batch, steps, seed=0):
+    net = topology.compile(batch=batch)
+    rng = np.random.default_rng(seed)
+    vals = rng.integers(-100, 100, size=(batch, 4)).astype(np.int32)
+
+    def prep(state):
+        return state._replace(
+            in_buf=state.in_buf.at[:, :4].set(vals), in_wr=state.in_wr + 4
+        )
+
+    ref = net.run(prep(net.init_state()), steps)
+    mesh = make_mesh(mp * dp, model_parallel=mp)
+    runner = make_sharded_runner(net.code, net.prog_len, mesh, num_steps=steps)
+    sharded = runner(shard_state(prep(net.init_state()), mesh))
+    return ref, sharded
+
+
+def test_mesh8_dp2_mp4_bit_identical():
+    ref, sharded = run_both(networks.mesh8(in_cap=8, out_cap=8), mp=4, dp=2, batch=4, steps=60)
+    assert_states_equal(ref, sharded)
+    assert int(np.asarray(sharded.out_wr).sum()) > 0  # it actually computed
+
+
+def test_mesh8_mp8_pure_lane_parallel():
+    ref, sharded = run_both(networks.mesh8(in_cap=8, out_cap=8), mp=8, dp=1, batch=2, steps=60)
+    assert_states_equal(ref, sharded)
+
+
+def test_add2_mp2_bit_identical():
+    ref, sharded = run_both(networks.add2(in_cap=8, out_cap=8), mp=2, dp=4, batch=8, steps=80)
+    assert_states_equal(ref, sharded)
+    # every instance finished all 4 values: out_wr == 4 across the batch
+    np.testing.assert_array_equal(np.asarray(sharded.out_wr), 4)
+
+
+def test_ring8_mp4_bit_identical():
+    ref, sharded = run_both(networks.ring(8, in_cap=8, out_cap=8), mp=4, dp=2, batch=4, steps=100)
+    assert_states_equal(ref, sharded)
+
+
+def test_dp_only_sharding():
+    # Pure data parallelism: mp=1, the whole lane axis on every shard.
+    ref, sharded = run_both(networks.add2(in_cap=8, out_cap=8), mp=1, dp=8, batch=8, steps=60)
+    assert_states_equal(ref, sharded)
+
+
+def test_make_mesh_validates_divisibility():
+    with pytest.raises(ValueError, match="not divisible"):
+        make_mesh(8, model_parallel=3)
+
+
+def test_lane_count_must_divide_model_axis():
+    net = networks.add2().compile()  # 2 lanes
+    mesh = make_mesh(8, model_parallel=4)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_sharded_runner(net.code, net.prog_len, mesh, num_steps=4)
+
+
+def test_collectives_actually_cross_shards():
+    # Sanity: on mp=4, a value injected at lane a0 (shard 0) arrives at lane
+    # a3 (shard 3) — the routing genuinely crosses shard boundaries.
+    top = networks.mesh8(in_cap=8, out_cap=8)
+    net = top.compile(batch=1)
+    mesh = make_mesh(4, model_parallel=4)
+    runner = make_sharded_runner(net.code, net.prog_len, mesh, num_steps=40)
+    state = net.init_state()
+    state = state._replace(in_buf=state.in_buf.at[:, 0].set(50), in_wr=state.in_wr + 1)
+    out = runner(shard_state(state, mesh))
+    assert int(np.asarray(out.out_wr)[0]) == 1
+    assert int(np.asarray(out.out_buf)[0, 0]) == 54

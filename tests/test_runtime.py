@@ -1,0 +1,219 @@
+"""Master HTTP runtime tests: route parity with the reference's control surface.
+
+Drives a real ThreadingHTTPServer over a loopback socket with the same
+form-POST flow the reference README documents (README.md:50-80) against the
+add-2 compose network.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.runtime.topology import Topology, TopologyError
+
+from misaka_tpu.networks import ADD2_PROGRAMS, add2
+
+
+@pytest.fixture(scope="module")
+def server():
+    topology = add2()
+    master = MasterNode(topology, chunk_steps=32)
+    httpd = make_http_server(master, port=0)  # ephemeral port
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", master
+    master.pause()
+    httpd.shutdown()
+
+
+def post(base, path, data=None):
+    body = urllib.parse.urlencode(data or {}).encode()
+    req = urllib.request.Request(base + path, data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_compute_before_run_rejected(server):
+    base, _ = server
+    status, body = post(base, "/compute", {"value": "1"})
+    assert status == 400
+    assert body == "network is not running"
+
+
+def test_run_then_compute_parity(server):
+    base, _ = server
+    status, body = post(base, "/run")
+    assert (status, body) == (200, "Success")
+    for v in [0, 41, -7]:
+        status, body = post(base, "/compute", {"value": str(v)})
+        assert status == 200
+        assert json.loads(body) == {"value": v + 2}
+
+
+def test_get_method_not_allowed(server):
+    base, _ = server
+    status, body = get(base, "/run")
+    assert status == 405
+    assert body == "method GET not allowed"
+
+
+def test_compute_bad_value(server):
+    base, _ = server
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "twelve"})
+    assert (status, body) == (400, "cannot parse value")
+
+
+def test_pause_blocks_compute_and_resume_continues(server):
+    base, _ = server
+    post(base, "/run")
+    status, body = post(base, "/pause")
+    assert (status, body) == (200, "Success")
+    status, body = post(base, "/compute", {"value": "1"})
+    assert (status, body) == (400, "network is not running")
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "5"})
+    assert json.loads(body) == {"value": 7}
+
+
+def test_load_unknown_node_leaves_network_running(server):
+    # Target validation precedes the reset (master.go:158-163): a bad target
+    # must not stop a running network.
+    base, _ = server
+    post(base, "/run")
+    status, body = post(base, "/load", {"program": "NOP", "targetURI": "ghost"})
+    assert status == 400
+    assert "node ghost not valid on this network" in body
+    status, body = post(base, "/compute", {"value": "1"})
+    assert json.loads(body) == {"value": 3}
+
+
+def test_load_bad_program_stops_network_keeps_old_program(server):
+    # A parse failure is discovered after the reset: network left stopped,
+    # old program intact (LoadProgram errors before overwriting, program.go:185-191).
+    base, _ = server
+    post(base, "/run")
+    status, body = post(base, "/load", {"program": "FROB", "targetURI": "misaka1"})
+    assert status == 400
+    status, body = post(base, "/compute", {"value": "1"})
+    assert (status, body) == (400, "network is not running")
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "1"})
+    assert json.loads(body) == {"value": 3}
+
+
+def test_load_parse_error(server):
+    base, _ = server
+    status, body = post(base, "/load", {"program": "FROB 1", "targetURI": "misaka1"})
+    assert status == 400
+    assert "error loading program on node misaka1" in body
+    assert "not a valid instruction" in body
+
+
+def test_load_stack_node_rejected(server):
+    base, _ = server
+    status, body = post(base, "/load", {"program": "NOP", "targetURI": "misaka3"})
+    assert status == 400
+    assert "not a program node" in body
+
+
+def test_load_reprograms_network(server):
+    base, master = server
+    # Turn misaka1 into an add-10 passthrough that skips misaka2 entirely.
+    status, body = post(
+        base, "/load", {"program": "IN ACC\nADD 10\nOUT ACC", "targetURI": "misaka1"}
+    )
+    assert (status, body) == (200, "Success")
+    # /load resets and stops the network (master.go:166-175)
+    status, body = post(base, "/compute", {"value": "1"})
+    assert (status, body) == (400, "network is not running")
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "3"})
+    assert json.loads(body) == {"value": 13}
+    # restore the original program for other tests
+    post(base, "/load", {"program": ADD2_PROGRAMS["misaka1"], "targetURI": "misaka1"})
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "3"})
+    assert json.loads(body) == {"value": 5}
+
+
+def test_reset_zeroes_state(server):
+    base, master = server
+    post(base, "/run")
+    post(base, "/compute", {"value": "9"})
+    status, body = post(base, "/reset")
+    assert (status, body) == (200, "Success")
+    assert not master.is_running
+    state = master.snapshot()
+    import numpy as np
+
+    assert int(np.asarray(state.tick)) == 0
+    assert not bool(np.asarray(state.port_full).any())
+
+
+def test_snapshot_restore_roundtrip(server):
+    base, master = server
+    post(base, "/run")
+    post(base, "/compute", {"value": "1"})
+    post(base, "/pause")
+    snap = master.snapshot()
+    post(base, "/run")
+    post(base, "/compute", {"value": "2"})
+    post(base, "/pause")
+    master.restore(snap)
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "10"})
+    assert json.loads(body) == {"value": 12}
+
+
+def test_compute_timeout_keeps_pairing():
+    # A timed-out /compute's eventual output must be discarded, not handed to
+    # the next caller (the correlation guarantee that fixes quirk #2).
+    from misaka_tpu.runtime.master import ComputeTimeout
+
+    top = Topology(node_info={"n": "program"}, programs={"n": "IN ACC\nOUT ACC"})
+    master = MasterNode(top, chunk_steps=16)
+    master.run()
+    master.pause()  # network stalled: inputs accepted, nothing computes
+    with pytest.raises(ComputeTimeout):
+        master.compute(1, timeout=0.3)
+    master.run()   # the orphaned value 1 now computes; its output is stale
+    assert master.compute(5, timeout=30) == 5  # not 1
+    master.pause()
+
+
+def test_topology_validation():
+    with pytest.raises(TopologyError, match="invalid node type"):
+        Topology(node_info={"x": "quantum"})
+    with pytest.raises(TopologyError, match="no program nodes"):
+        Topology(node_info={"s": "stack"}).compile()
+    with pytest.raises(TopologyError, match="non-program nodes"):
+        Topology(node_info={"s": "stack"}, programs={"s": "NOP"})
+
+
+def test_node_info_json_roundtrip():
+    # The exact NODE_INFO blob from docker-compose.yml:16-21.
+    blob = '{"misaka1": {"type": "program"}, "misaka2": {"type": "program"}, "misaka3": {"type": "stack"}}'
+    t = Topology.from_node_info_json(blob, ADD2_PROGRAMS)
+    assert t.lane_ids() == {"misaka1": 0, "misaka2": 1}
+    assert t.stack_ids() == {"misaka3": 0}
+    net = t.compile()
+    state = net.init_state()
+    state, outs = net.compute_stream(state, [5])
+    assert outs == [7]
