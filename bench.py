@@ -24,7 +24,13 @@ import numpy as np
 NORTH_STAR = 1_000_000.0  # BASELINE.json north_star target, inputs/sec
 
 
-def bench_add2(batch=8192, per_instance=128, chunk=512, max_chunks=200):
+def bench_add2(batch=32768, per_instance=128, ticks=1792, block_batch=2048):
+    """Fused-kernel benchmark: one launch drains Q values per instance.
+
+    The add-2 pipeline retires one value per ~12 ticks per instance, so
+    `ticks` is sized to drain `per_instance` values with slack; completion
+    and parity are asserted, so an undersized/incorrect run fails loudly.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -43,39 +49,39 @@ def bench_add2(batch=8192, per_instance=128, chunk=512, max_chunks=200):
             in_wr=state.in_wr + np.int32(per_instance),
         )
 
-    # Warm-up: compile the chunk runner (state is donated, so rebuild after).
-    s = net.run(fresh_state(), chunk)
-    jax.block_until_ready(s)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        runner = net.fused_runner(ticks, block_batch=block_batch)
+    else:
+        runner = lambda s: net.run(s, ticks)
+
+    # Warm-up compile; sync via a real transfer (block_until_ready does not
+    # wait under the axon relay).
+    s = runner(fresh_state())
+    _ = int(np.asarray(s.tick)[0])
 
     state = fresh_state()
+    _ = int(np.asarray(state.tick)[0])
     total = batch * per_instance
     t0 = time.perf_counter()
-    chunks = 0
-    while chunks < max_chunks:
-        state = net.run(state, chunk)
-        chunks += 1
-        done = int(np.asarray(state.out_wr).min())
-        if done >= per_instance:
-            break
-    jax.block_until_ready(state)
+    state = runner(state)
+    done = int(np.asarray(state.out_wr).min())  # sync point
     elapsed = time.perf_counter() - t0
 
     out = np.asarray(state.out_buf)
-    if not (np.asarray(state.out_wr) == per_instance).all():
-        raise RuntimeError(
-            f"benchmark did not complete: min out_wr "
-            f"{int(np.asarray(state.out_wr).min())}/{per_instance}"
-        )
+    if done < per_instance or not (np.asarray(state.out_wr) == per_instance).all():
+        raise RuntimeError(f"benchmark did not complete: min out_wr {done}/{per_instance}")
     if not (out == vals + 2).all():
         raise RuntimeError("output parity FAILED: results are not input+2")
 
-    ticks = int(np.asarray(state.tick)[0])
     return {
         "throughput": total / elapsed,
         "elapsed_s": elapsed,
-        "ticks": ticks,
+        "ticks": int(np.asarray(state.tick)[0]),
         "values": total,
         "ticks_per_value": ticks * batch / total,
+        "batch": batch,
+        "per_instance": per_instance,
     }
 
 
@@ -85,8 +91,8 @@ def main():
     platform = jax.devices()[0].platform
     r = bench_add2()
     print(
-        f"# platform={platform} batch=8192 q=128 values={r['values']} "
-        f"elapsed={r['elapsed_s']:.3f}s ticks={r['ticks']} "
+        f"# platform={platform} batch={r['batch']} q={r['per_instance']} "
+        f"values={r['values']} elapsed={r['elapsed_s']:.3f}s ticks={r['ticks']} "
         f"ticks/value={r['ticks_per_value']:.2f}",
         file=sys.stderr,
     )

@@ -109,6 +109,33 @@ class CompiledNetwork:
         runner = _run_chunk if self.batch is None else _run_chunk_batched
         return runner(self._tables, state, num_steps)
 
+    def fused_runner(
+        self,
+        num_steps: int,
+        block_batch: int | None = None,
+        interpret: bool = False,
+    ):
+        """The Pallas fast path: fn(state) -> state, `num_steps` ticks in ONE
+        kernel launch with all state VMEM-resident (batched networks only).
+        ~36x faster per tick than `run` on TPU at B=8192; bit-identical
+        semantics (tests/test_fused.py)."""
+        if self.batch is None:
+            raise ValueError("fused_runner requires a batched network")
+        from misaka_tpu.core.fused import make_fused_runner
+
+        return make_fused_runner(
+            self.code,
+            self.prog_len,
+            num_stacks=self.num_stacks,
+            stack_cap=self.stack_cap,
+            in_cap=self.in_cap,
+            out_cap=self.out_cap,
+            batch=self.batch,
+            num_steps=num_steps,
+            block_batch=block_batch,
+            interpret=interpret,
+        )
+
     # --- host-side I/O (chunk-boundary only) -------------------------------
 
     def feed(self, state: NetworkState, values) -> tuple[NetworkState, int]:

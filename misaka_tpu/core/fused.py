@@ -1,0 +1,465 @@
+"""Fused-network Pallas kernel: the whole network, VMEM-resident, one launch.
+
+The XLA-scan engine (core/engine.py) pays ~30 kernel launches and HBM
+round-trips of the full state per superstep.  This module instead *compiles
+each network into its own TPU kernel*: the lowered program tables are static
+Python data at build time, so every program line emits only the handful of
+masked vector ops its semantics need — a specialized dataflow machine, not an
+interpreter.  All state stays resident in VMEM for the entire chunk of
+`num_steps` ticks (one `pallas_call`), with a grid over batch blocks.
+
+Layout: batch-last.  Every per-instance quantity is a row of shape
+[B/128, 128] (VPU-tile aligned); lanes/ports/stack slots/ring slots are
+leading row indices.  The wrapper transposes the public batched NetworkState
+([B, ...]-major) in and out around the kernel — O(state) once per chunk,
+amortized over hundreds of ticks.
+
+Semantics are bit-identical to core/step.py (same pass order: consume ->
+send-arbitrate -> stack/IN/OUT elect -> commit; same lowest-lane priority,
+realized as static priority chains).  tests/test_fused.py proves it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.tis import isa
+
+LANE = 128  # VPU lane width; batch blocks are multiples of this
+
+_I32 = jnp.int32
+
+
+@dataclass(frozen=True)
+class _Instr:
+    op: int
+    src: int
+    imm: int
+    dst: int
+    tgt: int
+    port: int
+    jmp: int
+
+    @property
+    def reads_port(self) -> bool:
+        return self.op in isa.READS_SRC and self.src >= isa.SRC_R0
+
+    @property
+    def port_idx(self) -> int:
+        return self.src - isa.SRC_R0
+
+
+def _decode(code_np: np.ndarray, prog_len_np: np.ndarray) -> list[list[_Instr]]:
+    return [
+        [_Instr(*map(int, code_np[n, l])) for l in range(int(prog_len_np[n]))]
+        for n in range(code_np.shape[0])
+    ]
+
+
+def make_fused_runner(
+    code_np: np.ndarray,
+    prog_len_np: np.ndarray,
+    *,
+    num_stacks: int,
+    stack_cap: int,
+    in_cap: int,
+    out_cap: int,
+    batch: int,
+    num_steps: int,
+    block_batch: int | None = None,
+    interpret: bool = False,
+):
+    """Build `fn(state) -> state` advancing `num_steps` ticks in one kernel.
+
+    Operates on the standard batched NetworkState.  `block_batch` (multiple of
+    128, divides batch) bounds VMEM residency per grid block.
+    """
+    n_lanes = code_np.shape[0]
+    n_dests = n_lanes * isa.NUM_PORTS
+    n_stacks = max(1, num_stacks)
+    progs = _decode(code_np, prog_len_np)
+
+    if block_batch is None:
+        block_batch = min(batch, 1024)
+    if batch % block_batch or block_batch % LANE:
+        raise ValueError(
+            f"batch {batch} must be a multiple of block_batch {block_batch}, "
+            f"itself a multiple of {LANE}"
+        )
+    # The kernel unrolls select chains over every stack slot and ring slot and
+    # keeps one VMEM row per slot; engine-default caps (1024) would blow both
+    # the unroll and VMEM.  Fail loudly with the budget arithmetic.
+    total_rows = (
+        6 * n_lanes + 2 * n_dests + n_stacks * stack_cap + n_stacks
+        + in_cap + out_cap + 5
+    )
+    vmem_bytes = total_rows * block_batch * 4
+    if total_rows > 2048 or vmem_bytes > 8 * 1024 * 1024:
+        raise ValueError(
+            f"fused kernel budget exceeded: {total_rows} VMEM rows "
+            f"({vmem_bytes / 1e6:.1f} MB at block_batch={block_batch}) — "
+            "reduce stack_cap/in_cap/out_cap (compile the Topology with e.g. "
+            "stack_cap=16, in_cap=128, out_cap=128) or shrink block_batch"
+        )
+    bsr = block_batch // LANE  # sublane-rows per block
+    n_blocks = batch // block_batch
+
+    # Static routing tables: which (lane, line) contend for each resource.
+    sends_by_dest: dict[int, list[tuple[int, int]]] = {}
+    stack_ops: dict[int, list[tuple[int, int, bool]]] = {}  # (lane, line, is_push)
+    in_entries: list[tuple[int, int]] = []
+    out_entries: list[tuple[int, int]] = []
+    for n, prog in enumerate(progs):
+        for l, ins in enumerate(prog):
+            if ins.op == isa.OP_MOV_NET:
+                d = ins.tgt * isa.NUM_PORTS + ins.port
+                sends_by_dest.setdefault(d, []).append((n, l))
+            elif ins.op == isa.OP_PUSH:
+                stack_ops.setdefault(ins.tgt, []).append((n, l, True))
+            elif ins.op == isa.OP_POP:
+                stack_ops.setdefault(ins.tgt, []).append((n, l, False))
+            elif ins.op == isa.OP_IN:
+                in_entries.append((n, l))
+            elif ins.op == isa.OP_OUT:
+                out_entries.append((n, l))
+    # Priority = lowest lane index (core/step.py discipline); line order within
+    # a lane is irrelevant (at most one line active per lane per tick).
+    for entries in sends_by_dest.values():
+        entries.sort()
+    for entries in stack_ops.values():
+        entries.sort()
+    in_entries.sort()
+    out_entries.sort()
+
+    def tick_body(carry, inb):
+        (acc, bak, pc, pv, pf, hv, ho, sm, st, ob, sc, ret) = carry
+        in_rd, in_wr, out_rd, out_wr, tick = sc
+        i32 = lambda b: b.astype(_I32)
+
+        act = [
+            [pc[n] == l for l in range(len(progs[n]))] for n in range(n_lanes)
+        ]
+        ho_b = [ho[n] != 0 for n in range(n_lanes)]
+        pf_b = [pf[d] != 0 for d in range(n_dests)]
+
+        # --- pass 1: consume ready port sources into hold latches ----------
+        new_hv = list(hv)
+        new_ho = list(ho_b)
+        new_pf = list(pf_b)
+        for n, prog in enumerate(progs):
+            for l, ins in enumerate(prog):
+                if ins.reads_port:
+                    row = n * isa.NUM_PORTS + ins.port_idx
+                    consume = act[n][l] & ~new_ho[n] & new_pf[row]
+                    new_hv[n] = jnp.where(consume, pv[row], new_hv[n])
+                    new_ho[n] = new_ho[n] | consume
+                    new_pf[row] = new_pf[row] & ~consume
+
+        # --- pass 2: source resolution -------------------------------------
+        true_mask = pc[0] == pc[0]  # all-True [bsr, LANE]
+        src_ok: list = []
+        src_val: list = []
+        for n, prog in enumerate(progs):
+            ok = true_mask
+            val = jnp.zeros_like(acc[n])
+            for l, ins in enumerate(prog):
+                if ins.op not in isa.READS_SRC:
+                    continue
+                a = act[n][l]
+                if ins.src == isa.SRC_IMM:
+                    v = jnp.int32(ins.imm)
+                elif ins.src == isa.SRC_ACC:
+                    v = acc[n]
+                elif ins.src == isa.SRC_NIL:
+                    v = jnp.int32(0)
+                else:
+                    v = new_hv[n]
+                    ok = ok & (~a | new_ho[n])
+                val = jnp.where(a, v, val)
+            src_ok.append(ok)
+            src_val.append(val)
+
+        # --- pass 3a: network sends (static priority chain per dest) -------
+        send_ok: dict[tuple[int, int], jnp.ndarray] = {}
+        new_pv = list(pv)
+        for d, entries in sends_by_dest.items():
+            avail = ~new_pf[d]
+            delivered = jnp.zeros_like(avail)
+            val_d = new_pv[d]
+            for (n, l) in entries:
+                want = act[n][l] & src_ok[n]
+                win = want & avail
+                avail = avail & ~win
+                delivered = delivered | win
+                send_ok[(n, l)] = win
+                val_d = jnp.where(win, src_val[n], val_d)
+            new_pf[d] = new_pf[d] | delivered
+            new_pv[d] = val_d
+
+        # --- pass 3b: stacks (one op per stack per tick) --------------------
+        stack_ok: dict[tuple[int, int], jnp.ndarray] = {}
+        pop_val: dict[int, jnp.ndarray] = {}
+        new_sm = list(sm)
+        new_st = list(st)
+        for s, entries in stack_ops.items():
+            can_push = st[s] < stack_cap
+            can_pop = st[s] > 0
+            pv_s = jnp.zeros_like(st[s])
+            for c in range(stack_cap):
+                pv_s = jnp.where(st[s] - 1 == c, sm[s * stack_cap + c], pv_s)
+            pop_val[s] = pv_s
+            granted = jnp.zeros_like(can_push)
+            push_m = jnp.zeros_like(can_push)
+            pop_m = jnp.zeros_like(can_push)
+            push_v = jnp.zeros_like(st[s])
+            for (n, l, is_push) in entries:
+                if is_push:
+                    okm = act[n][l] & src_ok[n] & can_push & ~granted
+                    push_m = push_m | okm
+                    push_v = jnp.where(okm, src_val[n], push_v)
+                else:
+                    okm = act[n][l] & can_pop & ~granted
+                    pop_m = pop_m | okm
+                granted = granted | okm
+                stack_ok[(n, l)] = okm
+            for c in range(stack_cap):
+                slot = s * stack_cap + c
+                new_sm[slot] = jnp.where(
+                    push_m & (st[s] == c), push_v, new_sm[slot]
+                )
+            new_st[s] = st[s] + i32(push_m) - i32(pop_m)
+
+        # --- pass 3c: master input (single grant per tick) ------------------
+        in_ok: dict[tuple[int, int], jnp.ndarray] = {}
+        in_any = jnp.zeros_like(true_mask)
+        if in_entries:
+            in_avail = (in_wr - in_rd) > 0
+            for (n, l) in in_entries:
+                okm = act[n][l] & in_avail & ~in_any
+                in_any = in_any | okm
+                in_ok[(n, l)] = okm
+        rd_mod = jax.lax.rem(in_rd, jnp.int32(in_cap))
+        in_val = jnp.zeros_like(in_rd)
+        if in_entries:
+            for q in range(in_cap):
+                in_val = jnp.where(rd_mod == q, inb[q], in_val)
+        new_in_rd = in_rd + i32(in_any)
+
+        # --- pass 3d: master output (single grant per tick) -----------------
+        out_ok: dict[tuple[int, int], jnp.ndarray] = {}
+        out_any = jnp.zeros_like(true_mask)
+        out_val = jnp.zeros_like(out_rd)
+        new_ob = list(ob)
+        if out_entries:
+            out_free = (out_wr - out_rd) < out_cap
+            for (n, l) in out_entries:
+                okm = act[n][l] & src_ok[n] & out_free & ~out_any
+                out_any = out_any | okm
+                out_val = jnp.where(okm, src_val[n], out_val)
+                out_ok[(n, l)] = okm
+            wr_mod = jax.lax.rem(out_wr, jnp.int32(out_cap))
+            for q in range(out_cap):
+                new_ob[q] = jnp.where(out_any & (wr_mod == q), out_val, ob[q])
+        new_out_wr = out_wr + i32(out_any)
+
+        # --- pass 4: commit + register/pc effects ---------------------------
+        new_acc = list(acc)
+        new_bak = list(bak)
+        new_pc = list(pc)
+        new_ret = list(ret)
+        for n, prog in enumerate(progs):
+            ln = len(prog)
+            commit_n = jnp.zeros_like(true_mask)
+            for l, ins in enumerate(prog):
+                op = ins.op
+                if op == isa.OP_MOV_NET:
+                    c = send_ok[(n, l)]
+                elif op in (isa.OP_PUSH, isa.OP_POP):
+                    c = stack_ok[(n, l)]
+                elif op == isa.OP_IN:
+                    c = in_ok[(n, l)]
+                elif op == isa.OP_OUT:
+                    c = out_ok[(n, l)]
+                else:
+                    c = act[n][l] & src_ok[n]
+                commit_n = commit_n | c
+
+                # register effects (reading begin-of-tick acc/bak)
+                if op == isa.OP_MOV_LOCAL and ins.dst == isa.DST_ACC:
+                    new_acc[n] = jnp.where(c, src_val[n], new_acc[n])
+                elif op == isa.OP_ADD:
+                    new_acc[n] = jnp.where(c, acc[n] + src_val[n], new_acc[n])
+                elif op == isa.OP_SUB:
+                    new_acc[n] = jnp.where(c, acc[n] - src_val[n], new_acc[n])
+                elif op == isa.OP_NEG:
+                    new_acc[n] = jnp.where(c, -acc[n], new_acc[n])
+                elif op == isa.OP_SWP:
+                    new_acc[n] = jnp.where(c, bak[n], new_acc[n])
+                    new_bak[n] = jnp.where(c, acc[n], new_bak[n])
+                elif op == isa.OP_SAV:
+                    new_bak[n] = jnp.where(c, acc[n], new_bak[n])
+                elif op == isa.OP_POP and ins.dst == isa.DST_ACC:
+                    new_acc[n] = jnp.where(c, pop_val[ins.tgt], new_acc[n])
+                elif op == isa.OP_IN and ins.dst == isa.DST_ACC:
+                    new_acc[n] = jnp.where(c, in_val, new_acc[n])
+
+                # pc effect
+                nxt = jnp.int32((l + 1) % ln)
+                if op == isa.OP_JMP:
+                    target = jnp.int32(ins.jmp)
+                elif op == isa.OP_JEZ:
+                    target = jnp.where(acc[n] == 0, jnp.int32(ins.jmp), nxt)
+                elif op == isa.OP_JNZ:
+                    target = jnp.where(acc[n] != 0, jnp.int32(ins.jmp), nxt)
+                elif op == isa.OP_JGZ:
+                    target = jnp.where(acc[n] > 0, jnp.int32(ins.jmp), nxt)
+                elif op == isa.OP_JLZ:
+                    target = jnp.where(acc[n] < 0, jnp.int32(ins.jmp), nxt)
+                elif op == isa.OP_JRO:
+                    target = jnp.clip(l + src_val[n], 0, ln - 1)
+                else:
+                    target = nxt
+                new_pc[n] = jnp.where(c, target, new_pc[n])
+
+            new_ho[n] = new_ho[n] & ~commit_n
+            new_ret[n] = ret[n] + i32(commit_n)
+
+        new_sc = (new_in_rd, in_wr, out_rd, new_out_wr, tick + 1)
+        return (
+            new_acc,
+            new_bak,
+            new_pc,
+            new_pv,
+            [i32(x) for x in new_pf],
+            new_hv,
+            [i32(x) for x in new_ho],
+            new_sm,
+            new_st,
+            new_ob,
+            new_sc,
+            new_ret,
+        )
+
+    def kernel(*refs):
+        (acc_r, bak_r, pc_r, pv_r, pf_r, hv_r, ho_r, sm_r, st_r, ob_r, sc_r,
+         ret_r, inb_r) = refs[:13]
+        outs = refs[13:]
+
+        rows = lambda ref, k: [ref[i] for i in range(k)]
+        carry = (
+            rows(acc_r, n_lanes),
+            rows(bak_r, n_lanes),
+            rows(pc_r, n_lanes),
+            rows(pv_r, n_dests),
+            rows(pf_r, n_dests),
+            rows(hv_r, n_lanes),
+            rows(ho_r, n_lanes),
+            rows(sm_r, n_stacks * stack_cap),
+            rows(st_r, n_stacks),
+            rows(ob_r, out_cap),
+            tuple(rows(sc_r, 5)),
+            rows(ret_r, n_lanes),
+        )
+        inb = rows(inb_r, in_cap)
+
+        carry = jax.lax.fori_loop(
+            0, num_steps, lambda t, c: tick_body(c, inb), carry
+        )
+
+        for out_ref, vals in zip(outs, carry):
+            for i, v in enumerate(vals):
+                out_ref[i] = v
+
+    # --- pallas_call plumbing ----------------------------------------------
+
+    def spec(rows_count):
+        return pl.BlockSpec(
+            (rows_count, bsr, LANE),
+            lambda i: (0, i, 0),
+            memory_space=pltpu.VMEM,
+        )
+
+    row_counts = [
+        n_lanes, n_lanes, n_lanes, n_dests, n_dests, n_lanes, n_lanes,
+        n_stacks * stack_cap, n_stacks, out_cap, 5, n_lanes,
+    ]
+    in_specs = [spec(k) for k in row_counts] + [spec(in_cap)]
+    out_specs = [spec(k) for k in row_counts]
+    out_shapes = [
+        jax.ShapeDtypeStruct((k, batch // LANE, LANE), np.int32)
+        for k in row_counts
+    ]
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        input_output_aliases={i: i for i in range(12)},
+        interpret=interpret,
+    )
+
+    # --- layout transforms ---------------------------------------------------
+
+    def to_rows(x, rows_count):
+        """[B, ...rest] -> [rows, B//LANE, LANE] (rest flattened to rows)."""
+        flat = x.reshape(batch, rows_count)
+        return jnp.transpose(flat, (1, 0)).reshape(rows_count, batch // LANE, LANE)
+
+    def from_rows(y, rows_count, shape, dtype):
+        flat = jnp.transpose(y.reshape(rows_count, batch), (1, 0))
+        return flat.reshape(shape).astype(dtype)
+
+    @jax.jit
+    def run(state: NetworkState) -> NetworkState:
+        sc = jnp.stack(
+            [state.in_rd, state.in_wr, state.out_rd, state.out_wr, state.tick],
+            axis=1,
+        )  # [B, 5]
+        args = [
+            to_rows(state.acc, n_lanes),
+            to_rows(state.bak, n_lanes),
+            to_rows(state.pc, n_lanes),
+            to_rows(state.port_val, n_dests),
+            to_rows(state.port_full.astype(_I32), n_dests),
+            to_rows(state.hold_val, n_lanes),
+            to_rows(state.holding.astype(_I32), n_lanes),
+            to_rows(state.stack_mem, n_stacks * stack_cap),
+            to_rows(state.stack_top, n_stacks),
+            to_rows(state.out_buf, out_cap),
+            to_rows(sc, 5),
+            to_rows(state.retired, n_lanes),
+            to_rows(state.in_buf, in_cap),
+        ]
+        (acc, bak, pc, pv, pf, hv, ho, sm, st, ob, sc_o, ret) = call(*args)
+        b = batch
+        sc_flat = from_rows(sc_o, 5, (b, 5), _I32)
+        return NetworkState(
+            acc=from_rows(acc, n_lanes, (b, n_lanes), _I32),
+            bak=from_rows(bak, n_lanes, (b, n_lanes), _I32),
+            pc=from_rows(pc, n_lanes, (b, n_lanes), _I32),
+            port_val=from_rows(pv, n_dests, (b, n_lanes, isa.NUM_PORTS), _I32),
+            port_full=from_rows(pf, n_dests, (b, n_lanes, isa.NUM_PORTS), _I32).astype(bool),
+            hold_val=from_rows(hv, n_lanes, (b, n_lanes), _I32),
+            holding=from_rows(ho, n_lanes, (b, n_lanes), _I32).astype(bool),
+            stack_mem=from_rows(sm, n_stacks * stack_cap, (b, n_stacks, stack_cap), _I32),
+            stack_top=from_rows(st, n_stacks, (b, n_stacks), _I32),
+            in_buf=state.in_buf,
+            in_rd=sc_flat[:, 0],
+            in_wr=state.in_wr,
+            out_buf=from_rows(ob, out_cap, (b, out_cap), _I32),
+            out_rd=state.out_rd,
+            out_wr=sc_flat[:, 3],
+            tick=sc_flat[:, 4],
+            retired=from_rows(ret, n_lanes, (b, n_lanes), _I32),
+        )
+
+    return run
