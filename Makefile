@@ -1,0 +1,22 @@
+# Build targets for misaka_tpu (cf. the reference's Makefile: build/grpc/cert).
+# The TPU build has no codegen or TLS certs; native/ holds the C++ runtime
+# components.
+
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC
+
+native: native/libmisaka_assembler.so
+
+native/libmisaka_assembler.so: native/assembler.cpp
+	$(CXX) $(CXXFLAGS) $< -o $@
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+clean:
+	rm -f native/*.so
+
+.PHONY: native test bench clean

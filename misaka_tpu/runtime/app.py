@@ -11,6 +11,8 @@ so the master's env surface is what survives:
                    {"nodes": ..., "programs": ...} (alternative to the above)
   MISAKA_PORT      HTTP port (default 8000 = clientPort, master.go:19)
   MISAKA_AUTORUN   "1" to start running immediately (default: wait for /run)
+  MISAKA_CHECKPOINT_DIR  enable HTTP /checkpoint & /restore, storing named
+                   .npz snapshots in this directory (disabled when unset)
 
 NODE_TYPE=program / NODE_TYPE=stack have no fused-mode meaning: those
 processes' entire job (interpret asm / hold a stack) lives inside the jitted
@@ -59,7 +61,9 @@ def main() -> None:
     if os.environ.get("MISAKA_AUTORUN") == "1":
         master.run()
     port = int(os.environ.get("MISAKA_PORT", "8000"))
-    server = make_http_server(master, port)
+    server = make_http_server(
+        master, port, checkpoint_dir=os.environ.get("MISAKA_CHECKPOINT_DIR")
+    )
     logging.getLogger("misaka_tpu.app").info("starting http server on :%d", port)
     try:
         server.serve_forever()

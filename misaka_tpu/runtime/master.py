@@ -28,6 +28,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
+import numpy as np
+
 from misaka_tpu.runtime.topology import Topology, TopologyError
 from misaka_tpu.tis.parser import TISParseError
 from misaka_tpu.tis.lower import TISLowerError
@@ -57,6 +59,12 @@ class MasterNode:
         # Outputs orphaned by /compute timeouts; discarded on arrival so the
         # request/response pairing stays correlated (quirk #2 stays fixed).
         self._stale_outputs = 0
+        # Host-side tick-rate gauge, maintained solely by the device loop
+        # (readers of /status never mutate it).
+        self._ticks_done = 0
+        self._rate: float | None = None
+        self._rate_mark_tick = 0
+        self._rate_mark_time = time.monotonic()
 
     # --- lifecycle (the broadcastCommand surface, master.go:269-351) -------
 
@@ -78,6 +86,7 @@ class MasterNode:
             self._running = False
             if self._loop:
                 self._loop.join()
+            self._rate = None
             log.info("network was paused")
 
     def reset(self) -> None:
@@ -143,6 +152,94 @@ class MasterNode:
     def is_running(self) -> bool:
         return self._running
 
+    def status(self) -> dict:
+        """Live metrics (additive vs the reference, which has none —
+        SURVEY.md §5: stdlib log lines were its only observability).
+
+        All device arrays are materialized UNDER the state lock: the device
+        loop donates state buffers into each jitted chunk, so touching them
+        outside the lock races with invalidation on TPU.
+        """
+        with self._state_lock:
+            state = self._state
+            topo = self._topology
+            tick = int(np.asarray(state.tick))
+            retired = np.asarray(state.retired)
+            stack_top = np.asarray(state.stack_top)
+            in_depth = int(state.in_wr - state.in_rd)
+            out_depth = int(state.out_wr - state.out_rd)
+        return {
+            "running": self._running,
+            "tick": tick,
+            "ticks_per_sec": self._rate,  # maintained by the device loop
+            "retired_per_lane": {
+                name: int(retired[i]) for name, i in topo.lane_ids().items()
+            },
+            "stack_depth": {
+                name: int(stack_top[i]) for name, i in topo.stack_ids().items()
+            },
+            "in_queue": self._in_q.qsize() + in_depth,
+            "out_queue": self._out_q.qsize() + out_depth,
+            "nodes": dict(topo.node_info),
+        }
+
+    def save_checkpoint(self, path: str) -> None:
+        """Whole-network state + topology to one .npz (SURVEY.md §5: the
+        reference cannot checkpoint at all; here state is one pytree).
+
+        Arrays are materialized under the state lock (see status()).
+        """
+        with self._state_lock:
+            state = self._state
+            topo = self._topology
+            arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
+        arrays["__topology__"] = np.frombuffer(
+            json.dumps(
+                {
+                    "nodes": topo.node_info,
+                    "programs": topo.programs,
+                    "stack_cap": topo.stack_cap,
+                    "in_cap": topo.in_cap,
+                    "out_cap": topo.out_cap,
+                }
+            ).encode(),
+            dtype=np.uint8,
+        )
+        np.savez(path, **arrays)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore state + programs from a .npz written by save_checkpoint.
+
+        Capacities travel in the checkpoint: a snapshot taken under different
+        ring/stack caps restores those caps, keeping the state arrays and the
+        compiled network consistent.
+        """
+        import jax.numpy as jnp
+
+        from misaka_tpu.core.state import NetworkState
+
+        with np.load(path) as data:
+            meta = json.loads(bytes(data["__topology__"]).decode())
+            state = NetworkState(
+                **{f: jnp.asarray(data[f]) for f in NetworkState._fields}
+            )
+        new_topology = Topology(
+            node_info=meta["nodes"],
+            programs=meta["programs"],
+            stack_cap=int(meta.get("stack_cap", self._topology.stack_cap)),
+            in_cap=int(meta.get("in_cap", self._topology.in_cap)),
+            out_cap=int(meta.get("out_cap", self._topology.out_cap)),
+        )
+        with self._lifecycle_lock:
+            self.pause()
+            new_net = new_topology.compile()
+            with self._state_lock:
+                self._topology = new_topology
+                self._net = new_net
+                self._state = state
+            self._drain_queues()
+        log.info("checkpoint restored from %s", path)
+
     def snapshot(self):
         """Whole-network state as one pytree — checkpointing for free.
 
@@ -197,6 +294,14 @@ class MasterNode:
                     state, _ = self._net.feed(state, pending)
                     busy = True
                 state = self._net.run(state, self._chunk)
+                self._ticks_done += self._chunk
+                now = time.monotonic()
+                if now - self._rate_mark_time > 2:
+                    self._rate = (self._ticks_done - self._rate_mark_tick) / (
+                        now - self._rate_mark_time
+                    )
+                    self._rate_mark_tick = self._ticks_done
+                    self._rate_mark_time = now
                 state, outs = self._net.drain(state)
                 self._state = state
             for v in outs:
@@ -209,8 +314,29 @@ class MasterNode:
                 time.sleep(0.001)
 
 
-def make_http_server(master: MasterNode, port: int = 8000) -> ThreadingHTTPServer:
-    """The five client routes (master.go:90-224), byte-compatible."""
+def make_http_server(
+    master: MasterNode, port: int = 8000, checkpoint_dir: str | None = None
+) -> ThreadingHTTPServer:
+    """The five client routes (master.go:90-224), byte-compatible, plus the
+    additive /status, /checkpoint, /restore routes.
+
+    HTTP checkpointing is DISABLED unless `checkpoint_dir` is configured;
+    when enabled, clients pass a bare checkpoint NAME (no path separators)
+    resolved inside that directory — an unauthenticated form field must not
+    choose arbitrary server-side filesystem paths.  The Python API
+    (MasterNode.save_checkpoint/load_checkpoint) keeps full-path freedom for
+    local callers.
+    """
+    import os
+    import re
+    import zipfile
+
+    _name_re = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+    def resolve_checkpoint(name: str) -> str | None:
+        if not checkpoint_dir or not _name_re.match(name) or ".." in name:
+            return None
+        return os.path.join(checkpoint_dir, name if name.endswith(".npz") else name + ".npz")
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -231,7 +357,17 @@ def make_http_server(master: MasterNode, port: int = 8000) -> ThreadingHTTPServe
             raw = self.rfile.read(length).decode()
             return {k: v[0] for k, v in parse_qs(raw, keep_blank_values=True).items()}
 
-        def do_GET(self):  # parity: "method GET not allowed" (master.go:104)
+        def do_GET(self):
+            # /status is additive; the reference's routes reject GET
+            # ("method GET not allowed", master.go:104).
+            if self.path == "/status":
+                data = (json.dumps(master.status()) + "\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             self._text(405, "method GET not allowed")
 
         def do_POST(self):
@@ -277,6 +413,34 @@ def make_http_server(master: MasterNode, port: int = 8000) -> ThreadingHTTPServe
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+                elif self.path == "/checkpoint":
+                    # additive routes: the reference cannot checkpoint
+                    if not checkpoint_dir:
+                        self._text(403, "checkpointing disabled (no checkpoint_dir configured)")
+                        return
+                    name = self._form().get("name", "")
+                    path = resolve_checkpoint(name)
+                    if path is None:
+                        self._text(400, "invalid checkpoint name")
+                        return
+                    os.makedirs(checkpoint_dir, exist_ok=True)
+                    master.save_checkpoint(path)
+                    self._text(200, "Success")
+                elif self.path == "/restore":
+                    if not checkpoint_dir:
+                        self._text(403, "checkpointing disabled (no checkpoint_dir configured)")
+                        return
+                    name = self._form().get("name", "")
+                    path = resolve_checkpoint(name)
+                    if path is None:
+                        self._text(400, "invalid checkpoint name")
+                        return
+                    try:
+                        master.load_checkpoint(path)
+                    except (OSError, KeyError, ValueError, zipfile.BadZipFile) as e:
+                        self._text(400, f"error restoring checkpoint: {e}")
+                        return
+                    self._text(200, "Success")
                 else:
                     self._text(404, "not found")
             except Exception as e:  # defensive: a handler crash must not kill the server

@@ -19,7 +19,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from misaka_tpu.core.engine import CompiledNetwork
-from misaka_tpu.tis.lower import DEFAULT_PROGRAM, lower_program, pad_programs
+from misaka_tpu.tis.lower import DEFAULT_PROGRAM, pad_programs
+from misaka_tpu.tis.native import assemble
 
 
 class TopologyError(ValueError):
@@ -107,8 +108,11 @@ class Topology:
         if not lane_ids:
             raise TopologyError("network has no program nodes")
         stack_ids = self.stack_ids()
+        # `assemble` uses the native C++ assembler when built (make native),
+        # falling back to the pure-Python frontend; outputs are parity-tested
+        # identical (tests/test_native.py).
         lowered = [
-            lower_program(self.programs[name], lane_ids, stack_ids)
+            assemble(self.programs[name], lane_ids, stack_ids)
             for name in lane_ids
         ]
         code, lengths = pad_programs(lowered)

@@ -1,0 +1,113 @@
+"""ctypes bridge to the native C++ assembler (native/assembler.cpp).
+
+The native assembler is a functional twin of parser.py + lower.py, used for
+fast `/load` on large programs.  Build with `make native` (repo root) or let
+this module build it on first use (g++, ~1s).  Everything degrades to the
+pure-Python frontend when no compiler is available — `assemble()` is the
+drop-in entry point that picks the best backend.
+
+Known divergence: immediates beyond int64 range saturate in C++ but wrap in
+Python; both are far outside the reference's int domain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from misaka_tpu.tis import isa
+from misaka_tpu.tis.lower import LoweredProgram, TISLowerError, lower_program
+from misaka_tpu.tis.parser import TISParseError
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "assembler.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "libmisaka_assembler.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+_MAX_LINES = 65536
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+            ):
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.misaka_assemble.restype = ctypes.c_int
+            lib.misaka_assemble.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int,
+                ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _ordered_names(ids: dict[str, int]) -> str:
+    return "\n".join(name for name, _ in sorted(ids.items(), key=lambda kv: kv[1]))
+
+
+def assemble_native(
+    program: str, lane_ids: dict[str, int], stack_ids: dict[str, int]
+) -> LoweredProgram:
+    """Assemble via the C++ backend; raises like the Python frontend."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native assembler unavailable (no g++?)")
+    n_lines = program.count("\n") + 1
+    if n_lines > _MAX_LINES:
+        raise TISLowerError(f"program too long ({n_lines} lines)")
+    out = np.zeros((n_lines, isa.NFIELDS), dtype=np.int32)
+    err = ctypes.create_string_buffer(512)
+    rc = lib.misaka_assemble(
+        program.encode(),
+        _ordered_names(lane_ids).encode(),
+        _ordered_names(stack_ids).encode(),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n_lines,
+        err,
+        len(err),
+    )
+    if rc < 0:
+        msg = err.value.decode()
+        # mirror the Python frontend's exception taxonomy
+        if "not a program node" in msg or "not a stack node" in msg:
+            raise TISLowerError(msg)
+        raise TISParseError(msg)
+    return LoweredProgram(code=out[:rc], length=rc, source=program)
+
+
+def assemble(
+    program: str, lane_ids: dict[str, int], stack_ids: dict[str, int]
+) -> LoweredProgram:
+    """Best-backend assemble: native when available, Python otherwise."""
+    if native_available():
+        return assemble_native(program, lane_ids, stack_ids)
+    return lower_program(program, lane_ids, stack_ids)

@@ -1,0 +1,244 @@
+"""An independent, deliberately naive Python oracle for the superstep semantics.
+
+Implements the documented tick discipline (core/step.py module docstring) with
+plain Python ints/lists and sequential lane iteration — no numpy, no sharing
+of kernel code paths beyond the ISA field layout.  Used by the randomized
+differential tests to cross-check both the XLA and Pallas kernels.
+
+Semantics implemented (in this order, per tick):
+  phase A  every lane with a ready inbound-port source consumes it into its
+           hold latch (port cleared) — before any delivery
+  phase B  sends/stack ops/IN/OUT arbitrate by LOWEST LANE INDEX; sends see
+           post-consume port occupancy; one op per stack, one IN, one OUT
+           per network per tick; stack/ring feasibility uses begin-of-tick
+           tops/counters
+  commit   a lane commits iff its source was ready and its destination
+           granted; effects read begin-of-tick registers; PC advances
+           (wrap/jump/JRO-clamp) only on commit
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from misaka_tpu.tis import isa
+
+_M32 = 1 << 32
+
+
+def _i32(v: int) -> int:
+    v &= _M32 - 1
+    return v - _M32 if v >= (1 << 31) else v
+
+
+class Oracle:
+    def __init__(self, code, prog_len, num_stacks, stack_cap, in_cap, out_cap):
+        self.progs = [
+            [list(map(int, code[n, l])) for l in range(int(prog_len[n]))]
+            for n in range(code.shape[0])
+        ]
+        n = len(self.progs)
+        self.acc = [0] * n
+        self.bak = [0] * n
+        self.pc = [0] * n
+        self.port_val = [[0] * 4 for _ in range(n)]
+        self.port_full = [[False] * 4 for _ in range(n)]
+        self.hold_val = [0] * n
+        self.holding = [False] * n
+        self.num_stacks = max(1, num_stacks)
+        self.stack_cap = stack_cap
+        self.stacks = [[] for _ in range(self.num_stacks)]
+        self.in_cap = in_cap
+        self.out_cap = out_cap
+        self.in_buf = [0] * in_cap
+        self.in_rd = 0
+        self.in_wr = 0
+        self.out_buf = [0] * out_cap
+        self.out_rd = 0
+        self.out_wr = 0
+        self.tick_count = 0
+        self.retired = [0] * n
+
+    def feed(self, values):
+        for v in values:
+            assert self.in_wr - self.in_rd < self.in_cap
+            self.in_buf[self.in_wr % self.in_cap] = _i32(v)
+            self.in_wr += 1
+
+    def _instr(self, n):
+        return self.progs[n][self.pc[n]]
+
+    def tick(self):
+        n_lanes = len(self.progs)
+        f = isa
+
+        # --- phase A: consumes ---------------------------------------------
+        for n in range(n_lanes):
+            ins = self._instr(n)
+            if ins[f.F_OP] in f.READS_SRC and ins[f.F_SRC] >= f.SRC_R0:
+                p = ins[f.F_SRC] - f.SRC_R0
+                if not self.holding[n] and self.port_full[n][p]:
+                    self.hold_val[n] = self.port_val[n][p]
+                    self.holding[n] = True
+                    self.port_full[n][p] = False
+
+        # --- source resolution ---------------------------------------------
+        src_ok = [True] * n_lanes
+        src_val = [0] * n_lanes
+        for n in range(n_lanes):
+            ins = self._instr(n)
+            if ins[f.F_OP] not in f.READS_SRC:
+                continue
+            s = ins[f.F_SRC]
+            if s == f.SRC_IMM:
+                src_val[n] = ins[f.F_IMM]
+            elif s == f.SRC_ACC:
+                src_val[n] = self.acc[n]
+            elif s == f.SRC_NIL:
+                src_val[n] = 0
+            else:
+                src_val[n] = self.hold_val[n]
+                src_ok[n] = self.holding[n]
+
+        # --- arbitration ----------------------------------------------------
+        granted = [False] * n_lanes
+        begin_tops = [len(s) for s in self.stacks]
+        stack_taken = [False] * self.num_stacks
+        in_taken = False
+        out_taken = False
+        in_avail = self.in_wr - self.in_rd > 0
+        out_free = self.out_wr - self.out_rd < self.out_cap
+        deliveries = []   # (lane_to, port, value)
+        stack_pushes = [] # (stack, value)
+        stack_pops = {}   # lane -> value
+        in_winner = None
+        out_value = None
+
+        for n in range(n_lanes):
+            ins = self._instr(n)
+            op = ins[f.F_OP]
+            if op == f.OP_MOV_NET and src_ok[n]:
+                tgt, port = ins[f.F_TGT], ins[f.F_PORT]
+                occupied = self.port_full[tgt][port] or any(
+                    d[0] == tgt and d[1] == port for d in deliveries
+                )
+                if not occupied:
+                    deliveries.append((tgt, port, src_val[n]))
+                    granted[n] = True
+            elif op == f.OP_PUSH and src_ok[n]:
+                s = ins[f.F_TGT]
+                if not stack_taken[s] and begin_tops[s] < self.stack_cap:
+                    stack_taken[s] = True
+                    stack_pushes.append((s, src_val[n]))
+                    granted[n] = True
+            elif op == f.OP_POP:
+                s = ins[f.F_TGT]
+                if not stack_taken[s] and begin_tops[s] > 0:
+                    stack_taken[s] = True
+                    stack_pops[n] = self.stacks[s][-1]
+                    granted[n] = True
+            elif op == f.OP_IN:
+                if in_avail and not in_taken:
+                    in_taken = True
+                    in_winner = n
+                    granted[n] = True
+            elif op == f.OP_OUT and src_ok[n]:
+                if out_free and not out_taken:
+                    out_taken = True
+                    out_value = src_val[n]
+                    granted[n] = True
+
+        # --- commit + effects ----------------------------------------------
+        old_acc = list(self.acc)
+        old_bak = list(self.bak)
+        for n in range(n_lanes):
+            ins = self._instr(n)
+            op = ins[f.F_OP]
+            needs_grant = op in (
+                f.OP_MOV_NET, f.OP_PUSH, f.OP_POP, f.OP_IN, f.OP_OUT
+            )
+            commit = granted[n] if needs_grant else src_ok[n]
+            if not commit:
+                continue
+            ln = len(self.progs[n])
+            if op == f.OP_MOV_LOCAL and ins[f.F_DST] == f.DST_ACC:
+                self.acc[n] = src_val[n]
+            elif op == f.OP_ADD:
+                self.acc[n] = _i32(old_acc[n] + src_val[n])
+            elif op == f.OP_SUB:
+                self.acc[n] = _i32(old_acc[n] - src_val[n])
+            elif op == f.OP_NEG:
+                self.acc[n] = _i32(-old_acc[n])
+            elif op == f.OP_SWP:
+                self.acc[n] = old_bak[n]
+                self.bak[n] = old_acc[n]
+            elif op == f.OP_SAV:
+                self.bak[n] = old_acc[n]
+            elif op == f.OP_POP and ins[f.F_DST] == f.DST_ACC:
+                self.acc[n] = stack_pops[n]
+            elif op == f.OP_IN and ins[f.F_DST] == f.DST_ACC:
+                self.acc[n] = self.in_buf[self.in_rd % self.in_cap]
+
+            # pc
+            taken = (
+                op == f.OP_JMP
+                or (op == f.OP_JEZ and old_acc[n] == 0)
+                or (op == f.OP_JNZ and old_acc[n] != 0)
+                or (op == f.OP_JGZ and old_acc[n] > 0)
+                or (op == f.OP_JLZ and old_acc[n] < 0)
+            )
+            if taken:
+                self.pc[n] = ins[f.F_JMP]
+            elif op == f.OP_JRO:
+                self.pc[n] = max(0, min(self.pc[n] + src_val[n], ln - 1))
+            else:
+                self.pc[n] = (self.pc[n] + 1) % ln
+            self.holding[n] = False
+            self.retired[n] += 1
+
+        # --- apply resource effects ----------------------------------------
+        for (tgt, port, v) in deliveries:
+            self.port_full[tgt][port] = True
+            self.port_val[tgt][port] = v
+        for (s, v) in stack_pushes:
+            self.stacks[s].append(v)
+        pushed_stacks = {s for s, _ in stack_pushes}
+        for s in range(self.num_stacks):
+            if stack_taken[s] and s not in pushed_stacks:
+                self.stacks[s].pop()  # the tick's single op was a pop
+        if in_winner is not None:
+            self.in_rd += 1
+        if out_taken:
+            self.out_buf[self.out_wr % self.out_cap] = out_value
+            self.out_wr += 1
+        self.tick_count += 1
+
+    def run(self, steps):
+        for _ in range(steps):
+            self.tick()
+
+    def state_arrays(self):
+        """Mirror NetworkState for comparison (unbatched)."""
+        n = len(self.progs)
+        sm = np.zeros((self.num_stacks, self.stack_cap), np.int32)
+        st = np.zeros((self.num_stacks,), np.int32)
+        for s, vals in enumerate(self.stacks):
+            st[s] = len(vals)
+            for c, v in enumerate(vals):
+                sm[s, c] = v
+        return {
+            "acc": np.array(self.acc, np.int32),
+            "bak": np.array(self.bak, np.int32),
+            "pc": np.array(self.pc, np.int32),
+            "port_val": np.array(self.port_val, np.int32),
+            "port_full": np.array(self.port_full, bool),
+            "hold_val": np.array(self.hold_val, np.int32),
+            "holding": np.array(self.holding, bool),
+            "stack_top": st,
+            "stack_mem_used": sm,
+            "in_rd": np.int32(self.in_rd),
+            "out_wr": np.int32(self.out_wr),
+            "out_buf": np.array(self.out_buf, np.int32),
+            "tick": np.int32(self.tick_count),
+            "retired": np.array(self.retired, np.int32),
+        }

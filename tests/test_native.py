@@ -1,0 +1,70 @@
+"""Native C++ assembler parity: corpus + fuzz against the Python frontend."""
+
+import numpy as np
+import pytest
+
+from misaka_tpu.tis.lower import TISLowerError, lower_program
+from misaka_tpu.tis.native import assemble_native, native_available
+from misaka_tpu.tis.parser import TISParseError
+from tests.test_differential import build_random_network, random_program
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain for the native assembler"
+)
+
+LANES = {"misaka1": 0, "misaka2": 1}
+STACKS = {"misaka3": 0}
+
+CORPUS = [
+    "IN ACC\nADD 1\nMOV ACC, misaka2:R0\nMOV R0, ACC\nOUT ACC\n",
+    "MOV R0, ACC\nADD 1\nPUSH ACC, misaka3\nPOP misaka3, ACC\nMOV ACC, misaka1:R0\n",
+    "start: NOP\nJMP start\nJEZ START\nJNZ start\nJGZ start\nJLZ start",
+    "# comment\n\nlbl:\nlbl2: SWP\nSAV\nNEG",
+    "MOV -3, NIL\nMOV 7, misaka2:R3\nSUB R2\nJRO -1\nJRO ACC",
+    "PUSH 3, misaka3\nPUSH R1, misaka3\nPOP misaka3, NIL\nIN NIL\nOUT 12\nOUT R3",
+    "ADD 2147483650",  # int32 wrap
+]
+
+
+@pytest.mark.parametrize("idx", range(len(CORPUS)))
+def test_corpus_parity(idx):
+    program = CORPUS[idx]
+    want = lower_program(program, LANES, STACKS)
+    got = assemble_native(program, LANES, STACKS)
+    assert got.length == want.length
+    np.testing.assert_array_equal(got.code, want.code)
+
+
+@pytest.mark.parametrize(
+    "program,exc",
+    [
+        ("FROB 1", TISParseError),
+        ("MOV 1,ACC", TISParseError),
+        ("JMP nowhere", TISParseError),
+        ("a:\nA:", TISParseError),
+        ("MOV ACC, ghost:R0", TISLowerError),
+        ("PUSH 1, ghost", TISLowerError),
+    ],
+)
+def test_error_parity(program, exc):
+    with pytest.raises(exc) as native_err:
+        assemble_native(program, LANES, STACKS)
+    with pytest.raises(exc) as py_err:
+        try:
+            lower_program(program, LANES, STACKS)
+        except (TISParseError, TISLowerError) as e:
+            raise e
+    assert str(native_err.value) == str(py_err.value)
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_fuzz_parity(seed):
+    rng = np.random.default_rng(1000 + seed)
+    lane_names = list(LANES)
+    stack_names = list(STACKS)
+    program = random_program(rng, lane_names, stack_names, int(rng.integers(1, 12)))
+    want = lower_program(program, LANES, STACKS)
+    got = assemble_native(program, LANES, STACKS)
+    np.testing.assert_array_equal(
+        got.code, want.code, err_msg=f"seed {seed} program:\n{program}"
+    )

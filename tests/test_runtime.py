@@ -20,10 +20,11 @@ from misaka_tpu.networks import ADD2_PROGRAMS, add2
 
 
 @pytest.fixture(scope="module")
-def server():
+def server(tmp_path_factory):
     topology = add2()
     master = MasterNode(topology, chunk_steps=32)
-    httpd = make_http_server(master, port=0)  # ephemeral port
+    ckpt_dir = str(tmp_path_factory.mktemp("ckpts"))
+    httpd = make_http_server(master, port=0, checkpoint_dir=ckpt_dir)  # ephemeral port
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     yield f"http://127.0.0.1:{httpd.server_address[1]}", master
@@ -196,6 +197,96 @@ def test_compute_timeout_keeps_pairing():
     master.run()   # the orphaned value 1 now computes; its output is stale
     assert master.compute(5, timeout=30) == 5  # not 1
     master.pause()
+
+
+def test_status_endpoint(server):
+    base, _ = server
+    post(base, "/run")
+    post(base, "/compute", {"value": "1"})
+    status, body = get(base, "/status")
+    assert status == 200
+    s = json.loads(body)
+    assert s["running"] is True
+    assert s["tick"] > 0
+    assert s["nodes"] == {
+        "misaka1": "program",
+        "misaka2": "program",
+        "misaka3": "stack",
+    }
+    assert s["retired_per_lane"]["misaka1"] > 0
+    assert "misaka3" in s["stack_depth"]
+
+
+def test_checkpoint_restore_over_http(server):
+    base, _ = server
+    post(base, "/run")
+    post(base, "/compute", {"value": "4"})
+    status, body = post(base, "/checkpoint", {"name": "net"})
+    assert (status, body) == (200, "Success")
+    # mutate: load a different program, compute differently
+    post(base, "/load", {"program": "IN ACC\nADD 100\nOUT ACC", "targetURI": "misaka1"})
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "1"})
+    assert json.loads(body) == {"value": 101}
+    # restore: original programs and state come back
+    status, body = post(base, "/restore", {"name": "net"})
+    assert (status, body) == (200, "Success")
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "1"})
+    assert json.loads(body) == {"value": 3}
+
+
+def test_restore_missing_checkpoint(server):
+    base, _ = server
+    status, body = post(base, "/restore", {"name": "nope"})
+    assert status == 400
+    assert "error restoring checkpoint" in body
+
+
+def test_checkpoint_name_traversal_rejected(server):
+    base, _ = server
+    for bad in ["../../etc/pwned", "/etc/pwned", "a/b", ""]:
+        status, body = post(base, "/checkpoint", {"name": bad})
+        assert (status, body) == (400, "invalid checkpoint name"), bad
+
+
+def test_checkpoint_disabled_without_dir():
+    import threading
+
+    master = MasterNode(add2(), chunk_steps=16)
+    httpd = make_http_server(master, port=0)  # no checkpoint_dir
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        status, body = post(base, "/checkpoint", {"name": "x"})
+        assert status == 403
+        assert "disabled" in body
+    finally:
+        httpd.shutdown()
+
+
+def test_checkpoint_caps_roundtrip(tmp_path):
+    # Caps travel inside the checkpoint: restoring onto a master built with
+    # different caps must keep state arrays and compiled network consistent.
+    small = Topology(
+        node_info={"n": "program"},
+        programs={"n": "IN ACC\nADD 1\nOUT ACC"},
+        in_cap=16,
+        out_cap=16,
+        stack_cap=4,
+    )
+    m1 = MasterNode(small, chunk_steps=16)
+    path = str(tmp_path / "c.npz")
+    m1.save_checkpoint(path)
+
+    big = Topology(node_info={"n": "program"}, programs={"n": "NOP"})
+    m2 = MasterNode(big, chunk_steps=16)
+    m2.load_checkpoint(path)
+    m2.run()
+    assert m2.compute(9, timeout=30) == 10
+    m2.pause()
+    assert m2._net.in_cap == 16  # restored caps, not the host's
 
 
 def test_topology_validation():
