@@ -1,6 +1,5 @@
-# Build targets for misaka_tpu (cf. the reference's Makefile: build/grpc/cert).
-# The TPU build has no codegen or TLS certs; native/ holds the C++ runtime
-# components.
+# Build targets mirroring the reference's Makefile surface (build/grpc/cert,
+# /root/reference/Makefile:1-12) plus the native components and local QA.
 
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC
@@ -9,6 +8,31 @@ native: native/libmisaka_assembler.so
 
 native/libmisaka_assembler.so: native/assembler.cpp
 	$(CXX) $(CXXFLAGS) $< -o $@
+
+# Regenerate protobuf message classes for the per-process transport.  The
+# image ships protoc but not grpcio-tools; service stubs are hand-declared
+# in misaka_tpu/transport/rpc.py.
+grpc:
+	protoc --python_out=misaka_tpu/transport --proto_path=misaka_tpu/transport \
+		misaka_tpu/transport/messenger.proto
+
+# Self-signed TLS for per-process nodes (the reference's `make cert`,
+# Makefile:7-12): a CA plus a service cert whose SANs enumerate the node
+# hostnames (deploy/certificate.conf).  CERT_FILE=deploy/certs/service.pem
+# KEY_FILE=deploy/certs/service.key
+cert:
+	mkdir -p deploy/certs
+	openssl genrsa -out deploy/certs/ca.key 4096
+	openssl req -new -x509 -key deploy/certs/ca.key -sha256 \
+		-subj "/C=JP/ST=TOK/L=Academy City/O=SYSTEM/OU=Level 6 Shift" \
+		-days 365 -out deploy/certs/ca.cert
+	openssl genrsa -out deploy/certs/service.key 4096
+	openssl req -new -key deploy/certs/service.key \
+		-out deploy/certs/service.csr -config deploy/certificate.conf
+	openssl x509 -req -in deploy/certs/service.csr -CA deploy/certs/ca.cert \
+		-CAkey deploy/certs/ca.key -CAcreateserial \
+		-out deploy/certs/service.pem -days 365 -sha256 \
+		-extfile deploy/certificate.conf -extensions req_ext
 
 test:
 	python -m pytest tests/ -x -q
@@ -19,4 +43,4 @@ bench:
 clean:
 	rm -f native/*.so
 
-.PHONY: native test bench clean
+.PHONY: native grpc cert test bench clean

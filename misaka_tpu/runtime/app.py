@@ -12,11 +12,22 @@ so the master's env surface is what survives:
   MISAKA_PORT      HTTP port (default 8000 = clientPort, master.go:19)
   MISAKA_AUTORUN   "1" to start running immediately (default: wait for /run)
   MISAKA_CHECKPOINT_DIR  enable HTTP /checkpoint & /restore, storing named
-                   .npz snapshots in this directory (disabled when unset)
+                   .npz snapshots in this directory (disabled when unset;
+                   fused master only — per-process nodes hold their own
+                   state, which the distributed master cannot snapshot)
 
-NODE_TYPE=program / NODE_TYPE=stack have no fused-mode meaning: those
-processes' entire job (interpret asm / hold a stack) lives inside the jitted
-kernel.  Setting them exits with an explanatory error rather than pretending.
+Deployment modes (NODE_TYPE dispatch, mirroring cmd/app.go:17-39):
+  * NODE_TYPE unset / "master" (default): the fused single-process TPU
+    engine — the whole network in one jitted kernel.  This is the product.
+  * MISAKA_MODE=distributed + NODE_TYPE=master: the reference's distributed
+    control plane — HTTP surface + gRPC command fan-out + Master data-plane
+    service, for networks of per-process nodes (runtime/nodes.py).
+  * NODE_TYPE=program: one TIS interpreter process (MASTER_URI + PROGRAM
+    envs, app.go:20-25), serving the Program gRPC service.
+  * NODE_TYPE=stack: one LIFO storage process serving the Stack service.
+Per-process nodes honor CERT_FILE/KEY_FILE for TLS (app.go:15-16; plain TCP
+when unset), NODE_ADDRS ({name: "host:port"}) and MISAKA_GRPC_PORT for
+addressing (the reference hardcodes :8001).
 
 Run: python -m misaka_tpu.runtime.app
 """
@@ -46,30 +57,95 @@ def build_topology_from_env(environ=os.environ) -> Topology:
     return Topology.from_node_info_json(node_info, programs)
 
 
-def main() -> None:
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
-    )
-    node_type = os.environ.get("NODE_TYPE", "master")
-    if node_type != "master":
-        raise SystemExit(
-            f"NODE_TYPE={node_type!r}: program/stack nodes are lanes of the "
-            "fused TPU kernel, not processes; run the master (NODE_TYPE=master)"
-        )
-    topology = build_topology_from_env()
-    master = MasterNode(topology)
-    if os.environ.get("MISAKA_AUTORUN") == "1":
-        master.run()
-    port = int(os.environ.get("MISAKA_PORT", "8000"))
-    server = make_http_server(
-        master, port, checkpoint_dir=os.environ.get("MISAKA_CHECKPOINT_DIR")
-    )
+def _serve_http(master, environ=os.environ, checkpoint_dir: str | None = None) -> None:
+    port = int(environ.get("MISAKA_PORT", "8000"))
+    server = make_http_server(master, port, checkpoint_dir=checkpoint_dir)
     logging.getLogger("misaka_tpu.app").info("starting http server on :%d", port)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         master.pause()
         sys.exit(0)
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    environ = os.environ
+    node_type = environ.get("NODE_TYPE", "master")
+    cert, key = environ.get("CERT_FILE"), environ.get("KEY_FILE")
+
+    if node_type == "program":
+        from misaka_tpu.runtime.nodes import ProgramNodeProcess, Resolver
+
+        node = ProgramNodeProcess(
+            master_uri=environ.get("MASTER_URI", "last_order"),
+            resolver=Resolver.from_env(environ),
+            cert_file=cert,
+            key_file=key,
+            grpc_port=int(environ.get("MISAKA_GRPC_PORT", "8001")),
+        )
+        program = environ.get("PROGRAM")
+        if program:
+            try:
+                node.load_program(program)
+            except Exception as e:  # reference logs and keeps NOP (app.go:22-24)
+                logging.getLogger("misaka_tpu.app").warning(
+                    "Could not load default program: %s", e
+                )
+        node.start()
+        threading_event_forever()
+    elif node_type == "stack":
+        from misaka_tpu.runtime.nodes import StackNodeProcess
+
+        node = StackNodeProcess(
+            cert_file=cert,
+            key_file=key,
+            grpc_port=int(environ.get("MISAKA_GRPC_PORT", "8001")),
+        )
+        node.start()
+        threading_event_forever()
+    elif node_type == "master" and environ.get("MISAKA_MODE") == "distributed":
+        from misaka_tpu.runtime.nodes import MasterNodeProcess, Resolver
+
+        node_info = json.loads(environ.get("NODE_INFO", "{}"))
+        if not node_info:
+            raise SystemExit("distributed master requires NODE_INFO")
+        master = MasterNodeProcess(
+            node_info,
+            resolver=Resolver.from_env(environ),
+            cert_file=cert,
+            key_file=key,
+            grpc_port=int(environ.get("MISAKA_GRPC_PORT", "8001")),
+        )
+        master.start()
+        if environ.get("MISAKA_AUTORUN") == "1":
+            try:
+                master.run()
+            except Exception as e:  # peers may not be up yet; /run retries
+                logging.getLogger("misaka_tpu.app").warning("autorun failed: %s", e)
+        # No checkpoint_dir: state lives in the per-process nodes, which the
+        # distributed master cannot snapshot (the fused engine can).
+        _serve_http(master, environ)
+    elif node_type == "master":
+        topology = build_topology_from_env()
+        master = MasterNode(topology)
+        if environ.get("MISAKA_AUTORUN") == "1":
+            master.run()
+        _serve_http(
+            master, environ, checkpoint_dir=environ.get("MISAKA_CHECKPOINT_DIR")
+        )
+    else:
+        raise SystemExit(f"'{node_type}' not a valid node type")
+
+
+def threading_event_forever() -> None:
+    """Park the main thread while daemon servers run (the reference blocks in
+    Serve, program.go:105)."""
+    import threading
+
+    threading.Event().wait()
 
 
 if __name__ == "__main__":

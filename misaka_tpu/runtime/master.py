@@ -41,6 +41,15 @@ class ComputeTimeout(RuntimeError):
     """The network produced no output for a /compute value in time."""
 
 
+class BroadcastError(RuntimeError):
+    """A control-plane fan-out failed on at least one node (master.go:288-292).
+
+    Defined here (not in runtime.nodes, which raises it) so the shared HTTP
+    surface can catch it without importing the grpc-dependent distributed
+    module — the fused master must work with jax+numpy alone.
+    """
+
+
 class MasterNode:
     """Control plane + I/O gateway for one fused network."""
 
@@ -373,20 +382,37 @@ def make_http_server(
         def do_POST(self):
             try:
                 if self.path == "/run":
-                    master.run()
+                    try:
+                        master.run()
+                    except BroadcastError as e:
+                        self._text(400, f"error running network: {e}")
+                        return
                     self._text(200, "Success")
                 elif self.path == "/pause":
-                    master.pause()
+                    try:
+                        master.pause()
+                    except BroadcastError as e:
+                        self._text(400, f"error pausing network: {e}")
+                        return
                     self._text(200, "Success")
                 elif self.path == "/reset":
-                    master.reset()
+                    try:
+                        master.reset()
+                    except BroadcastError as e:
+                        self._text(400, f"error resetting network: {e}")
+                        return
                     self._text(200, "Success")
                 elif self.path == "/load":
                     form = self._form()
                     target = form.get("targetURI", "")
                     try:
                         master.load(target, form.get("program", ""))
-                    except (TopologyError, TISParseError, TISLowerError) as e:
+                    except (
+                        TopologyError,
+                        TISParseError,
+                        TISLowerError,
+                        BroadcastError,
+                    ) as e:
                         self._text(
                             400, f"error loading program on node {target}: {e}"
                         )

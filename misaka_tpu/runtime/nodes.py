@@ -1,0 +1,757 @@
+"""Per-process compatibility nodes: drop-in peers for the reference deployment.
+
+The fused single-process engine (misaka_tpu/core) is the product; this module
+is the *deployment-parity* mode — one OS process per node speaking the
+reference's gRPC protocol (misaka_tpu/transport), so a misaka_tpu node can
+replace any container in the reference's docker-compose topology, or mix with
+original Go nodes on one network.
+
+Three node kinds, mirroring internal/nodes/:
+  * ProgramNodeProcess — the TIS interpreter VM (program.go:24-432): registers
+    acc/bak, instruction ptr, four cap-1 inbound ports, a free-running
+    execute loop, and the Program gRPC service.  Executes the *same parsed
+    token rows* as the Go reference (shared frontend: misaka_tpu.tis.parser).
+  * StackNodeProcess — shared LIFO storage + the Stack service (stack.go).
+  * MasterNodeProcess — control plane: HTTP surface + command broadcast +
+    the Master data-plane service (master.go).
+
+Deliberate divergences from the reference (each documented at the site):
+  * One reused channel per peer instead of a fresh TLS dial per message
+    (quirk #6) — semantics identical, latency strictly better.
+  * Transient RPC errors are retried on the same instruction (matching the
+    reference's update()-error semantics, program.go:80-92) instead of
+    log.Fatalf-ing the process (quirk #8).
+  * A cancelled Stack.Pop wakes cleanly instead of leaking a consumer that
+    later swallows a value (quirk #4).
+  * /compute request/response pairing is serialized (quirk #2).
+  * /load dials the target's real gRPC port; the reference dials :8000 where
+    nothing listens (quirk #1).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from collections import deque
+
+import grpc
+from google.protobuf import empty_pb2
+
+from misaka_tpu.runtime.master import BroadcastError, ComputeTimeout
+from misaka_tpu.tis.parser import TISParseError, parse
+from misaka_tpu.transport import rpc
+from misaka_tpu.transport import messenger_pb2 as pb
+
+log = logging.getLogger("misaka_tpu.nodes")
+
+_EMPTY = empty_pb2.Empty
+_POLL = 0.05  # seconds between cancellation checks while blocked
+
+
+class NodeCancelled(Exception):
+    """A blocking op was interrupted by Pause/Reset (ctx cancellation,
+    program.go:196-204)."""
+
+
+class Resolver:
+    """Node name -> dial target.  The reference hardcodes `<name>:8001`
+    (grpcPort, master.go:20); NODE_ADDRS overrides let one host run many
+    nodes on distinct ports."""
+
+    def __init__(self, addrs: dict[str, str] | None = None, default_port: int = rpc.GRPC_PORT):
+        self._addrs = dict(addrs or {})
+        self._port = default_port
+
+    @classmethod
+    def from_env(cls, environ) -> "Resolver":
+        addrs = json.loads(environ.get("NODE_ADDRS", "{}"))
+        port = int(environ.get("MISAKA_GRPC_PORT", rpc.GRPC_PORT))
+        return cls(addrs, default_port=port)
+
+    def set_addr(self, name: str, target: str) -> None:
+        """Late registration — lets tests bind ephemeral ports first."""
+        self._addrs[name] = target
+
+    def resolve(self, name: str) -> str:
+        return self._addrs.get(name) or f"{name}:{self._port}"
+
+
+class _ClientPool:
+    """One lazily-dialed, reused client per (service, peer)."""
+
+    def __init__(self, resolver: Resolver, cert_file: str | None):
+        self._resolver = resolver
+        self._cert = cert_file
+        self._clients: dict[tuple[type, str], rpc._Stub] = {}
+        self._lock = threading.Lock()
+
+    def get(self, cls, name: str):
+        key = (cls, name)
+        with self._lock:
+            client = self._clients.get(key)
+            if client is None:
+                client = cls(self._resolver.resolve(name), cert_file=self._cert)
+                self._clients[key] = client
+            return client
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()
+
+
+class _Lifecycle:
+    """isRunning + generation-based cancellation, shared by all node kinds.
+
+    The reference pairs an unsynchronized isRunning flag (quirk #3) with a
+    context.Context recreated on every stop (stopNode, program.go:196-204).
+    Here: a lock-guarded flag plus a monotonically increasing generation;
+    blocked ops capture the generation and bail when it moves.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._running = False
+        self._gen = 0
+        self._run_signal = threading.Event()
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    @property
+    def gen(self) -> int:
+        return self._gen
+
+    def start(self) -> bool:
+        with self._lock:
+            if self._running:
+                return False
+            self._running = True
+            self._run_signal.set()
+            return True
+
+    def stop(self) -> bool:
+        """stopNode: cancel in-flight blocking ops, clear running."""
+        with self._lock:
+            was = self._running
+            self._running = False
+            self._gen += 1
+            self._run_signal.clear()
+            return was
+
+    def cancelled(self, gen: int) -> bool:
+        return self._gen != gen
+
+    def check(self, gen: int) -> None:
+        if self._gen != gen:
+            raise NodeCancelled()
+
+    def wait_for_run(self) -> None:
+        self._run_signal.wait(_POLL)
+
+
+def _await_future(fut: grpc.Future, life: _Lifecycle, gen: int):
+    """Block on an in-flight RPC, aborting if the node is paused/reset —
+    the Go pattern of passing the node ctx into every client call."""
+    while True:
+        try:
+            return fut.result(timeout=_POLL)
+        except grpc.FutureTimeoutError:
+            if life.cancelled(gen):
+                fut.cancel()
+                raise NodeCancelled()
+
+
+class ProgramNodeProcess:
+    """One TIS interpreter as an OS process (ProgramNode, program.go:24-432)."""
+
+    def __init__(
+        self,
+        master_uri: str,
+        resolver: Resolver | None = None,
+        cert_file: str | None = None,
+        key_file: str | None = None,
+        grpc_port: int = rpc.GRPC_PORT,
+        host: str = "0.0.0.0",
+    ):
+        self._master_uri = master_uri
+        self._resolver = resolver or Resolver()
+        self._cert, self._key = cert_file, key_file
+        self._grpc_port = grpc_port
+        self._host = host
+        self._pool = _ClientPool(self._resolver, cert_file)
+
+        self._life = _Lifecycle()
+        self._state_lock = threading.Lock()  # guards acc/bak/ptr/asm swaps
+        self.acc = 0
+        self.bak = 0
+        self.ptr = 0
+        # Hold latch for a consumed-but-uncommitted port value: once a source
+        # port is read, the value survives instruction retries (transient RPC
+        # errors, pause/resume) until the instruction commits — the same
+        # consume-then-park discipline as the fused kernel (core/fused.py
+        # pass 1).  The reference re-reads the port on retry and silently
+        # loses the consumed value (program.go:80-92 + :435-472).
+        self._hold: int | None = None
+        self._asm: list[list[str]] = [["NOP"]]  # fresh node default (program.go:64)
+        self._label_map: dict[str, int] = {}
+        # Inbound ports r0..r3: cap-1 queues (bufferSize=1, program.go:21,:60-63).
+        self._ports = [queue.Queue(maxsize=1) for _ in range(4)]
+
+        self._shutdown = threading.Event()
+        self._loop: threading.Thread | None = None
+        self._server: grpc.Server | None = None
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        """Start the run loop and gRPC server; returns the bound port."""
+        self._loop = threading.Thread(target=self._run_loop, daemon=True)
+        self._loop.start()
+        self._server, port = rpc.make_server(
+            {"Program": _ProgramServicer(self)},
+            self._grpc_port,
+            self._cert,
+            self._key,
+            host=self._host,
+        )
+        self._server.start()
+        log.info("program node serving grpc on :%d", port)
+        self._grpc_port = port
+        return port
+
+    def close(self) -> None:
+        self._shutdown.set()
+        self._life.stop()
+        if self._server:
+            self._server.stop(grace=0.2)
+        if self._loop:
+            self._loop.join(timeout=2)
+        self._pool.close()
+
+    def load_program(self, source: str) -> None:
+        """Parse + install a program (LoadProgram, program.go:178-193); a
+        parse error leaves the old program in place."""
+        tokens, label_map = parse(source)
+        with self._state_lock:
+            self._asm = tokens
+            self._label_map = label_map
+
+    def run_cmd(self) -> None:
+        if self._life.start():
+            log.info("node was run")
+        else:
+            log.info("node is already running")
+
+    def pause_cmd(self) -> None:
+        if self._life.stop():
+            log.info("node was paused")
+        else:
+            log.info("node is already paused")
+
+    def reset_cmd(self) -> None:
+        self._life.stop()
+        self._reset_state()
+        log.info("node was reset")
+
+    def _reset_state(self) -> None:
+        """resetNode (program.go:207-216): zero registers, fresh ports."""
+        with self._state_lock:
+            self.acc = 0
+            self.bak = 0
+            self.ptr = 0
+            self._hold = None
+            self._ports = [queue.Queue(maxsize=1) for _ in range(4)]
+
+    # --- the interpreter loop ----------------------------------------------
+
+    def _run_loop(self) -> None:
+        """Free-running execute loop (program.go:78-92): on error, log and
+        retry the same instruction (ptr not advanced)."""
+        while not self._shutdown.is_set():
+            gen = self._life.gen
+            if not self._life.is_running:
+                self._life.wait_for_run()
+                continue
+            try:
+                # _state_lock serializes each instruction's commit against
+                # pause/reset/load state mutation: a reset arriving while an
+                # RPC response is in flight must zero state strictly AFTER
+                # the instruction finishes, or the commit would clobber the
+                # fresh ptr/acc (observed: OUT completing against a reset
+                # left ptr=1, making the lane skip its IN on re-run).
+                with self._state_lock:
+                    self._life.check(gen)  # stop raced the lock acquisition
+                    self._update(gen)
+            except NodeCancelled:
+                continue
+            except TISParseError as e:  # unreachable post-load; defensive
+                log.warning("program error: %s", e)
+            except rpc.RpcError as e:
+                # Reference log.Fatalf's here (quirk #8); retry instead.
+                log.warning("rpc error (will retry): %s", e)
+                self._shutdown.wait(_POLL)
+
+    def _update(self, gen: int) -> None:
+        """One instruction (update(), program.go:219-432).  Taken jumps set
+        ptr and return; everything else falls through to the wrap increment
+        `ptr = (ptr+1) % len(asm)` (program.go:429)."""
+        # One consistent view of the program for this instruction: a /load
+        # swapping self._asm mid-step must not skew the fetch or the wrap.
+        asm = self._asm
+        self.ptr %= len(asm)
+        tokens = asm[self.ptr]
+        kind = tokens[0]
+
+        if kind == "NOP":
+            pass
+        elif kind == "SWP":
+            self.acc, self.bak = self.bak, self.acc
+        elif kind == "SAV":
+            self.bak = self.acc
+        elif kind == "NEG":
+            self.acc = -self.acc
+        elif kind == "MOV_VAL_LOCAL":
+            self._write_local(int(tokens[1]), tokens[2])
+        elif kind == "MOV_VAL_NETWORK":
+            self._send_value(int(tokens[1]), tokens[2], gen)
+        elif kind == "MOV_SRC_LOCAL":
+            self._write_local(self._get_from_src(tokens[1], gen), tokens[2])
+        elif kind == "MOV_SRC_NETWORK":
+            self._send_value(self._get_from_src(tokens[1], gen), tokens[2], gen)
+        elif kind in ("ADD_VAL", "SUB_VAL", "ADD_SRC", "SUB_SRC"):
+            v = int(tokens[1]) if kind.endswith("_VAL") else self._get_from_src(tokens[1], gen)
+            self.acc += v if kind.startswith("ADD") else -v
+        elif kind in ("JMP", "JEZ", "JNZ", "JGZ", "JLZ"):
+            taken = (
+                kind == "JMP"
+                or (kind == "JEZ" and self.acc == 0)
+                or (kind == "JNZ" and self.acc != 0)
+                or (kind == "JGZ" and self.acc > 0)
+                or (kind == "JLZ" and self.acc < 0)
+            )
+            if taken:
+                self.ptr = self._label_map[tokens[1]]
+                return  # taken jumps skip the wrap increment (program.go:319)
+        elif kind in ("JRO_VAL", "JRO_SRC"):
+            v = int(tokens[1]) if kind == "JRO_VAL" else self._get_from_src(tokens[1], gen)
+            self._hold = None  # committed (early return skips the shared clear)
+            self.ptr = max(0, min(self.ptr + v, len(asm) - 1))  # IntClamp (math.go:17)
+            return
+        elif kind in ("PUSH_VAL", "PUSH_SRC"):
+            v = int(tokens[1]) if kind == "PUSH_VAL" else self._get_from_src(tokens[1], gen)
+            client = self._pool.get(rpc.StackClient, tokens[2])
+            _await_future(client._Push.future(pb.ValueMessage(value=rpc._i32(v))), self._life, gen)
+        elif kind == "POP":
+            client = self._pool.get(rpc.StackClient, tokens[1])
+            v = _await_future(client._Pop.future(_EMPTY()), self._life, gen).value
+            self._write_local(int(v), tokens[2])
+        elif kind == "IN":
+            client = self._pool.get(rpc.MasterClient, self._master_uri)
+            v = _await_future(client._GetInput.future(_EMPTY()), self._life, gen).value
+            self._write_local(int(v), tokens[1])
+        elif kind in ("OUT_VAL", "OUT_SRC"):
+            v = int(tokens[1]) if kind == "OUT_VAL" else self._get_from_src(tokens[1], gen)
+            client = self._pool.get(rpc.MasterClient, self._master_uri)
+            _await_future(
+                client._SendOutput.future(pb.ValueMessage(value=rpc._i32(v))), self._life, gen
+            )
+
+        self._hold = None  # instruction committed: release the port latch
+        self.ptr = (self.ptr + 1) % len(asm)
+
+    def _write_local(self, v: int, dst: str) -> None:
+        """ACC stores, NIL discards (program.go:237-239)."""
+        if dst == "ACC":
+            self.acc = v
+
+    def _get_from_src(self, src: str, gen: int) -> int:
+        """getFromSrc (program.go:435-472): ACC/NIL immediate; ports block
+        until a peer's Send lands, cancellable by pause/reset.  A port value
+        is latched into self._hold so the instruction can retry (rpc error,
+        pause) without losing it; _update clears the latch on commit."""
+        if src == "ACC":
+            return self.acc
+        if src == "NIL":
+            return 0
+        if self._hold is not None:
+            return self._hold
+        q = self._ports[int(src[1])]
+        while True:
+            try:
+                v = q.get(timeout=_POLL)
+                self._hold = v
+                return v
+            except queue.Empty:
+                self._life.check(gen)
+
+    def _send_value(self, v: int, target: str, gen: int) -> None:
+        """MOV to `name:Rk` — the Send RPC (sendValue, program.go:475-506).
+        Blocks while the remote port is full (back-pressure via the
+        blocking handler, program.go:160-175)."""
+        name, port = target.rsplit(":", 1)
+        client = self._pool.get(rpc.ProgramClient, name)
+        fut = client._Send.future(
+            pb.SendMessage(value=rpc._i32(v), register=int(port[1]))
+        )
+        _await_future(fut, self._life, gen)
+
+    # --- inbound Send (the gRPC handler side) -------------------------------
+
+    def deliver(self, value: int, register: int, context) -> None:
+        """Blocking delivery into a cap-1 port (Send handler, program.go:160-175).
+        Re-reads self._ports each poll so a reset (fresh queues) receives the
+        value instead of stranding it in an orphaned buffer (the reference
+        blocks forever on the old channel — strictly better)."""
+        if not 0 <= register <= 3:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "not a valid register")
+        while context.is_active():
+            try:
+                self._ports[register].put(int(value), timeout=_POLL)
+                return
+            except queue.Full:
+                continue
+        raise NodeCancelled()  # caller went away; nothing to do
+
+
+class _ProgramServicer:
+    """gRPC Program service handlers (program.go:111-175)."""
+
+    def __init__(self, node: ProgramNodeProcess):
+        self._node = node
+
+    def run(self, request, context):
+        self._node.run_cmd()
+        return _EMPTY()
+
+    def pause(self, request, context):
+        self._node.pause_cmd()
+        return _EMPTY()
+
+    def reset(self, request, context):
+        self._node.reset_cmd()
+        return _EMPTY()
+
+    def load(self, request, context):
+        """Reset then load (Load handler, program.go:150-157); parse errors
+        become INVALID_ARGUMENT and leave the old program."""
+        self._node.reset_cmd()
+        try:
+            self._node.load_program(request.program)
+        except TISParseError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return _EMPTY()
+
+    def send(self, request, context):
+        self._node.deliver(request.value, request.register, context)
+        log.debug("received value")
+        return _EMPTY()
+
+
+class StackNodeProcess:
+    """Shared LIFO storage process (StackNode, stack.go:17-155).
+
+    The IntStack's empty-check races (quirk #5) and the cancelled-pop
+    goroutine leak (quirk #4) are fixed by a single Condition guarding the
+    list; pop waits on it and re-checks both emptiness and generation.
+    """
+
+    def __init__(
+        self,
+        cert_file: str | None = None,
+        key_file: str | None = None,
+        grpc_port: int = rpc.GRPC_PORT,
+        host: str = "0.0.0.0",
+    ):
+        self._cert, self._key = cert_file, key_file
+        self._grpc_port = grpc_port
+        self._host = host
+        self._life = _Lifecycle()
+        self._cond = threading.Condition()
+        self._stack: list[int] = []
+        self._server: grpc.Server | None = None
+
+    def start(self) -> int:
+        self._server, port = rpc.make_server(
+            {"Stack": _StackServicer(self)},
+            self._grpc_port,
+            self._cert,
+            self._key,
+            host=self._host,
+        )
+        self._server.start()
+        log.info("stack node serving grpc on :%d", port)
+        self._grpc_port = port
+        return port
+
+    def close(self) -> None:
+        self._life.stop()
+        with self._cond:
+            self._cond.notify_all()
+        if self._server:
+            self._server.stop(grace=0.2)
+
+    def push(self, value: int) -> None:
+        with self._cond:
+            self._stack.append(int(value))
+            self._cond.notify()
+
+    def pop_blocking(self, context) -> int:
+        """Blocks until a value exists (waitPop, stack.go:133-155); a
+        pause/reset cancels with the reference's error message."""
+        with self._cond:
+            gen = self._life.gen
+            while not self._stack:
+                if self._life.cancelled(gen) or not context.is_active():
+                    context.abort(grpc.StatusCode.CANCELLED, "stack pop cancelled")
+                self._cond.wait(_POLL)
+            return self._stack.pop()
+
+    def clear(self) -> None:
+        with self._cond:
+            self._stack.clear()
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._stack)
+
+
+class _StackServicer:
+    """gRPC Stack service handlers (stack.go:63-114)."""
+
+    def __init__(self, node: StackNodeProcess):
+        self._node = node
+
+    def run(self, request, context):
+        if self._node._life.start():
+            log.info("node was run")
+        else:
+            log.info("node is already running")
+        return _EMPTY()
+
+    def pause(self, request, context):
+        if self._node._life.stop():
+            log.info("node was paused")
+        else:
+            log.info("node is already paused")
+        with self._node._cond:
+            self._node._cond.notify_all()
+        return _EMPTY()
+
+    def reset(self, request, context):
+        self._node._life.stop()
+        self._node.clear()
+        with self._node._cond:
+            self._node._cond.notify_all()
+        log.info("node was reset")
+        return _EMPTY()
+
+    def push(self, request, context):
+        self._node.push(request.value)
+        return _EMPTY()
+
+    def pop(self, request, context):
+        return pb.ValueMessage(value=rpc._i32(self._node.pop_blocking(context)))
+
+
+class MasterNodeProcess:
+    """Distributed control plane (MasterNode, master.go:29-351): HTTP routes
+    served via runtime.master.make_http_server (duck-typed), command fan-out
+    over gRPC, and the Master data-plane service for program nodes' IN/OUT.
+    """
+
+    def __init__(
+        self,
+        node_info: dict[str, dict],
+        resolver: Resolver | None = None,
+        cert_file: str | None = None,
+        key_file: str | None = None,
+        grpc_port: int = rpc.GRPC_PORT,
+        host: str = "0.0.0.0",
+    ):
+        self.node_info = dict(node_info)
+        self._resolver = resolver or Resolver()
+        self._cert, self._key = cert_file, key_file
+        self._grpc_port = grpc_port
+        self._host = host
+        self._pool = _ClientPool(self._resolver, cert_file)
+        self._life = _Lifecycle()
+        # The reference uses cap-1 chans (master.go:58-59); unbounded deques
+        # here only relax producer blocking, pairing is what matters.  A
+        # Condition (not queue.Queue) so GetInput can re-check cancellation
+        # immediately before every dequeue: a handler orphaned by reset must
+        # not wake from a stale blocking get holding a fresh epoch's value.
+        self._io_cond = threading.Condition()
+        self._in_q: "deque[int]" = deque()
+        self._out_q: "deque[int]" = deque()
+        self._compute_lock = threading.Lock()
+        self._stale_outputs = 0
+        self._server: grpc.Server | None = None
+
+    def start(self) -> int:
+        self._server, port = rpc.make_server(
+            {"Master": _MasterServicer(self)},
+            self._grpc_port,
+            self._cert,
+            self._key,
+            host=self._host,
+        )
+        self._server.start()
+        log.info("master serving grpc on :%d", port)
+        self._grpc_port = port
+        return port
+
+    def close(self) -> None:
+        self._life.stop()
+        if self._server:
+            self._server.stop(grace=0.2)
+        self._pool.close()
+
+    # --- command broadcast (master.go:269-351) ------------------------------
+
+    def _broadcast(self, command: str) -> None:
+        """Concurrent fan-out, one thread per node; any error fails the whole
+        broadcast (master.go:271-294)."""
+        errors: list[Exception] = []
+        lock = threading.Lock()
+
+        def call(name: str, info: dict) -> None:
+            try:
+                cls = rpc.StackClient if info.get("type") == "stack" else rpc.ProgramClient
+                client = self._pool.get(cls, name)
+                getattr(client, command)(timeout=10)
+            except Exception as e:  # noqa: BLE001 — collected, not swallowed
+                with lock:
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=call, args=(name, info))
+            for name, info in self.node_info.items()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise BroadcastError(str(errors[0]))
+
+    # --- the HTTP-facing surface (duck-typed for make_http_server) ----------
+
+    def run(self) -> None:
+        self._life.start()  # isRunning=true before fan-out (master.go:93)
+        self._broadcast("run")
+        log.info("network was run")
+
+    def pause(self) -> None:
+        self._broadcast("pause")
+        self._life.stop()
+        log.info("network was paused")
+
+    def reset(self) -> None:
+        self._broadcast("reset")
+        self._life.stop()
+        self._drain_queues()
+        log.info("network was reset")
+
+    def load(self, target: str, program: str) -> None:
+        """Validate target, reset network, Load the target node
+        (master.go:145-195) — at the node's real gRPC port (fixes quirk #1)."""
+        if target not in self.node_info:
+            from misaka_tpu.runtime.topology import TopologyError
+
+            raise TopologyError(f"node {target} not valid on this network")
+        self._broadcast("reset")
+        self._life.stop()
+        self._drain_queues()
+        client = self._pool.get(rpc.ProgramClient, target)
+        try:
+            client.load(program, timeout=10)
+        except grpc.RpcError as e:
+            raise BroadcastError(e.details() or str(e))
+
+    def compute(self, value: int, timeout: float = 30.0) -> int:
+        """One value in, one out, correlated (fixes quirk #2 — the reference
+        pairs whatever output arrives first, master.go:216-219)."""
+        import time
+
+        with self._compute_lock:
+            deadline = time.monotonic() + timeout
+            with self._io_cond:
+                self._in_q.append(int(value))
+                self._io_cond.notify_all()
+                while True:
+                    while not self._out_q:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            self._stale_outputs += 1
+                            raise ComputeTimeout(
+                                f"no output for value {value} after {timeout}s"
+                            )
+                        self._io_cond.wait(remaining)
+                    out = self._out_q.popleft()
+                    if self._stale_outputs:
+                        self._stale_outputs -= 1
+                        continue
+                    return out
+
+    @property
+    def is_running(self) -> bool:
+        return self._life.is_running
+
+    def status(self) -> dict:
+        with self._io_cond:
+            in_depth, out_depth = len(self._in_q), len(self._out_q)
+        return {
+            "running": self._life.is_running,
+            "mode": "distributed",
+            "nodes": dict(self.node_info),
+            "in_queue": in_depth,
+            "out_queue": out_depth,
+        }
+
+    def _drain_queues(self) -> None:
+        with self._io_cond:
+            self._in_q.clear()
+            self._out_q.clear()
+            self._stale_outputs = 0
+
+    # --- data plane (Master service, master.go:233-249) ---------------------
+
+    def get_input_blocking(self, context) -> int:
+        """Blocks until a client value exists (GetInput, master.go:233-242).
+
+        The cancellation checks sit immediately before the dequeue: a handler
+        whose caller was reset away aborts without consuming a fresh epoch's
+        value.  (The reference can lose an input here the same way its
+        cancelled stack Pop loses a push, quirk #4.)
+        """
+        with self._io_cond:
+            gen = self._life.gen
+            while True:
+                if self._life.cancelled(gen) or not context.is_active():
+                    context.abort(grpc.StatusCode.CANCELLED, "main input cancelled")
+                if self._in_q:
+                    return self._in_q.popleft()
+                self._io_cond.wait(_POLL)
+
+    def send_output(self, value: int) -> None:
+        with self._io_cond:
+            self._out_q.append(int(value))
+            self._io_cond.notify_all()
+
+
+class _MasterServicer:
+    def __init__(self, node: MasterNodeProcess):
+        self._node = node
+
+    def get_input(self, request, context):
+        return pb.ValueMessage(value=rpc._i32(self._node.get_input_blocking(context)))
+
+    def send_output(self, request, context):
+        self._node.send_output(request.value)
+        return _EMPTY()

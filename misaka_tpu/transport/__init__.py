@@ -1,0 +1,28 @@
+"""gRPC transport for the per-process compatibility mode.
+
+Wire-compatible with the reference's internal/grpc/ package (same proto
+package, services, and messages — see messenger.proto).  The fused TPU
+engine does not use RPC at all; this package exists so a misaka_tpu
+deployment can span OS processes/hosts exactly like the reference's
+docker-compose topology, interoperating with original Go nodes.
+"""
+
+from misaka_tpu.transport.rpc import (
+    MasterClient,
+    ProgramClient,
+    StackClient,
+    RpcError,
+    channel_credentials,
+    server_credentials,
+    make_server,
+)
+
+__all__ = [
+    "MasterClient",
+    "ProgramClient",
+    "StackClient",
+    "RpcError",
+    "channel_credentials",
+    "server_credentials",
+    "make_server",
+]

@@ -1,0 +1,369 @@
+"""Per-process compatibility mode: gRPC nodes on localhost, end to end.
+
+Covers SURVEY.md §2 C7 (the transport) and the drop-in deployment story: a
+network of OS-process nodes speaking the reference's wire protocol
+(messenger.proto services /grpc.Master /grpc.Program /grpc.Stack), driven
+through the same HTTP surface.  The reference can only test this with a
+4-container docker-compose cluster (SURVEY.md §4); here the nodes bind
+ephemeral loopback ports in one process.
+"""
+
+import threading
+import time
+import urllib.request
+import urllib.parse
+
+import pytest
+
+from misaka_tpu.runtime.nodes import (
+    BroadcastError,
+    MasterNodeProcess,
+    ProgramNodeProcess,
+    Resolver,
+    StackNodeProcess,
+)
+from misaka_tpu.transport import ProgramClient, StackClient, RpcError
+
+# The docker-compose add-2 programs (docker-compose.yml:35-40,:54-59).
+MISAKA1 = "IN ACC\nADD 1\nMOV ACC, misaka2:R0\nMOV R0, ACC\nOUT ACC"
+MISAKA2 = "MOV R0, ACC\nADD 1\nPUSH ACC, misaka3\nPOP misaka3, ACC\nMOV ACC, misaka1:R0"
+
+
+@pytest.fixture
+def add2_cluster():
+    """master + 2 program nodes + 1 stack node on loopback ephemeral ports."""
+    resolver = Resolver()
+    nodes = {}
+
+    stack = StackNodeProcess(grpc_port=0, host="127.0.0.1")
+    resolver.set_addr("misaka3", f"127.0.0.1:{stack.start()}")
+    nodes["misaka3"] = stack
+
+    for name, program in (("misaka1", MISAKA1), ("misaka2", MISAKA2)):
+        p = ProgramNodeProcess(
+            master_uri="last_order", resolver=resolver, grpc_port=0, host="127.0.0.1"
+        )
+        p.load_program(program)
+        resolver.set_addr(name, f"127.0.0.1:{p.start()}")
+        nodes[name] = p
+
+    master = MasterNodeProcess(
+        node_info={
+            "misaka1": {"type": "program"},
+            "misaka2": {"type": "program"},
+            "misaka3": {"type": "stack"},
+        },
+        resolver=resolver,
+        grpc_port=0,
+        host="127.0.0.1",
+    )
+    resolver.set_addr("last_order", f"127.0.0.1:{master.start()}")
+
+    yield master, nodes
+    master.close()
+    for n in nodes.values():
+        n.close()
+
+
+def test_add2_end_to_end(add2_cluster):
+    master, _ = add2_cluster
+    master.run()
+    assert master.is_running
+    for v in (5, -3, 1000, 0):
+        assert master.compute(v, timeout=10) == v + 2
+
+
+def test_add2_pause_resume(add2_cluster):
+    master, nodes = add2_cluster
+    master.run()
+    assert master.compute(1, timeout=10) == 3
+    master.pause()
+    assert not master.is_running
+    assert not nodes["misaka1"]._life.is_running
+    master.run()
+    assert master.compute(7, timeout=10) == 9
+
+
+def test_reset_clears_state(add2_cluster):
+    master, nodes = add2_cluster
+    master.run()
+    assert master.compute(2, timeout=10) == 4
+    master.reset()
+    assert nodes["misaka1"].acc == 0
+    assert nodes["misaka3"].depth == 0
+    master.run()
+    assert master.compute(10, timeout=10) == 12
+
+
+def test_load_reprograms_target(add2_cluster):
+    """The /load path — which the reference cannot actually perform (it dials
+    port 8000 where no node listens, quirk #1, master.go:178)."""
+    master, _ = add2_cluster
+    master.run()
+    assert master.compute(1, timeout=10) == 3
+    # Make misaka2 add 10 instead of 1.
+    master.load(
+        "misaka2",
+        "MOV R0, ACC\nADD 10\nPUSH ACC, misaka3\nPOP misaka3, ACC\nMOV ACC, misaka1:R0",
+    )
+    master.run()
+    assert master.compute(1, timeout=10) == 12
+
+
+def test_load_rejects_unknown_node(add2_cluster):
+    from misaka_tpu.runtime.topology import TopologyError
+
+    master, _ = add2_cluster
+    with pytest.raises(TopologyError, match="not valid on this network"):
+        master.load("nobody", "NOP")
+
+
+def test_load_bad_program_surfaces_error(add2_cluster):
+    master, _ = add2_cluster
+    with pytest.raises(BroadcastError, match="not a valid instruction"):
+        master.load("misaka1", "FROB 3")
+
+
+def test_http_surface(add2_cluster):
+    """The reference's curl workflow (README.md:50-80) against the
+    distributed master, byte-for-byte."""
+    from misaka_tpu.runtime.master import make_http_server
+
+    master, _ = add2_cluster
+    server = make_http_server(master, 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        def post(path, data=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=urllib.parse.urlencode(data or {}).encode(),
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=15) as resp:
+                    return resp.status, resp.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        assert post("/run") == (200, "Success")
+        status, body = post("/compute", {"value": 40})
+        assert status == 200 and '"value": 42' in body
+        assert post("/pause") == (200, "Success")
+        assert post("/reset") == (200, "Success")
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_port_backpressure():
+    """Send blocks while the cap-1 port is full (program.go:160-175): the
+    second send must not complete until the program consumes the first."""
+    p = ProgramNodeProcess(master_uri="x", grpc_port=0, host="127.0.0.1")
+    port = p.start()
+    try:
+        with ProgramClient(f"127.0.0.1:{port}") as client:
+            client.send(1, 0, timeout=5)  # fills r0
+            fut = client.send_future(2, 0)  # must block: port full
+            time.sleep(0.3)
+            assert not fut.done()
+            # Consume r0 twice; the blocked send should then land.
+            p.load_program("MOV R0, ACC")
+            p.run_cmd()
+            fut.result(timeout=5)
+            deadline = time.time() + 5
+            while p.acc != 2 and time.time() < deadline:
+                time.sleep(0.02)
+            assert p.acc == 2
+    finally:
+        p.close()
+
+
+def test_send_invalid_register_rejected():
+    p = ProgramNodeProcess(master_uri="x", grpc_port=0, host="127.0.0.1")
+    port = p.start()
+    try:
+        with ProgramClient(f"127.0.0.1:{port}") as client:
+            with pytest.raises(RpcError, match="not a valid register"):
+                client.send(1, 7, timeout=5)
+    finally:
+        p.close()
+
+
+def test_stack_pop_blocks_until_push():
+    s = StackNodeProcess(grpc_port=0, host="127.0.0.1")
+    port = s.start()
+    try:
+        with StackClient(f"127.0.0.1:{port}") as client:
+            fut = client.pop_future()
+            time.sleep(0.2)
+            assert not fut.done()
+            client.push(42, timeout=5)
+            assert fut.result(timeout=5).value == 42
+            # LIFO order.
+            client.push(1, timeout=5)
+            client.push(2, timeout=5)
+            assert client.pop(timeout=5) == 2
+            assert client.pop(timeout=5) == 1
+    finally:
+        s.close()
+
+
+def test_stack_pop_cancelled_by_reset():
+    """A reset cancels a blocked Pop with the reference's error message
+    (stack.go:150-153) — and, unlike the reference (quirk #4), no leaked
+    consumer swallows the next pushed value."""
+    s = StackNodeProcess(grpc_port=0, host="127.0.0.1")
+    port = s.start()
+    try:
+        with StackClient(f"127.0.0.1:{port}") as client:
+            fut = client.pop_future()
+            time.sleep(0.2)
+            client.reset(timeout=5)
+            with pytest.raises(Exception, match="stack pop cancelled"):
+                fut.result(timeout=5)
+            # The next push+pop pair works: nothing swallowed the value.
+            client.push(7, timeout=5)
+            assert client.pop(timeout=5) == 7
+    finally:
+        s.close()
+
+
+def test_int32_wire_truncation():
+    """Cross-node transfers truncate to sint32 exactly like the reference's
+    int32(v) casts (program.go:498, messenger.proto:34-41)."""
+    p = ProgramNodeProcess(master_uri="x", grpc_port=0, host="127.0.0.1")
+    port = p.start()
+    try:
+        with ProgramClient(f"127.0.0.1:{port}") as client:
+            client.send(2**31 + 5, 0, timeout=5)  # wraps to -2**31+5
+            p.load_program("MOV R0, ACC")
+            p.run_cmd()
+            deadline = time.time() + 5
+            while p.acc == 0 and time.time() < deadline:
+                time.sleep(0.02)
+            assert p.acc == -(2**31) + 5
+    finally:
+        p.close()
+
+
+def test_broadcast_error_on_dead_node():
+    """Any single node failure fails the whole broadcast (master.go:288-292)."""
+    resolver = Resolver()
+    resolver.set_addr("ghost", "127.0.0.1:1")  # nothing listens there
+    master = MasterNodeProcess(
+        node_info={"ghost": {"type": "program"}},
+        resolver=resolver,
+        grpc_port=0,
+        host="127.0.0.1",
+    )
+    master.start()
+    try:
+        with pytest.raises(BroadcastError):
+            master.run()
+    finally:
+        master.close()
+
+
+@pytest.fixture(scope="module")
+def tls_cert(tmp_path_factory):
+    """Self-signed cert with loopback SANs — the reference's `make cert`
+    openssl flow (Makefile:7-12, openssl/certificate.conf), loopback SANs
+    instead of compose hostnames."""
+    import subprocess
+
+    d = tmp_path_factory.mktemp("certs")
+    conf = d / "certificate.conf"
+    conf.write_text(
+        "[req]\ndefault_bits = 2048\nprompt = no\ndefault_md = sha256\n"
+        "req_extensions = req_ext\ndistinguished_name = dn\n"
+        "[dn]\nC = JP\nST = TOK\nL = Academy City\nO = SYSTEM\nOU = Level 6 Shift\n"
+        "CN = localhost\n"
+        "[req_ext]\nsubjectAltName = @alt_names\n"
+        "[alt_names]\nDNS.1 = localhost\nIP.1 = 127.0.0.1\n"
+    )
+    cert, key = d / "service.pem", d / "service.key"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-config", str(conf), "-extensions", "req_ext",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return str(cert), str(key)
+
+
+def test_tls_program_node_roundtrip(tls_cert):
+    """CERT_FILE/KEY_FILE TLS on the node server, the same cert as the
+    client's root CA (program.go:52-55, :98-101)."""
+    cert, key = tls_cert
+    p = ProgramNodeProcess(
+        master_uri="x", cert_file=cert, key_file=key, grpc_port=0, host="127.0.0.1"
+    )
+    port = p.start()
+    try:
+        with ProgramClient(f"127.0.0.1:{port}", cert_file=cert) as client:
+            client.send(11, 1, timeout=5)
+            p.load_program("MOV R1, ACC")
+            p.run_cmd()
+            deadline = time.time() + 5
+            while p.acc != 11 and time.time() < deadline:
+                time.sleep(0.02)
+            assert p.acc == 11
+    finally:
+        p.close()
+
+
+def test_tls_rejects_plaintext_client(tls_cert):
+    cert, key = tls_cert
+    s = StackNodeProcess(cert_file=cert, key_file=key, grpc_port=0, host="127.0.0.1")
+    port = s.start()
+    try:
+        with StackClient(f"127.0.0.1:{port}") as client:  # no cert: plaintext
+            with pytest.raises(RpcError):
+                client.push(1, timeout=3)
+    finally:
+        s.close()
+
+
+def test_port_value_survives_rpc_retry():
+    """A consumed port value must survive a transient RPC failure: the hold
+    latch keeps it across retries (the reference would re-read the port and
+    silently lose it, program.go:80-92 + :435-472)."""
+    import socket
+
+    # Reserve a port, leave it dead for now.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+
+    resolver = Resolver()
+    resolver.set_addr("peer", f"127.0.0.1:{dead_port}")
+    p = ProgramNodeProcess(master_uri="x", resolver=resolver, grpc_port=0, host="127.0.0.1")
+    p.load_program("MOV R0, peer:R1")
+    port = p.start()
+    try:
+        with ProgramClient(f"127.0.0.1:{port}") as client:
+            client.send(123, 0, timeout=5)  # consumed into the hold latch
+        time.sleep(0.4)  # let the send fail against the dead peer at least once
+        p.run_cmd()
+        time.sleep(0.4)
+        assert p._hold == 123  # consumed, latched, not lost
+
+        peer = ProgramNodeProcess(
+            master_uri="x", grpc_port=dead_port, host="127.0.0.1"
+        )
+        peer.start()
+        try:
+            deadline = time.time() + 10
+            while peer._ports[1].qsize() == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert peer._ports[1].get_nowait() == 123  # retry delivered it
+        finally:
+            peer.close()
+    finally:
+        p.close()
