@@ -3,5 +3,14 @@
 from misaka_tpu.core.state import NetworkState, init_state
 from misaka_tpu.core.step import step
 from misaka_tpu.core.engine import CompiledNetwork
+from misaka_tpu.core.trace import TraceRing, init_trace, traced_step
 
-__all__ = ["NetworkState", "init_state", "step", "CompiledNetwork"]
+__all__ = [
+    "NetworkState",
+    "init_state",
+    "step",
+    "CompiledNetwork",
+    "TraceRing",
+    "init_trace",
+    "traced_step",
+]

@@ -47,6 +47,14 @@ def _run_chunk_batched(tables, state: NetworkState, num_steps: int) -> NetworkSt
     return out
 
 
+@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(1, 2))
+def _run_chunk_traced(tables, state: NetworkState, trace, num_steps: int):
+    from misaka_tpu.core.trace import run_traced
+
+    code, prog_len = tables
+    return run_traced(code, prog_len, state, trace, num_steps)
+
+
 @jax.jit
 def _feed(state: NetworkState, values: jnp.ndarray, count: jnp.ndarray) -> NetworkState:
     """Append `count` leading entries of `values` to the input ring.
@@ -108,6 +116,20 @@ class CompiledNetwork:
         """Advance `num_steps` supersteps in one jitted scan (donated state)."""
         runner = _run_chunk if self.batch is None else _run_chunk_batched
         return runner(self._tables, state, num_steps)
+
+    def init_trace(self, cap: int = 256):
+        """Fresh per-lane trace ring (unbatched networks; the debug path)."""
+        from misaka_tpu.core.trace import init_trace
+
+        return init_trace(self.num_lanes, cap)
+
+    def run_traced(self, state: NetworkState, trace, num_steps: int):
+        """Like `run`, but records every lane's fetch/commit/acc into `trace`
+        (core/trace.py).  Unbatched networks only — tracing is the debug
+        path, not the throughput path."""
+        if self.batch is not None:
+            raise ValueError("run_traced drives a single network instance")
+        return _run_chunk_traced(self._tables, state, trace, num_steps)
 
     def fused_runner(
         self,

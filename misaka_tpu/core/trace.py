@@ -1,0 +1,164 @@
+"""Per-lane instruction trace ring buffer — device-resident execution history.
+
+The reference's only execution visibility is a stdout log line per instruction
+(program.go:222-223, marked "TODO: remove this") — unusable at TPU rates and
+gone the moment the scroll passes.  Here the equivalent is an HBM-resident
+ring (SURVEY.md §5 "optional per-lane instruction trace ring buffer"): each
+traced tick appends every lane's (pc, opcode, committed, acc-after) to a
+fixed-capacity ring entirely inside the jitted scan — zero host syncs while
+recording — and the host decodes it afterwards with the disassembler.
+
+This is the debug path, deliberately separate from the hot kernel: `step`
+stays trace-free, `traced_step` wraps it.  Recording costs one dynamic-slice
+store per tick; capacity is a compile-time constant.
+
+Layout: `buf[lane, slot, field]` with slot = tick % cap and four fields
+(TR_PC, TR_OP, TR_COMMIT, TR_ACC).  `wr` counts traced ticks; when wr > cap
+the ring has wrapped and only the last `cap` ticks survive.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.core.step import step
+from misaka_tpu.tis import isa
+from misaka_tpu.tis.disasm import disassemble_line
+
+_I32 = jnp.int32
+
+# Trace record fields.
+TR_PC = 0      # pc at fetch
+TR_OP = 1      # opcode fetched
+TR_COMMIT = 2  # 1 if the instruction committed, 0 if the lane parked
+TR_ACC = 3     # acc AFTER the tick (the committed result)
+TR_NFIELDS = 4
+
+
+class TraceRing(NamedTuple):
+    """Device-resident execution history for one network instance."""
+
+    # wr is uint32 (not int32): a long-soak traced master passes 2^31 ticks in
+    # hours, and a signed wrap would make `wr % cap` negative and decode_trace
+    # silently empty.  Unsigned, the counter stays index-safe and merely
+    # restarts its tick labels every 2^32 ticks (int64 needs jax_enable_x64).
+    buf: jnp.ndarray  # [N, CAP, TR_NFIELDS] int32
+    wr: jnp.ndarray   # uint32 scalar — traced ticks so far (slot = wr % CAP)
+
+
+def init_trace(num_lanes: int, cap: int = 256) -> TraceRing:
+    return TraceRing(
+        buf=jnp.zeros((num_lanes, cap, TR_NFIELDS), np.int32),
+        wr=jnp.zeros((), np.uint32),
+    )
+
+
+def traced_step(
+    code: jnp.ndarray,
+    prog_len: jnp.ndarray,
+    state: NetworkState,
+    trace: TraceRing,
+) -> tuple[NetworkState, TraceRing]:
+    """One superstep + one trace record per lane (identical state semantics)."""
+    n_lanes = code.shape[0]
+    lane = jnp.arange(n_lanes)
+    pc_before = state.pc
+    op = code[lane, pc_before, isa.F_OP]
+
+    new_state = step(code, prog_len, state)
+    committed = new_state.retired - state.retired  # [N] 0/1
+
+    record = jnp.stack([pc_before, op, committed, new_state.acc], axis=-1)  # [N, 4]
+    cap = trace.buf.shape[1]
+    slot = trace.wr % cap
+    new_buf = trace.buf.at[:, slot, :].set(record)
+    return new_state, TraceRing(buf=new_buf, wr=trace.wr + 1)
+
+
+def run_traced(
+    code: jnp.ndarray,
+    prog_len: jnp.ndarray,
+    state: NetworkState,
+    trace: TraceRing,
+    num_steps: int,
+) -> tuple[NetworkState, TraceRing]:
+    """`num_steps` traced supersteps under one lax.scan (jit-friendly)."""
+    import jax
+
+    def body(carry, _):
+        s, t = carry
+        return traced_step(code, prog_len, s, t), None
+
+    (state, trace), _ = jax.lax.scan(body, (state, trace), None, length=num_steps)
+    return state, trace
+
+
+def decode_trace(
+    trace: TraceRing,
+    code: np.ndarray,
+    prog_len: np.ndarray,
+    lane_names: Sequence[str] | None = None,
+    stack_names: Sequence[str] | None = None,
+    last: int | None = None,
+) -> list[dict]:
+    """Host-side decode: the ring as a list of per-tick dicts, oldest first.
+
+    Each entry: {"tick", "lane", "name", "pc", "op", "committed", "acc",
+    "text"} where `text` is the disassembled instruction the lane executed
+    (or retried, if parked).
+    """
+    buf = np.asarray(trace.buf)
+    wr = int(trace.wr)
+    n_lanes, cap, _ = buf.shape
+    code = np.asarray(code)
+    lane_names = list(lane_names) if lane_names else [f"node{i}" for i in range(n_lanes)]
+    if not stack_names:
+        max_tgt = int(code[..., isa.F_TGT].max(initial=0))
+        stack_names = [f"stack{i}" for i in range(max_tgt + 1)]
+    else:
+        stack_names = list(stack_names)
+
+    n_avail = min(wr, cap)
+    if last is not None:
+        n_avail = min(n_avail, last)
+    first_tick = wr - n_avail
+
+    out = []
+    for tick in range(first_tick, wr):
+        slot = tick % cap
+        for lane in range(n_lanes):
+            pc, op, committed, acc = (int(v) for v in buf[lane, slot])
+            pc_clipped = min(pc, code.shape[1] - 1)
+            try:
+                text = disassemble_line(code[lane, pc_clipped], lane_names, stack_names)
+            except Exception:  # malformed row (e.g. trace older than a /load)
+                text = f"<op {op}>"
+            out.append(
+                {
+                    "tick": tick,
+                    "lane": lane,
+                    "name": lane_names[lane],
+                    "pc": pc,
+                    "op": isa.OP_NAMES.get(op, str(op)),
+                    "committed": bool(committed),
+                    "acc": acc,
+                    "text": text,
+                }
+            )
+    return out
+
+
+def format_trace(entries: list[dict]) -> str:
+    """Render decoded entries as an aligned text listing (debugger output)."""
+    lines = []
+    for e in entries:
+        mark = " " if e["committed"] else "*"  # * = parked/retry
+        lines.append(
+            f"t={e['tick']:>6} {e['name']:>10} pc={e['pc']:>3}{mark} "
+            f"acc={e['acc']:>11} | {e['text']}"
+        )
+    return "\n".join(lines)

@@ -26,7 +26,7 @@ import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -53,11 +53,21 @@ class BroadcastError(RuntimeError):
 class MasterNode:
     """Control plane + I/O gateway for one fused network."""
 
-    def __init__(self, topology: Topology, chunk_steps: int = 128):
+    def __init__(
+        self,
+        topology: Topology,
+        chunk_steps: int = 128,
+        trace_cap: int | None = None,
+    ):
         self._topology = topology
         self._chunk = chunk_steps
         self._net = topology.compile()
         self._state = self._net.init_state()
+        # Optional per-lane instruction trace ring (core/trace.py).  The debug
+        # path: every tick of every lane is recorded device-side and decoded
+        # on demand via self.trace() / GET /trace.
+        self._trace_cap = trace_cap
+        self._trace = self._net.init_trace(trace_cap) if trace_cap else None
         self._running = False
         self._loop: threading.Thread | None = None
         self._state_lock = threading.Lock()      # guards _state/_net swaps
@@ -104,6 +114,8 @@ class MasterNode:
             self.pause()
             with self._state_lock:
                 self._state = self._net.init_state()
+                if self._trace_cap:
+                    self._trace = self._net.init_trace(self._trace_cap)
             self._drain_queues()
             log.info("network was reset")
 
@@ -130,6 +142,8 @@ class MasterNode:
                 self._topology = new_topology
                 self._net = new_net
                 self._state = new_net.init_state()
+                if self._trace_cap:
+                    self._trace = new_net.init_trace(self._trace_cap)
             self._drain_queues()
             log.info("successfully loaded program")
 
@@ -192,6 +206,32 @@ class MasterNode:
             "nodes": dict(topo.node_info),
         }
 
+    def trace(self, last: int | None = None) -> list[dict]:
+        """Decoded instruction history, oldest first (requires trace_cap).
+
+        Buffers are materialized under the state lock — the device loop
+        donates the trace ring into each traced chunk.
+        """
+        from misaka_tpu.core.trace import TraceRing, decode_trace
+
+        if self._trace is None:
+            raise RuntimeError("tracing disabled (construct MasterNode with trace_cap)")
+        with self._state_lock:
+            ring = TraceRing(
+                buf=np.asarray(self._trace.buf).copy(),
+                wr=np.asarray(self._trace.wr).copy(),
+            )
+            net = self._net
+            topo = self._topology
+        return decode_trace(
+            ring,
+            net.code,
+            net.prog_len,
+            lane_names=list(topo.lane_ids()),
+            stack_names=list(topo.stack_ids()),
+            last=last,
+        )
+
     def save_checkpoint(self, path: str) -> None:
         """Whole-network state + topology to one .npz (SURVEY.md §5: the
         reference cannot checkpoint at all; here state is one pytree).
@@ -246,6 +286,8 @@ class MasterNode:
                 self._topology = new_topology
                 self._net = new_net
                 self._state = state
+                if self._trace_cap:
+                    self._trace = new_net.init_trace(self._trace_cap)
             self._drain_queues()
         log.info("checkpoint restored from %s", path)
 
@@ -302,7 +344,12 @@ class MasterNode:
                 if pending:
                     state, _ = self._net.feed(state, pending)
                     busy = True
-                state = self._net.run(state, self._chunk)
+                if self._trace is not None:
+                    state, self._trace = self._net.run_traced(
+                        state, self._trace, self._chunk
+                    )
+                else:
+                    state = self._net.run(state, self._chunk)
                 self._ticks_done += self._chunk
                 now = time.monotonic()
                 if now - self._rate_mark_time > 2:
@@ -324,10 +371,13 @@ class MasterNode:
 
 
 def make_http_server(
-    master: MasterNode, port: int = 8000, checkpoint_dir: str | None = None
+    master: MasterNode,
+    port: int = 8000,
+    checkpoint_dir: str | None = None,
+    profile_dir: str | None = None,
 ) -> ThreadingHTTPServer:
     """The five client routes (master.go:90-224), byte-compatible, plus the
-    additive /status, /checkpoint, /restore routes.
+    additive /status, /trace, /checkpoint, /restore, /profile/* routes.
 
     HTTP checkpointing is DISABLED unless `checkpoint_dir` is configured;
     when enabled, clients pass a bare checkpoint NAME (no path separators)
@@ -340,7 +390,10 @@ def make_http_server(
     import re
     import zipfile
 
+    from misaka_tpu.utils.profiling import Profiler, ProfilerError
+
     _name_re = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+    profiler = Profiler()
 
     def resolve_checkpoint(name: str) -> str | None:
         if not checkpoint_dir or not _name_re.match(name) or ".." in name:
@@ -366,18 +419,48 @@ def make_http_server(
             raw = self.rfile.read(length).decode()
             return {k: v[0] for k, v in parse_qs(raw, keep_blank_values=True).items()}
 
+        def _json(self, obj) -> None:
+            data = (json.dumps(obj) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
-            # /status is additive; the reference's routes reject GET
-            # ("method GET not allowed", master.go:104).
-            if self.path == "/status":
-                data = (json.dumps(master.status()) + "\n").encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-                return
-            self._text(405, "method GET not allowed")
+            # /status and /trace are additive; the reference's routes reject
+            # GET ("method GET not allowed", master.go:104).
+            try:
+                parsed = urlparse(self.path)
+                if parsed.path == "/status":
+                    self._json(master.status())
+                    return
+                if parsed.path == "/trace":
+                    if not hasattr(master, "trace"):
+                        # the distributed control plane (runtime/nodes.py)
+                        # has no fused trace ring
+                        self._text(404, "not found")
+                        return
+                    q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                    try:
+                        last = int(q["last"]) if "last" in q else None
+                    except ValueError:
+                        self._text(400, "cannot parse last")
+                        return
+                    try:
+                        entries = master.trace(last=last)
+                    except RuntimeError as e:
+                        self._text(403, str(e))
+                        return
+                    self._json({"entries": entries})
+                    return
+                self._text(405, "method GET not allowed")
+            except Exception as e:  # defensive: a handler crash must not kill the server
+                log.exception("handler error")
+                try:
+                    self._text(500, f"internal error: {e}")
+                except Exception:
+                    pass
 
         def do_POST(self):
             try:
@@ -433,12 +516,7 @@ def make_http_server(
                     except ComputeTimeout as e:
                         self._text(500, str(e))
                         return
-                    data = (json.dumps({"value": result}) + "\n").encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
+                    self._json({"value": result})
                 elif self.path == "/checkpoint":
                     # additive routes: the reference cannot checkpoint
                     if not checkpoint_dir:
@@ -467,6 +545,33 @@ def make_http_server(
                         self._text(400, f"error restoring checkpoint: {e}")
                         return
                     self._text(200, "Success")
+                elif self.path == "/profile/start":
+                    # additive: capture a jax.profiler trace of the live
+                    # device loop (SURVEY.md §5 — the reference has nothing)
+                    if not profile_dir:
+                        self._text(403, "profiling disabled (no profile_dir configured)")
+                        return
+                    name = self._form().get("name", "profile")
+                    if not _name_re.match(name) or ".." in name:
+                        self._text(400, "invalid profile name")
+                        return
+                    os.makedirs(profile_dir, exist_ok=True)
+                    try:
+                        profiler.start(os.path.join(profile_dir, name))
+                    except ProfilerError as e:
+                        self._text(409, str(e))
+                        return
+                    self._text(200, "Success")
+                elif self.path == "/profile/stop":
+                    if not profile_dir:
+                        self._text(403, "profiling disabled (no profile_dir configured)")
+                        return
+                    try:
+                        out = profiler.stop()
+                    except ProfilerError as e:
+                        self._text(409, str(e))
+                        return
+                    self._text(200, out)
                 else:
                     self._text(404, "not found")
             except Exception as e:  # defensive: a handler crash must not kill the server

@@ -1,0 +1,78 @@
+"""jax.profiler integration — device-level tracing for the fused engine.
+
+The reference's only "profiling" is wall-clock guessing over stdout logs
+(SURVEY.md §5: no tracing/profiling subsystem at all).  The TPU-native
+equivalent is XLA's own profiler: capture a trace around jitted chunks and
+inspect kernel timings, HBM traffic, and host↔device transfers in
+TensorBoard / Perfetto.
+
+Two surfaces:
+  * `capture(log_dir)` — context manager for scripts and benchmarks.
+  * `Profiler` — start/stop object used by the master's HTTP routes
+    (POST /profile/start, /profile/stop — runtime/master.py), so a live
+    network can be profiled without restarting it.
+
+Traces land in `log_dir/plugins/profile/<run>/` (TensorBoard layout, written
+by jax.profiler).  One capture at a time per process — JAX's profiler is a
+process-global singleton; Profiler enforces that with a lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+@contextmanager
+def capture(log_dir: str):
+    """Capture a jax.profiler trace of the enclosed block into `log_dir`."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfilerError(RuntimeError):
+    pass
+
+
+class Profiler:
+    """Process-wide start/stop profiler handle (one capture at a time)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active_dir: str | None = None
+
+    @property
+    def active_dir(self) -> str | None:
+        return self._active_dir
+
+    def start(self, log_dir: str) -> None:
+        import jax.profiler
+
+        with self._lock:
+            if self._active_dir is not None:
+                raise ProfilerError(
+                    f"profiler already capturing to {self._active_dir}"
+                )
+            jax.profiler.start_trace(log_dir)
+            self._active_dir = log_dir
+
+    def stop(self) -> str:
+        """Stop the capture; returns the directory the trace was written to.
+
+        The handle resyncs even when stop_trace fails mid-write (full disk,
+        unwritable dir): JAX's session is torn down either way, so keeping
+        _active_dir set would wedge start/stop with 409s until restart.
+        """
+        import jax.profiler
+
+        with self._lock:
+            if self._active_dir is None:
+                raise ProfilerError("profiler is not capturing")
+            out, self._active_dir = self._active_dir, None
+            jax.profiler.stop_trace()
+            return out

@@ -147,9 +147,22 @@ def test_http_surface(add2_cluster):
             except urllib.error.HTTPError as e:
                 return e.code, e.read().decode()
 
+        def get(path):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=15
+                ) as resp:
+                    return resp.status, resp.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
         assert post("/run") == (200, "Success")
         status, body = post("/compute", {"value": 40})
         assert status == 200 and '"value": 42' in body
+        # GET /trace must 404 cleanly: the distributed control plane has no
+        # fused trace ring (only the fused MasterNode does).
+        status, body = get("/trace")
+        assert (status, body) == (404, "not found")
         assert post("/pause") == (200, "Success")
         assert post("/reset") == (200, "Success")
     finally:

@@ -1,0 +1,93 @@
+"""jax.profiler surface: captures real traces, enforces one-at-a-time."""
+
+import glob
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from misaka_tpu.networks import add2
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.utils.profiling import Profiler, ProfilerError, capture
+
+
+def _trace_files(log_dir):
+    return glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
+
+
+def test_capture_writes_trace(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    with capture(log_dir):
+        jnp.arange(64).sum().block_until_ready()
+    assert _trace_files(log_dir), "no xplane trace written"
+
+
+def test_profiler_start_stop(tmp_path):
+    p = Profiler()
+    log_dir = str(tmp_path / "p1")
+    p.start(log_dir)
+    assert p.active_dir == log_dir
+    with pytest.raises(ProfilerError):
+        p.start(str(tmp_path / "p2"))  # already capturing
+    jnp.ones((8, 8)).sum().block_until_ready()
+    assert p.stop() == log_dir
+    assert p.active_dir is None
+    with pytest.raises(ProfilerError):
+        p.stop()  # not capturing
+    assert _trace_files(log_dir)
+
+
+def test_profile_routes(tmp_path):
+    profile_dir = str(tmp_path / "profiles")
+    master = MasterNode(add2(in_cap=8, out_cap=8, stack_cap=8), chunk_steps=16)
+    httpd = make_http_server(master, port=0, profile_dir=profile_dir)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def post(path, data=None):
+        body = urllib.parse.urlencode(data or {}).encode()
+        req = urllib.request.Request(base + path, data=body, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    try:
+        master.run()
+        assert post("/profile/start", {"name": "run1"}) == (200, "Success")
+        code, _ = post("/profile/start", {"name": "run2"})
+        assert code == 409  # one capture at a time
+        assert master.compute(1) == 3  # device work lands inside the capture
+        code, out_dir = post("/profile/stop")
+        assert code == 200
+        assert _trace_files(out_dir)
+        code, _ = post("/profile/stop")
+        assert code == 409  # nothing capturing
+
+        code, _ = post("/profile/start", {"name": "../escape"})
+        assert code == 400
+    finally:
+        master.pause()
+        httpd.shutdown()
+
+
+def test_profile_disabled_without_dir():
+    master = MasterNode(add2(in_cap=8, out_cap=8, stack_cap=8), chunk_steps=16)
+    httpd = make_http_server(master, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(base + "/profile/start", data=b"", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 403
+    finally:
+        httpd.shutdown()

@@ -1,0 +1,197 @@
+"""Trace ring buffer: device-recorded history matches an independent replay.
+
+traced_step must (a) leave network semantics bit-identical to the untraced
+step, (b) record exactly what each lane fetched and whether it committed,
+(c) wrap correctly once past capacity, and (d) decode to truthful listings.
+"""
+
+import jax
+import numpy as np
+
+from misaka_tpu import networks
+from misaka_tpu.core import CompiledNetwork, init_trace, traced_step
+from misaka_tpu.core.trace import (
+    TR_ACC,
+    TR_COMMIT,
+    TR_OP,
+    TR_PC,
+    decode_trace,
+    format_trace,
+    run_traced,
+)
+from misaka_tpu.tis import isa
+
+
+def make_add2(**kw):
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    return top, top.compile(**kw)
+
+
+def test_traced_step_state_identical():
+    """Tracing must not perturb execution: same state trajectory as `run`."""
+    _, net = make_add2()
+    # Two independent states (net.run donates its input buffers, so a
+    # tree-level alias would be deleted by the first run).
+    s_plain = net.init_state()
+    s_plain, _ = net.feed(s_plain, [5, 6, 7])
+    s_traced = net.init_state()
+    s_traced, _ = net.feed(s_traced, [5, 6, 7])
+    trace = net.init_trace(cap=64)
+
+    s_plain = net.run(s_plain, 40)
+    s_traced, trace = net.run_traced(s_traced, trace, 40)
+
+    for a, b, name in zip(s_plain, s_traced, s_plain._fields):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+    assert int(trace.wr) == 40
+
+
+def test_records_fetch_and_commit():
+    """Tick 0 on add2: misaka1 fetches IN (commits — input queued), misaka2
+    fetches MOV R0, ACC (parks — port empty)."""
+    _, net = make_add2()
+    state = net.init_state()
+    state, _ = net.feed(state, [10])
+    trace = net.init_trace(cap=16)
+    state, trace = net.run_traced(state, trace, 1)
+
+    buf = np.asarray(trace.buf)
+    # lane 0 = misaka1: IN ACC committed, acc now 10
+    assert buf[0, 0, TR_PC] == 0
+    assert buf[0, 0, TR_OP] == isa.OP_IN
+    assert buf[0, 0, TR_COMMIT] == 1
+    assert buf[0, 0, TR_ACC] == 10
+    # lane 1 = misaka2: MOV R0, ACC parked on empty port
+    assert buf[1, 0, TR_OP] == isa.OP_MOV_LOCAL
+    assert buf[1, 0, TR_COMMIT] == 0
+
+
+def test_ring_wrap_keeps_last_cap_ticks():
+    _, net = make_add2()
+    state = net.init_state()
+    state, _ = net.feed(state, [1, 2, 3])
+    trace = net.init_trace(cap=8)
+    state, trace = net.run_traced(state, trace, 20)
+
+    assert int(trace.wr) == 20
+    entries = decode_trace(trace, net.code, net.prog_len)
+    ticks = sorted({e["tick"] for e in entries})
+    assert ticks == list(range(12, 20))  # only the last 8 survive
+
+
+def test_decode_disassembles_truthfully():
+    top, net = make_add2()
+    state = net.init_state()
+    state, _ = net.feed(state, [41])
+    trace = net.init_trace(cap=64)
+    state, trace = net.run_traced(state, trace, 30)
+
+    entries = decode_trace(
+        trace,
+        net.code,
+        net.prog_len,
+        lane_names=list(top.lane_ids()),
+        stack_names=list(top.stack_ids()),
+    )
+    texts = {e["text"] for e in entries}
+    assert "IN ACC" in texts
+    assert "PUSH ACC, misaka3" in texts
+    listing = format_trace(entries)
+    assert "misaka1" in listing and "*" in listing  # parked ticks marked
+
+    # And the computation still finished: 41 + 2 emitted.
+    state, outs = net.drain(state)
+    assert outs == [43]
+
+
+def test_decode_last_n():
+    _, net = make_add2()
+    state = net.init_state()
+    trace = net.init_trace(cap=32)
+    state, trace = net.run_traced(state, trace, 10)
+    entries = decode_trace(trace, net.code, net.prog_len, last=3)
+    assert sorted({e["tick"] for e in entries}) == [7, 8, 9]
+
+
+def test_trace_under_jit():
+    """traced_step composes with jit/scan (no host callbacks inside)."""
+    _, net = make_add2()
+    code, prog_len = net._tables
+    state = net.init_state()
+    trace = net.init_trace(cap=16)
+
+    @jax.jit
+    def chunk(s, t):
+        return run_traced(code, prog_len, s, t, 12)
+
+    state, trace = chunk(state, trace)
+    assert int(trace.wr) == 12
+
+
+def test_batched_network_rejects_tracing():
+    _, net = make_add2(batch=4)
+    try:
+        net.run_traced(net.init_state(), init_trace(2, 4), 1)
+    except ValueError as e:
+        assert "single network instance" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_single_step_api():
+    """traced_step is usable directly, one tick at a time (debugger path)."""
+    _, net = make_add2()
+    code, prog_len = net._tables
+    state = net.init_state()
+    trace = net.init_trace(cap=4)
+    state, trace = traced_step(code, prog_len, state, trace)
+    assert int(trace.wr) == 1
+    assert int(state.tick) == 1
+
+
+def test_master_trace_live():
+    """MasterNode with trace_cap: live trace over HTTP GET /trace."""
+    import threading
+    import urllib.request
+
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    master = MasterNode(top, chunk_steps=16, trace_cap=64)
+    httpd = make_http_server(master, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        master.run()
+        assert master.compute(7) == 9  # tracing must not perturb execution
+
+        entries = master.trace(last=50)
+        assert entries and any(e["text"] == "IN ACC" for e in entries)
+
+        import json
+
+        with urllib.request.urlopen(base + "/trace?last=5", timeout=10) as resp:
+            payload = resp.read().decode()
+        decoded = json.loads(payload)["entries"]
+        assert decoded and {"tick", "lane", "name", "pc", "op", "committed", "acc", "text"} <= set(decoded[0])
+        assert len({e["tick"] for e in decoded}) <= 5
+
+        # reset reinitializes the ring
+        master.reset()
+        assert master.trace() == []
+    finally:
+        master.pause()
+        httpd.shutdown()
+
+
+def test_master_trace_disabled():
+    from misaka_tpu.runtime.master import MasterNode
+
+    master = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8), chunk_steps=16)
+    try:
+        master.trace()
+    except RuntimeError as e:
+        assert "tracing disabled" in str(e)
+    else:
+        raise AssertionError("expected RuntimeError")
