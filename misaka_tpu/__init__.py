@@ -13,16 +13,25 @@ compiled into ONE jitted SPMD superstep kernel in which
   * multi-chip scaling  = jax.sharding Mesh + shard_map with XLA collectives
 
 Component map vs. the reference (SURVEY.md §2):
-  C1 process entrypoint -> misaka_tpu.runtime.app
+  C1 process entrypoint -> misaka_tpu.runtime.app (+ the `python -m misaka_tpu` CLI)
   C2 MasterNode         -> misaka_tpu.runtime.master
   C3 ProgramNode        -> lanes of misaka_tpu.core.step
   C4 StackNode          -> stack arrays in misaka_tpu.core.step
-  C5 tokenizer          -> misaka_tpu.tis.parser (+ .lower, new)
+  C5 tokenizer          -> misaka_tpu.tis.parser (+ .lower/.disasm/.native, new)
   C6 IntStack           -> misaka_tpu.core.state stack arrays
-  C7 gRPC transport     -> in-kernel routing + XLA collectives (misaka_tpu.parallel)
+  C7 gRPC transport     -> in-kernel routing + XLA collectives (misaka_tpu.parallel;
+                           wire-compatible gRPC kept in .transport for per-process mode)
   C8 math utils         -> misaka_tpu.utils.intmath
-  C9/C10 build/deploy   -> pyproject-less pure package; topology config in runtime.topology
-  C11 docs              -> README.md
+  C9 build system       -> Makefile (native / grpc / cert / test / bench)
+  C10 deployment        -> deploy/ (Dockerfile + fused & per-process compose)
+  C11 docs              -> README.md, docs/NOTES.md
+
+Beyond-parity subsystems (SURVEY.md §5 — the reference has none of these):
+  tracing/profiling     -> misaka_tpu.utils.profiling (jax.profiler surface)
+  instruction trace     -> misaka_tpu.core.trace (HBM ring + host decoder)
+  debugger              -> misaka_tpu.debug (breakpoints, lane inspection)
+  checkpoint/resume     -> runtime.master save/load_checkpoint + HTTP routes
+  multi-host (DCN)      -> misaka_tpu.parallel.multihost (jax.distributed)
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,227 @@
+"""Command-line interface: python -m misaka_tpu <command>.
+
+The reference has no CLI beyond `./app` + env vars (cmd/app.go) and curl
+(README.md:50-80).  This front door adds developer tooling around the same
+surfaces:
+
+  serve                      run a node/master (same env contract as
+                             `python -m misaka_tpu.runtime.app`)
+  check    <topology>        compile a topology, report per-node code sizes
+  disasm   <topology>        compile then disassemble every program node
+  compute  <v...> [--url]    send values to a running master's /compute
+  bench    [--batch --values] quick add-2 throughput smoke (the real harness
+                             is bench.py at the repo root)
+  debug    <topology>        interactive single-step debugger (misaka_tpu.debug)
+
+<topology> is either a baseline config name (add2, acc_loop, ring4, sorter,
+mesh8 — misaka_tpu/networks.py) or a path to a declarative JSON file
+({"nodes": {...}, "programs": {...}} — runtime/topology.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_topology(spec: str):
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.topology import Topology
+
+    if spec in networks.BASELINE_CONFIGS:
+        return networks.BASELINE_CONFIGS[spec]()
+    with open(spec) as f:
+        return Topology.from_json(f.read())
+
+
+def cmd_check(args) -> int:
+    try:
+        top = _load_topology(args.topology)
+        net = top.compile()
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    lanes = top.lane_ids()
+    print(f"ok: {len(lanes)} program node(s), {len(top.stack_ids())} stack node(s)")
+    for name, i in lanes.items():
+        print(f"  {name}: {int(net.prog_len[i])} line(s)")
+    return 0
+
+
+def cmd_disasm(args) -> int:
+    from misaka_tpu.tis.disasm import disassemble_network
+
+    top = _load_topology(args.topology)
+    net = top.compile()
+    texts = disassemble_network(
+        net.code, net.prog_len, list(top.lane_ids()), list(top.stack_ids())
+    )
+    for name, text in texts.items():
+        print(f"# --- {name} ---")
+        print(text)
+    return 0
+
+
+def cmd_compute(args) -> int:
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    for v in args.values:
+        body = urllib.parse.urlencode({"value": v}).encode()
+        req = urllib.request.Request(
+            args.url.rstrip("/") + "/compute", data=body, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+                print(resp.read().decode().strip())
+        except urllib.error.HTTPError as e:
+            print(f"error: {e.read().decode().strip()}", file=sys.stderr)
+            return 1
+        except urllib.error.URLError as e:
+            print(f"error: cannot reach {args.url}: {e.reason}", file=sys.stderr)
+            return 1
+    return 0
+
+
+def cmd_bench(args) -> int:
+    """Quick engine-path throughput smoke on the add-2 network."""
+    import time
+
+    import numpy as np
+
+    from misaka_tpu import networks
+
+    batch, per = args.batch, args.values
+    net = networks.add2(in_cap=per, out_cap=per, stack_cap=16).compile(batch=batch)
+    vals = np.random.default_rng(0).integers(-1000, 1000, (batch, per)).astype(np.int32)
+    state = net.init_state()._replace(
+        in_buf=vals, in_wr=np.full((batch,), per, np.int32)
+    )
+    import jax
+
+    ticks = 14 * per + 64  # add-2 retires one value per ~12-14 ticks
+    # Warm the compile cache on a throwaway state — and block, or the async
+    # warmup execution would bleed into the timed region below.
+    jax.block_until_ready(net.run(net.init_state(), ticks))
+    t0 = time.perf_counter()
+    state = net.run(state, ticks)
+    out_wr = np.asarray(state.out_wr)
+    dt = time.perf_counter() - t0
+    if not (out_wr == per).all():
+        print(f"error: only {int(out_wr.min())}/{per} outputs after {ticks} ticks",
+              file=sys.stderr)
+        return 1
+    got = np.asarray(state.out_buf)
+    if not (np.sort(got, axis=1) == np.sort(vals + 2, axis=1)).all():
+        print("error: output mismatch", file=sys.stderr)
+        return 1
+    rate = batch * per / dt
+    print(json.dumps({"metric": "add2_cli_smoke", "value": round(rate, 1),
+                      "unit": "inputs/sec"}))
+    return 0
+
+
+def cmd_debug(args) -> int:
+    from misaka_tpu.debug import Debugger
+
+    top = _load_topology(args.topology)
+    dbg = Debugger(top)
+    lanes = list(top.lane_ids())
+    print(f"misaka_tpu debugger — lanes: {', '.join(lanes)} (type 'help')")
+    while True:
+        try:
+            line = input("(mdb) ").strip()
+        except EOFError:
+            return 0
+        if not line:
+            continue
+        cmd, *rest = line.split()
+        try:
+            if cmd in ("q", "quit", "exit"):
+                return 0
+            elif cmd == "help":
+                print(
+                    "step [n]         advance n ticks (default 1)\n"
+                    "run [n]          run until breakpoint (budget n, default 10000)\n"
+                    "break LANE LINE  set a breakpoint\n"
+                    "clear            clear all breakpoints\n"
+                    "feed V [V...]    queue input values\n"
+                    "out              drain outputs\n"
+                    "print LANE       show a lane's registers/ports\n"
+                    "stacks           show stack contents\n"
+                    "list LANE        disassembly with pc cursor\n"
+                    "trace [n]        recent execution history\n"
+                    "reset            reset all state\n"
+                    "quit             exit"
+                )
+            elif cmd == "step":
+                hits = dbg.step(int(rest[0]) if rest else 1)
+                print(f"tick={dbg.tick}" + (f" BREAK {hits}" if hits else ""))
+            elif cmd == "run":
+                hits = dbg.run(int(rest[0]) if rest else 10_000)
+                print(f"tick={dbg.tick}" + (f" BREAK {hits}" if hits else " (no hit)"))
+            elif cmd == "break":
+                dbg.add_breakpoint(rest[0], int(rest[1]))
+                print(f"breakpoint at {rest[0]}:{rest[1]}")
+            elif cmd == "clear":
+                dbg.clear_breakpoints()
+            elif cmd == "feed":
+                took = dbg.feed([int(v) for v in rest])
+                print(f"queued {took}")
+            elif cmd == "out":
+                print(dbg.outputs())
+            elif cmd == "print":
+                print(json.dumps(dbg.inspect(rest[0]), indent=2))
+            elif cmd == "stacks":
+                print(json.dumps(dbg.stacks()))
+            elif cmd == "list":
+                print(dbg.listing(rest[0]))
+            elif cmd == "trace":
+                print(dbg.history(int(rest[0]) if rest else 16))
+            elif cmd == "reset":
+                dbg.reset()
+                print("reset")
+            else:
+                print(f"unknown command '{cmd}' (try 'help')")
+        except (KeyError, ValueError, IndexError) as e:
+            print(f"error: {e}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="misaka_tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("serve", help="run a node/master from env vars")
+    p = sub.add_parser("check", help="compile a topology")
+    p.add_argument("topology")
+    p = sub.add_parser("disasm", help="disassemble a topology's programs")
+    p.add_argument("topology")
+    p = sub.add_parser("compute", help="POST values to a running master")
+    p.add_argument("values", nargs="+", type=int)
+    p.add_argument("--url", default="http://localhost:8000")
+    p.add_argument("--timeout", type=float, default=60.0)
+    p = sub.add_parser("bench", help="quick add-2 throughput smoke")
+    p.add_argument("--batch", type=int, default=1024)
+    p.add_argument("--values", type=int, default=32)
+    p = sub.add_parser("debug", help="interactive debugger")
+    p.add_argument("topology")
+
+    args = parser.parse_args(argv)
+    if args.command == "serve":
+        from misaka_tpu.runtime.app import main as serve_main
+
+        serve_main()
+        return 0
+    return {
+        "check": cmd_check,
+        "disasm": cmd_disasm,
+        "compute": cmd_compute,
+        "bench": cmd_bench,
+        "debug": cmd_debug,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""Interactive debugger: single-step a fused network, inspect every lane.
+
+The reference's only debugging story is tailing per-instruction stdout logs
+across N containers (program.go:222-223).  Because the TPU build keeps the
+whole network's state in one pytree, a debugger is small: step the superstep
+kernel one tick at a time, read registers/ports/stacks directly, break when a
+lane reaches a program line.
+
+Host-driven and deliberately unjitted across ticks (one traced_step per
+tick), so breakpoints can be data-dependent without recompilation.  This is
+the bring-up tool; production throughput lives in engine.run / fused_runner.
+
+    dbg = Debugger(networks.add2())
+    dbg.feed([5])
+    dbg.add_breakpoint("misaka2", 2)       # PUSH ACC, misaka3
+    hits = dbg.run(max_ticks=100)          # -> [("misaka2", 2)]
+    dbg.inspect("misaka2")["acc"]          # -> 7
+    print(dbg.listing("misaka2"))          # disasm with pc/breakpoint marks
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from misaka_tpu.core.trace import decode_trace, format_trace, traced_step
+from misaka_tpu.runtime.topology import Topology
+from misaka_tpu.tis.disasm import disassemble_program
+
+
+class Debugger:
+    """Single-instance stepper over a compiled topology."""
+
+    def __init__(self, topology: Topology, trace_cap: int = 256):
+        self._top = topology
+        self._net = topology.compile()
+        self._lane_ids = topology.lane_ids()
+        self._lane_names = list(self._lane_ids)
+        self._stack_names = list(topology.stack_ids())
+        self._state = self._net.init_state()
+        self._trace = self._net.init_trace(trace_cap)
+        # breakpoints: lane index -> set of program lines
+        self._breaks: dict[int, set[int]] = {}
+        # One compiled tick, reused every step (breakpoint checks stay on host).
+        import jax
+
+        self._step1 = jax.jit(traced_step)
+
+    # --- control -----------------------------------------------------------
+
+    def feed(self, values) -> int:
+        """Queue client inputs; returns how many were accepted."""
+        self._state, took = self._net.feed(self._state, list(values))
+        return took
+
+    def outputs(self) -> list[int]:
+        """Drain anything the network has emitted."""
+        self._state, outs = self._net.drain(self._state)
+        return outs
+
+    def reset(self) -> None:
+        self._state = self._net.init_state()
+        self._trace = self._net.init_trace(self._trace.buf.shape[1])
+
+    def add_breakpoint(self, lane: str, line: int) -> None:
+        idx = self._lane_index(lane)
+        length = int(self._net.prog_len[idx])
+        if not 0 <= line < length:
+            raise ValueError(f"line {line} out of range for {lane} (len {length})")
+        self._breaks.setdefault(idx, set()).add(line)
+
+    def clear_breakpoints(self) -> None:
+        self._breaks.clear()
+
+    def step(self, ticks: int = 1) -> list[tuple[str, int]]:
+        """Advance up to `ticks` supersteps; stops early on a breakpoint hit.
+
+        Returns the breakpoint hits ([(lane_name, line)]) of the stopping
+        tick, empty if the full count ran without a hit.
+        """
+        code, prog_len = self._net._tables
+        for _ in range(ticks):
+            self._state, self._trace = self._step1(
+                code, prog_len, self._state, self._trace
+            )
+            hits = self._hits()
+            if hits:
+                return hits
+        return []
+
+    def run(self, max_ticks: int = 10_000) -> list[tuple[str, int]]:
+        """Run until a breakpoint hit (or the tick budget); returns the hits."""
+        return self.step(max_ticks)
+
+    # --- inspection --------------------------------------------------------
+
+    @property
+    def tick(self) -> int:
+        return int(self._state.tick)
+
+    def inspect(self, lane: str) -> dict:
+        """One lane's full architectural state."""
+        i = self._lane_index(lane)
+        s = self._state
+        return {
+            "acc": int(s.acc[i]),
+            "bak": int(s.bak[i]),
+            "pc": int(s.pc[i]),
+            "ports": {
+                f"R{k}": (int(s.port_val[i, k]) if bool(s.port_full[i, k]) else None)
+                for k in range(s.port_val.shape[1])
+            },
+            "holding": bool(s.holding[i]),
+            "hold_val": int(s.hold_val[i]),
+            "retired": int(s.retired[i]),
+        }
+
+    def stacks(self) -> dict[str, list[int]]:
+        """Every stack node's live contents, bottom first."""
+        mem = np.asarray(self._state.stack_mem)
+        tops = np.asarray(self._state.stack_top)
+        return {
+            name: mem[i, : tops[i]].tolist()
+            for i, name in enumerate(self._stack_names)
+        }
+
+    def listing(self, lane: str) -> str:
+        """Disassembly with `->` at the current pc and `B` on breakpoints."""
+        i = self._lane_index(lane)
+        length = int(self._net.prog_len[i])
+        text = disassemble_program(
+            self._net.code[i], length, self._lane_names, self._stack_names
+        )
+        pc = int(self._state.pc[i])
+        rows = []
+        for line_no, line in enumerate(text.split("\n")):
+            cursor = "->" if line_no == pc else "  "
+            bp = "B" if line_no in self._breaks.get(i, ()) else " "
+            rows.append(f"{cursor}{bp} {line_no:>3}  {line}")
+        return "\n".join(rows)
+
+    def history(self, last: int | None = None) -> str:
+        """Formatted trace listing of the most recent ticks."""
+        entries = decode_trace(
+            self._trace,
+            self._net.code,
+            self._net.prog_len,
+            lane_names=self._lane_names,
+            stack_names=self._stack_names,
+            last=last,
+        )
+        return format_trace(entries)
+
+    # --- internals ---------------------------------------------------------
+
+    def _lane_index(self, lane: str) -> int:
+        if lane not in self._lane_ids:
+            raise KeyError(f"'{lane}' is not a program node (have {self._lane_names})")
+        return self._lane_ids[lane]
+
+    def _hits(self) -> list[tuple[str, int]]:
+        if not self._breaks:
+            return []
+        pc = np.asarray(self._state.pc)
+        return [
+            (self._lane_names[i], int(pc[i]))
+            for i, lines in self._breaks.items()
+            if int(pc[i]) in lines
+        ]

@@ -8,6 +8,12 @@ from misaka_tpu.parallel.mesh import (
     state_specs,
 )
 from misaka_tpu.parallel.sharded import make_sharded_runner, step_local
+from misaka_tpu.parallel.multihost import (
+    hybrid_mesh,
+    initialize_from_env,
+    make_global_state,
+    put_global,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -17,4 +23,8 @@ __all__ = [
     "state_specs",
     "make_sharded_runner",
     "step_local",
+    "hybrid_mesh",
+    "initialize_from_env",
+    "make_global_state",
+    "put_global",
 ]

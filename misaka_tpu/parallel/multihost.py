@@ -1,0 +1,132 @@
+"""Multi-host (DCN) execution: jax.distributed + hybrid ICI/DCN meshes.
+
+The reference scales out by adding Docker containers to one TLS/gRPC LAN
+(docker-compose.yml:26-74) — every hop pays a fresh dial (SURVEY.md quirk #6).
+The TPU-native equivalent when a network outgrows one slice is JAX's
+multi-process runtime: one process per host, a gRPC coordinator for setup,
+and XLA collectives that ride ICI within a slice and DCN between slices
+(SURVEY.md §5 "distributed comm backend").
+
+Layout doctrine (the scaling-book recipe): put the *batch* axis across DCN —
+pure data parallelism, zero cross-slice traffic per tick — and keep the
+*lane* axis (whose port-routing collectives run every tick) inside a slice on
+ICI.  `hybrid_mesh` builds exactly that: `data` spans processes, `model`
+never crosses a process/slice boundary.
+
+Pieces:
+  * initialize_from_env  — process bootstrap from MISAKA_COORDINATOR /
+    MISAKA_NUM_PROCESSES / MISAKA_PROCESS_ID (or jax's own auto-detect on
+    Cloud TPU, where no env is needed).
+  * hybrid_mesh          — (data, model) Mesh with model confined to a slice.
+  * make_global_state    — a NetworkState of global jax.Arrays assembled from
+    per-process shards (jax.make_array_from_callback), since multi-host
+    arrays cannot be device_put from one host's buffer.
+  * put_global           — same mechanism for any single array (code tables).
+
+Verified end-to-end by tests/test_multihost.py: two OS processes, a real
+coordinator handshake, and the full sharded superstep (all_gather/pmin/psum
+from parallel/sharded.py) crossing the process boundary with parity.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, state_specs
+
+COORDINATOR_ENV = "MISAKA_COORDINATOR"
+NUM_PROCESSES_ENV = "MISAKA_NUM_PROCESSES"
+PROCESS_ID_ENV = "MISAKA_PROCESS_ID"
+
+
+def initialize_from_env(environ=os.environ) -> bool:
+    """Join the multi-process runtime if MISAKA_COORDINATOR is configured.
+
+    Returns True when distributed mode was (or already is) initialized.  On
+    Cloud TPU pods jax.distributed can auto-detect everything, so a bare
+    `MISAKA_COORDINATOR=auto` defers entirely to that autodetection.
+    """
+    coordinator = environ.get(COORDINATOR_ENV)
+    if not coordinator:
+        return False
+    if jax.distributed.is_initialized():
+        return True
+    if coordinator == "auto":
+        jax.distributed.initialize()
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(environ[NUM_PROCESSES_ENV]),
+        process_id=int(environ[PROCESS_ID_ENV]),
+    )
+    return True
+
+
+def hybrid_mesh(model_parallel: int = 1) -> Mesh:
+    """A (data, model) mesh where `model` never crosses a process boundary.
+
+    Single-process: identical to make_mesh.  Multi-process: the DCN axis
+    (processes/slices) is folded into `data`, so per-tick lane collectives
+    stay on ICI and only the embarrassingly-parallel batch spans hosts.
+    """
+    n_procs = jax.process_count()
+    if n_procs == 1:
+        return make_mesh(model_parallel=model_parallel)
+
+    all_devices = jax.devices()
+    n_slices = len({getattr(d, "slice_index", 0) for d in all_devices})
+    if n_slices > 1:
+        # Real multi-slice TPU: let mesh_utils optimize intra-slice placement
+        # and fold the DCN (slice) axis into `data`.  mesh_shape must account
+        # for a whole slice's devices, which can span several processes.
+        from jax.experimental import mesh_utils
+
+        per_slice = len(all_devices) // n_slices
+        if per_slice % model_parallel:
+            raise ValueError(
+                f"{per_slice} devices per slice not divisible by "
+                f"model_parallel={model_parallel}"
+            )
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_slice // model_parallel, model_parallel),
+            dcn_mesh_shape=(n_slices, 1),
+        )
+        return Mesh(devices, (DATA_AXIS, MODEL_AXIS))
+
+    # Single physical slice but multiple processes (CPU fleets, TPU VMs that
+    # share a slice): group by process so `model` rows never cross a process.
+    n_local = len(jax.local_devices())
+    if n_local % model_parallel:
+        raise ValueError(
+            f"{n_local} local devices not divisible by model_parallel={model_parallel}"
+        )
+    devs = sorted(all_devices, key=lambda d: (d.process_index, d.id))
+    grid = np.asarray(devs).reshape(-1, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def put_global(arr: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
+    """Assemble a global array from identical host copies of `arr`.
+
+    Every process holds the full logical value (cheap here: code tables and
+    init states) and contributes only the shards its local devices own.
+    """
+    arr = np.asarray(arr)
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
+def make_global_state(
+    init: NetworkState, mesh: Mesh, batched: bool = True
+) -> NetworkState:
+    """Place a host-built NetworkState onto a (possibly multi-host) mesh with
+    the canonical shardings (parallel/mesh.state_specs)."""
+    specs = state_specs(batched)
+    return jax.tree.map(
+        lambda x, spec: put_global(np.asarray(x), mesh, spec), init, specs
+    )

@@ -30,6 +30,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -38,7 +39,11 @@ from misaka_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, state_specs
 from misaka_tpu.tis import isa
 
 _I32 = jnp.int32
-_BIG = jnp.int32(2**31 - 1)  # "no contender" sentinel for pmin elections
+# "no contender" sentinel for pmin elections.  A numpy scalar, NOT jnp: a
+# module-level jnp constant would initialize the XLA backend at import time,
+# which breaks jax.distributed.initialize (it must run before any backend
+# touch — parallel/multihost.py).
+_BIG = np.int32(2**31 - 1)
 
 
 def _elect(contender: jnp.ndarray, lane_global: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -258,7 +263,17 @@ def make_sharded_runner(code, prog_len, mesh, num_steps: int, batched: bool = Tr
         out_specs=specs,
         check_vma=False,
     )
-    code_sh = jax.device_put(jnp.asarray(code, _I32), NamedSharding(mesh, P(MODEL_AXIS, None, None)))
-    len_sh = jax.device_put(jnp.asarray(prog_len, _I32), NamedSharding(mesh, P(MODEL_AXIS)))
+
+    # make_array_from_callback (not device_put): each process contributes only
+    # the table shards its local devices own, so the same path works on a
+    # single host and across a multi-host DCN mesh (parallel/multihost.py).
+    def _put(arr, spec):
+        arr = np.asarray(arr, dtype=np.int32)
+        return jax.make_array_from_callback(
+            arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx]
+        )
+
+    code_sh = _put(code, P(MODEL_AXIS, None, None))
+    len_sh = _put(prog_len, P(MODEL_AXIS))
     jitted = jax.jit(functools.partial(sharded, code_sh, len_sh), donate_argnums=(0,))
     return jitted

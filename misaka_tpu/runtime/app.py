@@ -15,6 +15,17 @@ so the master's env surface is what survives:
                    .npz snapshots in this directory (disabled when unset;
                    fused master only — per-process nodes hold their own
                    state, which the distributed master cannot snapshot)
+  MISAKA_TRACE_CAP enable the per-lane instruction trace ring (core/trace.py)
+                   with this many ticks of history; decoded listings served
+                   at GET /trace?last=N (disabled when unset; debug path —
+                   recording costs one extra store per tick)
+  MISAKA_PROFILE_DIR  enable jax.profiler capture of the live device loop via
+                   POST /profile/start + /profile/stop, traces written under
+                   this directory (disabled when unset)
+  MISAKA_COORDINATOR  join a multi-host jax.distributed runtime before any
+                   device touch ("host:port", or "auto" on Cloud TPU pods);
+                   with MISAKA_NUM_PROCESSES + MISAKA_PROCESS_ID
+                   (parallel/multihost.py; unset = single-host)
 
 Deployment modes (NODE_TYPE dispatch, mirroring cmd/app.go:17-39):
   * NODE_TYPE unset / "master" (default): the fused single-process TPU
@@ -57,9 +68,16 @@ def build_topology_from_env(environ=os.environ) -> Topology:
     return Topology.from_node_info_json(node_info, programs)
 
 
-def _serve_http(master, environ=os.environ, checkpoint_dir: str | None = None) -> None:
+def _serve_http(
+    master,
+    environ=os.environ,
+    checkpoint_dir: str | None = None,
+    profile_dir: str | None = None,
+) -> None:
     port = int(environ.get("MISAKA_PORT", "8000"))
-    server = make_http_server(master, port, checkpoint_dir=checkpoint_dir)
+    server = make_http_server(
+        master, port, checkpoint_dir=checkpoint_dir, profile_dir=profile_dir
+    )
     logging.getLogger("misaka_tpu.app").info("starting http server on :%d", port)
     try:
         server.serve_forever()
@@ -75,6 +93,12 @@ def main() -> None:
     environ = os.environ
     node_type = environ.get("NODE_TYPE", "master")
     cert, key = environ.get("CERT_FILE"), environ.get("KEY_FILE")
+
+    # Multi-host bootstrap must precede any XLA backend touch
+    # (parallel/multihost.py); a no-op unless MISAKA_COORDINATOR is set.
+    from misaka_tpu.parallel.multihost import initialize_from_env
+
+    initialize_from_env(environ)
 
     if node_type == "program":
         from misaka_tpu.runtime.nodes import ProgramNodeProcess, Resolver
@@ -130,11 +154,15 @@ def main() -> None:
         _serve_http(master, environ)
     elif node_type == "master":
         topology = build_topology_from_env()
-        master = MasterNode(topology)
+        trace_cap = int(environ.get("MISAKA_TRACE_CAP", "0")) or None
+        master = MasterNode(topology, trace_cap=trace_cap)
         if environ.get("MISAKA_AUTORUN") == "1":
             master.run()
         _serve_http(
-            master, environ, checkpoint_dir=environ.get("MISAKA_CHECKPOINT_DIR")
+            master,
+            environ,
+            checkpoint_dir=environ.get("MISAKA_CHECKPOINT_DIR"),
+            profile_dir=environ.get("MISAKA_PROFILE_DIR"),
         )
     else:
         raise SystemExit(f"'{node_type}' not a valid node type")

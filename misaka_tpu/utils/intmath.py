@@ -1,0 +1,24 @@
+"""Integer math helpers — host-side parity with the reference's utils/math.go.
+
+The reference exposes IntMax/IntMin/IntClamp (math.go:4-22), with clamp used
+only by JRO (program.go:354,:362).  In the kernel that clamp is a dense
+`jnp.clip` (core/step.py pc_jro); these host-side twins exist for tooling and
+tests that need the exact same scalar semantics without importing jax.
+"""
+
+from __future__ import annotations
+
+
+def int_max(a: int, b: int) -> int:
+    """math.go:4-9."""
+    return a if a > b else b
+
+
+def int_min(a: int, b: int) -> int:
+    """math.go:11-16."""
+    return a if a < b else b
+
+
+def int_clamp(v: int, lo: int, hi: int) -> int:
+    """math.go:18-22 — clamp v into [lo, hi] (the JRO bound, program.go:354)."""
+    return int_max(lo, int_min(v, hi))

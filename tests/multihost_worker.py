@@ -1,0 +1,90 @@
+"""Worker process for tests/test_multihost.py: one of two JAX processes.
+
+Joins a real jax.distributed coordinator (gloo CPU collectives), builds the
+hybrid DCN mesh, and runs the full sharded superstep engine on the add-2
+network across the process boundary.  Prints "MULTIHOST_OK" on success.
+
+Usage: python multihost_worker.py <coordinator_port> <process_id>
+"""
+
+import os
+import sys
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+import numpy as np
+
+NUM_PROCS = 2
+LOCAL_DEVICES = 4
+MODEL_PARALLEL = 2
+BATCH = 4          # = data axis size: 2 procs x (4 local / 2 mp)
+PER_INSTANCE = 4
+TICKS = 64
+
+
+def main() -> None:
+    port, pid = sys.argv[1], sys.argv[2]
+    os.environ["MISAKA_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["MISAKA_NUM_PROCESSES"] = str(NUM_PROCS)
+    os.environ["MISAKA_PROCESS_ID"] = pid
+
+    from misaka_tpu import networks
+    from misaka_tpu.parallel import (
+        MODEL_AXIS,
+        hybrid_mesh,
+        initialize_from_env,
+        make_global_state,
+        make_sharded_runner,
+    )
+
+    assert initialize_from_env()
+    assert initialize_from_env()  # idempotent once up
+    assert jax.process_count() == NUM_PROCS
+    assert len(jax.local_devices()) == LOCAL_DEVICES
+
+    mesh = hybrid_mesh(model_parallel=MODEL_PARALLEL)
+    assert mesh.shape[MODEL_AXIS] == MODEL_PARALLEL
+    # `model` must never cross a process boundary (ICI-only lane collectives).
+    for row in mesh.devices:  # rows = data, cols = model
+        assert len({d.process_index for d in row}) == 1
+
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    net = top.compile(batch=BATCH)
+    state = net.init_state()
+
+    vals = (np.arange(BATCH)[:, None] * 10 + np.arange(PER_INSTANCE)[None, :]).astype(
+        np.int32
+    )
+    in_buf = np.zeros((BATCH, 8), np.int32)
+    in_buf[:, :PER_INSTANCE] = vals
+    state = state._replace(
+        in_buf=in_buf,
+        in_wr=np.full((BATCH,), PER_INSTANCE, np.int32),
+    )
+
+    gstate = make_global_state(state, mesh, batched=True)
+    runner = make_sharded_runner(net.code, net.prog_len, mesh, num_steps=TICKS)
+    gstate = runner(gstate)
+
+    # Every locally-owned instance must have emitted all values, +2 each.
+    expected_out = vals + 2
+    checked = 0
+    for shard in gstate.out_wr.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data), PER_INSTANCE)
+    for shard in gstate.out_buf.addressable_shards:
+        idx = shard.index[0]
+        got = np.asarray(shard.data)[:, :PER_INSTANCE]
+        np.testing.assert_array_equal(got, expected_out[idx])
+        checked += got.shape[0]
+    assert checked > 0
+    print("MULTIHOST_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

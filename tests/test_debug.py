@@ -1,0 +1,144 @@
+"""Debugger + CLI: breakpoints, inspection, listings, and the front door."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.__main__ import main as cli_main
+from misaka_tpu.debug import Debugger
+
+
+@pytest.fixture()
+def dbg():
+    return Debugger(networks.add2(in_cap=8, out_cap=8, stack_cap=8))
+
+
+def test_breakpoint_stops_at_line(dbg):
+    dbg.feed([5])
+    # misaka2 line 2 = PUSH ACC, misaka3; acc must hold 5+1+1 when we arrive.
+    dbg.add_breakpoint("misaka2", 2)
+    hits = dbg.run(max_ticks=100)
+    assert hits == [("misaka2", 2)]
+    assert dbg.inspect("misaka2")["acc"] == 7
+    assert dbg.inspect("misaka2")["pc"] == 2
+
+
+def test_step_through_completion(dbg):
+    dbg.feed([1])
+    assert dbg.step(40) == []  # no breakpoints: runs the full count
+    assert dbg.tick == 40
+    assert dbg.outputs() == [3]
+
+
+def test_inspect_ports_and_stacks(dbg):
+    dbg.feed([9])
+    dbg.add_breakpoint("misaka2", 3)  # POP misaka3, ACC — stack holds the value
+    dbg.run(max_ticks=100)
+    stacks = dbg.stacks()
+    assert stacks["misaka3"] == [11]
+    info = dbg.inspect("misaka1")
+    assert set(info) == {"acc", "bak", "pc", "ports", "holding", "hold_val", "retired"}
+    assert set(info["ports"]) == {"R0", "R1", "R2", "R3"}
+
+
+def test_listing_shows_cursor_and_breakpoint(dbg):
+    dbg.add_breakpoint("misaka1", 2)
+    listing = dbg.listing("misaka1")
+    lines = listing.split("\n")
+    assert lines[0].startswith("-> ")       # pc=0 cursor
+    assert lines[2].startswith("  B")       # breakpoint mark
+    assert "IN ACC" in lines[0]
+    assert "MOV ACC, misaka2:R0" in lines[2]
+
+
+def test_history_listing(dbg):
+    dbg.feed([4])
+    dbg.step(10)
+    hist = dbg.history(last=5)
+    assert "misaka1" in hist and "pc=" in hist
+
+
+def test_reset(dbg):
+    dbg.feed([1])
+    dbg.step(10)
+    dbg.reset()
+    assert dbg.tick == 0
+    assert dbg.inspect("misaka1")["acc"] == 0
+
+
+def test_bad_lane_and_line(dbg):
+    with pytest.raises(KeyError):
+        dbg.inspect("nope")
+    with pytest.raises(ValueError):
+        dbg.add_breakpoint("misaka1", 99)
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def test_cli_check_named_config(capsys):
+    assert cli_main(["check", "add2"]) == 0
+    out = capsys.readouterr().out
+    assert "2 program node(s), 1 stack node(s)" in out
+
+
+def test_cli_check_bad_file(capsys):
+    assert cli_main(["check", "/nonexistent.json"]) == 1
+
+
+def test_cli_check_topology_file(tmp_path, capsys):
+    spec = {"nodes": {"a": "program"}, "programs": {"a": "IN ACC\nOUT ACC"}}
+    path = tmp_path / "net.json"
+    path.write_text(json.dumps(spec))
+    assert cli_main(["check", str(path)]) == 0
+    assert "a: 2 line(s)" in capsys.readouterr().out
+
+
+def test_cli_disasm(capsys):
+    assert cli_main(["disasm", "add2"]) == 0
+    out = capsys.readouterr().out
+    assert "# --- misaka1 ---" in out
+    assert "PUSH ACC, misaka3" in out
+
+
+def test_cli_bench_smoke(capsys):
+    assert cli_main(["bench", "--batch", "32", "--values", "8"]) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["metric"] == "add2_cli_smoke"
+    assert payload["value"] > 0
+
+
+def test_cli_debug_scripted():
+    """Drive the interactive debugger through a pipe end-to-end."""
+    script = "\n".join(
+        [
+            "feed 5",
+            "break misaka2 2",
+            "run",
+            "print misaka2",
+            "stacks",
+            "list misaka1",
+            "out",
+            "step 100",
+            "out",
+            "trace 4",
+            "quit",
+        ]
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "misaka_tpu", "debug", "add2"],
+        input=script,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd="/root/repo",
+        env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "BREAK [('misaka2', 2)]" in proc.stdout
+    assert '"acc": 7' in proc.stdout
+    assert "[7]" in proc.stdout  # outputs after completion: 5+2

@@ -1,0 +1,26 @@
+"""Host-side int math parity (utils/math.go twins) vs the kernel's clamp."""
+
+import numpy as np
+
+from misaka_tpu.utils.intmath import int_clamp, int_max, int_min
+
+
+def test_minmax():
+    assert int_max(3, -5) == 3
+    assert int_min(3, -5) == -5
+    assert int_max(2, 2) == 2
+
+
+def test_clamp_matches_numpy_clip():
+    rng = np.random.default_rng(7)
+    for _ in range(200):
+        v, lo = int(rng.integers(-100, 100)), int(rng.integers(-50, 0))
+        hi = lo + int(rng.integers(0, 60))
+        assert int_clamp(v, lo, hi) == int(np.clip(v, lo, hi))
+
+
+def test_jro_bound_semantics():
+    """The exact JRO use: clamp(pc+offset, 0, len-1) (program.go:354)."""
+    length = 5
+    assert int_clamp(3 + 100, 0, length - 1) == 4
+    assert int_clamp(3 - 100, 0, length - 1) == 0
