@@ -1,0 +1,27 @@
+"""The benchmark harness itself is product code: verify it on tiny shapes.
+
+bench.py asserts completion + parity before reporting a number; these tests
+run every BASELINE config through the same code path (XLA engine on CPU) so
+a harness regression (wrong oracle, wrong ordering assumption, undersized
+tick budget that can't recover) fails here, not on TPU bench night.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402 — bench.py lives at the repo root
+
+
+@pytest.mark.parametrize("name", sorted(bench.CONFIGS))
+def test_bench_config_tiny(name):
+    r = bench.bench_config(name, batch=64, per_instance=8)
+    assert r["throughput"] > 0
+    assert r["values"] == 64 * 8
+
+
+def test_bench_add2_alias():
+    r = bench.bench_add2(batch=32, per_instance=4)
+    assert r["name"] == "add2"
