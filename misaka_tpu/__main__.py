@@ -13,9 +13,11 @@ surfaces:
                              is bench.py at the repo root)
   debug    <topology>        interactive single-step debugger (misaka_tpu.debug)
 
-<topology> is either a baseline config name (add2, acc_loop, ring4, sorter,
-mesh8 — misaka_tpu/networks.py) or a path to a declarative JSON file
-({"nodes": {...}, "programs": {...}} — runtime/topology.py).
+<topology> is a baseline config name (add2, acc_loop, ring4, sorter,
+mesh8 — misaka_tpu/networks.py), a path to a declarative JSON file
+({"nodes": {...}, "programs": {...}} — runtime/topology.py), or a reference
+docker-compose .yml whose services carry NODE_TYPE/PROGRAM envs
+(runtime/compose.py) — the drop-in migration path.
 """
 
 from __future__ import annotations
@@ -31,6 +33,11 @@ def _load_topology(spec: str):
 
     if spec in networks.BASELINE_CONFIGS:
         return networks.BASELINE_CONFIGS[spec]()
+    if spec.endswith((".yml", ".yaml")):
+        # a reference-style docker-compose deployment file (runtime/compose.py)
+        from misaka_tpu.runtime.compose import load_compose
+
+        return load_compose(spec)
     with open(spec) as f:
         return Topology.from_json(f.read())
 

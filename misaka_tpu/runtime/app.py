@@ -8,7 +8,8 @@ so the master's env surface is what survives:
   MISAKA_PROGRAMS  {"name": "<TIS source>", ...}   per-program-node source —
                    replaces the per-container PROGRAM env (app.go:20-25)
   MISAKA_TOPOLOGY  path to a single declarative JSON file
-                   {"nodes": ..., "programs": ...} (alternative to the above)
+                   {"nodes": ..., "programs": ...} — or a reference-style
+                   docker-compose .yml, imported directly (runtime/compose.py)
   MISAKA_PORT      HTTP port (default 8000 = clientPort, master.go:19)
   MISAKA_AUTORUN   "1" to start running immediately (default: wait for /run)
   MISAKA_CHECKPOINT_DIR  enable HTTP /checkpoint & /restore, storing named
@@ -57,6 +58,12 @@ from misaka_tpu.runtime.topology import Topology
 def build_topology_from_env(environ=os.environ) -> Topology:
     path = environ.get("MISAKA_TOPOLOGY")
     if path:
+        if path.endswith((".yml", ".yaml")):
+            # a reference docker-compose file: run its whole deployment as
+            # one fused network (runtime/compose.py)
+            from misaka_tpu.runtime.compose import load_compose
+
+            return load_compose(path)
         with open(path) as f:
             return Topology.from_json(f.read())
     node_info = environ.get("NODE_INFO")

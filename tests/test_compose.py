@@ -1,0 +1,167 @@
+"""Compose importer: a reference deployment file runs as one fused network."""
+
+import os
+import textwrap
+
+import pytest
+
+from misaka_tpu.runtime.compose import ComposeError, load_compose, parse_compose
+
+# A compose file in the reference's shape (docker-compose.yml:1-77): master
+# with NODE_INFO, program services with PROGRAM block scalars, a stack node,
+# plus container plumbing that must be ignored.
+SAMPLE = textwrap.dedent(
+    """\
+    version: '3'
+
+    services:
+      gateway:
+        image: misaka_net
+        ports:
+          - "8000:8000"
+        environment:
+          NODE_TYPE: master
+          NODE_INFO: |
+            {
+              "alpha": {"type": "program"},
+              "beta": {"type": "program"},
+              "store": {"type": "stack"}
+            }
+          CERT_FILE: ./openssl/service.pem
+        command: ./app
+
+      alpha:
+        image: misaka_net
+        environment:
+          NODE_TYPE: program
+          MASTER_URI: gateway
+          PROGRAM: |
+            IN ACC
+            ADD 1
+            MOV ACC, beta:R0
+            MOV R0, ACC
+            OUT ACC
+        command: ./app
+
+      beta:
+        image: misaka_net
+        environment:
+          NODE_TYPE: program
+          MASTER_URI: gateway
+          PROGRAM: |
+            MOV R0, ACC
+            ADD 1
+            PUSH ACC, store
+            POP store, ACC
+            MOV ACC, alpha:R0
+        command: ./app
+
+      store:
+        image: misaka_net
+        environment:
+          NODE_TYPE: stack
+        command: ./app
+
+      unrelated_db:
+        image: postgres
+    """
+)
+
+
+def test_parse_sample_end_to_end():
+    top = parse_compose(SAMPLE)
+    assert top.node_info == {"alpha": "program", "beta": "program", "store": "stack"}
+    # YAML block scalar keeps its trailing newline -> one NOP slot (parity).
+    assert top.programs["alpha"].endswith("OUT ACC\n")
+
+    net = top.compile()
+    state = net.init_state()
+    state, outs = net.compute_stream(state, [10, 20])
+    assert outs == [12, 22]
+
+
+def test_env_list_form():
+    text = SAMPLE.replace(
+        "environment:\n          NODE_TYPE: stack",
+        'environment:\n          - "NODE_TYPE=stack"',
+    )
+    top = parse_compose(text)
+    assert top.node_info["store"] == "stack"
+
+
+def test_node_info_mismatch_rejected():
+    text = SAMPLE.replace('"store": {"type": "stack"}', '"ghost": {"type": "stack"}')
+    with pytest.raises(ComposeError, match="disagrees"):
+        parse_compose(text)
+
+
+def test_no_master_is_fine():
+    """A compose file with only worker services still forms a network."""
+    text = textwrap.dedent(
+        """\
+        services:
+          solo:
+            environment:
+              NODE_TYPE: program
+              PROGRAM: |
+                IN ACC
+                OUT ACC
+          store:
+            environment:
+              NODE_TYPE: stack
+        """
+    )
+    top = parse_compose(text)
+    assert top.node_info == {"solo": "program", "store": "stack"}
+
+
+def test_node_info_non_object_rejected():
+    text = textwrap.dedent(
+        """\
+        services:
+          gateway:
+            environment:
+              NODE_TYPE: master
+              NODE_INFO: '["alpha", "beta"]'
+          alpha:
+            environment:
+              NODE_TYPE: program
+        """
+    )
+    with pytest.raises(ComposeError, match="NODE_INFO is not valid"):
+        parse_compose(text)
+
+
+def test_bad_yaml_and_empty():
+    with pytest.raises(ComposeError, match="invalid YAML"):
+        parse_compose(":\n  - {")
+    with pytest.raises(ComposeError, match="no services"):
+        parse_compose("services: 3")
+    with pytest.raises(ComposeError, match="NODE_TYPE"):
+        parse_compose("services:\n  a:\n    image: x\n")
+
+
+def test_bad_program_surfaces_as_compose_error():
+    text = SAMPLE.replace("IN ACC", "FROB 99")
+    with pytest.raises(Exception, match="not a valid instruction"):
+        parse_compose(text).compile()
+
+
+REFERENCE_COMPOSE = "/root/reference/docker-compose.yml"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REFERENCE_COMPOSE), reason="reference checkout not mounted"
+)
+def test_reference_compose_file_runs():
+    """The actual upstream deployment file computes v+2, fused."""
+    top = load_compose(REFERENCE_COMPOSE)
+    assert top.node_info == {
+        "misaka1": "program",
+        "misaka2": "program",
+        "misaka3": "stack",
+    }
+    net = top.compile()
+    state = net.init_state()
+    state, outs = net.compute_stream(state, [5])
+    assert outs == [7]
