@@ -4,9 +4,12 @@
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC
 
-native: native/libmisaka_assembler.so
+native: native/libmisaka_assembler.so native/libmisaka_interp.so
 
 native/libmisaka_assembler.so: native/assembler.cpp
+	$(CXX) $(CXXFLAGS) $< -o $@
+
+native/libmisaka_interp.so: native/interpreter.cpp
 	$(CXX) $(CXXFLAGS) $< -o $@
 
 # Regenerate protobuf message classes for the per-process transport.  The

@@ -32,6 +32,9 @@ Beyond-parity subsystems (SURVEY.md §5 — the reference has none of these):
   debugger              -> misaka_tpu.debug (breakpoints, lane inspection)
   checkpoint/resume     -> runtime.master save/load_checkpoint + HTTP routes
   multi-host (DCN)      -> misaka_tpu.parallel.multihost (jax.distributed)
+  compose migration     -> misaka_tpu.runtime.compose (run reference deploy files)
+  native interpreter    -> misaka_tpu.core.cinterp (C++ superstep engine,
+                           third differential implementation)
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,160 @@
+"""ctypes bridge to the native C++ superstep interpreter (native/interpreter.cpp).
+
+A zero-JAX host executor with the exact tick discipline of the kernels
+(core/step.py docstring): useful as a third independent implementation for
+differential testing, and as a microsecond-latency single-instance engine for
+control-plane-sized runs where a device round-trip isn't worth it.
+
+Build with `make native` (repo root) or let this module build it on first
+use (g++, ~1s).  `available()` reports whether the backend can load.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from misaka_tpu.tis import isa
+from misaka_tpu.utils.nativelib import NativeLib
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.misaka_interp_create.restype = ctypes.c_void_p
+    lib.misaka_interp_create.argtypes = [_I32P, _I32P] + [ctypes.c_int] * 6
+    lib.misaka_interp_destroy.restype = None
+    lib.misaka_interp_destroy.argtypes = [ctypes.c_void_p]
+    lib.misaka_interp_feed.restype = ctypes.c_int
+    lib.misaka_interp_feed.argtypes = [ctypes.c_void_p, _I32P, ctypes.c_int]
+    lib.misaka_interp_run.restype = None
+    lib.misaka_interp_run.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.misaka_interp_drain.restype = ctypes.c_int
+    lib.misaka_interp_drain.argtypes = [ctypes.c_void_p, _I32P, ctypes.c_int]
+    lib.misaka_interp_read.restype = None
+    lib.misaka_interp_read.argtypes = [ctypes.c_void_p] + [
+        _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
+        _I32P, _I32P, _I32P, _I32P, _I32P,
+    ]
+
+
+_NATIVE = NativeLib(
+    os.path.join(_REPO_ROOT, "native", "interpreter.cpp"),
+    os.path.join(_REPO_ROOT, "native", "libmisaka_interp.so"),
+    _configure,
+)
+
+
+def _load() -> ctypes.CDLL | None:
+    return _NATIVE.load()
+
+
+def available() -> bool:
+    return _NATIVE.available()
+
+
+def _as_i32p(arr: np.ndarray):
+    return arr.ctypes.data_as(_I32P)
+
+
+class NativeInterpreter:
+    """One network instance stepped by the C++ engine (Oracle-compatible API)."""
+
+    def __init__(self, code, prog_len, num_stacks, stack_cap, in_cap, out_cap):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native interpreter unavailable (no g++?)")
+        self._lib = lib
+        code = np.ascontiguousarray(code, dtype=np.int32)
+        prog_len = np.ascontiguousarray(prog_len, dtype=np.int32)
+        self.n_lanes, self.max_len, _ = code.shape
+        self.num_stacks = max(1, num_stacks)
+        self.stack_cap = stack_cap
+        self.in_cap = in_cap
+        self.out_cap = out_cap
+        self._h = lib.misaka_interp_create(
+            _as_i32p(code),
+            _as_i32p(prog_len),
+            self.n_lanes,
+            self.max_len,
+            self.num_stacks,
+            stack_cap,
+            in_cap,
+            out_cap,
+        )
+        if not self._h:
+            raise ValueError("invalid network tables")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.misaka_interp_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def feed(self, values) -> int:
+        vals = np.ascontiguousarray(values, dtype=np.int32)
+        return self._lib.misaka_interp_feed(self._h, _as_i32p(vals), len(vals))
+
+    def run(self, ticks: int) -> None:
+        self._lib.misaka_interp_run(self._h, int(ticks))
+
+    def drain(self) -> list[int]:
+        out = np.zeros((self.out_cap,), np.int32)
+        got = self._lib.misaka_interp_drain(self._h, _as_i32p(out), self.out_cap)
+        return out[:got].tolist()
+
+    def state_arrays(self) -> dict:
+        """Mirror tests/oracle.py state_arrays for differential comparison."""
+        n, s, cap = self.n_lanes, self.num_stacks, self.stack_cap
+        acc = np.zeros(n, np.int32)
+        bak = np.zeros(n, np.int32)
+        pc = np.zeros(n, np.int32)
+        port_val = np.zeros((n, isa.NUM_PORTS), np.int32)
+        port_full = np.zeros((n, isa.NUM_PORTS), np.uint8)
+        hold_val = np.zeros(n, np.int32)
+        holding = np.zeros(n, np.uint8)
+        stack_mem = np.zeros((s, cap), np.int32)
+        stack_top = np.zeros(s, np.int32)
+        out_buf = np.zeros(self.out_cap, np.int32)
+        counters = np.zeros(5, np.int32)
+        retired = np.zeros(n, np.int32)
+        self._lib.misaka_interp_read(
+            self._h,
+            _as_i32p(acc), _as_i32p(bak), _as_i32p(pc),
+            _as_i32p(port_val), port_full.ctypes.data_as(_U8P),
+            _as_i32p(hold_val), holding.ctypes.data_as(_U8P),
+            _as_i32p(stack_mem), _as_i32p(stack_top),
+            _as_i32p(out_buf), _as_i32p(counters), _as_i32p(retired),
+        )
+        return {
+            "acc": acc,
+            "bak": bak,
+            "pc": pc,
+            "port_val": port_val,
+            "port_full": port_full.astype(bool),
+            "hold_val": hold_val,
+            "holding": holding.astype(bool),
+            "stack_top": stack_top,
+            "stack_mem_used": stack_mem,
+            "in_rd": counters[0],
+            "out_wr": counters[3],
+            "out_buf": out_buf,
+            "tick": counters[4],
+            "retired": retired,
+        }

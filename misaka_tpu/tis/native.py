@@ -14,60 +14,45 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 
 import numpy as np
 
 from misaka_tpu.tis import isa
 from misaka_tpu.tis.lower import LoweredProgram, TISLowerError, lower_program
 from misaka_tpu.tis.parser import TISParseError
+from misaka_tpu.utils.nativelib import NativeLib
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "assembler.cpp")
-_SO = os.path.join(_REPO_ROOT, "native", "libmisaka_assembler.so")
-
-_lock = threading.Lock()
-_lib = None
-_lib_failed = False
 
 _MAX_LINES = 65536
 
 
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.misaka_assemble.restype = ctypes.c_int
+    lib.misaka_assemble.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+
+
+_NATIVE = NativeLib(
+    os.path.join(_REPO_ROOT, "native", "assembler.cpp"),
+    os.path.join(_REPO_ROOT, "native", "libmisaka_assembler.so"),
+    _configure,
+)
+
+
 def _load() -> ctypes.CDLL | None:
-    global _lib, _lib_failed
-    with _lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        try:
-            if not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-            ):
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO],
-                    check=True,
-                    capture_output=True,
-                )
-            lib = ctypes.CDLL(_SO)
-            lib.misaka_assemble.restype = ctypes.c_int
-            lib.misaka_assemble.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_char_p,
-                ctypes.c_char_p,
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.c_int,
-                ctypes.c_char_p,
-                ctypes.c_int,
-            ]
-            _lib = lib
-        except Exception:
-            _lib_failed = True
-        return _lib
+    return _NATIVE.load()
 
 
 def native_available() -> bool:
-    return _load() is not None
+    return _NATIVE.available()
 
 
 def _ordered_names(ids: dict[str, int]) -> str:

@@ -1,0 +1,65 @@
+"""Shared build-and-load scaffolding for the native C++ backends.
+
+Both native components (tis/native.py assembler, core/cinterp.py interpreter)
+follow the same contract: a checked-in .so for zero-setup use, rebuilt from
+source when the source is newer OR when the shipped binary fails to load
+(stale/foreign-arch artifact) and a compiler is available; a process-wide
+failure latch so an unavailable toolchain degrades quietly to the pure-Python
+paths instead of retrying every call.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable
+
+
+class NativeLib:
+    """Lazy loader for one shared object built from one C++ source file."""
+
+    def __init__(self, src: str, so: str, configure: Callable[[ctypes.CDLL], None]):
+        self._src = src
+        self._so = so
+        self._configure = configure  # declares restype/argtypes; may raise
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self._failed = False
+
+    def _build(self) -> None:
+        cxx = os.environ.get("CXX", "g++")
+        subprocess.run(
+            [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", self._src, "-o", self._so],
+            check=True,
+            capture_output=True,
+        )
+
+    def load(self) -> ctypes.CDLL | None:
+        with self._lock:
+            if self._lib is not None or self._failed:
+                return self._lib
+            try:
+                if not os.path.exists(self._so) or (
+                    os.path.exists(self._src)
+                    and os.path.getmtime(self._src) > os.path.getmtime(self._so)
+                ):
+                    self._build()
+                try:
+                    lib = ctypes.CDLL(self._so)
+                except OSError:
+                    # Shipped binary unloadable (stale or built for another
+                    # arch): rebuild from source once and retry.
+                    if not os.path.exists(self._src):
+                        raise
+                    self._build()
+                    lib = ctypes.CDLL(self._so)
+                self._configure(lib)
+                self._lib = lib
+            except Exception:
+                self._failed = True
+            return self._lib
+
+    def available(self) -> bool:
+        return self.load() is not None

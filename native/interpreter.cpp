@@ -1,0 +1,358 @@
+// Native superstep interpreter: the whole-network tick discipline in C++.
+//
+// A third, independent implementation of the execution semantics (after the
+// XLA/Pallas kernels and the Python oracle in tests/oracle.py), mirroring
+// the reference's concurrent behavior under the deterministic superstep
+// discipline documented in misaka_tpu/core/step.py:
+//
+//   phase A  lanes with a ready inbound-port source consume it into their
+//            hold latch (port cleared) before any delivery
+//   phase B  sends / stack ops / IN / OUT arbitrate by LOWEST LANE INDEX;
+//            sends see post-consume occupancy plus this tick's deliveries;
+//            at most one op per stack, one IN, one OUT per tick; stack and
+//            ring feasibility use begin-of-tick tops/counters
+//   commit   a lane commits iff source ready and destination granted;
+//            effects read begin-of-tick registers; PC wraps modulo program
+//            length (program.go:429), JRO clamps (program.go:354)
+//
+// Uses: differential testing against the kernels (tests/test_native_interp.py)
+// and a zero-JAX host executor for tiny control-plane runs.  C ABI for
+// ctypes (misaka_tpu/core/cinterp.py).  Build: make native.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+enum Op {
+  OP_NOP = 0, OP_SWP = 1, OP_SAV = 2, OP_NEG = 3,
+  OP_MOV_LOCAL = 4, OP_MOV_NET = 5, OP_ADD = 6, OP_SUB = 7,
+  OP_JMP = 8, OP_JEZ = 9, OP_JNZ = 10, OP_JGZ = 11, OP_JLZ = 12,
+  OP_JRO = 13, OP_PUSH = 14, OP_POP = 15, OP_IN = 16, OP_OUT = 17,
+};
+enum Src { SRC_IMM = 0, SRC_ACC = 1, SRC_NIL = 2, SRC_R0 = 3 };
+enum Dst { DST_ACC = 0, DST_NIL = 1 };
+enum Field { F_OP = 0, F_SRC, F_IMM, F_DST, F_TGT, F_PORT, F_JMP, NFIELDS };
+
+constexpr int kPorts = 4;
+
+inline int32_t i32(int64_t v) { return (int32_t)(uint32_t)(uint64_t)v; }
+
+inline bool reads_src(int op) {
+  switch (op) {
+    case OP_MOV_LOCAL: case OP_MOV_NET: case OP_ADD: case OP_SUB:
+    case OP_JRO: case OP_PUSH: case OP_OUT:
+      return true;
+    default:
+      return false;
+  }
+}
+
+struct Interp {
+  int n_lanes, max_len, num_stacks, stack_cap, in_cap, out_cap;
+  std::vector<int32_t> code;      // [n_lanes][max_len][NFIELDS]
+  std::vector<int32_t> prog_len;  // [n_lanes]
+
+  std::vector<int32_t> acc, bak, pc, hold_val, retired;
+  std::vector<uint8_t> holding;
+  std::vector<int32_t> port_val;   // [n_lanes][kPorts]
+  std::vector<uint8_t> port_full;  // [n_lanes][kPorts]
+  std::vector<std::vector<int32_t>> stacks;
+  std::vector<int32_t> in_buf, out_buf;
+  int32_t in_rd = 0, in_wr = 0, out_rd = 0, out_wr = 0, tick_count = 0;
+
+  const int32_t* ins(int lane) const {
+    return &code[(size_t)(lane * max_len + pc[lane]) * NFIELDS];
+  }
+
+  void tick() {
+    const int n = n_lanes;
+
+    // phase A: consume ready port sources into the hold latch
+    for (int l = 0; l < n; ++l) {
+      const int32_t* f = ins(l);
+      if (reads_src(f[F_OP]) && f[F_SRC] >= SRC_R0) {
+        int p = f[F_SRC] - SRC_R0;
+        if (!holding[l] && port_full[l * kPorts + p]) {
+          hold_val[l] = port_val[l * kPorts + p];
+          holding[l] = 1;
+          port_full[l * kPorts + p] = 0;
+        }
+      }
+    }
+
+    // source resolution
+    std::vector<int32_t> src_val(n, 0);
+    std::vector<uint8_t> src_ok(n, 1);
+    for (int l = 0; l < n; ++l) {
+      const int32_t* f = ins(l);
+      if (!reads_src(f[F_OP])) continue;
+      switch (f[F_SRC]) {
+        case SRC_IMM: src_val[l] = f[F_IMM]; break;
+        case SRC_ACC: src_val[l] = acc[l]; break;
+        case SRC_NIL: src_val[l] = 0; break;
+        default:
+          src_val[l] = hold_val[l];
+          src_ok[l] = holding[l];
+      }
+    }
+
+    // arbitration: lowest lane index wins each resource
+    std::vector<uint8_t> granted(n, 0);
+    std::vector<int32_t> begin_tops(num_stacks);
+    for (int s = 0; s < num_stacks; ++s) begin_tops[s] = (int32_t)stacks[s].size();
+    std::vector<uint8_t> stack_taken(num_stacks, 0);
+    bool in_taken = false, out_taken = false;
+    const bool in_avail = in_wr - in_rd > 0;
+    const bool out_free = out_wr - out_rd < out_cap;
+    struct Delivery { int tgt, port; int32_t val; };
+    std::vector<Delivery> deliveries;
+    std::vector<std::pair<int, int32_t>> stack_pushes;  // (stack, value)
+    std::vector<int32_t> pop_val(n, 0);
+    int in_winner = -1;
+    int32_t out_value = 0;
+
+    for (int l = 0; l < n; ++l) {
+      const int32_t* f = ins(l);
+      switch (f[F_OP]) {
+        case OP_MOV_NET: {
+          if (!src_ok[l]) break;
+          int tgt = f[F_TGT], port = f[F_PORT];
+          bool occupied = port_full[tgt * kPorts + port];
+          for (const auto& d : deliveries)
+            occupied |= (d.tgt == tgt && d.port == port);
+          if (!occupied) {
+            deliveries.push_back({tgt, port, src_val[l]});
+            granted[l] = 1;
+          }
+          break;
+        }
+        case OP_PUSH: {
+          if (!src_ok[l]) break;
+          int s = f[F_TGT];
+          if (!stack_taken[s] && begin_tops[s] < stack_cap) {
+            stack_taken[s] = 1;
+            stack_pushes.push_back({s, src_val[l]});
+            granted[l] = 1;
+          }
+          break;
+        }
+        case OP_POP: {
+          int s = f[F_TGT];
+          if (!stack_taken[s] && begin_tops[s] > 0) {
+            stack_taken[s] = 1;
+            pop_val[l] = stacks[s].back();
+            granted[l] = 1;
+          }
+          break;
+        }
+        case OP_IN:
+          if (in_avail && !in_taken) {
+            in_taken = true;
+            in_winner = l;
+            granted[l] = 1;
+          }
+          break;
+        case OP_OUT:
+          if (src_ok[l] && out_free && !out_taken) {
+            out_taken = true;
+            out_value = src_val[l];
+            granted[l] = 1;
+          }
+          break;
+        default:
+          break;
+      }
+    }
+
+    // commit + register/pc effects (reading begin-of-tick acc/bak)
+    std::vector<int32_t> old_acc = acc, old_bak = bak;
+    for (int l = 0; l < n; ++l) {
+      const int32_t* f = ins(l);
+      int op = f[F_OP];
+      bool needs_grant = op == OP_MOV_NET || op == OP_PUSH || op == OP_POP ||
+                         op == OP_IN || op == OP_OUT;
+      bool commit = needs_grant ? granted[l] : src_ok[l];
+      if (!commit) continue;
+      int32_t ln = prog_len[l];
+      switch (op) {
+        case OP_MOV_LOCAL:
+          if (f[F_DST] == DST_ACC) acc[l] = src_val[l];
+          break;
+        case OP_ADD: acc[l] = i32((int64_t)old_acc[l] + src_val[l]); break;
+        case OP_SUB: acc[l] = i32((int64_t)old_acc[l] - src_val[l]); break;
+        case OP_NEG: acc[l] = i32(-(int64_t)old_acc[l]); break;
+        case OP_SWP: acc[l] = old_bak[l]; bak[l] = old_acc[l]; break;
+        case OP_SAV: bak[l] = old_acc[l]; break;
+        case OP_POP:
+          if (f[F_DST] == DST_ACC) acc[l] = pop_val[l];
+          break;
+        case OP_IN:
+          if (f[F_DST] == DST_ACC) acc[l] = in_buf[in_rd % in_cap];
+          break;
+        default: break;
+      }
+      bool taken = op == OP_JMP || (op == OP_JEZ && old_acc[l] == 0) ||
+                   (op == OP_JNZ && old_acc[l] != 0) ||
+                   (op == OP_JGZ && old_acc[l] > 0) ||
+                   (op == OP_JLZ && old_acc[l] < 0);
+      if (taken) {
+        pc[l] = f[F_JMP];
+      } else if (op == OP_JRO) {
+        int64_t t = (int64_t)pc[l] + src_val[l];
+        pc[l] = (int32_t)(t < 0 ? 0 : (t > ln - 1 ? ln - 1 : t));
+      } else {
+        pc[l] = (pc[l] + 1) % ln;
+      }
+      holding[l] = 0;
+      retired[l] += 1;
+    }
+
+    // apply resource effects
+    for (const auto& d : deliveries) {
+      port_full[d.tgt * kPorts + d.port] = 1;
+      port_val[d.tgt * kPorts + d.port] = d.val;
+    }
+    std::vector<uint8_t> pushed(num_stacks, 0);
+    for (const auto& p : stack_pushes) {
+      stacks[p.first].push_back(p.second);
+      pushed[p.first] = 1;
+    }
+    for (int s = 0; s < num_stacks; ++s)
+      if (stack_taken[s] && !pushed[s]) stacks[s].pop_back();
+    if (in_winner >= 0) in_rd += 1;
+    if (out_taken) {
+      out_buf[out_wr % out_cap] = out_value;
+      out_wr += 1;
+    }
+    tick_count += 1;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* misaka_interp_create(const int32_t* code, const int32_t* prog_len,
+                           int n_lanes, int max_len, int num_stacks,
+                           int stack_cap, int in_cap, int out_cap) {
+  if (n_lanes <= 0 || max_len <= 0 || stack_cap <= 0 || in_cap <= 0 ||
+      out_cap <= 0)
+    return nullptr;
+  auto* it = new Interp();
+  it->n_lanes = n_lanes;
+  it->max_len = max_len;
+  it->num_stacks = num_stacks < 1 ? 1 : num_stacks;
+  it->stack_cap = stack_cap;
+  it->in_cap = in_cap;
+  it->out_cap = out_cap;
+  it->code.assign(code, code + (size_t)n_lanes * max_len * NFIELDS);
+  it->prog_len.assign(prog_len, prog_len + n_lanes);
+  for (int l = 0; l < n_lanes; ++l) {
+    if (it->prog_len[l] <= 0 || it->prog_len[l] > max_len) {
+      delete it;
+      return nullptr;
+    }
+  }
+  // Validate every reachable instruction word: the engine indexes ports,
+  // stacks, and jump targets straight from these fields, so a malformed
+  // table must be rejected here, not corrupt memory later.
+  for (int l = 0; l < n_lanes; ++l) {
+    for (int i = 0; i < it->prog_len[l]; ++i) {
+      const int32_t* f = &it->code[(size_t)(l * max_len + i) * NFIELDS];
+      int op = f[F_OP];
+      bool ok = op >= OP_NOP && op <= OP_OUT;
+      if (ok && reads_src(op))
+        ok = f[F_SRC] >= SRC_IMM && f[F_SRC] < SRC_R0 + kPorts;
+      if (ok && op == OP_MOV_NET)
+        ok = f[F_TGT] >= 0 && f[F_TGT] < n_lanes && f[F_PORT] >= 0 &&
+             f[F_PORT] < kPorts;
+      if (ok && (op == OP_PUSH || op == OP_POP))
+        ok = f[F_TGT] >= 0 && f[F_TGT] < it->num_stacks;
+      if (ok && op >= OP_JMP && op <= OP_JLZ)
+        ok = f[F_JMP] >= 0 && f[F_JMP] < it->prog_len[l];
+      if (ok && (op == OP_MOV_LOCAL || op == OP_POP || op == OP_IN))
+        ok = f[F_DST] == DST_ACC || f[F_DST] == DST_NIL;
+      if (!ok) {
+        delete it;
+        return nullptr;
+      }
+    }
+  }
+  it->acc.assign(n_lanes, 0);
+  it->bak.assign(n_lanes, 0);
+  it->pc.assign(n_lanes, 0);
+  it->hold_val.assign(n_lanes, 0);
+  it->retired.assign(n_lanes, 0);
+  it->holding.assign(n_lanes, 0);
+  it->port_val.assign((size_t)n_lanes * kPorts, 0);
+  it->port_full.assign((size_t)n_lanes * kPorts, 0);
+  it->stacks.resize(it->num_stacks);
+  it->in_buf.assign(in_cap, 0);
+  it->out_buf.assign(out_cap, 0);
+  return it;
+}
+
+void misaka_interp_destroy(void* h) { delete (Interp*)h; }
+
+int misaka_interp_feed(void* h, const int32_t* values, int count) {
+  auto* it = (Interp*)h;
+  int fed = 0;
+  for (int i = 0; i < count; ++i) {
+    if (it->in_wr - it->in_rd >= it->in_cap) break;
+    it->in_buf[it->in_wr % it->in_cap] = values[i];
+    it->in_wr += 1;
+    fed += 1;
+  }
+  return fed;
+}
+
+void misaka_interp_run(void* h, int ticks) {
+  auto* it = (Interp*)h;
+  for (int i = 0; i < ticks; ++i) it->tick();
+}
+
+int misaka_interp_drain(void* h, int32_t* out, int max_out) {
+  auto* it = (Interp*)h;
+  int got = 0;
+  while (it->out_rd < it->out_wr && got < max_out) {
+    out[got++] = it->out_buf[it->out_rd % it->out_cap];
+    it->out_rd += 1;
+  }
+  return got;
+}
+
+// Bulk state read-back for differential comparison.  stack_mem is
+// [num_stacks][stack_cap], zero-padded above each stack's top.
+void misaka_interp_read(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
+                        int32_t* port_val, uint8_t* port_full,
+                        int32_t* hold_val, uint8_t* holding,
+                        int32_t* stack_mem, int32_t* stack_top,
+                        int32_t* out_buf, int32_t* counters /*[5]*/,
+                        int32_t* retired) {
+  auto* it = (Interp*)h;
+  int n = it->n_lanes;
+  std::memcpy(acc, it->acc.data(), n * 4);
+  std::memcpy(bak, it->bak.data(), n * 4);
+  std::memcpy(pc, it->pc.data(), n * 4);
+  std::memcpy(port_val, it->port_val.data(), (size_t)n * kPorts * 4);
+  std::memcpy(port_full, it->port_full.data(), (size_t)n * kPorts);
+  std::memcpy(hold_val, it->hold_val.data(), n * 4);
+  std::memcpy(holding, it->holding.data(), n);
+  std::memcpy(retired, it->retired.data(), n * 4);
+  for (int s = 0; s < it->num_stacks; ++s) {
+    stack_top[s] = (int32_t)it->stacks[s].size();
+    for (int c = 0; c < it->stack_cap; ++c)
+      stack_mem[s * it->stack_cap + c] =
+          c < (int)it->stacks[s].size() ? it->stacks[s][c] : 0;
+  }
+  std::memcpy(out_buf, it->out_buf.data(), (size_t)it->out_cap * 4);
+  counters[0] = it->in_rd;
+  counters[1] = it->in_wr;
+  counters[2] = it->out_rd;
+  counters[3] = it->out_wr;
+  counters[4] = it->tick_count;
+}
+
+}  // extern "C"
