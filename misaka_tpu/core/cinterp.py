@@ -36,6 +36,8 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.misaka_interp_run.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.misaka_interp_drain.restype = ctypes.c_int
     lib.misaka_interp_drain.argtypes = [ctypes.c_void_p, _I32P, ctypes.c_int]
+    lib.misaka_interp_seed_counters.restype = None
+    lib.misaka_interp_seed_counters.argtypes = [ctypes.c_void_p] + [ctypes.c_int32] * 4
     lib.misaka_interp_read.restype = None
     lib.misaka_interp_read.argtypes = [ctypes.c_void_p] + [
         _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
@@ -72,6 +74,14 @@ class NativeInterpreter:
         self._lib = lib
         code = np.ascontiguousarray(code, dtype=np.int32)
         prog_len = np.ascontiguousarray(prog_len, dtype=np.int32)
+        if code.ndim != 3 or code.shape[2] != isa.NFIELDS:
+            raise ValueError(
+                f"code must be [n_lanes, max_len, {isa.NFIELDS}], got {code.shape}"
+            )
+        if prog_len.shape != (code.shape[0],):
+            raise ValueError(
+                f"prog_len must have shape ({code.shape[0]},), got {prog_len.shape}"
+            )
         self.n_lanes, self.max_len, _ = code.shape
         self.num_stacks = max(1, num_stacks)
         self.stack_cap = stack_cap
@@ -107,20 +117,32 @@ class NativeInterpreter:
     def __exit__(self, *exc):
         self.close()
 
+    def _handle(self):
+        if not self._h:
+            raise RuntimeError("interpreter is closed")
+        return self._h
+
     def feed(self, values) -> int:
         vals = np.ascontiguousarray(values, dtype=np.int32)
-        return self._lib.misaka_interp_feed(self._h, _as_i32p(vals), len(vals))
+        return self._lib.misaka_interp_feed(self._handle(), _as_i32p(vals), len(vals))
 
     def run(self, ticks: int) -> None:
-        self._lib.misaka_interp_run(self._h, int(ticks))
+        self._lib.misaka_interp_run(self._handle(), int(ticks))
 
     def drain(self) -> list[int]:
         out = np.zeros((self.out_cap,), np.int32)
-        got = self._lib.misaka_interp_drain(self._h, _as_i32p(out), self.out_cap)
+        got = self._lib.misaka_interp_drain(self._handle(), _as_i32p(out), self.out_cap)
         return out[:got].tolist()
+
+    def seed_counters(self, in_rd: int, in_wr: int, out_rd: int, out_wr: int) -> None:
+        """Set the ring counters directly (checkpoint restore / soak tests)."""
+        self._lib.misaka_interp_seed_counters(
+            self._handle(), int(in_rd), int(in_wr), int(out_rd), int(out_wr)
+        )
 
     def state_arrays(self) -> dict:
         """Mirror tests/oracle.py state_arrays for differential comparison."""
+        self._handle()
         n, s, cap = self.n_lanes, self.num_stacks, self.stack_cap
         acc = np.zeros(n, np.int32)
         bak = np.zeros(n, np.int32)

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from misaka_tpu.core.state import NetworkState, init_state
+from misaka_tpu.core.state import NetworkState, init_state, rebase_rings
 from misaka_tpu.core.step import step
 
 _I32 = jnp.int32
@@ -32,7 +32,7 @@ def _run_chunk(tables, state: NetworkState, num_steps: int) -> NetworkState:
         return step(code, prog_len, s), None
 
     out, _ = jax.lax.scan(body, state, None, length=num_steps)
-    return out
+    return rebase_rings(out)
 
 
 @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
@@ -44,7 +44,7 @@ def _run_chunk_batched(tables, state: NetworkState, num_steps: int) -> NetworkSt
         return step_b(code, prog_len, s), None
 
     out, _ = jax.lax.scan(body, state, None, length=num_steps)
-    return out
+    return rebase_rings(out)
 
 
 @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(1, 2))
@@ -52,7 +52,8 @@ def _run_chunk_traced(tables, state: NetworkState, trace, num_steps: int):
     from misaka_tpu.core.trace import run_traced
 
     code, prog_len = tables
-    return run_traced(code, prog_len, state, trace, num_steps)
+    state, trace = run_traced(code, prog_len, state, trace, num_steps)
+    return rebase_rings(state), trace
 
 
 @jax.jit

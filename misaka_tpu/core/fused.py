@@ -29,7 +29,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from misaka_tpu.core.state import NetworkState
+from misaka_tpu.core.state import NetworkState, rebase_rings
 from misaka_tpu.tis import isa
 
 LANE = 128  # VPU lane width; batch blocks are multiples of this
@@ -442,7 +442,7 @@ def make_fused_runner(
         (acc, bak, pc, pv, pf, hv, ho, sm, st, ob, sc_o, ret) = call(*args)
         b = batch
         sc_flat = from_rows(sc_o, 5, (b, 5), _I32)
-        return NetworkState(
+        return rebase_rings(NetworkState(
             acc=from_rows(acc, n_lanes, (b, n_lanes), _I32),
             bak=from_rows(bak, n_lanes, (b, n_lanes), _I32),
             pc=from_rows(pc, n_lanes, (b, n_lanes), _I32),
@@ -460,6 +460,6 @@ def make_fused_runner(
             out_wr=sc_flat[:, 3],
             tick=sc_flat[:, 4],
             retired=from_rows(ret, n_lanes, (b, n_lanes), _I32),
-        )
+        ))
 
     return run

@@ -14,6 +14,15 @@ Ring-buffer convention: `rd`/`wr` are monotonically increasing int32 counters;
 the slot index is `counter % capacity`; occupancy is `wr - rd`.  The device
 consumes inputs (IN) and produces outputs (OUT); the host refills `in_buf` /
 advances `out_rd` between jitted chunks.
+
+Counter lifetime: a long-soak master moves >2^31 values within hours, and a
+wrapped-negative int32 counter breaks `% capacity` indexing.  Every chunk
+runner therefore calls `rebase_rings` after its scan: once a ring's `rd`
+passes 2^30, a multiple of the ring's capacity is subtracted from both its
+counters — slot indices and occupancy are unchanged, and the headroom
+(2^31 - 2^30 ≈ 1e9 values) can never be consumed within one chunk.  The
+`tick`/`retired` metrics counters, by contrast, are allowed to wrap: nothing
+indexes off them.
 """
 
 from __future__ import annotations
@@ -58,6 +67,27 @@ class NetworkState(NamedTuple):
     # metrics
     tick: jnp.ndarray       # int32 scalar — supersteps executed
     retired: jnp.ndarray    # [N] int32 — committed instructions per lane
+
+
+REBASE_THRESHOLD = 1 << 30
+
+
+def rebase_rings(state: NetworkState) -> NetworkState:
+    """Rebase I/O ring counters below the int32 wrap (see module docstring).
+
+    Elementwise, so it works for unbatched scalars and batched [B] counters
+    alike; a no-op until a counter passes REBASE_THRESHOLD.
+    """
+
+    def rb(rd, wr, cap):
+        base = jnp.where(
+            rd > REBASE_THRESHOLD, (rd // cap) * cap, jnp.zeros_like(rd)
+        )
+        return rd - base, wr - base
+
+    in_rd, in_wr = rb(state.in_rd, state.in_wr, state.in_buf.shape[-1])
+    out_rd, out_wr = rb(state.out_rd, state.out_wr, state.out_buf.shape[-1])
+    return state._replace(in_rd=in_rd, in_wr=in_wr, out_rd=out_rd, out_wr=out_wr)
 
 
 def init_state(

@@ -34,7 +34,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from misaka_tpu.core.state import NetworkState
+from misaka_tpu.core.state import NetworkState, rebase_rings
 from misaka_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, state_specs
 from misaka_tpu.tis import isa
 
@@ -254,7 +254,7 @@ def make_sharded_runner(code, prog_len, mesh, num_steps: int, batched: bool = Tr
             return step_fn(code_l, prog_len_l, s), None
 
         out, _ = jax.lax.scan(body, state, None, length=num_steps)
-        return out
+        return rebase_rings(out)
 
     sharded = shard_map(
         chunk,

@@ -206,7 +206,9 @@ struct Interp {
         pc[l] = (pc[l] + 1) % ln;
       }
       holding[l] = 0;
-      retired[l] += 1;
+      // wrap-safe: signed int32 overflow is UB, and soak runs can pass 2^31
+      // commits; the JAX kernels wrap deterministically, match them.
+      retired[l] = i32((int64_t)retired[l] + 1);
     }
 
     // apply resource effects
@@ -226,7 +228,7 @@ struct Interp {
       out_buf[out_wr % out_cap] = out_value;
       out_wr += 1;
     }
-    tick_count += 1;
+    tick_count = i32((int64_t)tick_count + 1);  // wrap-safe, like retired
   }
 };
 
@@ -311,6 +313,30 @@ int misaka_interp_feed(void* h, const int32_t* values, int count) {
 void misaka_interp_run(void* h, int ticks) {
   auto* it = (Interp*)h;
   for (int i = 0; i < ticks; ++i) it->tick();
+  // Rebase ring counters below the int32 wrap at the chunk boundary, exactly
+  // like the device engines (core/state.py rebase_rings): a multiple of the
+  // ring capacity preserves slot indices and occupancy.
+  const int32_t kThreshold = 1 << 30;
+  if (it->in_rd > kThreshold) {
+    int32_t base = (it->in_rd / it->in_cap) * it->in_cap;
+    it->in_rd -= base;
+    it->in_wr -= base;
+  }
+  if (it->out_rd > kThreshold) {
+    int32_t base = (it->out_rd / it->out_cap) * it->out_cap;
+    it->out_rd -= base;
+    it->out_wr -= base;
+  }
+}
+
+// Set ring counters directly (checkpoint restore; rebase soak tests).
+void misaka_interp_seed_counters(void* h, int32_t in_rd, int32_t in_wr,
+                                 int32_t out_rd, int32_t out_wr) {
+  auto* it = (Interp*)h;
+  it->in_rd = in_rd;
+  it->in_wr = in_wr;
+  it->out_rd = out_rd;
+  it->out_wr = out_wr;
 }
 
 int misaka_interp_drain(void* h, int32_t* out, int max_out) {

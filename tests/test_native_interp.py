@@ -104,6 +104,30 @@ def test_invalid_tables_rejected():
         )
 
 
+def test_malformed_shapes_rejected():
+    """Wrong field/lane dimensions must raise before any pointer crosses the
+    ABI (a [2,4,6] table used to over-read the buffer in C++)."""
+    with pytest.raises(ValueError, match="code must be"):
+        cinterp.NativeInterpreter(
+            np.zeros((2, 4, 6), np.int32), np.array([1, 1], np.int32), 1, 4, 4, 4
+        )
+    with pytest.raises(ValueError, match="prog_len must have shape"):
+        cinterp.NativeInterpreter(
+            np.zeros((2, 4, 7), np.int32), np.array([1], np.int32), 1, 4, 4, 4
+        )
+
+
+def test_closed_handle_raises():
+    top = networks.acc_loop(in_cap=4, out_cap=4)
+    net = top.compile()
+    n = cinterp.NativeInterpreter(net.code, net.prog_len, 1, 4, 4, 4)
+    n.close()
+    for call in (lambda: n.feed([1]), lambda: n.run(1), n.drain, n.state_arrays):
+        with pytest.raises(RuntimeError, match="closed"):
+            call()
+    n.close()  # double-close is fine
+
+
 def test_out_of_bounds_fields_rejected():
     """Malformed field values must be rejected at create, not corrupt memory
     at run time (MOV_NET target OOB used to segfault)."""

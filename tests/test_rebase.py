@@ -1,0 +1,119 @@
+"""Ring-counter rebasing: long-soak masters must never wrap int32 counters.
+
+A master at ~1e5-1e6 values/sec crosses 2^31 ring-counter increments within
+hours; a wrapped-negative counter breaks `% capacity` indexing.  Every chunk
+runner rebases counters past 2^30 by a multiple of the ring capacity
+(core/state.rebase_rings) — these tests start engines just past the
+threshold and prove computation is unaffected and counters come back small.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from misaka_tpu import networks
+from misaka_tpu.core import cinterp
+from misaka_tpu.core.state import REBASE_THRESHOLD, rebase_rings
+
+BIG = REBASE_THRESHOLD + 7
+
+
+def near_wrap_state(net):
+    """An add2 state whose ring counters sit just past the rebase threshold.
+
+    Counters are advanced by an exact multiple of each ring's capacity, so
+    slot indices are identical to a fresh state's.
+    """
+    state = net.init_state()
+    in_base = (BIG // net.in_cap + 1) * net.in_cap
+    out_base = (BIG // net.out_cap + 1) * net.out_cap
+    return state._replace(
+        in_rd=state.in_rd + np.int32(in_base),
+        in_wr=state.in_wr + np.int32(in_base),
+        out_rd=state.out_rd + np.int32(out_base),
+        out_wr=state.out_wr + np.int32(out_base),
+    )
+
+
+def test_rebase_rings_preserves_depth_and_slots():
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    state = near_wrap_state(net)
+    state = state._replace(in_wr=state.in_wr + 3)  # depth 3
+    rebased = rebase_rings(state)
+    assert int(rebased.in_rd) < REBASE_THRESHOLD
+    assert int(rebased.in_wr - rebased.in_rd) == 3
+    assert int(rebased.in_rd) % net.in_cap == int(state.in_rd) % net.in_cap
+
+
+def test_rebase_noop_below_threshold():
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    state = net.init_state()
+    rebased = rebase_rings(state)
+    assert int(rebased.in_rd) == 0 and int(rebased.out_wr) == 0
+
+
+def test_engine_computes_through_threshold():
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    state = near_wrap_state(net)
+    state, outs = net.compute_stream(state, [5, 6, 7])
+    assert outs == [7, 8, 9]
+    assert int(state.in_rd) < REBASE_THRESHOLD
+    assert int(state.out_wr) < REBASE_THRESHOLD
+
+
+def test_batched_engine_rebases():
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile(batch=4)
+    state = net.init_state()
+    in_base = (BIG // net.in_cap + 1) * net.in_cap
+    vals = np.tile(np.arange(4, dtype=np.int32)[:, None], (1, 4))
+    in_buf = np.zeros((4, 8), np.int32)
+    in_buf[:, :4] = vals
+    state = state._replace(
+        in_buf=jnp.asarray(in_buf),
+        in_rd=state.in_rd + np.int32(in_base),
+        in_wr=state.in_wr + np.int32(in_base + 4),
+    )
+    state = net.run(state, 64)
+    assert (np.asarray(state.out_wr) == 4).all()
+    np.testing.assert_array_equal(np.asarray(state.out_buf)[:, :4], vals + 2)
+    assert (np.asarray(state.in_rd) < REBASE_THRESHOLD).all()
+
+
+def test_native_interp_rebases():
+    if not cinterp.available():
+        import pytest
+
+        pytest.skip("native interpreter unavailable")
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    with cinterp.NativeInterpreter(net.code, net.prog_len, 1, 8, 8, 8) as n:
+        # Seed the counters just past the threshold (multiple of cap keeps
+        # slot indices aligned with the empty buffers), then compute through.
+        big = (BIG // 8 + 1) * 8
+        n.seed_counters(big, big, big, big)
+        n.feed([1, 2])
+        n.run(100)
+        assert n.drain() == [3, 4]
+        st = n.state_arrays()
+        assert 0 < int(st["in_rd"]) < REBASE_THRESHOLD
+        assert int(st["out_wr"]) < REBASE_THRESHOLD
+        # depth/slot invariants held across the rebase
+        assert int(st["in_rd"]) % 8 == big % 8 + 2
+
+
+def test_fused_kernel_rebases():
+    """The Pallas path (interpret mode on CPU) rebases like the XLA path."""
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile(batch=128)
+    state = net.init_state()
+    in_base = (BIG // net.in_cap + 1) * net.in_cap
+    vals = np.tile(np.arange(128, dtype=np.int32)[:, None], (1, 2))
+    in_buf = np.zeros((128, 8), np.int32)
+    in_buf[:, :2] = vals
+    state = state._replace(
+        in_buf=jnp.asarray(in_buf),
+        in_rd=state.in_rd + np.int32(in_base),
+        in_wr=state.in_wr + np.int32(in_base + 2),
+    )
+    runner = net.fused_runner(48, interpret=True)
+    state = runner(state)
+    assert (np.asarray(state.out_wr) - np.asarray(state.out_rd) == 2).all()
+    np.testing.assert_array_equal(np.asarray(state.out_buf)[:, :2], vals + 2)
+    assert (np.asarray(state.in_rd) < REBASE_THRESHOLD).all()
