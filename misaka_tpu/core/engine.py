@@ -71,6 +71,23 @@ def _feed(state: NetworkState, values: jnp.ndarray, count: jnp.ndarray) -> Netwo
     return state._replace(in_buf=new_buf, in_wr=state.in_wr + count.astype(_I32))
 
 
+@jax.jit
+def _feed_batched(state: NetworkState, values: jnp.ndarray, counts: jnp.ndarray) -> NetworkState:
+    """Per-instance ring append: values [B, K], counts [B] (counts <= free).
+
+    K is fixed (the ring capacity) so this compiles once; masked slots keep
+    their old contents.
+    """
+    in_cap = state.in_buf.shape[-1]
+    b, k = values.shape
+    rows = jnp.arange(b)[:, None]
+    idx = (state.in_wr[:, None] + jnp.arange(k, dtype=_I32)[None, :]) % in_cap
+    mask = jnp.arange(k)[None, :] < counts[:, None]
+    cur = state.in_buf[rows, idx]
+    new_buf = state.in_buf.at[rows, idx].set(jnp.where(mask, values, cur))
+    return state._replace(in_buf=new_buf, in_wr=state.in_wr + counts.astype(_I32))
+
+
 @dataclass
 class CompiledNetwork:
     """A lowered network bound to the jitted superstep engine.
@@ -180,6 +197,38 @@ class CompiledNetwork:
         buf = np.zeros((self.in_cap,), np.int32)
         buf[:k] = values[:k]
         return _feed(state, jnp.asarray(buf), jnp.asarray(k, _I32)), k
+
+    def feed_batched(self, state: NetworkState, values, counts) -> NetworkState:
+        """Append per-instance inputs: values [B, in_cap] int32, counts [B].
+
+        Caller guarantees counts[b] <= free space of instance b (the batched
+        master computes free from the same state it passes in).
+        """
+        if self.batch is None:
+            raise ValueError("feed_batched requires a batched network")
+        values = np.ascontiguousarray(values, dtype=np.int32)
+        counts = np.ascontiguousarray(counts, dtype=np.int32)
+        if values.shape != (self.batch, self.in_cap) or counts.shape != (self.batch,):
+            raise ValueError(
+                f"need values [{self.batch}, {self.in_cap}] and counts "
+                f"[{self.batch}], got {values.shape} / {counts.shape}"
+            )
+        return _feed_batched(state, jnp.asarray(values), jnp.asarray(counts))
+
+    def drain_batched(self, state: NetworkState) -> tuple[NetworkState, list[list[int]]]:
+        """Collect pending outputs per instance, in order; advances out_rd."""
+        if self.batch is None:
+            raise ValueError("drain_batched requires a batched network")
+        rd = np.asarray(state.out_rd)
+        wr = np.asarray(state.out_wr)
+        if (wr == rd).all():
+            return state, [[] for _ in range(self.batch)]
+        buf = np.asarray(state.out_buf)
+        outs = [
+            [int(buf[b, i % self.out_cap]) for i in range(rd[b], wr[b])]
+            for b in range(self.batch)
+        ]
+        return state._replace(out_rd=jnp.asarray(wr)), outs
 
     def drain(self, state: NetworkState) -> tuple[NetworkState, list[int]]:
         """Collect all pending outputs in order; advances out_rd."""

@@ -12,6 +12,10 @@ so the master's env surface is what survives:
                    docker-compose .yml, imported directly (runtime/compose.py)
   MISAKA_PORT      HTTP port (default 8000 = clientPort, master.go:19)
   MISAKA_AUTORUN   "1" to start running immediately (default: wait for /run)
+  MISAKA_BATCH     run N independent network instances in lockstep and serve
+                   concurrent /compute requests round-robin across them
+                   (default: one instance, strictly serialized /compute;
+                   incompatible with MISAKA_TRACE_CAP)
   MISAKA_CHECKPOINT_DIR  enable HTTP /checkpoint & /restore, storing named
                    .npz snapshots in this directory (disabled when unset;
                    fused master only — per-process nodes hold their own
@@ -162,7 +166,8 @@ def main() -> None:
     elif node_type == "master":
         topology = build_topology_from_env()
         trace_cap = int(environ.get("MISAKA_TRACE_CAP", "0")) or None
-        master = MasterNode(topology, trace_cap=trace_cap)
+        batch = int(environ.get("MISAKA_BATCH", "0")) or None
+        master = MasterNode(topology, trace_cap=trace_cap, batch=batch)
         if environ.get("MISAKA_AUTORUN") == "1":
             master.run()
         _serve_http(

@@ -58,10 +58,24 @@ class MasterNode:
         topology: Topology,
         chunk_steps: int = 128,
         trace_cap: int | None = None,
+        batch: int | None = None,
     ):
+        """batch=None serves one network instance (every /compute strictly
+        serialized — the correlated fix for quirk #2).  batch=B runs B
+        independent instances in lockstep (the engine's vmap axis) and
+        round-robins concurrent /compute requests across them: up to B
+        requests progress in parallel, each instance's request/response
+        pairing still strictly FIFO.  The reference allows concurrency only
+        by racing (master.go:216-219 swaps responses); this is the
+        deterministic version of that capability."""
+        if batch is not None and trace_cap is not None:
+            raise ValueError("tracing drives a single instance (batch=None)")
+        if batch is not None and batch < 1:
+            raise ValueError(f"batch must be >= 1, got {batch}")
         self._topology = topology
         self._chunk = chunk_steps
-        self._net = topology.compile()
+        self._batch = batch
+        self._net = topology.compile(batch=batch)
         self._state = self._net.init_state()
         # Optional per-lane instruction trace ring (core/trace.py).  The debug
         # path: every tick of every lane is recorded device-side and decoded
@@ -72,12 +86,19 @@ class MasterNode:
         self._loop: threading.Thread | None = None
         self._state_lock = threading.Lock()      # guards _state/_net swaps
         self._lifecycle_lock = threading.RLock() # serializes run/pause/reset/load
-        self._compute_lock = threading.Lock()    # serializes /compute pairing
-        self._in_q: queue.Queue[int] = queue.Queue()
-        self._out_q: queue.Queue[int] = queue.Queue()
+        # Unbatched: one global pairing lock + one queue pair.  Batched: a
+        # queue pair + pairing lock + stale counter PER INSTANCE, and a
+        # round-robin dispenser.
+        n_slots = batch or 1
+        self._compute_locks = [threading.Lock() for _ in range(n_slots)]
+        self._in_qs = [queue.Queue() for _ in range(n_slots)]
+        self._out_qs = [queue.Queue() for _ in range(n_slots)]
+        self._in_q = self._in_qs[0]  # the unbatched device-loop path
+        self._rr = 0
+        self._rr_lock = threading.Lock()
         # Outputs orphaned by /compute timeouts; discarded on arrival so the
         # request/response pairing stays correlated (quirk #2 stays fixed).
-        self._stale_outputs = 0
+        self._stale = [0] * n_slots
         # Host-side tick-rate gauge, maintained solely by the device loop
         # (readers of /status never mutate it).
         self._ticks_done = 0
@@ -132,7 +153,7 @@ class MasterNode:
             new_topology = self._topology.with_program(target, program)  # validates target
             self.pause()
             try:
-                new_net = new_topology.compile()  # may raise parse/lower errors
+                new_net = new_topology.compile(batch=self._batch)  # may raise parse/lower errors
             except Exception:
                 with self._state_lock:
                     self._state = self._net.init_state()
@@ -150,24 +171,29 @@ class MasterNode:
     def compute(self, value: int, timeout: float = 30.0) -> int:
         """One value in, one value out — correlated (fixes quirk #2).
 
-        On timeout the in-flight value's eventual output is recorded as stale
-        and discarded when it surfaces, so later calls stay correctly paired.
+        Batched masters round-robin requests over instances: concurrency up
+        to `batch`, with per-instance FIFO pairing.  On timeout the in-flight
+        value's eventual output is recorded as stale and discarded when it
+        surfaces, so later calls on that instance stay correctly paired.
         """
-        with self._compute_lock:
-            self._in_q.put(value)
+        with self._rr_lock:
+            slot = self._rr
+            self._rr = (self._rr + 1) % len(self._in_qs)
+        with self._compute_locks[slot]:
+            self._in_qs[slot].put(value)
             deadline = time.monotonic() + timeout
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    self._stale_outputs += 1
+                    self._stale[slot] += 1
                     raise ComputeTimeout(f"no output for value {value} after {timeout}s")
                 try:
-                    out = self._out_q.get(timeout=remaining)
+                    out = self._out_qs[slot].get(timeout=remaining)
                 except queue.Empty:
-                    self._stale_outputs += 1
+                    self._stale[slot] += 1
                     raise ComputeTimeout(f"no output for value {value} after {timeout}s")
-                if self._stale_outputs:
-                    self._stale_outputs -= 1
+                if self._stale[slot]:
+                    self._stale[slot] -= 1
                     continue  # a previously timed-out request's output; drop it
                 return out
 
@@ -186,12 +212,17 @@ class MasterNode:
         with self._state_lock:
             state = self._state
             topo = self._topology
-            tick = int(np.asarray(state.tick))
+            # Batched states carry a leading [B] axis; report totals across
+            # instances (tick is lockstep-identical, take instance 0).
+            tick = int(np.asarray(state.tick).flat[0])
             retired = np.asarray(state.retired)
             stack_top = np.asarray(state.stack_top)
-            in_depth = int(state.in_wr - state.in_rd)
-            out_depth = int(state.out_wr - state.out_rd)
-        return {
+            if self._batch is not None:
+                retired = retired.sum(axis=0)
+                stack_top = stack_top.sum(axis=0)
+            in_depth = int(np.asarray(state.in_wr - state.in_rd).sum())
+            out_depth = int(np.asarray(state.out_wr - state.out_rd).sum())
+        status = {
             "running": self._running,
             "tick": tick,
             "ticks_per_sec": self._rate,  # maintained by the device loop
@@ -201,10 +232,13 @@ class MasterNode:
             "stack_depth": {
                 name: int(stack_top[i]) for name, i in topo.stack_ids().items()
             },
-            "in_queue": self._in_q.qsize() + in_depth,
-            "out_queue": self._out_q.qsize() + out_depth,
+            "in_queue": sum(q.qsize() for q in self._in_qs) + in_depth,
+            "out_queue": sum(q.qsize() for q in self._out_qs) + out_depth,
             "nodes": dict(topo.node_info),
         }
+        if self._batch is not None:
+            status["batch"] = self._batch
+        return status
 
     def trace(self, last: int | None = None) -> list[dict]:
         """Decoded instruction history, oldest first (requires trace_cap).
@@ -250,6 +284,7 @@ class MasterNode:
                     "stack_cap": topo.stack_cap,
                     "in_cap": topo.in_cap,
                     "out_cap": topo.out_cap,
+                    "batch": self._batch,
                 }
             ).encode(),
             dtype=np.uint8,
@@ -272,6 +307,12 @@ class MasterNode:
             state = NetworkState(
                 **{f: jnp.asarray(data[f]) for f in NetworkState._fields}
             )
+        ckpt_batch = meta.get("batch")
+        if ckpt_batch != self._batch:
+            raise ValueError(
+                f"checkpoint batch={ckpt_batch} does not match this master's "
+                f"batch={self._batch} (request queues are per-instance)"
+            )
         new_topology = Topology(
             node_info=meta["nodes"],
             programs=meta["programs"],
@@ -281,7 +322,7 @@ class MasterNode:
         )
         with self._lifecycle_lock:
             self.pause()
-            new_net = new_topology.compile()
+            new_net = new_topology.compile(batch=self._batch)
             with self._state_lock:
                 self._topology = new_topology
                 self._net = new_net
@@ -311,13 +352,14 @@ class MasterNode:
     # --- the device loop ----------------------------------------------------
 
     def _drain_queues(self) -> None:
-        for q in (self._in_q, self._out_q):
+        for q in (*self._in_qs, *self._out_qs):
             while True:
                 try:
                     q.get_nowait()
                 except queue.Empty:
                     break
-        self._stale_outputs = 0  # reset/load wipe the rings: nothing stale survives
+        # reset/load wipe the rings: nothing stale survives
+        self._stale = [0] * len(self._stale)
 
     def _device_loop(self) -> None:
         """Run jitted chunks; sync rings with host queues at the boundaries."""
@@ -334,16 +376,36 @@ class MasterNode:
             busy = False
             with self._state_lock:
                 state = self._state
-                pending = []
-                free = self._net.in_cap - int(state.in_wr - state.in_rd)
-                while len(pending) < free:
-                    try:
-                        pending.append(self._in_q.get_nowait())
-                    except queue.Empty:
-                        break
-                if pending:
-                    state, _ = self._net.feed(state, pending)
-                    busy = True
+                if self._batch is None:
+                    pending = []
+                    free = self._net.in_cap - int(state.in_wr - state.in_rd)
+                    while len(pending) < free:
+                        try:
+                            pending.append(self._in_q.get_nowait())
+                        except queue.Empty:
+                            break
+                    if pending:
+                        state, _ = self._net.feed(state, pending)
+                        busy = True
+                elif any(not q.empty() for q in self._in_qs):
+                    # allocate the [B, in_cap] feed matrix only when there is
+                    # actually something queued — an idle batched loop must
+                    # not churn 256KB/iteration
+                    vals = np.zeros((self._batch, self._net.in_cap), np.int32)
+                    counts = np.zeros((self._batch,), np.int32)
+                    free = self._net.in_cap - (
+                        np.asarray(state.in_wr) - np.asarray(state.in_rd)
+                    )
+                    for b in range(self._batch):
+                        while counts[b] < free[b]:
+                            try:
+                                vals[b, counts[b]] = self._in_qs[b].get_nowait()
+                                counts[b] += 1
+                            except queue.Empty:
+                                break
+                    if counts.any():
+                        state = self._net.feed_batched(state, vals, counts)
+                        busy = True
                 if self._trace is not None:
                     state, self._trace = self._net.run_traced(
                         state, self._trace, self._chunk
@@ -358,12 +420,17 @@ class MasterNode:
                     )
                     self._rate_mark_tick = self._ticks_done
                     self._rate_mark_time = now
-                state, outs = self._net.drain(state)
+                if self._batch is None:
+                    state, outs = self._net.drain(state)
+                    per_slot = [outs]
+                else:
+                    state, per_slot = self._net.drain_batched(state)
                 self._state = state
-            for v in outs:
-                self._out_q.put(v)
-            if outs:
-                busy = True
+            for slot, outs in enumerate(per_slot):
+                for v in outs:
+                    self._out_qs[slot].put(v)
+                if outs:
+                    busy = True
             if not busy:
                 # Nothing moved: the network is parked on empty queues.  Idle
                 # gently instead of burning host CPU on no-op chunks.
