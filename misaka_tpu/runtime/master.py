@@ -98,7 +98,12 @@ class MasterNode:
         self._rr_lock = threading.Lock()
         # Outputs orphaned by /compute timeouts; discarded on arrival so the
         # request/response pairing stays correlated (quirk #2 stays fixed).
+        # The epoch invalidates that bookkeeping across reset/load: a compute
+        # whose request was wiped by a queue drain must NOT mark its missing
+        # output as stale (there is no output coming — a phantom stale entry
+        # would mispair every later request on the slot).
         self._stale = [0] * n_slots
+        self._epoch = 0
         # Host-side tick-rate gauge, maintained solely by the device loop
         # (readers of /status never mutate it).
         self._ticks_done = 0
@@ -171,31 +176,50 @@ class MasterNode:
     def compute(self, value: int, timeout: float = 30.0) -> int:
         """One value in, one value out — correlated (fixes quirk #2).
 
-        Batched masters round-robin requests over instances: concurrency up
-        to `batch`, with per-instance FIFO pairing.  On timeout the in-flight
-        value's eventual output is recorded as stale and discarded when it
-        surfaces, so later calls on that instance stay correctly paired.
+        Batched masters prefer a FREE instance (try-acquire scan from a
+        rotating start) so one slow request can't head-of-line block traffic
+        while other instances idle; only when every instance is busy does
+        the caller block on one.  On timeout the in-flight value's eventual
+        output is recorded as stale and discarded when it surfaces, so later
+        calls on that instance stay correctly paired — unless a reset/load
+        wiped the request (epoch bump), in which case no output is coming
+        and nothing is marked stale.
         """
+        n = len(self._in_qs)
         with self._rr_lock:
-            slot = self._rr
-            self._rr = (self._rr + 1) % len(self._in_qs)
-        with self._compute_locks[slot]:
+            start = self._rr
+            self._rr = (self._rr + 1) % n
+        slot = None
+        for i in range(n):
+            cand = (start + i) % n
+            if self._compute_locks[cand].acquire(blocking=False):
+                slot = cand
+                break
+        if slot is None:  # all instances busy: wait on the rotating one
+            slot = start
+            self._compute_locks[slot].acquire()
+        try:
+            epoch = self._epoch
             self._in_qs[slot].put(value)
             deadline = time.monotonic() + timeout
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    self._stale[slot] += 1
+                    if self._epoch == epoch:
+                        self._stale[slot] += 1
                     raise ComputeTimeout(f"no output for value {value} after {timeout}s")
                 try:
                     out = self._out_qs[slot].get(timeout=remaining)
                 except queue.Empty:
-                    self._stale[slot] += 1
+                    if self._epoch == epoch:
+                        self._stale[slot] += 1
                     raise ComputeTimeout(f"no output for value {value} after {timeout}s")
                 if self._stale[slot]:
                     self._stale[slot] -= 1
                     continue  # a previously timed-out request's output; drop it
                 return out
+        finally:
+            self._compute_locks[slot].release()
 
     @property
     def is_running(self) -> bool:
@@ -358,8 +382,10 @@ class MasterNode:
                     q.get_nowait()
                 except queue.Empty:
                     break
-        # reset/load wipe the rings: nothing stale survives
+        # reset/load wipe the rings: nothing stale survives, and any compute
+        # still waiting must not record its wiped request as stale (epoch).
         self._stale = [0] * len(self._stale)
+        self._epoch += 1
 
     def _device_loop(self) -> None:
         """Run jitted chunks; sync rings with host queues at the boundaries."""

@@ -143,3 +143,49 @@ def test_unbatched_still_serializes():
         assert "batch" not in master.status()
     finally:
         master.pause()
+
+
+def test_reset_during_blocked_compute_keeps_slot_healthy():
+    """A reset that wipes a waiting request must not poison its slot's
+    pairing (phantom stale counter -> every later compute times out)."""
+    master = make_master(batch=2)  # not running: computes block
+    errors = []
+
+    def doomed():
+        try:
+            master.compute(1, timeout=3)
+        except ComputeTimeout:
+            pass
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    t = threading.Thread(target=doomed)
+    t.start()
+    import time
+
+    time.sleep(0.3)
+    master.reset()  # wipes the queued request mid-wait (epoch bump)
+    t.join()
+    assert not errors
+
+    master.run()
+    try:
+        # Every slot must still pair correctly (4 values roll through both).
+        for v in (10, 20, 30, 40):
+            assert master.compute(v, timeout=60) == v + 2
+    finally:
+        master.pause()
+
+
+def test_free_slot_preferred_over_busy():
+    """With one instance stuck, requests flow through the free one instead
+    of head-of-line blocking behind the round-robin cursor."""
+    master = make_master(batch=2)
+    master.run()
+    master._compute_locks[0].acquire()  # simulate a stuck in-flight request
+    try:
+        for v in (1, 2, 3):  # rr start alternates; all must use slot 1
+            assert master.compute(v, timeout=10) == v + 2
+    finally:
+        master._compute_locks[0].release()
+        master.pause()
