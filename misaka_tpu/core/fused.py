@@ -95,13 +95,18 @@ def make_fused_runner(
         )
     # The kernel unrolls select chains over every stack slot and ring slot and
     # keeps one VMEM row per slot; engine-default caps (1024) would blow both
-    # the unroll and VMEM.  Fail loudly with the budget arithmetic.
+    # the unroll and VMEM.  Fail loudly with the budget arithmetic.  The
+    # resident-state budget is 4MB: Mosaic's scoped-vmem stack peaks at ~4x
+    # the resident rows (input+output aliasing plus transients), and the
+    # hardware scoped limit is 16MB — measured on a v5e, block_batch=4096 on
+    # the add-2 net (5MB resident) compiles to a 22MB scoped allocation and
+    # is rejected by the TPU compiler.
     total_rows = (
         6 * n_lanes + 2 * n_dests + n_stacks * stack_cap + n_stacks
         + in_cap + out_cap + 5
     )
     vmem_bytes = total_rows * block_batch * 4
-    if total_rows > 2048 or vmem_bytes > 8 * 1024 * 1024:
+    if total_rows > 2048 or vmem_bytes > 4 * 1024 * 1024:
         raise ValueError(
             f"fused kernel budget exceeded: {total_rows} VMEM rows "
             f"({vmem_bytes / 1e6:.1f} MB at block_batch={block_batch}) — "
