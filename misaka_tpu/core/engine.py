@@ -251,16 +251,20 @@ class CompiledNetwork:
         values,
         chunk: int = 64,
         max_steps: int = 1_000_000,
+        expected: int | None = None,
     ) -> tuple[NetworkState, list[int]]:
-        """Feed a value stream and run until one output per input arrives.
+        """Feed a value stream and run until `expected` outputs arrive
+        (default: one output per input).
 
         The serialized-workload oracle mode: equivalent to the reference's
         /compute called sequentially (master.go:197-224), where pairing is
-        unambiguous.
+        unambiguous.  Pass `expected` for networks whose output count differs
+        from the input count (e.g. examples/multiply.json: 2 inputs -> 1).
         """
         pending = list(values)
         outputs: list[int] = []
-        expected = len(pending)
+        if expected is None:
+            expected = len(pending)
         steps = 0
         while len(outputs) < expected:
             if steps >= max_steps:
