@@ -21,7 +21,10 @@ STACK_CAP = 4
 
 def random_program(rng, lane_names, stack_names, length):
     lines = []
-    srcs = ["ACC", "NIL", "R0", "R1", str(rng.integers(-50, 50))]
+    # All four inbound ports as sources; lane_names includes the program's
+    # own node, so self-sends (examples/running_total.json's trick) are
+    # generated too.
+    srcs = ["ACC", "NIL", "R0", "R1", "R2", "R3", str(rng.integers(-50, 50))]
 
     def src():
         return srcs[rng.integers(len(srcs))]
@@ -34,7 +37,7 @@ def random_program(rng, lane_names, stack_names, length):
             lines.append(f"MOV {src()}, {rng.choice(['ACC', 'NIL'])}")
         elif kind == 2:
             tgt = rng.choice(lane_names)
-            lines.append(f"MOV {src()}, {tgt}:R{rng.integers(2)}")
+            lines.append(f"MOV {src()}, {tgt}:R{rng.integers(4)}")
         elif kind == 3:
             lines.append(f"ADD {src()}")
         elif kind == 4:

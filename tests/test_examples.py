@@ -75,6 +75,7 @@ def test_examples_disassemble_cleanly():
         for lane, text in texts.items():
             again = lower_program(text, lane_ids, stack_ids)
             i = lane_ids[lane]
+            assert again.length == int(net.prog_len[i]), f"{name}:{lane} truncated"
             np.testing.assert_array_equal(
                 again.code, net.code[i, : again.length], err_msg=f"{name}:{lane}"
             )
