@@ -10,7 +10,9 @@ is measured against the driver's north-star target of 1e6 inputs/sec.
 
 `python bench.py --all` additionally measures every BASELINE config
 (add2, acc_loop, ring4, sorter, mesh8) and reports them in a "configs"
-field; the headline metric stays add2.
+field; the headline metric stays add2.  `--latency` appends single-value
+end-to-end latency (latency_us_p50 / latency_us_p99 fields) measured
+through the minimal-sync serving path.
 
 Method: B independent network instances run in lockstep (vmap batch axis);
 each instance's input ring is preloaded with Q values, and we time jitted
@@ -131,6 +133,68 @@ def bench_add2(batch=32768, per_instance=128, block_batch=2048):
     return bench_config("add2", batch, per_instance, block_batch)
 
 
+def bench_latency(samples=200, chunk=16, warmup=20):
+    """Single-value end-to-end latency through the engine (unbatched add2).
+
+    Uses the minimal-sync serving shape: enqueue + `chunk` supersteps +
+    drain fused into ONE jitted call, so a request costs one dispatch and
+    one scalar readback — the per-request latency floor (the HTTP master
+    adds queue hops on top).  Returns p50/p99 in microseconds.  Note: on a
+    relayed/remote device this mostly measures the host<->device link.
+    """
+    import jax
+    import numpy as np
+
+    from misaka_tpu import networks
+    from misaka_tpu.core.step import step
+
+    net = networks.add2(in_cap=16, out_cap=16, stack_cap=16).compile()
+    code, prog_len = net._tables
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def compute_one(state, v):
+        in_cap = state.in_buf.shape[0]
+        out_cap = state.out_buf.shape[0]
+        state = state._replace(
+            in_buf=state.in_buf.at[state.in_wr % in_cap].set(v),
+            in_wr=state.in_wr + 1,
+        )
+
+        def body(s, _):
+            return step(code, prog_len, s), None
+
+        state, _ = jax.lax.scan(body, state, None, length=chunk)
+        out_val = state.out_buf[(state.out_wr - 1) % out_cap]
+        done = state.out_wr - state.out_rd  # 1 iff the value retired in-chunk
+        return state._replace(out_rd=state.out_wr), out_val, done
+
+    state = net.init_state()
+
+    def one(state, v):
+        t0 = time.perf_counter()
+        state, out, done = compute_one(state, v)
+        out = int(out)  # the single host sync
+        dt = time.perf_counter() - t0
+        assert int(done) == 1 and out == v + 2, (out, int(done))
+        return state, dt
+
+    for i in range(warmup):
+        state, _ = one(state, i)
+    times = []
+    for i in range(samples):
+        state, dt = one(state, i)
+        times.append(dt)
+    us = np.asarray(times) * 1e6
+    return {
+        "p50_us": float(np.percentile(us, 50)),
+        "p99_us": float(np.percentile(us, 99)),
+        "samples": samples,
+        "chunk": chunk,
+    }
+
+
 def main():
     import jax
 
@@ -161,6 +225,15 @@ def main():
         payload["configs"] = {
             name: round(r["throughput"], 1) for name, r in results.items()
         }
+    if "--latency" in sys.argv:
+        lat = bench_latency()
+        print(
+            f"# latency: p50={lat['p50_us']:.0f}us p99={lat['p99_us']:.0f}us "
+            f"(single value, chunk={lat['chunk']}, n={lat['samples']})",
+            file=sys.stderr,
+        )
+        payload["latency_us_p50"] = round(lat["p50_us"], 1)
+        payload["latency_us_p99"] = round(lat["p99_us"], 1)
     print(json.dumps(payload))
 
 

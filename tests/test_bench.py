@@ -25,3 +25,8 @@ def test_bench_config_tiny(name):
 def test_bench_add2_alias():
     r = bench.bench_add2(batch=32, per_instance=4)
     assert r["name"] == "add2"
+
+
+def test_bench_latency_tiny():
+    lat = bench.bench_latency(samples=10, warmup=2)
+    assert lat["p50_us"] > 0 and lat["p99_us"] >= lat["p50_us"]

@@ -1,0 +1,74 @@
+"""Failure injection: the rebuild degrades gracefully where the reference dies.
+
+The reference has 17 log.Fatalf sites — any transient error kills a node
+process (SURVEY.md quirk #8).  Here: a crashed device loop stops the network
+cleanly and /run restarts it; a per-process node with an unreachable master
+keeps serving and retrying instead of exiting.
+"""
+
+import time
+
+import pytest
+
+from misaka_tpu.networks import add2
+from misaka_tpu.runtime.master import ComputeTimeout, MasterNode
+
+
+def test_device_loop_crash_stops_cleanly_and_restarts():
+    master = MasterNode(add2(in_cap=8, out_cap=8, stack_cap=8), chunk_steps=16)
+    master.run()
+    try:
+        assert master.compute(1) == 3
+
+        real_run = master._net.run
+
+        def boom(*a, **k):
+            raise RuntimeError("injected device fault")
+
+        master._net.run = boom
+        deadline = time.monotonic() + 10
+        while master.is_running and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not master.is_running  # supervised: loop stopped, no hang
+
+        # A compute against the stopped network fails fast-ish (timeout),
+        # and does not poison later pairing.
+        with pytest.raises(ComputeTimeout):
+            master.compute(2, timeout=0.3)
+
+        # Heal the fault; /run restarts the loop and service resumes.
+        master._net.run = real_run
+        master.run()
+        assert master.compute(5) == 7
+    finally:
+        master.pause()
+
+
+def test_program_node_survives_unreachable_master():
+    """IN against a dead master retries forever instead of killing the node
+    (the reference would log.Fatalf on the dial error, program.go:494)."""
+    grpc = pytest.importorskip("grpc")
+    from misaka_tpu.runtime.nodes import ProgramNodeProcess, Resolver
+    from misaka_tpu.transport.rpc import ProgramClient
+
+    node = ProgramNodeProcess(
+        master_uri="master",
+        resolver=Resolver({"master": "127.0.0.1:1"}),  # nothing listens there
+        grpc_port=0,
+        host="127.0.0.1",
+    )
+    port = node.start()
+    try:
+        node.load_program("IN ACC")
+        node.run_cmd()
+        time.sleep(1.0)  # the IN keeps failing and retrying the whole time
+        with ProgramClient(f"127.0.0.1:{port}") as client:
+            client.pause(timeout=5)  # node still alive and serving RPCs
+            client.load("MOV 7, ACC", timeout=5)  # and still reprogrammable
+            client.run(timeout=5)
+        deadline = time.monotonic() + 5
+        while node.acc != 7 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert node.acc == 7
+    finally:
+        node.close()
