@@ -48,7 +48,7 @@ CONFIGS = {
 
 
 def bench_config(
-    name, batch=32768, per_instance=128, block_batch=2048, max_attempts=3
+    name, batch=262144, per_instance=128, block_batch=2048, max_attempts=3
 ):
     """Measure one BASELINE config: B instances drain Q values each.
 
@@ -128,7 +128,7 @@ def bench_config(
     }
 
 
-def bench_add2(batch=32768, per_instance=128, block_batch=2048):
+def bench_add2(batch=262144, per_instance=128, block_batch=2048):
     """The headline metric (kept as an alias for external callers)."""
     return bench_config("add2", batch, per_instance, block_batch)
 
