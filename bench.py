@@ -133,6 +133,164 @@ def bench_add2(batch=262144, per_instance=128, block_batch=2048):
     return bench_config("add2", batch, per_instance, block_batch)
 
 
+def bench_served(
+    batch=None,
+    in_cap=128,
+    chunk_steps=2048,
+    threads=8,
+    waves=6,
+    timeout=120.0,
+    mode="raw",
+):
+    """Throughput through the PRODUCT surface: a real MasterNode + HTTP
+    server + /compute_raw (or /compute_batch with mode="text") requests,
+    fused Pallas engine when on TPU.
+
+    Round-1's 106M/s was a harness number (kernel-only); this drives the
+    actual serve path the way a client fleet would: `threads` concurrent
+    HTTP clients each posting spread requests sized to cover their share of
+    the batch, for `waves` rounds.  Outputs are parity-checked.  Returns
+    served inputs/sec plus the engine that served them.
+    """
+    import threading as _threading
+    import urllib.request
+    from urllib.parse import urlencode
+
+    import jax
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if batch is None:
+        batch = 8192 if on_tpu else 256
+    top = networks.add2(in_cap=in_cap, out_cap=in_cap, stack_cap=16)
+    master = MasterNode(top, chunk_steps=chunk_steps, batch=batch, engine="auto")
+    httpd = make_http_server(master, port=0)
+    server_thread = _threading.Thread(target=httpd.serve_forever, daemon=True)
+    server_thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    master.run()
+
+    per_request = (batch // threads) * in_cap  # covers the thread's batch share
+    rng = np.random.default_rng(1)
+
+    def post_values(vals):
+        if mode == "raw":
+            req = urllib.request.Request(
+                base + "/compute_raw?spread=1",
+                data=np.ascontiguousarray(vals, "<i4").tobytes(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return np.frombuffer(resp.read(), dtype="<i4")
+        body = urlencode(
+            {"values": " ".join(map(str, vals)), "spread": "1"}
+        ).encode()
+        req = urllib.request.Request(base + "/compute_batch", data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())["values"]
+
+    errors = []
+    counts = [0] * threads
+
+    def worker(i, measure):
+        try:
+            for _ in range(waves if measure else 1):
+                vals = rng.integers(-1000, 1000, size=per_request)
+                out = post_values(vals)
+                if not np.array_equal(np.asarray(out), vals + 2):
+                    raise RuntimeError("served output parity FAILED")
+                if measure:
+                    counts[i] += len(vals)
+        except Exception as e:  # pragma: no cover — failure path
+            errors.append(e)
+
+    try:
+        # warmup wave (compile + queue plumbing)
+        ws = [
+            _threading.Thread(target=worker, args=(i, False))
+            for i in range(threads)
+        ]
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        if errors:
+            raise errors[0]
+
+        t0 = time.perf_counter()
+        ws = [
+            _threading.Thread(target=worker, args=(i, True))
+            for i in range(threads)
+        ]
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+    finally:
+        master.pause()
+        httpd.shutdown()
+
+    total = sum(counts)
+    return {
+        "throughput": total / elapsed,
+        "values": total,
+        "elapsed_s": elapsed,
+        "engine": master.engine_name,
+        "batch": batch,
+        "threads": threads,
+        "per_request": per_request,
+        "mode": mode,
+    }
+
+
+def bench_latency_http(samples=200, warmup=20):
+    """p50/p99 of a REAL single-value HTTP POST /compute against a running
+    master — the number a reference client would see (the kernel-floor
+    variant below strips the HTTP+queue layers)."""
+    import threading as _threading
+    import urllib.request
+    from urllib.parse import urlencode
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    top = networks.add2(in_cap=16, out_cap=16, stack_cap=16)
+    master = MasterNode(top, chunk_steps=16)
+    httpd = make_http_server(master, port=0)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    master.run()
+
+    def one(v):
+        body = urlencode({"value": str(v)}).encode()
+        req = urllib.request.Request(base + "/compute", data=body, method="POST")
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())["value"]
+        dt = time.perf_counter() - t0
+        assert out == v + 2, (out, v)
+        return dt
+
+    try:
+        for i in range(warmup):
+            one(i)
+        times = [one(i) for i in range(samples)]
+    finally:
+        master.pause()
+        httpd.shutdown()
+    us = np.asarray(times) * 1e6
+    return {
+        "p50_us": float(np.percentile(us, 50)),
+        "p99_us": float(np.percentile(us, 99)),
+        "samples": samples,
+    }
+
+
 def bench_latency(samples=200, chunk=16, warmup=20):
     """Single-value end-to-end latency through the engine (unbatched add2).
 
@@ -225,15 +383,36 @@ def main():
         payload["configs"] = {
             name: round(r["throughput"], 1) for name, r in results.items()
         }
+    if "--served" in sys.argv or run_all:
+        for mode, key in (("raw", "served_throughput"), ("text", "served_text_throughput")):
+            served = bench_served(mode=mode)
+            print(
+                f"# served[{mode}]: engine={served['engine']} batch={served['batch']} "
+                f"threads={served['threads']} values={served['values']} "
+                f"elapsed={served['elapsed_s']:.3f}s "
+                f"throughput={served['throughput']:.0f}/s (through HTTP "
+                f"{'/compute_raw' if mode == 'raw' else '/compute_batch'})",
+                file=sys.stderr,
+            )
+            payload[key] = round(served["throughput"], 1)
+        payload["served_engine"] = served["engine"]
     if "--latency" in sys.argv:
         lat = bench_latency()
         print(
-            f"# latency: p50={lat['p50_us']:.0f}us p99={lat['p99_us']:.0f}us "
+            f"# latency floor: p50={lat['p50_us']:.0f}us p99={lat['p99_us']:.0f}us "
             f"(single value, chunk={lat['chunk']}, n={lat['samples']})",
             file=sys.stderr,
         )
         payload["latency_us_p50"] = round(lat["p50_us"], 1)
         payload["latency_us_p99"] = round(lat["p99_us"], 1)
+        hlat = bench_latency_http()
+        print(
+            f"# latency HTTP: p50={hlat['p50_us']:.0f}us p99={hlat['p99_us']:.0f}us "
+            f"(single value through POST /compute, n={hlat['samples']})",
+            file=sys.stderr,
+        )
+        payload["http_latency_us_p50"] = round(hlat["p50_us"], 1)
+        payload["http_latency_us_p99"] = round(hlat["p99_us"], 1)
     print(json.dumps(payload))
 
 

@@ -56,6 +56,42 @@ def _run_chunk_traced(tables, state: NetworkState, trace, num_steps: int):
     return rebase_rings(state), trace
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(1, 2))
+def _run_chunk_traced_batched(tables, state: NetworkState, trace, num_steps: int,
+                              instance: int):
+    """Batched chunk with instruction tracing of ONE instance (default 0).
+
+    Instances are independent, so recording instance `instance` while all B
+    advance in lockstep costs one sliced trace store per tick — the batched
+    production configuration stays debuggable (the reference's only substitute
+    is a per-instruction stdout log, program.go:222-223)."""
+    from misaka_tpu.core.trace import record_step
+
+    code, prog_len = tables
+    step_b = jax.vmap(step, in_axes=(None, None, 0))
+
+    def body(carry, _):
+        s, t = carry
+        s2 = step_b(code, prog_len, s)
+        one = lambda st: jax.tree.map(lambda x: x[instance], st)
+        t2 = record_step(code, one(s), one(s2), t)
+        return (s2, t2), None
+
+    (state, trace), _ = jax.lax.scan(body, (state, trace), None, length=num_steps)
+    return rebase_rings(state), trace
+
+
+@jax.jit
+def _read_counters(state: NetworkState) -> jnp.ndarray:
+    """All four ring counters as ONE device array: [4] (or [4, B] batched).
+
+    The serving loop reads these every iteration; packing them into a single
+    transfer matters when the device link is a relay (one round trip instead
+    of four).
+    """
+    return jnp.stack([state.in_rd, state.in_wr, state.out_rd, state.out_wr])
+
+
 @jax.jit
 def _feed(state: NetworkState, values: jnp.ndarray, count: jnp.ndarray) -> NetworkState:
     """Append `count` leading entries of `values` to the input ring.
@@ -141,13 +177,20 @@ class CompiledNetwork:
 
         return init_trace(self.num_lanes, cap)
 
-    def run_traced(self, state: NetworkState, trace, num_steps: int):
-        """Like `run`, but records every lane's fetch/commit/acc into `trace`
-        (core/trace.py).  Unbatched networks only — tracing is the debug
-        path, not the throughput path."""
-        if self.batch is not None:
-            raise ValueError("run_traced drives a single network instance")
-        return _run_chunk_traced(self._tables, state, trace, num_steps)
+    def run_traced(self, state: NetworkState, trace, num_steps: int,
+                   instance: int = 0):
+        """Like `run`, but records fetch/commit/acc into `trace` (core/trace.py).
+
+        Unbatched networks record every lane; batched networks record the
+        lanes of one selectable instance (instances are independent, so the
+        traced instance's history is exact while all B advance together)."""
+        if self.batch is None:
+            return _run_chunk_traced(self._tables, state, trace, num_steps)
+        if not (0 <= instance < self.batch):
+            raise ValueError(f"instance {instance} out of range [0, {self.batch})")
+        return _run_chunk_traced_batched(
+            self._tables, state, trace, num_steps, instance
+        )
 
     def fused_runner(
         self,
@@ -215,18 +258,34 @@ class CompiledNetwork:
             )
         return _feed_batched(state, jnp.asarray(values), jnp.asarray(counts))
 
-    def drain_batched(self, state: NetworkState) -> tuple[NetworkState, list[list[int]]]:
-        """Collect pending outputs per instance, in order; advances out_rd."""
+    def counters(self, state: NetworkState) -> np.ndarray:
+        """[in_rd, in_wr, out_rd, out_wr] in ONE device read ([4] or [4, B])."""
+        return np.asarray(_read_counters(state))
+
+    def drain_batched(
+        self,
+        state: NetworkState,
+        rd: np.ndarray | None = None,
+        wr: np.ndarray | None = None,
+    ) -> tuple[NetworkState, list[tuple[int, np.ndarray]]]:
+        """Collect pending outputs per instance, in order; advances out_rd.
+
+        Returns (slot, values) pairs for instances that produced anything —
+        host cost is O(active + values), with exactly one device read (the
+        output ring) when rd/wr are passed in from a prior counters() call.
+        """
         if self.batch is None:
             raise ValueError("drain_batched requires a batched network")
-        rd = np.asarray(state.out_rd)
-        wr = np.asarray(state.out_wr)
+        if rd is None or wr is None:
+            c = self.counters(state)
+            rd, wr = c[2], c[3]
         if (wr == rd).all():
-            return state, [[] for _ in range(self.batch)]
+            return state, []
         buf = np.asarray(state.out_buf)
+        active = np.nonzero(wr > rd)[0]
         outs = [
-            [int(buf[b, i % self.out_cap]) for i in range(rd[b], wr[b])]
-            for b in range(self.batch)
+            (int(b), buf[b, (rd[b] + np.arange(wr[b] - rd[b])) % self.out_cap])
+            for b in active
         ]
         return state._replace(out_rd=jnp.asarray(wr)), outs
 

@@ -57,6 +57,30 @@ def init_trace(num_lanes: int, cap: int = 256) -> TraceRing:
     )
 
 
+def record_step(
+    code: jnp.ndarray,
+    before: NetworkState,
+    after: NetworkState,
+    trace: TraceRing,
+) -> TraceRing:
+    """Append one tick's record for every lane of ONE network instance.
+
+    `before`/`after` are the instance's state around the tick (unbatched
+    shapes); the caller owns the step itself — this lets the batched engine
+    record a single instance out of a vmapped step (engine.py)."""
+    n_lanes = code.shape[0]
+    lane = jnp.arange(n_lanes)
+    pc_before = before.pc
+    op = code[lane, pc_before, isa.F_OP]
+    committed = after.retired - before.retired  # [N] 0/1
+
+    record = jnp.stack([pc_before, op, committed, after.acc], axis=-1)  # [N, 4]
+    cap = trace.buf.shape[1]
+    slot = trace.wr % cap
+    new_buf = trace.buf.at[:, slot, :].set(record)
+    return TraceRing(buf=new_buf, wr=trace.wr + 1)
+
+
 def traced_step(
     code: jnp.ndarray,
     prog_len: jnp.ndarray,
@@ -64,19 +88,8 @@ def traced_step(
     trace: TraceRing,
 ) -> tuple[NetworkState, TraceRing]:
     """One superstep + one trace record per lane (identical state semantics)."""
-    n_lanes = code.shape[0]
-    lane = jnp.arange(n_lanes)
-    pc_before = state.pc
-    op = code[lane, pc_before, isa.F_OP]
-
     new_state = step(code, prog_len, state)
-    committed = new_state.retired - state.retired  # [N] 0/1
-
-    record = jnp.stack([pc_before, op, committed, new_state.acc], axis=-1)  # [N, 4]
-    cap = trace.buf.shape[1]
-    slot = trace.wr % cap
-    new_buf = trace.buf.at[:, slot, :].set(record)
-    return new_state, TraceRing(buf=new_buf, wr=trace.wr + 1)
+    return new_state, record_step(code, state, new_state, trace)
 
 
 def run_traced(

@@ -14,8 +14,18 @@ so the master's env surface is what survives:
   MISAKA_AUTORUN   "1" to start running immediately (default: wait for /run)
   MISAKA_BATCH     run N independent network instances in lockstep and serve
                    concurrent /compute requests round-robin across them
-                   (default: one instance, strictly serialized /compute;
-                   incompatible with MISAKA_TRACE_CAP)
+                   (default: one instance, strictly serialized /compute)
+  MISAKA_ENGINE    device-loop chunk runner: "auto" (default — the fused
+                   Pallas kernel when batched+untraced+on-TPU+within budget,
+                   the XLA scan engine otherwise), "scan", "fused" (require
+                   the kernel), "fused-interpret" (CI coverage off-TPU)
+  MISAKA_DATA_PARALLEL   shard the batch axis over N chips (requires
+                   MISAKA_BATCH divisible by N); MISAKA_MODEL_PARALLEL
+                   shards program-node lanes over M chips via the ICI-
+                   collective engine (parallel/sharded.py).  Together they
+                   serve over a (data=N, model=M) jax.sharding.Mesh of N*M
+                   devices — the replacement for compose scale-out
+                   (docker-compose.yml:26-74); /status reports the mesh
   MISAKA_CHECKPOINT_DIR  enable HTTP /checkpoint & /restore, storing named
                    .npz snapshots in this directory (disabled when unset;
                    fused master only — per-process nodes hold their own
@@ -23,7 +33,9 @@ so the master's env surface is what survives:
   MISAKA_TRACE_CAP enable the per-lane instruction trace ring (core/trace.py)
                    with this many ticks of history; decoded listings served
                    at GET /trace?last=N (disabled when unset; debug path —
-                   recording costs one extra store per tick)
+                   recording costs one extra store per tick and forces the
+                   scan engine).  With MISAKA_BATCH, traces the instance
+                   selected by MISAKA_TRACE_INSTANCE (default 0)
   MISAKA_PROFILE_DIR  enable jax.profiler capture of the live device loop via
                    POST /profile/start + /profile/stop, traces written under
                    this directory (disabled when unset)
@@ -60,6 +72,19 @@ from misaka_tpu.runtime.topology import Topology
 
 
 def build_topology_from_env(environ=os.environ) -> Topology:
+    # Capacity knobs (MISAKA_STACK_CAP / MISAKA_IN_CAP / MISAKA_OUT_CAP):
+    # ring/stack depths trade capacity for VMEM residency — the fused Pallas
+    # engine's budget (core/fused.py) is only reachable from env config when
+    # these are settable (e.g. MISAKA_IN_CAP=128 MISAKA_STACK_CAP=16).
+    caps = {}
+    for env_name, field in (
+        ("MISAKA_STACK_CAP", "stack_cap"),
+        ("MISAKA_IN_CAP", "in_cap"),
+        ("MISAKA_OUT_CAP", "out_cap"),
+    ):
+        v = environ.get(env_name)
+        if v:
+            caps[field] = int(v)
     path = environ.get("MISAKA_TOPOLOGY")
     if path:
         if path.endswith((".yml", ".yaml")):
@@ -67,16 +92,16 @@ def build_topology_from_env(environ=os.environ) -> Topology:
             # one fused network (runtime/compose.py)
             from misaka_tpu.runtime.compose import load_compose
 
-            return load_compose(path)
+            return load_compose(path, **caps)
         with open(path) as f:
-            return Topology.from_json(f.read())
+            return Topology.from_json(f.read(), **caps)
     node_info = environ.get("NODE_INFO")
     if not node_info:
         raise SystemExit(
             "set NODE_INFO (reference JSON shape) or MISAKA_TOPOLOGY (file path)"
         )
     programs = json.loads(environ.get("MISAKA_PROGRAMS", "{}"))
-    return Topology.from_node_info_json(node_info, programs)
+    return Topology.from_node_info_json(node_info, programs, **caps)
 
 
 def _serve_http(
@@ -167,7 +192,15 @@ def main() -> None:
         topology = build_topology_from_env()
         trace_cap = int(environ.get("MISAKA_TRACE_CAP", "0")) or None
         batch = int(environ.get("MISAKA_BATCH", "0")) or None
-        master = MasterNode(topology, trace_cap=trace_cap, batch=batch)
+        master = MasterNode(
+            topology,
+            trace_cap=trace_cap,
+            batch=batch,
+            engine=environ.get("MISAKA_ENGINE", "auto"),
+            trace_instance=int(environ.get("MISAKA_TRACE_INSTANCE", "0")),
+            data_parallel=int(environ.get("MISAKA_DATA_PARALLEL", "0")) or None,
+            model_parallel=int(environ.get("MISAKA_MODEL_PARALLEL", "0")) or None,
+        )
         if environ.get("MISAKA_AUTORUN") == "1":
             master.run()
         _serve_http(

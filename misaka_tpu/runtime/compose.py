@@ -47,7 +47,7 @@ def _env_of(service: dict) -> dict[str, str]:
     return {str(k): ("" if v is None else str(v)) for k, v in env.items()}
 
 
-def parse_compose(text: str) -> Topology:
+def parse_compose(text: str, **caps) -> Topology:
     """Parse docker-compose YAML text into a Topology."""
     import yaml
 
@@ -104,12 +104,12 @@ def parse_compose(text: str) -> Topology:
         raise ComposeError(f"master NODE_INFO disagrees with services ({detail})")
 
     try:
-        return Topology(node_info=node_info, programs=programs)
+        return Topology(node_info=node_info, programs=programs, **caps)
     except TopologyError as e:
         raise ComposeError(str(e)) from e
 
 
-def load_compose(path: str) -> Topology:
-    """Read + parse a compose file from disk."""
+def load_compose(path: str, **caps) -> Topology:
+    """Read + parse a compose file from disk (caps: stack_cap/in_cap/out_cap)."""
     with open(path) as f:
-        return parse_compose(f.read())
+        return parse_compose(f.read(), **caps)

@@ -130,9 +130,171 @@ def test_load_recompiles_batched():
         master.pause()
 
 
-def test_trace_incompatible_with_batch():
-    with pytest.raises(ValueError, match="single instance"):
-        make_master(batch=2, trace_cap=16)
+def test_batched_tracing_records_one_instance():
+    """trace_cap with batch traces one instance exactly (round-2 closure of
+    the round-1 gap: the production batched config is now debuggable)."""
+    master = make_master(batch=2, trace_cap=4096)
+    assert master.engine_name == "scan-traced"
+    master.run()
+    try:
+        for v in (5, 6, 7):
+            assert master.compute(v) == v + 2
+    finally:
+        master.pause()
+    entries = master.trace()
+    assert entries, "batched master recorded no trace"
+    committed = [e for e in entries if e["committed"]]
+    assert committed, "traced instance committed nothing"
+    # the traced instance runs the same add2 program: its records carry real
+    # opcodes from both lanes
+    assert {e["name"] for e in entries} == {"misaka1", "misaka2"}
+
+
+def test_batched_trace_instance_selectable():
+    master = make_master(batch=3, trace_cap=4096, trace_instance=2)
+    master.run()
+    try:
+        for v in range(6):  # round-robin lands two values on instance 2
+            master.compute(v)
+    finally:
+        master.pause()
+    entries = master.trace()
+    assert any(e["committed"] for e in entries)
+
+
+def test_compute_many_fifo_pairing():
+    master = make_master(batch=2)
+    master.run()
+    try:
+        vals = list(range(40))
+        assert master.compute_many(vals, timeout=60) == [v + 2 for v in vals]
+        # interleaved with single computes on the other slot
+        assert master.compute(99) == 101
+    finally:
+        master.pause()
+
+
+def test_compute_many_concurrent_chunks():
+    master = make_master(batch=4)
+    master.run()
+    results = {}
+    errors = []
+
+    def worker(base):
+        try:
+            vals = list(range(base, base + 50))
+            results[base] = master.compute_many(vals, timeout=60)
+        except Exception as e:  # pragma: no cover — failure path
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(b,)) for b in (0, 100, 200, 300)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        master.pause()
+    assert not errors
+    for base, outs in results.items():
+        assert outs == [v + 2 for v in range(base, base + 50)]
+
+
+def test_compute_spread_order_and_parity():
+    master = MasterNode(
+        add2(in_cap=4, out_cap=4, stack_cap=8), chunk_steps=32, batch=8
+    )
+    master.run()
+    try:
+        vals = list(range(-30, 70))  # 100 values over 8 instances, ring cap 4
+        assert master.compute_spread(vals, timeout=60) == [v + 2 for v in vals]
+        # instances genuinely shared the work
+        state = master.snapshot()
+        per_instance = np.asarray(state.retired).sum(axis=1)
+        assert (per_instance > 0).sum() >= 4
+    finally:
+        master.pause()
+
+
+def test_compute_spread_small_falls_back():
+    master = make_master(batch=4)
+    master.run()
+    try:
+        assert master.compute_spread([7]) == [9]  # single-slot path
+        assert master.compute_spread([]) == []
+    finally:
+        master.pause()
+
+
+def test_compute_spread_concurrent_with_compute():
+    master = make_master(batch=8)
+    master.run()
+    errors = []
+    results = {}
+
+    def spreader():
+        try:
+            vals = list(range(200))
+            results["spread"] = master.compute_spread(vals, timeout=60)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def singles():
+        try:
+            results["singles"] = [
+                master.compute(v, timeout=60) for v in (1000, 2000, 3000)
+            ]
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    try:
+        ts = [threading.Thread(target=spreader), threading.Thread(target=singles)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        master.pause()
+    assert not errors
+    assert results["spread"] == [v + 2 for v in range(200)]
+    assert results["singles"] == [1002, 2002, 3002]
+
+
+def test_compute_many_empty_and_bad_shape():
+    master = make_master(batch=2)
+    assert master.compute_many([]) == []
+    with pytest.raises(ValueError, match="flat"):
+        master.compute_many([[1, 2]])
+
+
+def test_fused_interpret_engine_serves():
+    """The fused Pallas kernel on the REAL serving path (interpret mode off
+    TPU): MISAKA_ENGINE=fused-interpret must produce identical results."""
+    master = MasterNode(
+        add2(in_cap=8, out_cap=8, stack_cap=8),
+        chunk_steps=32,
+        batch=128,  # fused kernel needs a multiple of 128
+        engine="fused-interpret",
+    )
+    assert master.engine_name == "fused"
+    assert master.status()["engine"] == "fused"
+    master.run()
+    try:
+        assert master.compute_many([3, 4, 5], timeout=120) == [5, 6, 7]
+    finally:
+        master.pause()
+
+
+def test_fused_engine_requires_batch():
+    with pytest.raises(ValueError, match="fused engine requires"):
+        MasterNode(add2(), engine="fused")
+
+
+def test_auto_engine_falls_back_off_tpu():
+    master = make_master(batch=2, engine="auto")
+    assert master.engine_name == "scan"
 
 
 def test_unbatched_still_serializes():

@@ -67,6 +67,48 @@ def test_run_then_compute_parity(server):
         assert json.loads(body) == {"value": v + 2}
 
 
+def test_compute_batch_route(server):
+    base, _ = server
+    post(base, "/run")
+    status, body = post(base, "/compute_batch", {"values": "1, 2 3,4"})
+    assert status == 200
+    assert json.loads(body) == {"values": [3, 4, 5, 6]}
+    # empty stream is a valid no-op
+    status, body = post(base, "/compute_batch", {"values": ""})
+    assert (status, json.loads(body)) == (200, {"values": []})
+
+
+def test_compute_raw_route(server):
+    import numpy as np
+
+    base, _ = server
+    post(base, "/run")
+    vals = np.arange(-5, 20, dtype="<i4")
+    req = urllib.request.Request(
+        base + "/compute_raw", data=vals.tobytes(), method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        out = np.frombuffer(resp.read(), dtype="<i4")
+    assert (out == vals + 2).all()
+    # truncated body rejected
+    req = urllib.request.Request(
+        base + "/compute_raw", data=b"\x01\x02\x03", method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 400
+
+
+def test_compute_batch_bad_values(server):
+    base, _ = server
+    post(base, "/run")
+    status, body = post(base, "/compute_batch", {"values": "1 two 3"})
+    assert (status, body) == (400, "cannot parse values")
+
+
 def test_get_method_not_allowed(server):
     base, _ = server
     status, body = get(base, "/run")

@@ -128,12 +128,43 @@ def test_trace_under_jit():
     assert int(trace.wr) == 12
 
 
-def test_batched_network_rejects_tracing():
+def test_batched_tracing_matches_unbatched():
+    """A batched run tracing instance k records exactly what an unbatched run
+    of that instance's inputs records (instances are independent)."""
+    import numpy as np
+
+    _, net_b = make_add2(batch=4)
+    _, net_1 = make_add2()
+
+    state_b = net_b.init_state()
+    # distinct inputs per instance; instance 2 gets value 41
+    vals = np.asarray([[10], [20], [41], [30]], np.int32)
+    state_b = state_b._replace(
+        in_buf=state_b.in_buf.at[:, 0].set(vals[:, 0]),
+        in_wr=state_b.in_wr + 1,
+    )
+    trace_b = net_b.init_trace(cap=32)
+    state_b, trace_b = net_b.run_traced(state_b, trace_b, 20, instance=2)
+
+    state_1 = net_1.init_state()
+    state_1 = state_1._replace(
+        in_buf=state_1.in_buf.at[0].set(41), in_wr=state_1.in_wr + 1
+    )
+    trace_1 = net_1.init_trace(cap=32)
+    state_1, trace_1 = net_1.run_traced(state_1, trace_1, 20)
+
+    assert int(trace_b.wr) == int(trace_1.wr) == 20
+    assert (np.asarray(trace_b.buf) == np.asarray(trace_1.buf)).all()
+    # and the batched state advanced all four instances
+    assert (np.asarray(state_b.out_wr) == 1).all()
+
+
+def test_batched_tracing_instance_out_of_range():
     _, net = make_add2(batch=4)
     try:
-        net.run_traced(net.init_state(), init_trace(2, 4), 1)
+        net.run_traced(net.init_state(), init_trace(2, 4), 1, instance=4)
     except ValueError as e:
-        assert "single network instance" in str(e)
+        assert "out of range" in str(e)
     else:
         raise AssertionError("expected ValueError")
 
