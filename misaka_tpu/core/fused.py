@@ -34,6 +34,18 @@ from misaka_tpu.tis import isa
 
 LANE = 128  # VPU lane width; batch blocks are multiples of this
 
+# Capacity threshold between the two storage modes for stacks/rings:
+#   cap <= UNROLL_CAP — slots live in the fori_loop carry (registers) and
+#     every access is an unrolled select chain: fastest, but O(cap) unrolled
+#     ops and carry rows (the round-1 capacity cliff).
+#   cap >  UNROLL_CAP — slots stay in the VMEM ref; accesses are chunked
+#     dynamic-slice scans (pl.ds, 8 rows at a time) inside lax.fori_loop:
+#     program size O(1), per-tick cost O(cap/8) vector ops, no carry rows.
+# Engine-default 1024-deep stacks/rings (intStack.go:9 is unbounded) now
+# compile and run; the five bench configs (small caps) keep the fast path.
+UNROLL_CAP = 64
+_CHUNK = 8  # rows per dynamic slice (sublane multiple)
+
 _I32 = jnp.int32
 
 
@@ -75,6 +87,7 @@ def make_fused_runner(
     num_steps: int,
     block_batch: int | None = None,
     interpret: bool = False,
+    unroll_cap: int | None = None,
 ):
     """Build `fn(state) -> state` advancing `num_steps` ticks in one kernel.
 
@@ -93,25 +106,50 @@ def make_fused_runner(
             f"batch {batch} must be a multiple of block_batch {block_batch}, "
             f"itself a multiple of {LANE}"
         )
-    # The kernel unrolls select chains over every stack slot and ring slot and
-    # keeps one VMEM row per slot; engine-default caps (1024) would blow both
-    # the unroll and VMEM.  Fail loudly with the budget arithmetic.  The
-    # resident-state budget is 4MB: Mosaic's scoped-vmem stack peaks at ~4x
-    # the resident rows (input+output aliasing plus transients), and the
-    # hardware scoped limit is 16MB — measured on a v5e, block_batch=4096 on
-    # the add-2 net (5MB resident) compiles to a 22MB scoped allocation and
-    # is rejected by the TPU compiler.
+    # Storage-mode split (see UNROLL_CAP above): small caps live in the
+    # fori_loop carry and pay unrolled select chains; big caps stay in VMEM
+    # refs and pay chunked dynamic-slice scans.
+    ucap = UNROLL_CAP if unroll_cap is None else unroll_cap
+    sm_in_regs = stack_cap <= ucap
+    inb_in_regs = in_cap <= ucap
+    ob_in_regs = out_cap <= ucap
+    for name, cap, in_regs in (
+        ("stack_cap", stack_cap, sm_in_regs),
+        ("in_cap", in_cap, inb_in_regs),
+        ("out_cap", out_cap, ob_in_regs),
+    ):
+        if not in_regs and cap % _CHUNK:
+            raise ValueError(
+                f"{name}={cap} above the unroll threshold must be a "
+                f"multiple of {_CHUNK} (chunked dynamic-slice access)"
+            )
+    # Budget arithmetic.  Carry-resident rows are the scarce resource:
+    # Mosaic's scoped-vmem stack peaks at ~4x the carry rows (input+output
+    # aliasing plus transients) against the 16MB hardware scoped limit —
+    # measured on a v5e, block_batch=4096 on the add-2 net (5MB carry)
+    # compiles to a 22MB scoped allocation and is rejected.  Ref-resident
+    # rows (the chunked big-cap mode) are plain VMEM arrays without that
+    # multiplier; bound the total at a conservative 8MB.
+    carry_rows = 6 * n_lanes + 2 * n_dests + n_stacks + 5
+    if sm_in_regs:
+        carry_rows += n_stacks * stack_cap
+    if inb_in_regs:
+        carry_rows += in_cap
+    if ob_in_regs:
+        carry_rows += out_cap
     total_rows = (
         6 * n_lanes + 2 * n_dests + n_stacks * stack_cap + n_stacks
         + in_cap + out_cap + 5
     )
-    vmem_bytes = total_rows * block_batch * 4
-    if total_rows > 2048 or vmem_bytes > 4 * 1024 * 1024:
+    carry_bytes = carry_rows * block_batch * 4
+    total_bytes = total_rows * block_batch * 4
+    if carry_rows > 2048 or carry_bytes > 4 * 1024 * 1024 \
+            or total_bytes > 8 * 1024 * 1024:
         raise ValueError(
-            f"fused kernel budget exceeded: {total_rows} VMEM rows "
-            f"({vmem_bytes / 1e6:.1f} MB at block_batch={block_batch}) — "
-            "reduce stack_cap/in_cap/out_cap (compile the Topology with e.g. "
-            "stack_cap=16, in_cap=128, out_cap=128) or shrink block_batch"
+            f"fused kernel budget exceeded: {carry_rows} carry rows "
+            f"({carry_bytes / 1e6:.1f} MB) / {total_rows} total rows "
+            f"({total_bytes / 1e6:.1f} MB) at block_batch={block_batch} — "
+            "reduce stack_cap/in_cap/out_cap or shrink block_batch"
         )
     bsr = block_batch // LANE  # sublane-rows per block
     n_blocks = batch // block_batch
@@ -143,7 +181,46 @@ def make_fused_runner(
     in_entries.sort()
     out_entries.sort()
 
-    def tick_body(carry, inb):
+    # --- chunked dynamic-slice access for ref-resident big caps ------------
+    # The target slot differs per batch element ([bsr, LANE] indices), so a
+    # scalar dynamic index cannot address it; instead scan the slot axis in
+    # _CHUNK-row slices and mask — O(cap/_CHUNK) vector ops, O(1) program.
+
+    def _slot_ids(i):
+        return i * _CHUNK + jax.lax.broadcasted_iota(_I32, (_CHUNK, 1, 1), 0)
+
+    def ref_gather(ref, base, cap, idx):
+        """ref[base + idx[b], b] per batch element (0 where idx misses)."""
+
+        def body(i, acc_v):
+            blk = ref[pl.ds(base + i * _CHUNK, _CHUNK)]
+            m = _slot_ids(i) == idx[None, :, :]
+            return acc_v + jnp.where(m, blk, 0).sum(axis=0)
+
+        return jax.lax.fori_loop(0, cap // _CHUNK, body, jnp.zeros_like(idx))
+
+    def ref_scatter(ref, base, cap, idx, mask, val):
+        """ref[base + idx[b], b] = val[b] where mask[b] (read-modify-write)."""
+
+        def body(i, _):
+            blk = ref[pl.ds(base + i * _CHUNK, _CHUNK)]
+            m = (_slot_ids(i) == idx[None, :, :]) & mask[None, :, :]
+            ref[pl.ds(base + i * _CHUNK, _CHUNK)] = jnp.where(m, val[None], blk)
+            return 0
+
+        jax.lax.fori_loop(0, cap // _CHUNK, body, 0)
+
+    def ref_copy(src, dst, rows_count):
+        def body(i, _):
+            dst[pl.ds(i * _CHUNK, _CHUNK)] = src[pl.ds(i * _CHUNK, _CHUNK)]
+            return 0
+
+        jax.lax.fori_loop(0, rows_count // _CHUNK, body, 0)
+
+    def tick_body(carry, inb, sm_ref, ob_ref):
+        """One superstep.  inb: list of rows (regs mode) or a ref; sm_ref /
+        ob_ref: the writable stack/out-ring refs (None in regs mode, where
+        the corresponding carry entries hold the rows)."""
         (acc, bak, pc, pv, pf, hv, ho, sm, st, ob, sc, ret) = carry
         in_rd, in_wr, out_rd, out_wr, tick = sc
         i32 = lambda b: b.astype(_I32)
@@ -216,9 +293,12 @@ def make_fused_runner(
         for s, entries in stack_ops.items():
             can_push = st[s] < stack_cap
             can_pop = st[s] > 0
-            pv_s = jnp.zeros_like(st[s])
-            for c in range(stack_cap):
-                pv_s = jnp.where(st[s] - 1 == c, sm[s * stack_cap + c], pv_s)
+            if sm_in_regs:
+                pv_s = jnp.zeros_like(st[s])
+                for c in range(stack_cap):
+                    pv_s = jnp.where(st[s] - 1 == c, sm[s * stack_cap + c], pv_s)
+            else:
+                pv_s = ref_gather(sm_ref, s * stack_cap, stack_cap, st[s] - 1)
             pop_val[s] = pv_s
             granted = jnp.zeros_like(can_push)
             push_m = jnp.zeros_like(can_push)
@@ -234,11 +314,14 @@ def make_fused_runner(
                     pop_m = pop_m | okm
                 granted = granted | okm
                 stack_ok[(n, l)] = okm
-            for c in range(stack_cap):
-                slot = s * stack_cap + c
-                new_sm[slot] = jnp.where(
-                    push_m & (st[s] == c), push_v, new_sm[slot]
-                )
+            if sm_in_regs:
+                for c in range(stack_cap):
+                    slot = s * stack_cap + c
+                    new_sm[slot] = jnp.where(
+                        push_m & (st[s] == c), push_v, new_sm[slot]
+                    )
+            else:
+                ref_scatter(sm_ref, s * stack_cap, stack_cap, st[s], push_m, push_v)
             new_st[s] = st[s] + i32(push_m) - i32(pop_m)
 
         # --- pass 3c: master input (single grant per tick) ------------------
@@ -253,8 +336,11 @@ def make_fused_runner(
         rd_mod = jax.lax.rem(in_rd, jnp.int32(in_cap))
         in_val = jnp.zeros_like(in_rd)
         if in_entries:
-            for q in range(in_cap):
-                in_val = jnp.where(rd_mod == q, inb[q], in_val)
+            if inb_in_regs:
+                for q in range(in_cap):
+                    in_val = jnp.where(rd_mod == q, inb[q], in_val)
+            else:
+                in_val = ref_gather(inb, 0, in_cap, rd_mod)
         new_in_rd = in_rd + i32(in_any)
 
         # --- pass 3d: master output (single grant per tick) -----------------
@@ -270,8 +356,11 @@ def make_fused_runner(
                 out_val = jnp.where(okm, src_val[n], out_val)
                 out_ok[(n, l)] = okm
             wr_mod = jax.lax.rem(out_wr, jnp.int32(out_cap))
-            for q in range(out_cap):
-                new_ob[q] = jnp.where(out_any & (wr_mod == q), out_val, ob[q])
+            if ob_in_regs:
+                for q in range(out_cap):
+                    new_ob[q] = jnp.where(out_any & (wr_mod == q), out_val, ob[q])
+            else:
+                ref_scatter(ob_ref, 0, out_cap, wr_mod, out_any, out_val)
         new_out_wr = out_wr + i32(out_any)
 
         # --- pass 4: commit + register/pc effects ---------------------------
@@ -356,6 +445,15 @@ def make_fused_runner(
         (acc_r, bak_r, pc_r, pv_r, pf_r, hv_r, ho_r, sm_r, st_r, ob_r, sc_r,
          ret_r, inb_r) = refs[:13]
         outs = refs[13:]
+        sm_out, ob_out = outs[7], outs[9]
+
+        # Ref-resident big caps: seed the writable OUTPUT ref from the input
+        # (input refs are aliased but only read; all tick-time access goes to
+        # the output ref), then ticks mutate it in place.
+        if not sm_in_regs:
+            ref_copy(sm_r, sm_out, n_stacks * stack_cap)
+        if not ob_in_regs:
+            ref_copy(ob_r, ob_out, out_cap)
 
         rows = lambda ref, k: [ref[i] for i in range(k)]
         carry = (
@@ -366,18 +464,26 @@ def make_fused_runner(
             rows(pf_r, n_dests),
             rows(hv_r, n_lanes),
             rows(ho_r, n_lanes),
-            rows(sm_r, n_stacks * stack_cap),
+            rows(sm_r, n_stacks * stack_cap) if sm_in_regs else [],
             rows(st_r, n_stacks),
-            rows(ob_r, out_cap),
+            rows(ob_r, out_cap) if ob_in_regs else [],
             tuple(rows(sc_r, 5)),
             rows(ret_r, n_lanes),
         )
-        inb = rows(inb_r, in_cap)
+        inb = rows(inb_r, in_cap) if inb_in_regs else inb_r
 
         carry = jax.lax.fori_loop(
-            0, num_steps, lambda t, c: tick_body(c, inb), carry
+            0, num_steps,
+            lambda t, c: tick_body(
+                c, inb,
+                None if sm_in_regs else sm_out,
+                None if ob_in_regs else ob_out,
+            ),
+            carry,
         )
 
+        # Carry-resident entries write back here; ref-resident ones ([] in
+        # the carry) were mutated in place during the ticks.
         for out_ref, vals in zip(outs, carry):
             for i, v in enumerate(vals):
                 out_ref[i] = v
