@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 from misaka_tpu import networks
+from misaka_tpu.runtime.topology import Topology
 
 
 def assert_states_equal(a, b):
@@ -20,7 +21,8 @@ def assert_states_equal(a, b):
         )
 
 
-def run_both(topology, batch, steps, n_inputs=4, seed=0, block_batch=128):
+def run_both(topology, batch, steps, n_inputs=4, seed=0, block_batch=128,
+             unroll_cap=None):
     net = topology.compile(batch=batch)
     rng = np.random.default_rng(seed)
     vals = rng.integers(-100, 100, size=(batch, n_inputs)).astype(np.int32)
@@ -32,35 +34,55 @@ def run_both(topology, batch, steps, n_inputs=4, seed=0, block_batch=128):
         )
 
     ref = net.run(prep(net.init_state()), steps)
-    fused = net.fused_runner(steps, block_batch=block_batch, interpret=True)
+    fused = net.fused_runner(
+        steps, block_batch=block_batch, interpret=True, unroll_cap=unroll_cap
+    )
     out = fused(prep(net.init_state()))
     return ref, out
 
 
+# unroll_cap=4 forces every cap-8 buffer below onto the chunked VMEM-ref
+# path (ref_gather/ref_scatter/ref_copy, fused.py) that production hits only
+# at caps > UNROLL_CAP=64 — so both storage modes run in every parity case.
+STORAGE_MODES = pytest.mark.parametrize(
+    "unroll_cap", [None, 4], ids=["regs", "chunked"]
+)
+
+
+@STORAGE_MODES
 @pytest.mark.parametrize(
     "name,steps",
     [("add2", 60), ("acc_loop", 50), ("ring4", 80), ("sorter", 50), ("mesh8", 60)],
 )
-def test_fused_bit_identical(name, steps):
+def test_fused_bit_identical(name, steps, unroll_cap):
     top = networks.BASELINE_CONFIGS[name](in_cap=8, out_cap=8, stack_cap=8)
-    ref, out = run_both(top, batch=128, steps=steps)
+    ref, out = run_both(top, batch=128, steps=steps, unroll_cap=unroll_cap)
     assert_states_equal(ref, out)
     assert int(np.asarray(out.out_wr).min()) > 0  # it actually computed
 
 
-def test_fused_multiblock_grid():
+@STORAGE_MODES
+def test_fused_multiblock_grid(unroll_cap):
     # 4 grid blocks of 128: block independence + index maps.
     top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
-    ref, out = run_both(top, batch=512, steps=60, block_batch=128)
+    ref, out = run_both(
+        top, batch=512, steps=60, block_batch=128, unroll_cap=unroll_cap
+    )
     assert_states_equal(ref, out)
 
 
-def test_fused_backpressure_parks():
-    # Tiny out ring (cap 2): producers park identically in both kernels.
-    top = networks.acc_loop(in_cap=8, out_cap=2, stack_cap=8)
-    ref, out = run_both(top, batch=128, steps=50, n_inputs=6)
+@STORAGE_MODES
+def test_fused_backpressure_parks(unroll_cap):
+    # Tiny out ring (cap 8 chunked / 2 regs): producers park identically in
+    # both kernels.  Chunked caps must be multiples of 8, so the chunked
+    # variant uses out_cap=8 with more inputs to hit the cap.
+    out_cap = 2 if unroll_cap is None else 8
+    top = networks.acc_loop(in_cap=16, out_cap=out_cap, stack_cap=8)
+    ref, out = run_both(
+        top, batch=128, steps=120, n_inputs=out_cap + 4, unroll_cap=unroll_cap
+    )
     assert_states_equal(ref, out)
-    np.testing.assert_array_equal(np.asarray(out.out_wr), 2)  # parked at cap
+    np.testing.assert_array_equal(np.asarray(out.out_wr), out_cap)  # parked
 
 
 def test_fused_starvation_parks():
@@ -72,6 +94,62 @@ def test_fused_starvation_parks():
     out = net.fused_runner(40, block_batch=128, interpret=True)(net.init_state())
     assert_states_equal(ref, out)
     assert int(np.asarray(out.out_wr).sum()) == 0
+
+
+@pytest.mark.parametrize("name", ["add2", "mesh8"])
+def test_fused_engine_default_caps(name):
+    # Engine-default 1024-deep rings/stacks (the caps every serve topology
+    # gets unless overridden, engine.py) compile and hold bit-parity on the
+    # chunked path at production thresholds — no unroll_cap override, so
+    # this runs exactly the storage mode a default `engine=fused` serve hits.
+    top = networks.BASELINE_CONFIGS[name]()  # stack/in/out caps = 1024
+    ref, out = run_both(top, batch=128, steps=60, n_inputs=6)
+    assert_states_equal(ref, out)
+    assert int(np.asarray(out.out_wr).min()) > 0
+
+
+def test_fused_deep_stack_push_chunked():
+    # Flood a cap-128 stack to depth 100 (> UNROLL_CAP=64): every push above
+    # slot 64 lands via ref_scatter across chunk boundaries.  add2's own
+    # stack never passes depth 1, so this uses a dedicated pusher.
+    top = Topology(
+        node_info={"p": "program", "st": "stack"},
+        programs={"p": "IN ACC\nPUSH ACC, st\n"},
+        in_cap=104, out_cap=8, stack_cap=128,
+    )
+    ref, out = run_both(top, batch=128, steps=310, n_inputs=100)
+    assert_states_equal(ref, out)
+    np.testing.assert_array_equal(np.asarray(out.stack_top)[:, 0], 100)
+
+
+def test_fused_deep_stack_pop_chunked():
+    # Drain a prefilled depth-100 stack through OUT: every pop above slot 64
+    # reads via ref_gather across chunk boundaries, and the LIFO stream
+    # must match the scan engine value-for-value.
+    top = Topology(
+        node_info={"p": "program", "st": "stack"},
+        programs={"p": "POP st, ACC\nOUT ACC\n"},
+        in_cap=8, out_cap=104, stack_cap=128,
+    )
+    net = top.compile(batch=128)
+    rng = np.random.default_rng(7)
+    depth = 100
+    fill = rng.integers(-1000, 1000, size=(128, 1, depth)).astype(np.int32)
+
+    def prep(state):
+        return state._replace(
+            stack_mem=state.stack_mem.at[:, :, :depth].set(fill),
+            stack_top=state.stack_top.at[:, 0].set(depth),
+        )
+
+    ref = net.run(prep(net.init_state()), 320)
+    fused = net.fused_runner(320, block_batch=128, interpret=True)
+    out = fused(prep(net.init_state()))
+    assert_states_equal(ref, out)
+    np.testing.assert_array_equal(np.asarray(out.out_wr), depth)
+    np.testing.assert_array_equal(  # LIFO order through the chunked gather
+        np.asarray(out.out_buf)[:, :depth], fill[:, 0, ::-1]
+    )
 
 
 def test_fused_requires_batch():
