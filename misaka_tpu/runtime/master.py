@@ -33,6 +33,7 @@ import numpy as np
 from misaka_tpu.runtime.topology import Topology, TopologyError
 from misaka_tpu.tis.parser import TISParseError
 from misaka_tpu.tis.lower import TISLowerError
+from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
 
 log = logging.getLogger("misaka_tpu.master")
 
@@ -63,6 +64,7 @@ class MasterNode:
         trace_instance: int = 0,
         data_parallel: int | None = None,
         model_parallel: int | None = None,
+        stripe: int | None = None,
     ):
         """batch=None serves one network instance (every /compute strictly
         serialized — the correlated fix for quirk #2).  batch=B runs B
@@ -110,6 +112,13 @@ class MasterNode:
         self._chunk = chunk_steps
         self._batch = batch
         self._engine = engine
+        # compute_spread stripe size (values per instance per request).
+        # Default = the input-ring capacity: each stripe fits one refill.
+        # Larger stripes spread a request over fewer instances — less
+        # per-slot host work (locks, queue hops, drain entries) at the cost
+        # of device-side parallel coverage; the serve path is host-bound
+        # well past B=1024, so moderate multiples win (see bench.py).
+        self._stripe = int(stripe) if stripe else None
         self._mesh = None
         self._dp = self._mp = 1
         if data_parallel or model_parallel:
@@ -240,18 +249,34 @@ class MasterNode:
 
             if jax.devices()[0].platform != "tpu":
                 return None
-        try:
-            if self._mesh is not None:
+        if self._mesh is not None:
+            try:
                 return self._make_dp_fused_runner(net)
-            return net.fused_runner(
-                self._chunk, interpret=(eng == "fused-interpret")
-            )
-        except ValueError:
-            if eng == "auto":
-                # over the VMEM budget (e.g. default 1024-deep rings):
-                # the scan engine serves everything the kernel can't
-                return None
-            raise
+            except ValueError:
+                if eng == "auto":
+                    return None
+                raise
+        # Big-cap topologies (e.g. the engine-default 1024-deep rings) can
+        # exceed the kernel's VMEM budget at the default batch block; a
+        # smaller block trades grid iterations for residency, so walk down
+        # before giving up — the chunked storage mode plus a 128-wide block
+        # serves everything the scan engine does.
+        err: ValueError | None = None
+        for bb in (None, 512, 256, 128):
+            if bb is not None and (self._batch % bb or bb > self._batch):
+                continue
+            try:
+                return net.fused_runner(
+                    self._chunk, block_batch=bb,
+                    interpret=(eng == "fused-interpret"),
+                )
+            except ValueError as e:
+                err = e
+        if eng == "auto":
+            # nothing fits (or non-TPU shapes): the scan engine serves
+            # everything the kernel can't
+            return None
+        raise err
 
     def _make_dp_fused_runner(self, net):
         """The fused Pallas kernel under shard_map over the `data` axis: each
@@ -363,7 +388,8 @@ class MasterNode:
         """One value in, one value out — correlated (fixes quirk #2)."""
         return self.compute_many([value], timeout=timeout)[0]
 
-    def compute_many(self, values, timeout: float = 30.0) -> list[int]:
+    def compute_many(self, values, timeout: float = 30.0,
+                     return_array: bool = False):
         """A FIFO stream of values through ONE instance: len(values) in,
         len(values) out, pairing strictly ordered.
 
@@ -385,7 +411,7 @@ class MasterNode:
         if arr.ndim != 1:
             raise ValueError(f"values must be a flat sequence, got shape {arr.shape}")
         if arr.size == 0:
-            return []
+            return np.empty((0,), np.int32) if return_array else []
         n = self._n_slots
         with self._rr_lock:
             start = self._rr
@@ -408,7 +434,8 @@ class MasterNode:
             self._work_event.set()
             deadline = time.monotonic() + timeout
             parts = self._collect_slot(slot, arr.size, deadline, epoch, timeout)
-            return np.concatenate(parts).tolist()
+            out = np.concatenate(parts)
+            return out if return_array else out.tolist()
         finally:
             with self._waiters_lock:
                 self._waiters -= 1
@@ -490,7 +517,7 @@ class MasterNode:
         if arr.size == 0:
             return np.empty((0,), np.int32) if return_array else []
         n = self._n_slots
-        stripe = max(1, self._net.in_cap)
+        stripe = self._stripe or max(1, self._net.in_cap)
         owned: list[int] = []
         if n > 1 and arr.size > stripe:
             want_slots = min(n, -(-arr.size // stripe))
@@ -500,8 +527,7 @@ class MasterNode:
                     if len(owned) >= want_slots:
                         break
         if not owned:
-            out = self.compute_many(arr, timeout=timeout)
-            return np.asarray(out, np.int32) if return_array else out
+            return self.compute_many(arr, timeout=timeout, return_array=return_array)
         with self._waiters_lock:
             self._waiters += 1
         try:
@@ -732,6 +758,17 @@ class MasterNode:
             self._stale = [0] * len(self._stale)
             self._epoch += 1
 
+    def _mark_ticks(self) -> None:
+        """Advance the tick-rate gauge by one chunk (device loop thread)."""
+        self._ticks_done += self._chunk
+        now = time.monotonic()
+        if now - self._rate_mark_time > 2:
+            self._rate = (self._ticks_done - self._rate_mark_tick) / (
+                now - self._rate_mark_time
+            )
+            self._rate_mark_tick = self._ticks_done
+            self._rate_mark_time = now
+
     def _device_loop(self) -> None:
         """Run jitted chunks; sync rings with host queues at the boundaries."""
         try:
@@ -784,7 +821,33 @@ class MasterNode:
             with self._state_lock:
                 state = self._state
                 self._ingest_submissions()
-                if self._batch is None:
+                if self._batch is None and self._trace is None:
+                    # ONE device dispatch + ONE read for the whole iteration
+                    # (feed+run+counters+drain fused, engine.serve_chunk):
+                    # on a relayed device this is the difference between ~2
+                    # and ~6 round trips per quiet /compute.
+                    free = self._net.in_cap - int(ctrs[1] - ctrs[0])
+                    got = self._cut_pending(0, free)
+                    vals = np.zeros((self._net.in_cap,), np.int32)
+                    count = 0
+                    if got is not None:
+                        vals[: len(got)] = got
+                        count = len(got)
+                        busy = True
+                    state, packed = self._net.serve_chunk(
+                        state, vals, count, self._chunk
+                    )
+                    self._mark_ticks()
+                    p = np.asarray(packed)  # the single device read
+                    ctrs = p[:4]
+                    rd, wr = int(p[2]), int(p[3])
+                    if wr > rd:
+                        idx = (rd + np.arange(wr - rd)) % self._net.out_cap
+                        per_slot = [(0, p[4:][idx])]
+                    else:
+                        per_slot = []
+                    self._state = state
+                elif self._batch is None:
                     free = self._net.in_cap - int(ctrs[1] - ctrs[0])
                     got = self._cut_pending(0, free)
                     if got is not None:
@@ -805,36 +868,32 @@ class MasterNode:
                     if counts.any():
                         state = self._net.feed_batched(state, vals, counts)
                         busy = True
-                if self._trace is not None:
-                    state, self._trace = self._net.run_traced(
-                        state, self._trace, self._chunk,
-                        **({"instance": self._trace_instance}
-                           if self._batch is not None else {}),
-                    )
-                elif self._runner is not None:
-                    state = self._runner(state)  # the fused Pallas fast path
+                if self._batch is None and self._trace is None:
+                    pass  # the one-dispatch branch above did run+drain
                 else:
-                    state = self._net.run(state, self._chunk)
-                self._ticks_done += self._chunk
-                now = time.monotonic()
-                if now - self._rate_mark_time > 2:
-                    self._rate = (self._ticks_done - self._rate_mark_tick) / (
-                        now - self._rate_mark_time
-                    )
-                    self._rate_mark_tick = self._ticks_done
-                    self._rate_mark_time = now
-                ctrs = self._net.counters(state)  # post-run, exact
-                if self._batch is None:
-                    if ctrs[3] > ctrs[2]:
-                        state, outs = self._net.drain(state)
-                        per_slot = [(0, np.asarray(outs, np.int32))]
+                    if self._trace is not None:
+                        state, self._trace = self._net.run_traced(
+                            state, self._trace, self._chunk,
+                            **({"instance": self._trace_instance}
+                               if self._batch is not None else {}),
+                        )
+                    elif self._runner is not None:
+                        state = self._runner(state)  # the fused Pallas fast path
                     else:
-                        per_slot = []
-                else:
-                    state, per_slot = self._net.drain_batched(
-                        state, rd=ctrs[2], wr=ctrs[3]
-                    )
-                self._state = state
+                        state = self._net.run(state, self._chunk)
+                    self._mark_ticks()
+                    ctrs = self._net.counters(state)  # post-run, exact
+                    if self._batch is None:
+                        if ctrs[3] > ctrs[2]:
+                            state, outs = self._net.drain(state)
+                            per_slot = [(0, np.asarray(outs, np.int32))]
+                        else:
+                            per_slot = []
+                    else:
+                        state, per_slot = self._net.drain_batched(
+                            state, rd=ctrs[2], wr=ctrs[3]
+                        )
+                    self._state = state
             for slot, outs in per_slot:
                 self._out_qs[slot].put(outs)
                 busy = True
@@ -864,6 +923,9 @@ def make_http_server(
 ) -> ThreadingHTTPServer:
     """The five client routes (master.go:90-224), byte-compatible, plus the
     additive /status, /trace, /checkpoint, /restore, /profile/* routes.
+    (Byte compatibility covers the five reference routes; the additive
+    /compute_batch emits JSON-equivalent fixed-width-padded int arrays —
+    legal JSON whitespace, not byte-identical to json.dumps output.)
 
     HTTP checkpointing is DISABLED unless `checkpoint_dir` is configured;
     when enabled, clients pass a bare checkpoint NAME (no path separators)
@@ -906,19 +968,21 @@ def make_http_server(
             return {k: v[0] for k, v in parse_qs(raw, keep_blank_values=True).items()}
 
         def _json(self, obj) -> None:
-            data = (json.dumps(obj) + "\n").encode()
+            self._bytes_json((json.dumps(obj) + "\n").encode())
+
+        def _send(self, data: bytes, ctype: str) -> None:
             self.send_response(200)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
 
         def _bytes(self, data: bytes) -> None:
-            self.send_response(200)
-            self.send_header("Content-Type", "application/octet-stream")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            self._send(data, "application/octet-stream")
+
+        def _bytes_json(self, data: bytes) -> None:
+            """Pre-encoded JSON body (the vectorized /compute_batch path)."""
+            self._send(data, "application/json")
 
         def do_GET(self):
             # /status and /trace are additive; the reference's routes reject
@@ -1024,24 +1088,32 @@ def make_http_server(
                         self._text(400, "network is not running")
                         return
                     form = self._form()
-                    raw = form.get("values", "").replace(",", " ").split()
                     try:
-                        values = np.array(raw, dtype=np.int32) if raw \
-                            else np.empty((0,), np.int32)
-                    except (ValueError, OverflowError):
+                        # vectorized decimal parse — the per-value Python of
+                        # round 2 capped this route at 859k/s (textcodec.py)
+                        values = dec_to_ints(form.get("values", ""))
+                    except (ValueError, UnicodeEncodeError):
                         self._text(400, "cannot parse values")
                         return
                     try:
                         if form.get("spread") == "1" and hasattr(
                             master, "compute_spread"
                         ):
-                            result = master.compute_spread(values)
+                            result = master.compute_spread(
+                                values, return_array=True
+                            )
                         else:
-                            result = master.compute_many(values)
+                            result = master.compute_many(
+                                values, return_array=True
+                            )
                     except ComputeTimeout as e:
                         self._text(500, str(e))
                         return
-                    self._json({"values": result})
+                    # one vectorized pass; pad spaces are legal JSON
+                    # whitespace, so json.loads clients decode unchanged
+                    self._bytes_json(
+                        b'{"values": [' + ints_to_dec(result, b",") + b"]}\n"
+                    )
                 elif self.path.split("?", 1)[0] == "/compute_raw":
                     # additive: the wire-efficient twin of /compute_batch —
                     # request body is raw little-endian int32 values, the

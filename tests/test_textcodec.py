@@ -1,0 +1,77 @@
+"""Vectorized decimal codec: round-trips, edge values, malformed rejection.
+
+The codec is the /compute_batch wire format (utils/textcodec.py) — its
+output must stay loadable by ordinary json/int() clients, and its parser
+must reject exactly what the round-2 per-value parser rejected (pinned by
+test_runtime.py's 400-path tests, which now route through it).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
+
+EDGES = np.array(
+    [0, 1, -1, 9, 10, -10, 99, 100, 2**31 - 1, -(2**31), 123456789, -987654321],
+    np.int32,
+)
+
+
+@pytest.mark.parametrize("sep", [b" ", b",", b"+"])
+def test_roundtrip_edges(sep):
+    txt = ints_to_dec(EDGES, sep)
+    np.testing.assert_array_equal(dec_to_ints(txt), EDGES)
+
+
+@pytest.mark.parametrize("lo,hi", [(-10, 10), (-1000, 1000), (-2**31, 2**31)])
+def test_roundtrip_random(lo, hi):
+    rng = np.random.default_rng(42)
+    arr = rng.integers(lo, hi, size=10_000).astype(np.int32)
+    for sep in (b" ", b",", b"+"):
+        np.testing.assert_array_equal(dec_to_ints(ints_to_dec(arr, sep)), arr)
+
+
+def test_tokens_match_python_str():
+    rng = np.random.default_rng(3)
+    arr = rng.integers(-(2**31), 2**31, size=2000).astype(np.int32)
+    toks = ints_to_dec(arr, b" ").split()
+    assert [int(t) for t in toks] == arr.tolist()
+
+
+def test_comma_sep_is_valid_json_array():
+    # pad spaces are JSON whitespace: a json.loads client must decode the
+    # /compute_batch response unchanged
+    body = b'{"values": [' + ints_to_dec(EDGES, b",") + b"]}"
+    assert json.loads(body) == {"values": EDGES.tolist()}
+
+
+def test_empty():
+    assert ints_to_dec(np.empty((0,), np.int32)) == b""
+    assert dec_to_ints(b"").size == 0
+    assert dec_to_ints("  , \t\n").size == 0
+
+
+def test_accepts_mixed_separators():
+    np.testing.assert_array_equal(
+        dec_to_ints("1, 2 3,4\t5\n-6"), np.array([1, 2, 3, 4, 5, -6], np.int32)
+    )
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["1 two 3", "5x", "x5", "--5", "5-", "5-6", "1.5", "0x10",
+     "9999999999999", "-9999999999999", "2147483648", "-2147483649", "-", "- 5",
+     # equal-width out-of-range tokens land on the fixed-stride grid with a
+     # 12+ char field: must 400 (ValueError), not crash (round-3 regression)
+     "999999999999 999999999999", "999999999999,999999999999"],
+)
+def test_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        dec_to_ints(bad)
+
+
+def test_rejects_non_ascii():
+    with pytest.raises((ValueError, UnicodeEncodeError)):
+        dec_to_ints("１２３")  # fullwidth digits must not silently parse
