@@ -36,7 +36,7 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.misaka_interp_run.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.misaka_interp_drain.restype = ctypes.c_int
     lib.misaka_interp_drain.argtypes = [ctypes.c_void_p, _I32P, ctypes.c_int]
-    lib.misaka_interp_seed_counters.restype = None
+    lib.misaka_interp_seed_counters.restype = ctypes.c_int
     lib.misaka_interp_seed_counters.argtypes = [ctypes.c_void_p] + [ctypes.c_int32] * 4
     lib.misaka_interp_read.restype = None
     lib.misaka_interp_read.argtypes = [ctypes.c_void_p] + [
@@ -135,10 +135,19 @@ class NativeInterpreter:
         return out[:got].tolist()
 
     def seed_counters(self, in_rd: int, in_wr: int, out_rd: int, out_wr: int) -> None:
-        """Set the ring counters directly (checkpoint restore / soak tests)."""
-        self._lib.misaka_interp_seed_counters(
+        """Set the ring counters directly (checkpoint restore / soak tests).
+
+        Raises ValueError when the counters violate the ring invariants
+        (0 <= rd <= wr, wr - rd <= cap) — the C side rejects them with the
+        interpreter state unchanged."""
+        rc = self._lib.misaka_interp_seed_counters(
             self._handle(), int(in_rd), int(in_wr), int(out_rd), int(out_wr)
         )
+        if rc != 0:
+            raise ValueError(
+                f"invalid ring counters: in {in_rd}/{in_wr} (cap {self.in_cap}), "
+                f"out {out_rd}/{out_wr} (cap {self.out_cap})"
+            )
 
     def state_arrays(self) -> dict:
         """Mirror tests/oracle.py state_arrays for differential comparison."""

@@ -1,20 +1,31 @@
 """Shared build-and-load scaffolding for the native C++ backends.
 
 Both native components (tis/native.py assembler, core/cinterp.py interpreter)
-follow the same contract: a checked-in .so for zero-setup use, rebuilt from
-source when the source is newer OR when the shipped binary fails to load
-(stale/foreign-arch artifact) and a compiler is available; a process-wide
-failure latch so an unavailable toolchain degrades quietly to the pure-Python
-paths instead of retrying every call.
+follow the same contract: a checked-in .so for zero-setup use, rebuilt
+whenever the binary does not carry the current source's identity hash or
+fails to load (stale/foreign-arch artifact) and a compiler is available; a
+process-wide failure latch so an unavailable toolchain degrades quietly to
+the pure-Python paths instead of retrying every call.
+
+Staleness is decided by CONTENT, not mtime: each .cpp embeds a
+"MISAKA-SRC-HASH:<sha256[:16]>" tag injected at build time, and the loader
+scans the .so bytes for the tag matching the current source hash.  (A fresh
+clone gives source and binary identical mtimes, so the old mtime comparison
+could never flag a stale shipped binary.)  A binary with a wrong or missing
+tag is rebuilt; if no toolchain is available the component is treated as
+unavailable rather than running stale native code.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
 from typing import Callable
+
+_TAG = b"MISAKA-SRC-HASH:"
 
 
 class NativeLib:
@@ -28,29 +39,59 @@ class NativeLib:
         self._lib: ctypes.CDLL | None = None
         self._failed = False
 
+    def _src_hash(self) -> str:
+        with open(self._src, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:16]
+
+    def _so_matches_src(self) -> bool:
+        """True iff the on-disk .so embeds the current source's hash tag."""
+        try:
+            with open(self._so, "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        i = data.find(_TAG)
+        if i < 0:
+            return False  # pre-tag binary: provenance unknown, rebuild
+        want = self._src_hash().encode()
+        return data[i + len(_TAG): i + len(_TAG) + len(want)] == want
+
     def _build(self) -> None:
         cxx = os.environ.get("CXX", "g++")
-        subprocess.run(
-            [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", self._src, "-o", self._so],
-            check=True,
-            capture_output=True,
-        )
+        # Compile to a temp name and swap atomically: truncating a .so that
+        # some process has dlopen'd rewrites its mapped text pages (SIGSEGV
+        # in that process); os.replace gives the new build a fresh inode and
+        # leaves existing mappings intact.
+        tmp = f"{self._so}.tmp.{os.getpid()}"
+        try:
+            subprocess.run(
+                [
+                    cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+                    f'-DMISAKA_SRC_HASH="{self._src_hash()}"',
+                    self._src, "-o", tmp,
+                ],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, self._so)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
     def load(self) -> ctypes.CDLL | None:
         with self._lock:
             if self._lib is not None or self._failed:
                 return self._lib
             try:
-                if not os.path.exists(self._so) or (
-                    os.path.exists(self._src)
-                    and os.path.getmtime(self._src) > os.path.getmtime(self._so)
-                ):
+                if os.path.exists(self._src) and not self._so_matches_src():
                     self._build()
                 try:
                     lib = ctypes.CDLL(self._so)
                 except OSError:
-                    # Shipped binary unloadable (stale or built for another
-                    # arch): rebuild from source once and retry.
+                    # Shipped binary unloadable (e.g. built for another
+                    # arch): rebuild from source once and retry.  dlopen
+                    # caches by path, so this only works because nothing
+                    # loaded the old file in this process.
                     if not os.path.exists(self._src):
                         raise
                     self._build()

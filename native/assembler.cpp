@@ -103,6 +103,16 @@ struct Error {
 
 extern "C" {
 
+// Source-identity tag scanned from the .so bytes by utils/nativelib.py to
+// detect a binary built from different source (mtime comparison cannot —
+// a fresh checkout gives every file the same timestamp).  The build injects
+// -DMISAKA_SRC_HASH=<sha256[:16] of this file>.
+#ifndef MISAKA_SRC_HASH
+#define MISAKA_SRC_HASH "unbuilt"
+#endif
+__attribute__((used)) const char misaka_src_hash_tag[] =
+    "MISAKA-SRC-HASH:" MISAKA_SRC_HASH;
+
 // Assemble `program` into out_code[max_lines * NFIELDS] (row-major).
 // Returns the number of lines, or -1 with `err` filled.
 int misaka_assemble(const char* program, const char* lane_names,

@@ -236,6 +236,16 @@ struct Interp {
 
 extern "C" {
 
+// Source-identity tag scanned from the .so bytes by utils/nativelib.py to
+// detect a binary built from different source (mtime comparison cannot —
+// a fresh checkout gives every file the same timestamp).  The build injects
+// -DMISAKA_SRC_HASH=<sha256[:16] of this file>.
+#ifndef MISAKA_SRC_HASH
+#define MISAKA_SRC_HASH "unbuilt"
+#endif
+__attribute__((used)) const char misaka_src_hash_tag[] =
+    "MISAKA-SRC-HASH:" MISAKA_SRC_HASH;
+
 void* misaka_interp_create(const int32_t* code, const int32_t* prog_len,
                            int n_lanes, int max_len, int num_stacks,
                            int stack_cap, int in_cap, int out_cap) {
@@ -330,13 +340,21 @@ void misaka_interp_run(void* h, int ticks) {
 }
 
 // Set ring counters directly (checkpoint restore; rebase soak tests).
-void misaka_interp_seed_counters(void* h, int32_t in_rd, int32_t in_wr,
-                                 int32_t out_rd, int32_t out_wr) {
+// Returns 0 on success, -1 (state unchanged) when the pair violates the
+// ring invariants 0 <= rd <= wr, wr - rd <= cap: a hostile rd (negative
+// `%` in C++ rounds toward zero) or over-occupied ring would index out of
+// the buffers on the next run/drain.
+int misaka_interp_seed_counters(void* h, int32_t in_rd, int32_t in_wr,
+                                int32_t out_rd, int32_t out_wr) {
   auto* it = (Interp*)h;
+  if (in_rd < 0 || in_wr < in_rd || in_wr - in_rd > it->in_cap ||
+      out_rd < 0 || out_wr < out_rd || out_wr - out_rd > it->out_cap)
+    return -1;
   it->in_rd = in_rd;
   it->in_wr = in_wr;
   it->out_rd = out_rd;
   it->out_wr = out_wr;
+  return 0;
 }
 
 int misaka_interp_drain(void* h, int32_t* out, int max_out) {

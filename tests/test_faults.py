@@ -21,11 +21,13 @@ def test_device_loop_crash_stops_cleanly_and_restarts():
         assert master.compute(1) == 3
 
         real_run = master._net.run
+        real_serve = master._net.serve_chunk  # the unbatched loop's one-dispatch path
 
         def boom(*a, **k):
             raise RuntimeError("injected device fault")
 
         master._net.run = boom
+        master._net.serve_chunk = boom
         deadline = time.monotonic() + 10
         while master.is_running and time.monotonic() < deadline:
             time.sleep(0.02)
@@ -38,6 +40,7 @@ def test_device_loop_crash_stops_cleanly_and_restarts():
 
         # Heal the fault; /run restarts the loop and service resumes.
         master._net.run = real_run
+        master._net.serve_chunk = real_serve
         master.run()
         assert master.compute(5) == 7
     finally:

@@ -1,0 +1,142 @@
+"""Native-backend hardening: hostile counter seeds and stale-.so detection.
+
+Round-2 advisor findings (VERDICT r2 weak #7): `misaka_interp_seed_counters`
+accepted arbitrary counters (a negative rd means a negative C++ `%` — an
+out-of-bounds index on the next run), and staleness was mtime-based (a fresh
+clone gives source and binary identical mtimes, so a stale shipped binary
+was never rebuilt).  Counters are now validated at the ABI (interpreter.cpp)
+and staleness is decided by an embedded source-hash tag (utils/nativelib.py).
+"""
+
+import ctypes
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.core import cinterp
+from misaka_tpu.utils.nativelib import _TAG, NativeLib
+
+needs_native = pytest.mark.skipif(
+    not cinterp.available(), reason="native interpreter unavailable"
+)
+
+
+def make_interp():
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    return cinterp.NativeInterpreter(net.code, net.prog_len, 1, 8, 8, 8)
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "ctrs",
+    [
+        (-1, 0, 0, 0),          # negative rd: negative C++ % -> OOB index
+        (0, -5, 0, 0),          # wr < rd
+        (5, 2, 0, 0),           # inverted pair
+        (0, 9, 0, 0),           # occupancy beyond in_cap=8
+        (0, 0, -(2**31), 0),    # int32 min out_rd
+        (0, 0, 0, 2**31 - 1),   # out ring over-occupied
+    ],
+)
+def test_seed_counters_rejects_hostile(ctrs):
+    with make_interp() as n:
+        with pytest.raises(ValueError):
+            n.seed_counters(*ctrs)
+        # the reject left state untouched: the interpreter still computes
+        n.feed([1, 2])
+        n.run(100)
+        assert n.drain() == [3, 4]
+
+
+@needs_native
+def test_seed_counters_accepts_valid():
+    with make_interp() as n:
+        n.seed_counters(16, 16, 24, 24)  # empty rings at rebased offsets
+        n.feed([7])
+        n.run(100)
+        assert n.drain() == [9]
+
+
+# --- stale-.so detection ----------------------------------------------------
+
+SRC = """
+extern "C" {
+#ifndef MISAKA_SRC_HASH
+#define MISAKA_SRC_HASH "unbuilt"
+#endif
+__attribute__((used)) const char misaka_src_hash_tag[] =
+    "MISAKA-SRC-HASH:" MISAKA_SRC_HASH;
+extern "C" int misaka_probe() { return %d; }
+}
+"""
+
+
+def build_lib(tmp, version):
+    src = os.path.join(tmp, "probe.cpp")
+    so = os.path.join(tmp, "probe.so")
+    with open(src, "w") as f:
+        f.write(SRC % version)
+
+    def configure(lib):
+        lib.misaka_probe.restype = ctypes.c_int
+
+    return NativeLib(src, so, configure), src, so
+
+
+def toolchain():
+    return shutil.which(os.environ.get("CXX", "g++")) is not None
+
+
+@pytest.mark.skipif(not toolchain(), reason="no C++ toolchain")
+def test_fresh_build_embeds_hash(tmp_path):
+    nl, src, so = build_lib(str(tmp_path), 1)
+    lib = nl.load()
+    assert lib is not None and lib.misaka_probe() == 1
+    with open(so, "rb") as f:
+        assert _TAG in f.read()
+
+
+@pytest.mark.skipif(not toolchain(), reason="no C++ toolchain")
+def test_stale_so_is_rebuilt_despite_older_mtime(tmp_path):
+    # A "fresh clone" shape: a v1 binary shipped next to v2 source, with the
+    # binary's mtime NEWER than the source's — the old mtime rule would have
+    # trusted it forever.  Separate directories per loader: dlopen caches by
+    # pathname, so reloading a replaced .so at the same path in one process
+    # is not meaningful to test.
+    d1, d2 = tmp_path / "v1", tmp_path / "clone"
+    d1.mkdir(), d2.mkdir()
+    nl1, src1, so1 = build_lib(str(d1), 1)
+    assert nl1.load() is not None and nl1.load().misaka_probe() == 1
+    shutil.copy(so1, d2 / "probe.so")
+    nl2, src2, so2 = build_lib(str(d2), 2)  # v2 source beside the v1 binary
+    future = os.path.getmtime(src2) + 3600
+    os.utime(so2, (future, future))
+    assert not nl2._so_matches_src()
+    lib = nl2.load()  # hash mismatch -> rebuild from the v2 source
+    assert lib is not None and lib.misaka_probe() == 2
+
+
+@pytest.mark.skipif(not toolchain(), reason="no C++ toolchain")
+def test_tagless_so_is_rebuilt(tmp_path):
+    # a doctored/pre-tag binary (no embedded hash) is never trusted
+    d1, d2 = tmp_path / "v1", tmp_path / "doctored"
+    d1.mkdir(), d2.mkdir()
+    nl1, src1, so1 = build_lib(str(d1), 3)
+    assert nl1.load() is not None
+    with open(so1, "rb") as f:
+        data = f.read()
+    with open(d2 / "probe.so", "wb") as f:
+        f.write(data.replace(_TAG, b"XXXXXX-XXX-XXXX:"))
+    nl2, _, _ = build_lib(str(d2), 3)
+    assert not nl2._so_matches_src()
+    lib = nl2.load()  # rebuilds from source
+    assert lib is not None and lib.misaka_probe() == 3
+    assert nl2._so_matches_src()
+
+
+def test_matches_missing_so(tmp_path):
+    nl, src, so = build_lib(str(tmp_path), 1)
+    assert not nl._so_matches_src()  # no .so on disk yet
