@@ -6,11 +6,14 @@ CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC
 
 native: native/libmisaka_assembler.so native/libmisaka_interp.so
 
+# -DMISAKA_SRC_HASH must match utils/nativelib.py's _build (sha256[:16] of
+# the source): the loader trusts a .so only when its embedded tag matches
+# the source hash, so an untagged build would always be treated as stale.
 native/libmisaka_assembler.so: native/assembler.cpp
-	$(CXX) $(CXXFLAGS) $< -o $@
+	$(CXX) $(CXXFLAGS) -DMISAKA_SRC_HASH="\"$$(sha256sum $< | cut -c1-16)\"" $< -o $@
 
 native/libmisaka_interp.so: native/interpreter.cpp
-	$(CXX) $(CXXFLAGS) $< -o $@
+	$(CXX) $(CXXFLAGS) -DMISAKA_SRC_HASH="\"$$(sha256sum $< | cut -c1-16)\"" $< -o $@
 
 # Regenerate protobuf message classes for the per-process transport.  The
 # image ships protoc but not grpcio-tools; service stubs are hand-declared
@@ -37,6 +40,11 @@ cert:
 		-out deploy/certs/service.pem -days 365 -sha256 \
 		-extfile deploy/certificate.conf -extensions req_ext
 
+# Real-hardware lane: the Mosaic-compiled fused kernel, one config per
+# storage mode (tests/test_tpu.py).  Requires an attached TPU.
+test-tpu:
+	MISAKA_TPU_TESTS=1 python -m pytest tests/test_tpu.py -m tpu -q
+
 test:
 	python -m pytest tests/ -x -q
 
@@ -46,4 +54,4 @@ bench:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test bench clean
+.PHONY: native grpc cert test test-tpu bench clean

@@ -6,15 +6,36 @@ driver's dryrun does.  This must run before any module imports jax.
 """
 
 import os
+import sys
 
-# The axon sitecustomize may have initialized JAX backends at interpreter
-# start (it runs before conftest), which makes env-var routes (XLA_FLAGS /
-# JAX_PLATFORMS) unreliable here.  The config API works post-import as long
-# as no computation has run yet.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
+_TPU_LANE = bool(os.environ.get("MISAKA_TPU_TESTS")) and any(
+    "tpu" in arg for arg in sys.argv
+)
 
-import jax
+if _TPU_LANE:
+    # The real-hardware lane (`make test-tpu` / MISAKA_TPU_TESTS=1
+    # pytest -m tpu tests/test_tpu.py): leave the platform alone so
+    # tests/test_tpu.py runs the Mosaic-compiled kernel on the attached
+    # chip.  The argv check keeps a leftover exported MISAKA_TPU_TESTS
+    # from silently unforcing CPU for a plain `pytest tests/` run.
+    pass
+else:
+    # The axon sitecustomize may have initialized JAX backends at
+    # interpreter start (it runs before conftest), which makes env-var
+    # routes (XLA_FLAGS / JAX_PLATFORMS) unreliable here.  The config API
+    # works post-import as long as no computation has run yet.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: runs the compiled Mosaic kernel on real TPU hardware "
+        "(requires MISAKA_TPU_TESTS=1; skipped otherwise)",
+    )

@@ -26,6 +26,13 @@ produce the same output stream as the lockstep engine; a divergence means
 the superstep discipline (or the per-process interpreter, nodes.py:299-365)
 mis-models the reference.  This doubles as the randomized fuzz for the
 per-process interpreter (round-1 VERDICT items 2 and 8).
+
+Round 3 widens the generator with the three deterministic forms it missed
+(JRO with a static offset, MOV <int> to a network port, OUT <int>) and adds
+a CONTENDED suite: networks where several lanes race for one stack, one
+port, and the OUT grant.  There output order is schedule-dependent by
+design, so the invariant asserted is multiset equality — arbitration
+differences may reorder values but must never lose or duplicate one.
 """
 
 import threading
@@ -42,10 +49,10 @@ from misaka_tpu.runtime.nodes import (
 )
 from misaka_tpu.runtime.topology import Topology
 
-IN_CAP = OUT_CAP = 16
+IN_CAP = OUT_CAP = 32
 STACK_CAP = 64
-N_INPUTS = 6
-ENGINE_TICKS = 512
+N_INPUTS = 8
+ENGINE_TICKS = 768
 
 
 def gen_network(seed):
@@ -67,7 +74,7 @@ def gen_network(seed):
         n_seg = int(rng.integers(0, 5))
         owned = [s for s in stacks if stack_owner[s] == i]
         for _ in range(n_seg):
-            kind = int(rng.integers(0, 10))
+            kind = int(rng.integers(0, 12))
             if kind <= 3:  # local register op
                 segments.append([
                     rng.choice([
@@ -80,13 +87,21 @@ def gen_network(seed):
                 s = rng.choice(owned)
                 src = rng.choice(["ACC", str(imm())])
                 segments.append([f"PUSH {src}, {s}", f"POP {s}, ACC"])
-            elif kind <= 7:  # self-send round trip on a private port R1-R3
+            elif kind <= 7:  # self-send round trip on a private port R1-R3;
+                # the sent value is ACC or an immediate (MOV_VAL_NETWORK)
                 port = int(rng.integers(1, 4))
+                src = rng.choice(["ACC", str(imm())])
                 segments.append(
-                    [f"MOV ACC, {name}:R{port}", f"MOV R{port}, ACC"]
+                    [f"MOV {src}, {name}:R{port}", f"MOV R{port}, ACC"]
                 )
-            else:  # forward conditional/unconditional jump to a boundary
+            elif kind <= 9:  # forward conditional/unconditional jump
                 segments.append([rng.choice(["JMP", "JEZ", "JNZ", "JGZ", "JLZ"])])
+            else:  # computed jump with a static offset: "JRO 2" atomically
+                # skips its partner line, "JRO 1" falls through — both land
+                # on the next segment boundary regardless of surroundings
+                segments.append(
+                    ["JRO 2", "NEG"] if rng.integers(2) else ["JRO 1"]
+                )
 
         # resolve forward jumps to segment-boundary labels (atomic skips)
         lines: list[str] = []
@@ -98,21 +113,29 @@ def gen_network(seed):
                 bound_labels.setdefault(tgt, f"b{tgt}")
                 seg = [f"{seg[0]} b{tgt}"]
                 segments[j] = seg
-        tail = (
-            "OUT ACC" if i == n_lanes - 1 else f"MOV ACC, {lanes[i + 1]}:R0"
-        )
+        # tail: the last lane emits its value; sometimes it also emits a
+        # constant (OUT_VAL) — a fixed 2-outputs-per-iteration cadence, still
+        # deterministic (same lane, successive lines)
+        outs_per_input = 1
+        if i == n_lanes - 1:
+            tail = ["OUT ACC"]
+            if rng.integers(3) == 0:
+                tail.append(f"OUT {imm()}")
+                outs_per_input = 2
+        else:
+            tail = [f"MOV ACC, {lanes[i + 1]}:R0"]
         for j, seg in enumerate(segments):
             if j in bound_labels:
                 lines.append(f"{bound_labels[j]}:")
             lines.extend(seg)
         if len(segments) in bound_labels:
             lines.append(f"{bound_labels[len(segments)]}:")
-        lines.append(tail)
+        lines.extend(tail)
         programs[name] = "\n".join(lines)
 
     node_info = {name: "program" for name in lanes}
     node_info.update({s: "stack" for s in stacks})
-    return node_info, programs
+    return node_info, programs, outs_per_input
 
 
 def run_engine(node_info, programs, inputs):
@@ -189,20 +212,70 @@ def run_cluster(node_info, programs, inputs, expect_n, timeout=30.0):
 
 @pytest.mark.parametrize("seed", range(40))
 def test_engine_matches_cluster(seed):
-    node_info, programs = gen_network(seed)
+    node_info, programs, outs_per_input = gen_network(seed)
     inputs = np.random.default_rng(1000 + seed).integers(
         -100, 100, size=N_INPUTS
     ).tolist()
 
     engine_outs = run_engine(node_info, programs, inputs)
-    # the generator guarantees 1:1 liveness: every input must come out
-    assert len(engine_outs) == N_INPUTS, (
-        f"seed {seed}: engine emitted {len(engine_outs)}/{N_INPUTS} — "
-        f"generator liveness broken\n" + "\n---\n".join(programs.values())
+    # the generator guarantees liveness: every input must produce its
+    # full output cadence (1, or 2 with an OUT_VAL tail)
+    assert len(engine_outs) == N_INPUTS * outs_per_input, (
+        f"seed {seed}: engine emitted {len(engine_outs)}/"
+        f"{N_INPUTS * outs_per_input} — generator liveness broken\n"
+        + "\n---\n".join(programs.values())
     )
 
     cluster_outs = run_cluster(node_info, programs, inputs, len(engine_outs))
     assert cluster_outs == engine_outs, (
         f"seed {seed}: cross-mode divergence\nengine:  {engine_outs}\n"
         f"cluster: {cluster_outs}\nprograms:\n" + "\n---\n".join(programs.values())
+    )
+
+
+def gen_contended(seed):
+    """A deliberately CONTENDED network: multiple lanes race for one stack,
+    one destination port, and the OUT grant.  Output ORDER is
+    schedule-dependent, but every worker applies the same transform, so the
+    output MULTISET is not: arbitration differences may reorder values but
+    must never lose or duplicate one.
+    """
+    rng = np.random.default_rng(seed)
+    k = int(rng.integers(-20, 20))
+    n_workers = int(rng.integers(2, 4))
+    via_port = bool(rng.integers(2))  # workers -> shared port -> tail OUT
+    node_info = {"head": "program", "st": "stack"}
+    programs = {"head": "IN ACC\nPUSH ACC, st\n"}
+    for w in range(n_workers):
+        name = f"w{w}"
+        node_info[name] = "program"
+        sink = "MOV ACC, tail:R0" if via_port else "OUT ACC"
+        programs[name] = f"POP st, ACC\nADD {k}\n{sink}\n"
+    if via_port:
+        node_info["tail"] = "program"
+        programs["tail"] = "MOV R0, ACC\nOUT ACC\n"
+    return node_info, programs, k
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_contended_multiset_equal(seed):
+    """Two+ lanes share a stack (and possibly a port and the OUT grant):
+    the engine's lowest-lane arbitration and the cluster's free-running
+    races must produce the SAME MULTISET of outputs — schedule-independent
+    conservation, the property quirk-free arbitration must preserve."""
+    node_info, programs, k = gen_contended(seed)
+    inputs = np.random.default_rng(2000 + seed).integers(
+        -100, 100, size=N_INPUTS
+    ).tolist()
+    expect = sorted(v + k for v in inputs)
+
+    engine_outs = run_engine(node_info, programs, inputs)
+    assert sorted(engine_outs) == expect, (
+        f"seed {seed}: engine multiset wrong\n{engine_outs}\nprograms:\n"
+        + "\n---\n".join(programs.values())
+    )
+    cluster_outs = run_cluster(node_info, programs, inputs, len(engine_outs))
+    assert sorted(cluster_outs) == expect, (
+        f"seed {seed}: cluster multiset wrong\n{cluster_outs}\nprograms:\n"
+        + "\n---\n".join(programs.values())
     )

@@ -1,0 +1,87 @@
+"""Real-hardware lane: the Mosaic-COMPILED fused kernel vs the scan engine.
+
+Every other fused test runs the Pallas kernel in interpret mode (CPU CI), so
+a Mosaic-specific miscompile would surface only as a bench parity failure
+with nothing minimized to bisect (VERDICT r2 weak #6).  This file runs the
+same parity checks through the actual TPU compiler, one config per storage
+mode (register-resident small caps, chunked VMEM-ref big caps).
+
+Run: `make test-tpu`, i.e. `MISAKA_TPU_TESTS=1 pytest -m tpu tests/`.
+Skipped entirely in the normal CPU suite (conftest.py forces cpu there).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+if not os.environ.get("MISAKA_TPU_TESTS"):
+    pytest.skip(
+        "TPU lane disabled (set MISAKA_TPU_TESTS=1)", allow_module_level=True
+    )
+
+import jax  # noqa: E402  (after the env gate on purpose)
+
+if not jax.devices() or jax.devices()[0].platform != "tpu":
+    pytest.skip("no TPU attached", allow_module_level=True)
+
+from misaka_tpu import networks  # noqa: E402
+from misaka_tpu.runtime.topology import Topology  # noqa: E402
+
+
+def assert_states_equal(a, b):
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)),
+            np.asarray(getattr(b, name)),
+            err_msg=f"state field '{name}' diverged on hardware",
+        )
+
+
+def run_both_compiled(top, batch, steps, n_inputs, seed=0):
+    net = top.compile(batch=batch)
+    rng = np.random.default_rng(seed)
+    vals = rng.integers(-1000, 1000, size=(batch, n_inputs)).astype(np.int32)
+
+    def prep(state):
+        return state._replace(
+            in_buf=state.in_buf.at[:, :n_inputs].set(vals),
+            in_wr=state.in_wr + n_inputs,
+        )
+
+    ref = net.run(prep(net.init_state()), steps)
+    fused = net.fused_runner(steps, block_batch=128)  # interpret=False: Mosaic
+    out = fused(prep(net.init_state()))
+    return ref, out
+
+
+def test_mosaic_regs_mode_parity():
+    # caps <= UNROLL_CAP: all storage lives in the fori_loop carry
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    ref, out = run_both_compiled(top, batch=128, steps=60, n_inputs=4)
+    assert_states_equal(ref, out)
+    assert int(np.asarray(out.out_wr).min()) > 0
+
+
+def test_mosaic_chunked_mode_parity():
+    # caps > UNROLL_CAP: stacks/rings stay in VMEM refs, chunked
+    # dynamic-slice access — the storage mode engine-default (1024) serving
+    # uses; exercised here at 128 to keep hardware compile time sane
+    top = networks.mesh8(in_cap=128, out_cap=128, stack_cap=128)
+    ref, out = run_both_compiled(top, batch=128, steps=120, n_inputs=8)
+    assert_states_equal(ref, out)
+    assert int(np.asarray(out.out_wr).min()) > 0
+
+
+def test_mosaic_deep_stack_parity():
+    # stack depth crosses the 64-slot chunk boundary under Mosaic
+    top = Topology(
+        node_info={"p": "program", "st": "stack"},
+        programs={"p": "IN ACC\nPUSH ACC, st\n"},
+        in_cap=104, out_cap=8, stack_cap=128,
+    )
+    ref, out = run_both_compiled(top, batch=128, steps=310, n_inputs=100)
+    assert_states_equal(ref, out)
+    np.testing.assert_array_equal(np.asarray(out.stack_top)[:, 0], 100)
