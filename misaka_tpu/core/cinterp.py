@@ -41,7 +41,7 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.misaka_interp_read.restype = None
     lib.misaka_interp_read.argtypes = [ctypes.c_void_p] + [
         _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
-        _I32P, _I32P, _I32P, _I32P, _I32P,
+        _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P,
     ]
 
 
@@ -165,6 +165,8 @@ class NativeInterpreter:
         out_buf = np.zeros(self.out_cap, np.int32)
         counters = np.zeros(5, np.int32)
         retired = np.zeros(n, np.int32)
+        acc_hi = np.zeros(n, np.int32)
+        bak_hi = np.zeros(n, np.int32)
         self._lib.misaka_interp_read(
             self._h,
             _as_i32p(acc), _as_i32p(bak), _as_i32p(pc),
@@ -172,10 +174,13 @@ class NativeInterpreter:
             _as_i32p(hold_val), holding.ctypes.data_as(_U8P),
             _as_i32p(stack_mem), _as_i32p(stack_top),
             _as_i32p(out_buf), _as_i32p(counters), _as_i32p(retired),
+            _as_i32p(acc_hi), _as_i32p(bak_hi),
         )
         return {
             "acc": acc,
             "bak": bak,
+            "acc_hi": acc_hi,
+            "bak_hi": bak_hi,
             "pc": pc,
             "port_val": port_val,
             "port_full": port_full.astype(bool),

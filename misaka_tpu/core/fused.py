@@ -29,6 +29,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from misaka_tpu.core import regs64
 from misaka_tpu.core.state import NetworkState, rebase_rings
 from misaka_tpu.tis import isa
 
@@ -123,14 +124,15 @@ def make_fused_runner(
                 f"{name}={cap} above the unroll threshold must be a "
                 f"multiple of {_CHUNK} (chunked dynamic-slice access)"
             )
-    # Budget arithmetic.  Carry-resident rows are the scarce resource:
+    # Budget arithmetic (acc/bak carry TWO rows each — 64-bit hi/lo planes,
+    # core/regs64.py).  Carry-resident rows are the scarce resource:
     # Mosaic's scoped-vmem stack peaks at ~4x the carry rows (input+output
     # aliasing plus transients) against the 16MB hardware scoped limit —
     # measured on a v5e, block_batch=4096 on the add-2 net (5MB carry)
     # compiles to a 22MB scoped allocation and is rejected.  Ref-resident
     # rows (the chunked big-cap mode) are plain VMEM arrays without that
     # multiplier; bound the total at a conservative 8MB.
-    carry_rows = 6 * n_lanes + 2 * n_dests + n_stacks + 5
+    carry_rows = 8 * n_lanes + 2 * n_dests + n_stacks + 5
     if sm_in_regs:
         carry_rows += n_stacks * stack_cap
     if inb_in_regs:
@@ -138,7 +140,7 @@ def make_fused_runner(
     if ob_in_regs:
         carry_rows += out_cap
     total_rows = (
-        6 * n_lanes + 2 * n_dests + n_stacks * stack_cap + n_stacks
+        8 * n_lanes + 2 * n_dests + n_stacks * stack_cap + n_stacks
         + in_cap + out_cap + 5
     )
     carry_bytes = carry_rows * block_batch * 4
@@ -220,8 +222,10 @@ def make_fused_runner(
     def tick_body(carry, inb, sm_ref, ob_ref):
         """One superstep.  inb: list of rows (regs mode) or a ref; sm_ref /
         ob_ref: the writable stack/out-ring refs (None in regs mode, where
-        the corresponding carry entries hold the rows)."""
-        (acc, bak, pc, pv, pf, hv, ho, sm, st, ob, sc, ret) = carry
+        the corresponding carry entries hold the rows).  acc/bak are 64-bit
+        (hi, lo) row pairs (core/regs64.py); ports/stacks/rings stay int32
+        (the wire truncates, messenger.proto:34-41)."""
+        (acc, bak, acc_hi, bak_hi, pc, pv, pf, hv, ho, sm, st, ob, sc, ret) = carry
         in_rd, in_wr, out_rd, out_wr, tick = sc
         i32 = lambda b: b.astype(_I32)
 
@@ -245,28 +249,38 @@ def make_fused_runner(
                     new_pf[row] = new_pf[row] & ~consume
 
         # --- pass 2: source resolution -------------------------------------
+        # src_val is the low/wire word; src_hi the 64-bit high word (only
+        # ACC sources carry a live one — imm is static, ports are int32)
         true_mask = pc[0] == pc[0]  # all-True [bsr, LANE]
         src_ok: list = []
         src_val: list = []
+        src_hi: list = []
         for n, prog in enumerate(progs):
             ok = true_mask
             val = jnp.zeros_like(acc[n])
+            val_hi = jnp.zeros_like(acc[n])
             for l, ins in enumerate(prog):
                 if ins.op not in isa.READS_SRC:
                     continue
                 a = act[n][l]
                 if ins.src == isa.SRC_IMM:
                     v = jnp.int32(ins.imm)
+                    vh = jnp.int32(-1 if ins.imm < 0 else 0)  # static sext
                 elif ins.src == isa.SRC_ACC:
                     v = acc[n]
+                    vh = acc_hi[n]
                 elif ins.src == isa.SRC_NIL:
                     v = jnp.int32(0)
+                    vh = jnp.int32(0)
                 else:
                     v = new_hv[n]
+                    vh = new_hv[n] >> 31  # port values are int32: sext
                     ok = ok & (~a | new_ho[n])
                 val = jnp.where(a, v, val)
+                val_hi = jnp.where(a, vh, val_hi)
             src_ok.append(ok)
             src_val.append(val)
+            src_hi.append(val_hi)
 
         # --- pass 3a: network sends (static priority chain per dest) -------
         send_ok: dict[tuple[int, int], jnp.ndarray] = {}
@@ -366,6 +380,8 @@ def make_fused_runner(
         # --- pass 4: commit + register/pc effects ---------------------------
         new_acc = list(acc)
         new_bak = list(bak)
+        new_acc_hi = list(acc_hi)
+        new_bak_hi = list(bak_hi)
         new_pc = list(pc)
         new_ret = list(ret)
         for n, prog in enumerate(progs):
@@ -385,39 +401,64 @@ def make_fused_runner(
                     c = act[n][l] & src_ok[n]
                 commit_n = commit_n | c
 
-                # register effects (reading begin-of-tick acc/bak)
+                # register effects (reading begin-of-tick acc/bak; 64-bit
+                # hi/lo arithmetic per core/regs64.py)
                 if op == isa.OP_MOV_LOCAL and ins.dst == isa.DST_ACC:
                     new_acc[n] = jnp.where(c, src_val[n], new_acc[n])
+                    new_acc_hi[n] = jnp.where(c, src_hi[n], new_acc_hi[n])
                 elif op == isa.OP_ADD:
-                    new_acc[n] = jnp.where(c, acc[n] + src_val[n], new_acc[n])
+                    r_hi, r_lo = regs64.add64(acc_hi[n], acc[n], src_hi[n], src_val[n])
+                    new_acc[n] = jnp.where(c, r_lo, new_acc[n])
+                    new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
                 elif op == isa.OP_SUB:
-                    new_acc[n] = jnp.where(c, acc[n] - src_val[n], new_acc[n])
+                    r_hi, r_lo = regs64.sub64(acc_hi[n], acc[n], src_hi[n], src_val[n])
+                    new_acc[n] = jnp.where(c, r_lo, new_acc[n])
+                    new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
                 elif op == isa.OP_NEG:
-                    new_acc[n] = jnp.where(c, -acc[n], new_acc[n])
+                    r_hi, r_lo = regs64.neg64(acc_hi[n], acc[n])
+                    new_acc[n] = jnp.where(c, r_lo, new_acc[n])
+                    new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
                 elif op == isa.OP_SWP:
                     new_acc[n] = jnp.where(c, bak[n], new_acc[n])
+                    new_acc_hi[n] = jnp.where(c, bak_hi[n], new_acc_hi[n])
                     new_bak[n] = jnp.where(c, acc[n], new_bak[n])
+                    new_bak_hi[n] = jnp.where(c, acc_hi[n], new_bak_hi[n])
                 elif op == isa.OP_SAV:
                     new_bak[n] = jnp.where(c, acc[n], new_bak[n])
+                    new_bak_hi[n] = jnp.where(c, acc_hi[n], new_bak_hi[n])
                 elif op == isa.OP_POP and ins.dst == isa.DST_ACC:
                     new_acc[n] = jnp.where(c, pop_val[ins.tgt], new_acc[n])
+                    new_acc_hi[n] = jnp.where(
+                        c, pop_val[ins.tgt] >> 31, new_acc_hi[n]
+                    )
                 elif op == isa.OP_IN and ins.dst == isa.DST_ACC:
                     new_acc[n] = jnp.where(c, in_val, new_acc[n])
+                    new_acc_hi[n] = jnp.where(c, in_val >> 31, new_acc_hi[n])
 
-                # pc effect
+                # pc effect (conditions see the full 64-bit acc)
                 nxt = jnp.int32((l + 1) % ln)
                 if op == isa.OP_JMP:
                     target = jnp.int32(ins.jmp)
                 elif op == isa.OP_JEZ:
-                    target = jnp.where(acc[n] == 0, jnp.int32(ins.jmp), nxt)
+                    target = jnp.where(
+                        regs64.is_zero(acc_hi[n], acc[n]), jnp.int32(ins.jmp), nxt
+                    )
                 elif op == isa.OP_JNZ:
-                    target = jnp.where(acc[n] != 0, jnp.int32(ins.jmp), nxt)
+                    target = jnp.where(
+                        ~regs64.is_zero(acc_hi[n], acc[n]), jnp.int32(ins.jmp), nxt
+                    )
                 elif op == isa.OP_JGZ:
-                    target = jnp.where(acc[n] > 0, jnp.int32(ins.jmp), nxt)
+                    target = jnp.where(
+                        regs64.is_pos(acc_hi[n], acc[n]), jnp.int32(ins.jmp), nxt
+                    )
                 elif op == isa.OP_JLZ:
-                    target = jnp.where(acc[n] < 0, jnp.int32(ins.jmp), nxt)
+                    target = jnp.where(
+                        regs64.is_neg(acc_hi[n], acc[n]), jnp.int32(ins.jmp), nxt
+                    )
                 elif op == isa.OP_JRO:
-                    target = jnp.clip(l + src_val[n], 0, ln - 1)
+                    target = regs64.jro_target(
+                        jnp.int32(l), src_hi[n], src_val[n], jnp.int32(ln)
+                    )
                 else:
                     target = nxt
                 new_pc[n] = jnp.where(c, target, new_pc[n])
@@ -429,6 +470,8 @@ def make_fused_runner(
         return (
             new_acc,
             new_bak,
+            new_acc_hi,
+            new_bak_hi,
             new_pc,
             new_pv,
             [i32(x) for x in new_pf],
@@ -442,10 +485,10 @@ def make_fused_runner(
         )
 
     def kernel(*refs):
-        (acc_r, bak_r, pc_r, pv_r, pf_r, hv_r, ho_r, sm_r, st_r, ob_r, sc_r,
-         ret_r, inb_r) = refs[:13]
-        outs = refs[13:]
-        sm_out, ob_out = outs[7], outs[9]
+        (acc_r, bak_r, acc_hi_r, bak_hi_r, pc_r, pv_r, pf_r, hv_r, ho_r,
+         sm_r, st_r, ob_r, sc_r, ret_r, inb_r) = refs[:15]
+        outs = refs[15:]
+        sm_out, ob_out = outs[9], outs[11]
 
         # Ref-resident big caps: seed the writable OUTPUT ref from the input
         # (input refs are aliased but only read; all tick-time access goes to
@@ -459,6 +502,8 @@ def make_fused_runner(
         carry = (
             rows(acc_r, n_lanes),
             rows(bak_r, n_lanes),
+            rows(acc_hi_r, n_lanes),
+            rows(bak_hi_r, n_lanes),
             rows(pc_r, n_lanes),
             rows(pv_r, n_dests),
             rows(pf_r, n_dests),
@@ -498,8 +543,8 @@ def make_fused_runner(
         )
 
     row_counts = [
-        n_lanes, n_lanes, n_lanes, n_dests, n_dests, n_lanes, n_lanes,
-        n_stacks * stack_cap, n_stacks, out_cap, 5, n_lanes,
+        n_lanes, n_lanes, n_lanes, n_lanes, n_lanes, n_dests, n_dests,
+        n_lanes, n_lanes, n_stacks * stack_cap, n_stacks, out_cap, 5, n_lanes,
     ]
     in_specs = [spec(k) for k in row_counts] + [spec(in_cap)]
     out_specs = [spec(k) for k in row_counts]
@@ -514,7 +559,7 @@ def make_fused_runner(
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
-        input_output_aliases={i: i for i in range(12)},
+        input_output_aliases={i: i for i in range(14)},
         interpret=interpret,
     )
 
@@ -538,6 +583,8 @@ def make_fused_runner(
         args = [
             to_rows(state.acc, n_lanes),
             to_rows(state.bak, n_lanes),
+            to_rows(state.acc_hi, n_lanes),
+            to_rows(state.bak_hi, n_lanes),
             to_rows(state.pc, n_lanes),
             to_rows(state.port_val, n_dests),
             to_rows(state.port_full.astype(_I32), n_dests),
@@ -550,12 +597,15 @@ def make_fused_runner(
             to_rows(state.retired, n_lanes),
             to_rows(state.in_buf, in_cap),
         ]
-        (acc, bak, pc, pv, pf, hv, ho, sm, st, ob, sc_o, ret) = call(*args)
+        (acc, bak, acc_hi, bak_hi, pc, pv, pf, hv, ho, sm, st, ob, sc_o,
+         ret) = call(*args)
         b = batch
         sc_flat = from_rows(sc_o, 5, (b, 5), _I32)
         return rebase_rings(NetworkState(
             acc=from_rows(acc, n_lanes, (b, n_lanes), _I32),
             bak=from_rows(bak, n_lanes, (b, n_lanes), _I32),
+            acc_hi=from_rows(acc_hi, n_lanes, (b, n_lanes), _I32),
+            bak_hi=from_rows(bak_hi, n_lanes, (b, n_lanes), _I32),
             pc=from_rows(pc, n_lanes, (b, n_lanes), _I32),
             port_val=from_rows(pv, n_dests, (b, n_lanes, isa.NUM_PORTS), _I32),
             port_full=from_rows(pf, n_dests, (b, n_lanes, isa.NUM_PORTS), _I32).astype(bool),

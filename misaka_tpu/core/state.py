@@ -38,9 +38,15 @@ from misaka_tpu.tis import isa
 class NetworkState(NamedTuple):
     """All mutable state of one Misaka network instance."""
 
-    # program-node lanes
-    acc: jnp.ndarray        # [N] int32   (program.go:27)
-    bak: jnp.ndarray        # [N] int32   (program.go:28)
+    # program-node lanes.  acc/bak are the reference's 64-bit Go ints
+    # (program.go:27-28) carried as int32 (hi, lo) planes — `acc`/`bak`
+    # hold the LOW word (which is also the wire value; the wire truncates
+    # to sint32, messenger.proto:34-41), `acc_hi`/`bak_hi` bits 32-63.
+    # See core/regs64.py.
+    acc: jnp.ndarray        # [N] int32 — low word of the 64-bit acc
+    bak: jnp.ndarray        # [N] int32 — low word of the 64-bit bak
+    acc_hi: jnp.ndarray     # [N] int32 — high word of acc
+    bak_hi: jnp.ndarray     # [N] int32 — high word of bak
     pc: jnp.ndarray         # [N] int32   (program.go:34)
     port_val: jnp.ndarray   # [N, 4] int32 — inbound ports r0..r3 (program.go:29-32)
     port_full: jnp.ndarray  # [N, 4] bool — cap-1 occupancy (bufferSize=1, program.go:21)
@@ -102,6 +108,8 @@ def init_state(
     return NetworkState(
         acc=jnp.zeros((num_lanes,), i32),
         bak=jnp.zeros((num_lanes,), i32),
+        acc_hi=jnp.zeros((num_lanes,), i32),
+        bak_hi=jnp.zeros((num_lanes,), i32),
         pc=jnp.zeros((num_lanes,), i32),
         port_val=jnp.zeros((num_lanes, isa.NUM_PORTS), i32),
         port_full=jnp.zeros((num_lanes, isa.NUM_PORTS), bool),

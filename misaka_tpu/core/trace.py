@@ -74,6 +74,8 @@ def record_step(
     op = code[lane, pc_before, isa.F_OP]
     committed = after.retired - before.retired  # [N] 0/1
 
+    # acc column records the LOW (wire) word of the 64-bit register — one
+    # int32 per entry keeps the ring dense; debug.inspect shows full width
     record = jnp.stack([pc_before, op, committed, after.acc], axis=-1)  # [N, 4]
     cap = trace.buf.shape[1]
     slot = trace.wr % cap

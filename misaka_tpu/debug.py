@@ -101,9 +101,12 @@ class Debugger:
         """One lane's full architectural state."""
         i = self._lane_index(lane)
         s = self._state
+        def full64(hi, lo):  # the true 64-bit register (core/regs64.py)
+            return (int(hi) << 32) | (int(lo) & 0xFFFFFFFF)
+
         return {
-            "acc": int(s.acc[i]),
-            "bak": int(s.bak[i]),
+            "acc": full64(s.acc_hi[i], s.acc[i]),
+            "bak": full64(s.bak_hi[i], s.bak[i]),
             "pc": int(s.pc[i]),
             "ports": {
                 f"R{k}": (int(s.port_val[i, k]) if bool(s.port_full[i, k]) else None)

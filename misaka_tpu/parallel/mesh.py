@@ -49,7 +49,7 @@ def state_specs(batched: bool = True) -> NetworkState:
     repl2 = P(*d, None, None)
     scalar = P(*d)
     return NetworkState(
-        acc=lane, bak=lane, pc=lane,
+        acc=lane, bak=lane, acc_hi=lane, bak_hi=lane, pc=lane,
         port_val=lane_port, port_full=lane_port,
         hold_val=lane, holding=lane,
         stack_mem=repl2, stack_top=repl1,

@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from misaka_tpu.core import regs64
 from misaka_tpu.core.state import NetworkState, rebase_rings
 from misaka_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, state_specs
 from misaka_tpu.tis import isa
@@ -112,6 +113,8 @@ def step_local(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState,
             jnp.where(src == isa.SRC_NIL, jnp.zeros_like(imm), hold_val),
         ),
     )
+    # 64-bit source view (core/regs64.py): src_val stays the wire word
+    src_hi = jnp.where(src == isa.SRC_ACC, state.acc_hi, regs64.sext(src_val))
     src_ok = ~reads_port | holding
 
     consume_onehot = consume_now[:, None] & (pidx[:, None] == jnp.arange(n_ports)[None, :])
@@ -183,15 +186,29 @@ def step_local(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState,
     )
     commit = src_ok & dst_ok
 
+    # 64-bit (hi, lo) register arithmetic — identical discipline to
+    # core/step.py; see core/regs64.py
     incoming = jnp.where(is_pop, pop_val_lane, jnp.where(op == isa.OP_IN, in_val, src_val))
+    incoming_hi = jnp.where(op == isa.OP_MOV_LOCAL, src_hi, regs64.sext(incoming))
     writes_acc = ((op == isa.OP_MOV_LOCAL) | is_pop | (op == isa.OP_IN)) & (dst == isa.DST_ACC)
     acc = state.acc
+    acc_hi = state.acc_hi
+    add_hi, add_lo = regs64.add64(acc_hi, acc, src_hi, src_val)
+    sub_hi, sub_lo = regs64.sub64(acc_hi, acc, src_hi, src_val)
+    neg_hi, neg_lo = regs64.neg64(acc_hi, acc)
     new_acc = jnp.where(commit & writes_acc, incoming, acc)
-    new_acc = jnp.where(commit & (op == isa.OP_ADD), acc + src_val, new_acc)
-    new_acc = jnp.where(commit & (op == isa.OP_SUB), acc - src_val, new_acc)
-    new_acc = jnp.where(commit & (op == isa.OP_NEG), -acc, new_acc)
+    new_acc_hi = jnp.where(commit & writes_acc, incoming_hi, acc_hi)
+    new_acc = jnp.where(commit & (op == isa.OP_ADD), add_lo, new_acc)
+    new_acc_hi = jnp.where(commit & (op == isa.OP_ADD), add_hi, new_acc_hi)
+    new_acc = jnp.where(commit & (op == isa.OP_SUB), sub_lo, new_acc)
+    new_acc_hi = jnp.where(commit & (op == isa.OP_SUB), sub_hi, new_acc_hi)
+    new_acc = jnp.where(commit & (op == isa.OP_NEG), neg_lo, new_acc)
+    new_acc_hi = jnp.where(commit & (op == isa.OP_NEG), neg_hi, new_acc_hi)
     new_acc = jnp.where(commit & (op == isa.OP_SWP), state.bak, new_acc)
-    new_bak = jnp.where(commit & ((op == isa.OP_SWP) | (op == isa.OP_SAV)), acc, state.bak)
+    new_acc_hi = jnp.where(commit & (op == isa.OP_SWP), state.bak_hi, new_acc_hi)
+    saves_bak = commit & ((op == isa.OP_SWP) | (op == isa.OP_SAV))
+    new_bak = jnp.where(saves_bak, acc, state.bak)
+    new_bak_hi = jnp.where(saves_bak, acc_hi, state.bak_hi)
 
     # --- replicated stack/ring updates (identical on every shard) ----------
     stack_ids = jnp.arange(n_stacks)
@@ -211,18 +228,19 @@ def step_local(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState,
 
     jump_taken = (
         (op == isa.OP_JMP)
-        | ((op == isa.OP_JEZ) & (acc == 0))
-        | ((op == isa.OP_JNZ) & (acc != 0))
-        | ((op == isa.OP_JGZ) & (acc > 0))
-        | ((op == isa.OP_JLZ) & (acc < 0))
+        | ((op == isa.OP_JEZ) & regs64.is_zero(acc_hi, acc))
+        | ((op == isa.OP_JNZ) & ~regs64.is_zero(acc_hi, acc))
+        | ((op == isa.OP_JGZ) & regs64.is_pos(acc_hi, acc))
+        | ((op == isa.OP_JLZ) & regs64.is_neg(acc_hi, acc))
     )
     pc_inc = (state.pc + 1) % prog_len
-    pc_jro = jnp.clip(state.pc + src_val, 0, prog_len - 1)
+    pc_jro = regs64.jro_target(state.pc, src_hi, src_val, prog_len)
     new_pc = jnp.where(jump_taken, jmp, jnp.where(op == isa.OP_JRO, pc_jro, pc_inc))
     new_pc = jnp.where(commit, new_pc, state.pc)
 
     return NetworkState(
-        acc=new_acc, bak=new_bak, pc=new_pc,
+        acc=new_acc, bak=new_bak, acc_hi=new_acc_hi, bak_hi=new_bak_hi,
+        pc=new_pc,
         port_val=new_port_val, port_full=new_port_full,
         hold_val=hold_val, holding=holding & ~commit,
         stack_mem=new_stack_mem, stack_top=new_stack_top,

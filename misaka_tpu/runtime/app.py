@@ -200,6 +200,9 @@ def main() -> None:
             trace_instance=int(environ.get("MISAKA_TRACE_INSTANCE", "0")),
             data_parallel=int(environ.get("MISAKA_DATA_PARALLEL", "0")) or None,
             model_parallel=int(environ.get("MISAKA_MODEL_PARALLEL", "0")) or None,
+            # intStack.go:9-45 is unbounded; capacity auto-grows on wedge
+            # unless disabled (MISAKA_STACK_AUTOGROW=0)
+            stack_autogrow=environ.get("MISAKA_STACK_AUTOGROW", "1") != "0",
         )
         if environ.get("MISAKA_AUTORUN") == "1":
             master.run()

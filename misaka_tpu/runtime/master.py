@@ -65,6 +65,8 @@ class MasterNode:
         data_parallel: int | None = None,
         model_parallel: int | None = None,
         stripe: int | None = None,
+        stack_autogrow: bool = True,
+        stack_grow_max_bytes: int = 256 * 1024 * 1024,
     ):
         """batch=None serves one network instance (every /compute strictly
         serialized — the correlated fix for quirk #2).  batch=B runs B
@@ -112,6 +114,16 @@ class MasterNode:
         self._chunk = chunk_steps
         self._batch = batch
         self._engine = engine
+        # Stack auto-grow (reference parity: intStack.go:9-45 grows without
+        # limit, while XLA shapes are static): when a full stack wedges the
+        # network mid-request, the device loop doubles stack capacity —
+        # recompile + state pad, geometric growth — up to a byte budget.
+        self._grow = bool(stack_autogrow)
+        self._grow_max_bytes = int(stack_grow_max_bytes)
+        self._stall_iters = 0
+        # warn-once latch for a wedge growth cannot fix (budget/engine);
+        # cleared when anything moves again or on reset/load
+        self._grow_blocked = False
         # compute_spread stripe size (values per instance per request).
         # Default = the input-ring capacity: each stripe fits one refill.
         # Larger stripes spread a request over fewer instances — less
@@ -684,9 +696,16 @@ class MasterNode:
 
         with np.load(path) as data:
             meta = json.loads(bytes(data["__topology__"]).decode())
-            state = NetworkState(
-                **{f: jnp.asarray(data[f]) for f in NetworkState._fields}
-            )
+            fields = {
+                f: jnp.asarray(data[f])
+                for f in NetworkState._fields if f in data
+            }
+            # pre-regs64 checkpoints lack the hi planes; those states were
+            # int32-exact, so sign-extension reconstructs the 64-bit value
+            for hi, lo in (("acc_hi", "acc"), ("bak_hi", "bak")):
+                if hi not in fields:
+                    fields[hi] = fields[lo] >> 31
+            state = NetworkState(**fields)
         ckpt_batch = meta.get("batch")
         if ckpt_batch != self._batch:
             raise ValueError(
@@ -727,10 +746,36 @@ class MasterNode:
             return jax.tree.map(lambda x: x.copy(), self._state)
 
     def restore(self, state) -> None:
+        """Reinstall a snapshot() pytree.
+
+        A snapshot taken before a stack auto-grow has narrower stack_mem
+        than the live engine compiles for — pad it (zero slots above the
+        restored tops are exactly the grown state's invariant).  Any other
+        shape mismatch is rejected here instead of crashing the device loop
+        on its next chunk."""
         import jax
+        import jax.numpy as jnp
 
         with self._state_lock:
-            self._state = self._shard(jax.tree.map(lambda x: x.copy(), state))
+            state = jax.tree.map(lambda x: x.copy(), state)
+            want_cap = self._net.stack_cap
+            have_cap = state.stack_mem.shape[-1]
+            if have_cap < want_cap:
+                pad = [(0, 0)] * (state.stack_mem.ndim - 1) \
+                    + [(0, want_cap - have_cap)]
+                state = state._replace(stack_mem=jnp.pad(state.stack_mem, pad))
+            ref = self._net.init_state()
+            mismatch = [
+                f for f, a, b in zip(
+                    state._fields, jax.tree.leaves(state), jax.tree.leaves(ref)
+                ) if a.shape != b.shape
+            ]
+            if mismatch:
+                raise ValueError(
+                    f"snapshot shapes do not match the compiled network "
+                    f"(fields {mismatch}); reset/load first"
+                )
+            self._state = self._shard(state)
 
     # --- the device loop ----------------------------------------------------
 
@@ -756,7 +801,64 @@ class MasterNode:
             # lands before the drain (wiped; its waiter sees a new epoch) or
             # after (it survives into the fresh queues under the new epoch).
             self._stale = [0] * len(self._stale)
+            self._grow_blocked = False
             self._epoch += 1
+
+    def _maybe_grow_stacks(self) -> None:
+        """Double stack capacity when a full stack has wedged the network.
+
+        Reference parity: the Go stacks grow without limit (intStack.go:9-45)
+        while XLA needs static shapes — so capacity grows geometrically, each
+        step a recompile plus a zero-pad of stack_mem (slot indices and
+        occupancy unchanged).  Bounded by `stack_grow_max_bytes`; when the
+        preferred engine can't serve the new shape, `engine=auto` falls back
+        (e.g. fused -> scan via _make_runner) and a forced engine logs and
+        keeps the old capacity.  Called from the device loop thread only.
+        """
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        with self._state_lock:
+            net = self._net
+            tops = np.asarray(self._state.stack_top)
+            if not (tops >= net.stack_cap).any():
+                return  # stalled for some other reason (e.g. starvation)
+            new_cap = net.stack_cap * 2
+            new_bytes = (self._batch or 1) * net.num_stacks * new_cap * 4
+            if new_bytes > self._grow_max_bytes:
+                log.warning(
+                    "stack at capacity %d but growing to %d would use %d "
+                    "bytes (> stack_grow_max_bytes=%d); leaving it parked",
+                    net.stack_cap, new_cap, new_bytes, self._grow_max_bytes,
+                )
+                self._grow_blocked = True  # warn once per wedge
+                return
+            new_topology = dataclasses.replace(
+                self._topology, stack_cap=new_cap
+            )
+            new_net = new_topology.compile(batch=self._batch)
+            try:
+                new_runner = self._make_runner(new_net)
+            except ValueError as e:
+                log.warning(
+                    "stack at capacity but engine=%s cannot serve "
+                    "stack_cap=%d: %s", self._engine, new_cap, e
+                )
+                self._grow_blocked = True  # warn once per wedge
+                return
+            pad = [(0, 0)] * (self._state.stack_mem.ndim - 1) \
+                + [(0, new_cap - net.stack_cap)]
+            self._topology = new_topology
+            self._net = new_net
+            self._state = self._shard(
+                self._state._replace(stack_mem=jnp.pad(self._state.stack_mem, pad))
+            )
+            self._runner = new_runner
+            log.info(
+                "grew stack capacity %d -> %d (engine=%s)",
+                net.stack_cap, new_cap, self.engine_name,
+            )
 
     def _mark_ticks(self) -> None:
         """Advance the tick-rate gauge by one chunk (device loop thread)."""
@@ -898,6 +1000,8 @@ class MasterNode:
                 self._out_qs[slot].put(outs)
                 busy = True
             if busy:
+                self._stall_iters = 0
+                self._grow_blocked = False
                 continue
             # Nothing moved this iteration.  A waiting compute means work is
             # mid-flight on the device — keep chunking (latency is then
@@ -907,6 +1011,15 @@ class MasterNode:
             with self._waiters_lock:
                 waiting = self._waiters
             if waiting:
+                # A wedged network looks exactly like this: requests in
+                # flight, nothing moving, chunk after chunk.  After a few
+                # strikes, check the one wedge the engine can repair —
+                # a stack at capacity (the reference's are unbounded).
+                self._stall_iters += 1
+                if self._grow and not self._grow_blocked \
+                        and self._stall_iters >= 8:
+                    self._stall_iters = 0
+                    self._maybe_grow_stacks()
                 continue
             self._work_event.clear()
             with self._waiters_lock:

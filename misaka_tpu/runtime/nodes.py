@@ -44,6 +44,16 @@ from misaka_tpu.tis.parser import TISParseError, parse
 from misaka_tpu.transport import rpc
 from misaka_tpu.transport import messenger_pb2 as pb
 
+_M64 = 1 << 64
+
+
+def _wrap64(v: int) -> int:
+    """Wrap to Go's 64-bit int: acc/bak are `int` (program.go:27-28); local
+    arithmetic wraps at 64 bits while the wire truncates to sint32
+    (rpc._i32 at every Send/Push/SendOutput)."""
+    v &= _M64 - 1
+    return v - _M64 if v >= (1 << 63) else v
+
 log = logging.getLogger("misaka_tpu.nodes")
 
 _EMPTY = empty_pb2.Empty
@@ -314,7 +324,7 @@ class ProgramNodeProcess:
         elif kind == "SAV":
             self.bak = self.acc
         elif kind == "NEG":
-            self.acc = -self.acc
+            self.acc = _wrap64(-self.acc)
         elif kind == "MOV_VAL_LOCAL":
             self._write_local(int(tokens[1]), tokens[2])
         elif kind == "MOV_VAL_NETWORK":
@@ -325,7 +335,7 @@ class ProgramNodeProcess:
             self._send_value(self._get_from_src(tokens[1], gen), tokens[2], gen)
         elif kind in ("ADD_VAL", "SUB_VAL", "ADD_SRC", "SUB_SRC"):
             v = int(tokens[1]) if kind.endswith("_VAL") else self._get_from_src(tokens[1], gen)
-            self.acc += v if kind.startswith("ADD") else -v
+            self.acc = _wrap64(self.acc + (v if kind.startswith("ADD") else -v))
         elif kind in ("JMP", "JEZ", "JNZ", "JGZ", "JLZ"):
             taken = (
                 kind == "JMP"

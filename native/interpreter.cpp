@@ -54,7 +54,11 @@ struct Interp {
   std::vector<int32_t> code;      // [n_lanes][max_len][NFIELDS]
   std::vector<int32_t> prog_len;  // [n_lanes]
 
-  std::vector<int32_t> acc, bak, pc, hold_val, retired;
+  // acc/bak are the reference's 64-bit Go ints (program.go:27-28); only
+  // the wire truncates to int32 (messenger.proto:34-41).  Arithmetic wraps
+  // at 64 bits via unsigned ops (signed overflow is UB in C++; Go wraps).
+  std::vector<int64_t> acc, bak;
+  std::vector<int32_t> pc, hold_val, retired;
   std::vector<uint8_t> holding;
   std::vector<int32_t> port_val;   // [n_lanes][kPorts]
   std::vector<uint8_t> port_full;  // [n_lanes][kPorts]
@@ -82,8 +86,9 @@ struct Interp {
       }
     }
 
-    // source resolution
-    std::vector<int32_t> src_val(n, 0);
+    // source resolution (64-bit: an ACC source carries full width; the
+    // wire sites below truncate with i32())
+    std::vector<int64_t> src_val(n, 0);
     std::vector<uint8_t> src_ok(n, 1);
     for (int l = 0; l < n; ++l) {
       const int32_t* f = ins(l);
@@ -123,7 +128,7 @@ struct Interp {
           for (const auto& d : deliveries)
             occupied |= (d.tgt == tgt && d.port == port);
           if (!occupied) {
-            deliveries.push_back({tgt, port, src_val[l]});
+            deliveries.push_back({tgt, port, i32(src_val[l])});  // wire: sint32
             granted[l] = 1;
           }
           break;
@@ -133,7 +138,7 @@ struct Interp {
           int s = f[F_TGT];
           if (!stack_taken[s] && begin_tops[s] < stack_cap) {
             stack_taken[s] = 1;
-            stack_pushes.push_back({s, src_val[l]});
+            stack_pushes.push_back({s, i32(src_val[l])});  // wire: sint32
             granted[l] = 1;
           }
           break;
@@ -157,7 +162,7 @@ struct Interp {
         case OP_OUT:
           if (src_ok[l] && out_free && !out_taken) {
             out_taken = true;
-            out_value = src_val[l];
+            out_value = i32(src_val[l]);
             granted[l] = 1;
           }
           break;
@@ -167,7 +172,7 @@ struct Interp {
     }
 
     // commit + register/pc effects (reading begin-of-tick acc/bak)
-    std::vector<int32_t> old_acc = acc, old_bak = bak;
+    std::vector<int64_t> old_acc = acc, old_bak = bak;
     for (int l = 0; l < n; ++l) {
       const int32_t* f = ins(l);
       int op = f[F_OP];
@@ -180,9 +185,13 @@ struct Interp {
         case OP_MOV_LOCAL:
           if (f[F_DST] == DST_ACC) acc[l] = src_val[l];
           break;
-        case OP_ADD: acc[l] = i32((int64_t)old_acc[l] + src_val[l]); break;
-        case OP_SUB: acc[l] = i32((int64_t)old_acc[l] - src_val[l]); break;
-        case OP_NEG: acc[l] = i32(-(int64_t)old_acc[l]); break;
+        case OP_ADD:
+          acc[l] = (int64_t)((uint64_t)old_acc[l] + (uint64_t)src_val[l]);
+          break;
+        case OP_SUB:
+          acc[l] = (int64_t)((uint64_t)old_acc[l] - (uint64_t)src_val[l]);
+          break;
+        case OP_NEG: acc[l] = (int64_t)(0 - (uint64_t)old_acc[l]); break;
         case OP_SWP: acc[l] = old_bak[l]; bak[l] = old_acc[l]; break;
         case OP_SAV: bak[l] = old_acc[l]; break;
         case OP_POP:
@@ -200,7 +209,12 @@ struct Interp {
       if (taken) {
         pc[l] = f[F_JMP];
       } else if (op == OP_JRO) {
-        int64_t t = (int64_t)pc[l] + src_val[l];
+        // 64-bit offset: saturate by sign when it exceeds int32 (signed
+        // pc+offset could overflow int64 — UB; mirrors regs64.jro_target)
+        int64_t v = src_val[l];
+        int64_t t = (v >= INT32_MIN && v <= INT32_MAX)
+                        ? (int64_t)pc[l] + v
+                        : (v < 0 ? 0 : (int64_t)ln - 1);
         pc[l] = (int32_t)(t < 0 ? 0 : (t > ln - 1 ? ln - 1 : t));
       } else {
         pc[l] = (pc[l] + 1) % ln;
@@ -374,11 +388,15 @@ void misaka_interp_read(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
                         int32_t* hold_val, uint8_t* holding,
                         int32_t* stack_mem, int32_t* stack_top,
                         int32_t* out_buf, int32_t* counters /*[5]*/,
-                        int32_t* retired) {
+                        int32_t* retired, int32_t* acc_hi, int32_t* bak_hi) {
   auto* it = (Interp*)h;
   int n = it->n_lanes;
-  std::memcpy(acc, it->acc.data(), n * 4);
-  std::memcpy(bak, it->bak.data(), n * 4);
+  for (int l = 0; l < n; ++l) {
+    acc[l] = i32(it->acc[l]);
+    acc_hi[l] = (int32_t)(it->acc[l] >> 32);
+    bak[l] = i32(it->bak[l]);
+    bak_hi[l] = (int32_t)(it->bak[l] >> 32);
+  }
   std::memcpy(pc, it->pc.data(), n * 4);
   std::memcpy(port_val, it->port_val.data(), (size_t)n * kPorts * 4);
   std::memcpy(port_full, it->port_full.data(), (size_t)n * kPorts);

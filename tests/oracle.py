@@ -24,11 +24,18 @@ import numpy as np
 from misaka_tpu.tis import isa
 
 _M32 = 1 << 32
+_M64 = 1 << 64
 
 
 def _i32(v: int) -> int:
     v &= _M32 - 1
     return v - _M32 if v >= (1 << 31) else v
+
+
+def _i64(v: int) -> int:
+    """Wrap to Go's 64-bit int: acc/bak are `int` (program.go:27-28)."""
+    v &= _M64 - 1
+    return v - _M64 if v >= (1 << 63) else v
 
 
 class Oracle:
@@ -123,13 +130,13 @@ class Oracle:
                     d[0] == tgt and d[1] == port for d in deliveries
                 )
                 if not occupied:
-                    deliveries.append((tgt, port, src_val[n]))
+                    deliveries.append((tgt, port, _i32(src_val[n])))  # wire: sint32
                     granted[n] = True
             elif op == f.OP_PUSH and src_ok[n]:
                 s = ins[f.F_TGT]
                 if not stack_taken[s] and begin_tops[s] < self.stack_cap:
                     stack_taken[s] = True
-                    stack_pushes.append((s, src_val[n]))
+                    stack_pushes.append((s, _i32(src_val[n])))  # wire: sint32
                     granted[n] = True
             elif op == f.OP_POP:
                 s = ins[f.F_TGT]
@@ -145,7 +152,7 @@ class Oracle:
             elif op == f.OP_OUT and src_ok[n]:
                 if out_free and not out_taken:
                     out_taken = True
-                    out_value = src_val[n]
+                    out_value = _i32(src_val[n])  # wire: sint32
                     granted[n] = True
 
         # --- commit + effects ----------------------------------------------
@@ -164,11 +171,11 @@ class Oracle:
             if op == f.OP_MOV_LOCAL and ins[f.F_DST] == f.DST_ACC:
                 self.acc[n] = src_val[n]
             elif op == f.OP_ADD:
-                self.acc[n] = _i32(old_acc[n] + src_val[n])
+                self.acc[n] = _i64(old_acc[n] + src_val[n])
             elif op == f.OP_SUB:
-                self.acc[n] = _i32(old_acc[n] - src_val[n])
+                self.acc[n] = _i64(old_acc[n] - src_val[n])
             elif op == f.OP_NEG:
-                self.acc[n] = _i32(-old_acc[n])
+                self.acc[n] = _i64(-old_acc[n])
             elif op == f.OP_SWP:
                 self.acc[n] = old_bak[n]
                 self.bak[n] = old_acc[n]
@@ -227,8 +234,10 @@ class Oracle:
             for c, v in enumerate(vals):
                 sm[s, c] = v
         return {
-            "acc": np.array(self.acc, np.int32),
-            "bak": np.array(self.bak, np.int32),
+            "acc": np.array([_i32(v) for v in self.acc], np.int32),
+            "bak": np.array([_i32(v) for v in self.bak], np.int32),
+            "acc_hi": np.array([_i64(v) >> 32 for v in self.acc], np.int32),
+            "bak_hi": np.array([_i64(v) >> 32 for v in self.bak], np.int32),
             "pc": np.array(self.pc, np.int32),
             "port_val": np.array(self.port_val, np.int32),
             "port_full": np.array(self.port_full, bool),

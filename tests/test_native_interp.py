@@ -19,9 +19,9 @@ pytestmark = pytest.mark.skipif(
 )
 
 COMPARE_KEYS = [
-    "acc", "bak", "pc", "port_val", "port_full", "hold_val", "holding",
-    "stack_top", "stack_mem_used", "in_rd", "out_wr", "out_buf", "tick",
-    "retired",
+    "acc", "bak", "acc_hi", "bak_hi", "pc", "port_val", "port_full",
+    "hold_val", "holding", "stack_top", "stack_mem_used", "in_rd", "out_wr",
+    "out_buf", "tick", "retired",
 ]
 
 

@@ -1,0 +1,209 @@
+"""64-bit local registers (reference parity): acc/bak past the int32 wall.
+
+The reference's acc/bak are Go `int` — 64-bit (program.go:27-33); only the
+wire truncates to sint32 (messenger.proto:34-41).  Rounds 1-2 were int32
+end-to-end, so a program whose ACC legitimately passes 2^31 BRANCHED
+differently than the Go binary without touching the wire (VERDICT r2
+missing #2).  These tests pin the closed gap across every implementation:
+the XLA scan engine, the Pallas fused kernel, the Python oracle, the C++
+native interpreter, and the per-process gRPC cluster — all carrying 64-bit
+registers (core/regs64.py hi/lo planes on device; int64 on hosts) with
+truncation exactly at the wire.
+"""
+
+import numpy as np
+import pytest
+
+from misaka_tpu.core import cinterp
+from misaka_tpu.runtime.topology import Topology
+from tests.oracle import Oracle
+
+IN_CAP = OUT_CAP = 16
+STACK_CAP = 16
+
+# ACC passes 2^31 via two ADDs, then branches: 64-bit sees a positive value
+# (JLZ not taken) and OUT emits the wire-truncated low word; an int32
+# implementation would see a wrapped negative and take the branch.
+OVERFLOW_BRANCH = (
+    "IN ACC\n"
+    "ADD 2000000000\n"
+    "ADD 2000000000\n"
+    "JLZ neg\n"
+    "OUT ACC\n"
+    "JMP end\n"
+    "neg: OUT 0\n"
+    "end: NOP\n"
+)
+
+
+def overflow_branch_expect(v):
+    # low word of v + 4e9 (the wire truncation of the 64-bit acc)
+    return int(np.int64(v + 4_000_000_000).astype(np.int32))
+
+
+# NEG of int32-min: 64-bit gives +2^31 (positive -> JGZ taken, OUT emits the
+# low word 0x80000000 = int32 min); int32 NEG(min) stays min (negative).
+NEG_MIN = (
+    "IN ACC\n"
+    "NEG\n"
+    "JGZ pos\n"
+    "OUT 0\n"
+    "JMP end\n"
+    "pos: OUT ACC\n"
+    "end: NOP\n"
+)
+
+# JRO with a 64-bit positive offset (~4e9) must clamp FORWARD to the last
+# line; an int32 implementation sees a negative offset and clamps to 0
+# (looping back to a parked IN: no output ever).
+# NOTE: no trailing newline — a trailing newline lowers to a parity NOP
+# line (YAML block-scalar parity) and the JRO clamp must land on OUT 2.
+JRO_HUGE = (
+    "IN ACC\n"
+    "ADD 2000000000\n"
+    "ADD 2000000000\n"
+    "JRO ACC\n"
+    "OUT 1\n"
+    "OUT 2"
+)
+
+CASES = [
+    ("overflow_branch", OVERFLOW_BRANCH, [5, -7, 123],
+     [overflow_branch_expect(v) for v in [5, -7, 123]]),
+    ("neg_min", NEG_MIN, [-(2**31)], [-(2**31)]),
+    ("jro_huge", JRO_HUGE, [1], [2]),
+]
+
+
+def single_lane_top(program):
+    return Topology(
+        node_info={"solo": "program"},
+        programs={"solo": program},
+        in_cap=IN_CAP, out_cap=OUT_CAP, stack_cap=STACK_CAP,
+    )
+
+
+@pytest.mark.parametrize("name,program,inputs,expect", CASES)
+def test_scan_engine(name, program, inputs, expect):
+    net = single_lane_top(program).compile()
+    state, outs = net.compute_stream(
+        net.init_state(), inputs, expected=len(expect)
+    )
+    assert outs == expect, name
+
+
+@pytest.mark.parametrize("name,program,inputs,expect", CASES)
+def test_fused_kernel(name, program, inputs, expect):
+    net = single_lane_top(program).compile(batch=128)
+    vals = np.tile(np.asarray(inputs, np.int32), (128, 1))
+    state = net.init_state()
+    state = state._replace(
+        in_buf=state.in_buf.at[:, : len(inputs)].set(vals),
+        in_wr=state.in_wr + len(inputs),
+    )
+    out = net.fused_runner(64, block_batch=128, interpret=True)(state)
+    np.testing.assert_array_equal(np.asarray(out.out_wr), len(expect))
+    np.testing.assert_array_equal(
+        np.asarray(out.out_buf)[:, : len(expect)],
+        np.tile(np.asarray(expect, np.int32), (128, 1)),
+        err_msg=name,
+    )
+
+
+@pytest.mark.parametrize("name,program,inputs,expect", CASES)
+def test_python_oracle(name, program, inputs, expect):
+    net = single_lane_top(program).compile()
+    oracle = Oracle(net.code, net.prog_len, 1, STACK_CAP, IN_CAP, OUT_CAP)
+    oracle.feed(inputs)
+    oracle.run(64)
+    st = oracle.state_arrays()
+    assert list(st["out_buf"][: len(expect)]) == expect, name
+    assert int(st["out_wr"]) == len(expect)
+
+
+@pytest.mark.parametrize("name,program,inputs,expect", CASES)
+def test_native_interpreter(name, program, inputs, expect):
+    if not cinterp.available():
+        pytest.skip("native interpreter unavailable")
+    net = single_lane_top(program).compile()
+    with cinterp.NativeInterpreter(
+        net.code, net.prog_len, 1, STACK_CAP, IN_CAP, OUT_CAP
+    ) as n:
+        assert n.feed(inputs) == len(inputs)
+        n.run(64)
+        assert n.drain() == expect, name
+
+
+@pytest.mark.parametrize("name,program,inputs,expect", CASES)
+def test_per_process_cluster(name, program, inputs, expect):
+    pytest.importorskip("grpc")
+    from tests.test_cross_mode import run_cluster
+
+    outs = run_cluster(
+        {"solo": "program"}, {"solo": program}, inputs, len(expect)
+    )
+    assert outs == expect, name
+
+
+# --- randomized four-way differential past the int32 wall -------------------
+
+BIG_OPS = [
+    "ADD 2000000000", "ADD 1999999999", "SUB 2000000000", "SUB 1500000007",
+    "NEG", "SAV", "SWP", "ADD 3", "SUB 1",
+]
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_big_arithmetic_four_way(seed):
+    """Random big-magnitude ADD/SUB/NEG/SAV/SWP streams: scan engine, fused
+    kernel, Python oracle, and C++ interpreter must agree on the FULL 64-bit
+    register file (hi and lo planes) and the truncated output stream."""
+    rng = np.random.default_rng(seed)
+    body = "\n".join(rng.choice(BIG_OPS) for _ in range(10))
+    program = f"IN ACC\n{body}\nOUT ACC\n"
+    inputs = rng.integers(-(2**31), 2**31, size=4).tolist()
+    net = single_lane_top(program).compile()
+    steps = 64
+
+    oracle = Oracle(net.code, net.prog_len, 1, STACK_CAP, IN_CAP, OUT_CAP)
+    oracle.feed(inputs)
+    oracle.run(steps)
+    want = oracle.state_arrays()
+
+    state = net.init_state()
+    state, _ = net.feed(state, inputs)
+    state = net.run(state, steps)
+    for key in ("acc", "bak", "acc_hi", "bak_hi", "pc", "out_wr", "out_buf"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(state, key)), want[key],
+            err_msg=f"seed {seed} scan field {key}\n{program}",
+        )
+
+    netb = single_lane_top(program).compile(batch=128)
+    sb = netb.init_state()
+    sb = sb._replace(
+        in_buf=sb.in_buf.at[:, : len(inputs)].set(
+            np.tile(np.asarray(inputs, np.int32), (128, 1))
+        ),
+        in_wr=sb.in_wr + len(inputs),
+    )
+    outb = netb.fused_runner(steps, block_batch=128, interpret=True)(sb)
+    for key in ("acc", "bak", "acc_hi", "bak_hi", "pc", "out_wr", "out_buf"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(outb, key))[0], want[key],
+            err_msg=f"seed {seed} fused field {key}\n{program}",
+        )
+
+    if cinterp.available():
+        with cinterp.NativeInterpreter(
+            net.code, net.prog_len, 1, STACK_CAP, IN_CAP, OUT_CAP
+        ) as n:
+            n.feed(inputs)
+            n.run(steps)
+            got = n.state_arrays()
+            for key in ("acc", "bak", "acc_hi", "bak_hi", "pc", "out_wr",
+                        "out_buf"):
+                np.testing.assert_array_equal(
+                    got[key], want[key],
+                    err_msg=f"seed {seed} native field {key}\n{program}",
+                )
