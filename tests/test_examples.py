@@ -57,6 +57,42 @@ def test_multiply_stream_of_pairs():
     run_pairs(net, [2, 3, 4, 5, 0, 99, 3, 3], [6, 20, 0, 9])
 
 
+def test_overflow64():
+    net = load("overflow64.json").compile()
+    state = net.init_state()
+    state, outs = net.compute_stream(state, [5, -7])
+    # 64-bit acc: JLZ not taken; OUT emits the sint32 wire truncation
+    want = [int(np.int64(v + 4_000_000_000).astype(np.int32)) for v in (5, -7)]
+    assert outs == want
+
+
+def test_reverse_any():
+    # engine-level: caps sized for the stream (the MASTER auto-grows; the
+    # raw engine honors whatever capacity it was compiled with)
+    net = load("reverse_any.json").compile()
+    state = net.init_state()
+    state, outs = net.compute_stream(
+        state, [7, 8, 9, 0], max_steps=20_000, expected=4
+    )
+    assert outs == [0, 9, 8, 7]
+
+
+def test_reverse_any_autogrows_under_master():
+    # serving-path: 40 values through an initial stack_cap of 8 — completes
+    # only because the master grows the stack.  tests/test_autogrow.py pins
+    # the mechanism; this only pins that the SHIPPED example lowers to the
+    # same reverser, so it reuses that suite's driver.
+    from misaka_tpu.runtime.master import MasterNode
+    from tests.test_autogrow import run_reverser
+
+    top = load("reverse_any.json")
+    top.stack_cap = 8
+    master = MasterNode(top, chunk_steps=32)
+    master.run()
+    run_reverser(master)
+    assert master._net.stack_cap >= 64
+
+
 def test_examples_disassemble_cleanly():
     """Round-trip every example through the disassembler (docs never lie)."""
     from misaka_tpu.tis.disasm import disassemble_network
