@@ -90,6 +90,35 @@ def test_autogrow_respects_budget():
     assert master._net.stack_cap == 8
 
 
+def test_restore_pads_pre_grow_snapshot():
+    # a snapshot taken BEFORE a grow must restore against the grown engine
+    # (zero-padded), not crash the device loop on its next chunk
+    master = MasterNode(reverser_top(), chunk_steps=32)
+    master.run()
+    snap = master.snapshot()  # stack_cap=8 shapes
+    run_reverser(master)      # grows to >= 64
+    grown_cap = master._net.stack_cap
+    master.restore(snap)      # must pad, not wedge
+    assert master._state.stack_mem.shape[-1] == grown_cap
+    master.run()
+    run_reverser(master, n=4)  # restored state still serves
+    master.pause()
+
+
+def test_restore_rejects_true_shape_mismatch():
+    m1 = MasterNode(reverser_top(), chunk_steps=32)
+    m2 = MasterNode(
+        Topology(
+            node_info={"a": "program", "b": "program"},
+            programs={"a": "IN ACC\nOUT ACC", "b": "NOP"},
+            in_cap=64, out_cap=64, stack_cap=8,
+        ),
+        chunk_steps=32,
+    )
+    with pytest.raises(ValueError, match="snapshot shapes"):
+        m1.restore(m2.snapshot())
+
+
 def test_autogrow_not_triggered_by_starvation():
     # a stalled request whose stacks are NOT full (a sink program that
     # consumes inputs and never emits) must not trigger growth

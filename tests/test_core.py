@@ -359,3 +359,45 @@ def test_batched_instances_are_independent():
     out = np.asarray(state.out_buf[:, 0])
     np.testing.assert_array_equal(out, [11, 21, 31, 41])
     np.testing.assert_array_equal(np.asarray(state.out_wr), [1, 1, 1, 1])
+
+
+# --- the one-dispatch serve path ---------------------------------------------
+
+def test_serve_chunk_equals_piecewise():
+    """serve_chunk (feed+run+snapshot+drain in one dispatch) must land in
+    exactly the state the piecewise feed/run/drain path produces, and its
+    packed snapshot must carry the same outputs."""
+    net = build({"n": "IN ACC\nADD 1\nOUT ACC"}, [])
+    s1 = net.init_state()
+    s1, took = net.feed(s1, [5, 6])
+    assert took == 2
+    s1 = net.run(s1, 40)
+    s1, outs1 = net.drain(s1)
+
+    s2 = net.init_state()
+    vals = np.zeros(net.in_cap, np.int32)
+    vals[:2] = [5, 6]
+    s2, packed = net.serve_chunk(s2, vals, 2, 40)
+    p = np.asarray(packed)
+    rd, wr = int(p[2]), int(p[3])
+    outs2 = [int(p[4 + ((rd + i) % net.out_cap)]) for i in range(wr - rd)]
+
+    assert outs1 == outs2 == [6, 7]
+    for f in s1._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s1, f)), np.asarray(getattr(s2, f)),
+            err_msg=f"serve_chunk diverged from piecewise path on '{f}'",
+        )
+
+
+def test_serve_chunk_zero_count_is_pure_run():
+    net = build({"n": "IN ACC\nADD 1\nOUT ACC"}, [])
+    s1 = net.run(net.init_state(), 16)
+    s2, packed = net.serve_chunk(
+        net.init_state(), np.zeros(net.in_cap, np.int32), 0, 16
+    )
+    assert int(np.asarray(packed)[3]) == 0  # nothing produced
+    for f in s1._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s1, f)), np.asarray(getattr(s2, f)), err_msg=f
+        )

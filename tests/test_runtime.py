@@ -11,6 +11,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+import numpy as np
 import pytest
 
 from misaka_tpu.runtime.master import MasterNode, make_http_server
@@ -306,6 +307,37 @@ def test_checkpoint_disabled_without_dir():
         assert "disabled" in body
     finally:
         httpd.shutdown()
+
+
+def test_checkpoint_pre_regs64_compat(tmp_path):
+    # checkpoints written before the 64-bit register planes existed lack
+    # acc_hi/bak_hi; those states were int32-exact, so loading must
+    # reconstruct the hi planes by sign extension — not KeyError
+    top = Topology(
+        node_info={"n": "program"},
+        programs={"n": "IN ACC\nADD 1\nOUT ACC"},
+        in_cap=16, out_cap=16, stack_cap=4,
+    )
+    m1 = MasterNode(top, chunk_steps=16)
+    with m1._state_lock:
+        m1._state = m1._state._replace(
+            acc=m1._state.acc.at[0].set(-5),
+            acc_hi=m1._state.acc_hi.at[0].set(-1),
+        )
+    path = str(tmp_path / "old.npz")
+    m1.save_checkpoint(path)
+    # rewrite the npz without the hi planes (the pre-upgrade format)
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files if k not in ("acc_hi", "bak_hi")}
+    np.savez(path, **arrays)
+
+    m2 = MasterNode(top, chunk_steps=16)
+    m2.load_checkpoint(path)
+    assert int(np.asarray(m2._state.acc)[0]) == -5
+    assert int(np.asarray(m2._state.acc_hi)[0]) == -1  # sign-extended
+    m2.run()
+    assert m2.compute(9, timeout=30) == 10
+    m2.pause()
 
 
 def test_checkpoint_caps_roundtrip(tmp_path):
