@@ -37,6 +37,7 @@ import threading
 from collections import deque
 
 import grpc
+import numpy as np
 from google.protobuf import empty_pb2
 
 from misaka_tpu.runtime.master import BroadcastError, ComputeTimeout
@@ -601,6 +602,12 @@ class MasterNodeProcess:
         self._out_q: "deque[int]" = deque()
         self._compute_lock = threading.Lock()
         self._stale_outputs = 0
+        # bumped by _drain_queues (reset/load): a compute whose request was
+        # wiped must NOT mark its missing outputs stale — nothing is coming,
+        # and phantom stale entries would mispair every later request (the
+        # fused MasterNode guards the same race with its epoch,
+        # master.py _collect_slot)
+        self._epoch = 0
         self._server: grpc.Server | None = None
 
     def start(self) -> int:
@@ -687,27 +694,65 @@ class MasterNodeProcess:
     def compute(self, value: int, timeout: float = 30.0) -> int:
         """One value in, one out, correlated (fixes quirk #2 — the reference
         pairs whatever output arrives first, master.go:216-219)."""
+        return self.compute_many([value], timeout=timeout)[0]
+
+    def compute_many(self, values, timeout: float = 30.0,
+                     return_array: bool = False):
+        """A FIFO stream of values through the distributed cluster in ONE
+        request: len(values) in, len(values) out, pairing strictly ordered.
+
+        This is the /compute_batch (and, via compute_spread, /compute_raw)
+        lane for the per-process control plane: the reference moves one
+        value per HTTP round trip (master.go:197-224); here a whole stream
+        costs one queue append and the pipeline stays full.
+        """
         import time
 
+        # ingress truncates to the sint32 wire exactly like the reference
+        # (every value crosses gRPC as sint32 anyway, messenger.proto:34-41)
+        arr = np.asarray(values, dtype=np.int64).astype(np.int32)
+        if arr.ndim != 1:
+            raise ValueError(f"values must be a flat sequence, got shape {arr.shape}")
+        if arr.size == 0:
+            return np.empty((0,), np.int32) if return_array else []
+        outs: list[int] = []
         with self._compute_lock:
             deadline = time.monotonic() + timeout
             with self._io_cond:
-                self._in_q.append(int(value))
+                epoch = self._epoch
+                self._in_q.extend(int(v) for v in arr)
                 self._io_cond.notify_all()
-                while True:
+                while len(outs) < arr.size:
                     while not self._out_q:
+                        if self._epoch != epoch:
+                            # reset/load wiped this request: nothing further
+                            # is coming and nothing may be marked stale
+                            raise ComputeTimeout(
+                                "request wiped by reset/load mid-collect"
+                            )
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
-                            self._stale_outputs += 1
+                            # outputs still owed to this request surface later:
+                            # mark them stale so pairing survives the failure
+                            self._stale_outputs += arr.size - len(outs)
                             raise ComputeTimeout(
-                                f"no output for value {value} after {timeout}s"
+                                f"no output for {arr.size - len(outs)}/"
+                                f"{arr.size} value(s) after {timeout}s"
                             )
                         self._io_cond.wait(remaining)
-                    out = self._out_q.popleft()
+                    v = self._out_q.popleft()
                     if self._stale_outputs:
                         self._stale_outputs -= 1
                         continue
-                    return out
+                    outs.append(v)
+        out = np.asarray(outs, np.int32)
+        return out if return_array else out.tolist()
+
+    def compute_spread(self, values, timeout: float = 30.0,
+                       return_array: bool = False):
+        """Same stream through the single pipeline (no instance striping in
+        the distributed mode) — exists so /compute_raw serves here too."""
+        return self.compute_many(values, timeout=timeout, return_array=return_array)
 
     @property
     def is_running(self) -> bool:
@@ -729,6 +774,8 @@ class MasterNodeProcess:
             self._in_q.clear()
             self._out_q.clear()
             self._stale_outputs = 0
+            self._epoch += 1
+            self._io_cond.notify_all()  # wake waiters to observe the wipe
 
     # --- data plane (Master service, master.go:233-249) ---------------------
 

@@ -8,11 +8,13 @@ through the same HTTP surface.  The reference can only test this with a
 ephemeral loopback ports in one process.
 """
 
+import json
 import threading
 import time
 import urllib.request
 import urllib.parse
 
+import numpy as np
 import pytest
 
 from misaka_tpu.runtime.nodes import (
@@ -159,6 +161,17 @@ def test_http_surface(add2_cluster):
         assert post("/run") == (200, "Success")
         status, body = post("/compute", {"value": 40})
         assert status == 200 and '"value": 42' in body
+        # the stream lanes serve the distributed control plane too: one
+        # request, FIFO pairing through the live gRPC pipeline
+        status, body = post("/compute_batch", {"values": "1, 2 3"})
+        assert status == 200 and json.loads(body) == {"values": [3, 4, 5]}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/compute_raw",
+            data=np.asarray([10, 11], "<i4").tobytes(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            assert np.frombuffer(resp.read(), "<i4").tolist() == [12, 13]
         # GET /trace must 404 cleanly: the distributed control plane has no
         # fused trace ring (only the fused MasterNode does).
         status, body = get("/trace")
