@@ -259,6 +259,78 @@ class CompiledNetwork:
             unroll_cap=unroll_cap,
         )
 
+    def make_batched_serve(self, runner, num_steps: int):
+        """Build the one-dispatch BATCHED serve iteration: returns
+        (serve_fn, idle_fn) where
+
+          serve_fn(state, values [B, in_cap], counts [B]) -> (state, packed)
+          idle_fn(state)                                  -> (state, ctrs)
+
+        serve_fn's `packed` is ONE [B, 4 + out_cap] device array holding
+        each instance's [in_rd, in_wr, out_rd, out_wr, out_buf...] snapshot
+        with the output ring already drained on-device (out_rd := out_wr).
+        The piecewise loop paid four device interactions per iteration
+        (feed, run, counters, drain) — four round trips on a relayed
+        device; this pays one dispatch + one read.
+
+        idle_fn (quiet iterations) skips BOTH the [B, in_cap] feed upload
+        and the [B, out_cap] ring download: it returns only the [B, 4]
+        counters and leaves the ring undrained, so the caller fetches
+        outputs with drain_batched only on the rare idle iteration that
+        actually produced some.
+
+        `runner` is the engine chunk fn (the fused Pallas runner) or None
+        for the XLA scan engine; it is inlined into the combined jit.
+        """
+        if self.batch is None:
+            raise ValueError("make_batched_serve requires a batched network")
+        tables = self._tables
+
+        def advance(state):
+            if runner is not None:
+                return runner(state)
+            step_b = jax.vmap(step, in_axes=(None, None, 0))
+
+            def body(s, _):
+                return step_b(tables[0], tables[1], s), None
+
+            out, _ = jax.lax.scan(body, state, None, length=num_steps)
+            return rebase_rings(out)
+
+        def ctrs_of(state):
+            return jnp.stack(
+                [state.in_rd, state.in_wr, state.out_rd, state.out_wr], axis=1
+            )
+
+        def serve(state, values, counts):
+            state = advance(_feed_batched(state, values, counts))
+            packed = jnp.concatenate([ctrs_of(state), state.out_buf], axis=1)
+            return state._replace(out_rd=state.out_wr), packed
+
+        def idle(state):
+            state = advance(state)
+            return state, ctrs_of(state)  # ring untouched: counters only
+
+        return (
+            jax.jit(serve, donate_argnums=(0,)),
+            jax.jit(idle, donate_argnums=(0,)),
+        )
+
+    @staticmethod
+    def drain_from_snapshot(buf, rd, wr, out_cap):
+        """Ragged per-instance gather of pending outputs from a ring
+        snapshot: returns [(slot, values)] like drain_batched, with one
+        vectorized gather for all active instances."""
+        active = np.nonzero(wr > rd)[0]
+        if active.size == 0:
+            return []
+        counts = (wr - rd)[active]
+        bounds = np.cumsum(counts)
+        seq = np.arange(bounds[-1]) - np.repeat(bounds - counts, counts)
+        idx = (np.repeat(rd[active], counts) + seq) % out_cap
+        flat = buf[np.repeat(active, counts), idx]
+        return list(zip(active.tolist(), np.split(flat, bounds[:-1])))
+
     def serve_chunk(self, state: NetworkState, values, count, num_steps: int):
         """One-dispatch serve iteration (unbatched device loop): feed the
         `count` leading entries of `values` ([in_cap] int32), advance
@@ -339,18 +411,11 @@ class CompiledNetwork:
             rd, wr = c[2], c[3]
         if (wr == rd).all():
             return state, []
-        buf = np.asarray(state.out_buf)
-        active = np.nonzero(wr > rd)[0]
         # one ragged gather for ALL active instances (the per-instance
         # fancy-index loop cost O(active) numpy calls per drain — at B=8192
         # that loop, not the engine, was the serve path's floor)
-        counts = (wr - rd)[active]
-        bounds = np.cumsum(counts)
-        seq = np.arange(bounds[-1]) - np.repeat(bounds - counts, counts)
-        idx = (np.repeat(rd[active], counts) + seq) % self.out_cap
-        flat = buf[np.repeat(active, counts), idx]
-        parts = np.split(flat, bounds[:-1])
-        outs = list(zip(active.tolist(), parts))
+        buf = np.asarray(state.out_buf)
+        outs = self.drain_from_snapshot(buf, rd, wr, self.out_cap)
         return state._replace(out_rd=jnp.asarray(wr)), outs
 
     def drain(self, state: NetworkState) -> tuple[NetworkState, list[int]]:

@@ -161,6 +161,7 @@ class MasterNode:
         self._trace_instance = trace_instance
         self._trace = self._net.init_trace(trace_cap) if trace_cap else None
         self._runner = self._make_runner(self._net)
+        self._batched_serve = self._make_serve_fns(self._net, self._runner)
         self._running = False
         self._loop: threading.Thread | None = None
         self._state_lock = threading.Lock()      # guards _state/_net swaps
@@ -290,6 +291,14 @@ class MasterNode:
             return None
         raise err
 
+    def _make_serve_fns(self, net, runner):
+        """The batched one-dispatch (serve, idle) jit pair, or None where
+        the piecewise loop must run (unbatched, tracing, or mesh serving —
+        mesh state carries shardings the combined jit does not manage)."""
+        if self._batch is None or self._trace_cap or self._mesh is not None:
+            return None
+        return net.make_batched_serve(runner, self._chunk)
+
     def _make_dp_fused_runner(self, net):
         """The fused Pallas kernel under shard_map over the `data` axis: each
         chip runs the whole kernel on its batch shard (pure DP — pallas_call
@@ -393,6 +402,7 @@ class MasterNode:
                 if self._trace_cap:
                     self._trace = new_net.init_trace(self._trace_cap)
                 self._runner = new_runner
+                self._batched_serve = self._make_serve_fns(new_net, new_runner)
             self._drain_queues()
             log.info("successfully loaded program")
 
@@ -731,6 +741,7 @@ class MasterNode:
                 if self._trace_cap:
                     self._trace = new_net.init_trace(self._trace_cap)
                 self._runner = new_runner
+                self._batched_serve = self._make_serve_fns(new_net, new_runner)
             self._drain_queues()
         log.info("checkpoint restored from %s", path)
 
@@ -855,6 +866,7 @@ class MasterNode:
                 self._state._replace(stack_mem=jnp.pad(self._state.stack_mem, pad))
             )
             self._runner = new_runner
+            self._batched_serve = self._make_serve_fns(new_net, new_runner)
             log.info(
                 "grew stack capacity %d -> %d (engine=%s)",
                 net.stack_cap, new_cap, self.engine_name,
@@ -912,6 +924,19 @@ class MasterNode:
             self._active.discard(slot)
         return np.concatenate(take) if take else None
 
+    def _build_feed(self, ctrs):
+        """Cut pending submissions into a [B, in_cap] feed matrix + counts
+        (loop thread only); shared by the one-dispatch and piecewise paths."""
+        vals = np.zeros((self._batch, self._net.in_cap), np.int32)
+        counts = np.zeros((self._batch,), np.int32)
+        free = self._net.in_cap - (ctrs[1] - ctrs[0])
+        for b in list(self._active):
+            got = self._cut_pending(b, int(free[b]))
+            if got is not None:
+                vals[b, : len(got)] = got
+                counts[b] = len(got)
+        return vals, counts
+
     def _device_loop_inner(self) -> None:
         # One device counter read per iteration (post-run), reused for the
         # next iteration's feed decisions: between chunks nothing on the
@@ -949,30 +974,53 @@ class MasterNode:
                     else:
                         per_slot = []
                     self._state = state
-                elif self._batch is None:
-                    free = self._net.in_cap - int(ctrs[1] - ctrs[0])
-                    got = self._cut_pending(0, free)
-                    if got is not None:
-                        state, _ = self._net.feed(state, got)
+                elif self._batched_serve is not None:
+                    # the batched twin of the one-dispatch path: feed matrix
+                    # + chunk + per-instance counter/ring snapshot in one
+                    # jit, one [B, 4+out_cap] read.  The idle variant skips
+                    # the feed upload AND the ring download (counters only;
+                    # outputs fetched separately only if some appeared).
+                    serve_fn, idle_fn = self._batched_serve
+                    fed = False
+                    if self._active:
+                        vals, counts = self._build_feed(ctrs)
+                        fed = bool(counts.any())
+                    if fed:
+                        state, packed = serve_fn(state, vals, counts)
+                        self._mark_ticks()
+                        p = np.asarray(packed)  # the single device read
+                        ctrs = p[:, :4].T  # the counters() orientation
+                        per_slot = self._net.drain_from_snapshot(
+                            p[:, 4:], p[:, 2], p[:, 3], self._net.out_cap
+                        )
                         busy = True
-                elif self._active:
-                    # allocate the [B, in_cap] feed matrix only when there is
-                    # actually something queued — an idle batched loop must
-                    # not churn MBs/iteration
-                    vals = np.zeros((self._batch, self._net.in_cap), np.int32)
-                    counts = np.zeros((self._batch,), np.int32)
-                    free = self._net.in_cap - (ctrs[1] - ctrs[0])
-                    for b in list(self._active):
-                        got = self._cut_pending(b, int(free[b]))
-                        if got is not None:
-                            vals[b, : len(got)] = got
-                            counts[b] = len(got)
-                    if counts.any():
-                        state = self._net.feed_batched(state, vals, counts)
-                        busy = True
-                if self._batch is None and self._trace is None:
-                    pass  # the one-dispatch branch above did run+drain
+                    else:
+                        state, packed = idle_fn(state)
+                        self._mark_ticks()
+                        p = np.asarray(packed)  # [B, 4]: counters only
+                        ctrs = p.T
+                        if (p[:, 3] > p[:, 2]).any():
+                            state, per_slot = self._net.drain_batched(
+                                state, rd=p[:, 2], wr=p[:, 3]
+                            )
+                        else:
+                            per_slot = []
+                    self._state = state
                 else:
+                    # piecewise path: tracing and mesh serving
+                    if self._batch is None:
+                        free = self._net.in_cap - int(ctrs[1] - ctrs[0])
+                        got = self._cut_pending(0, free)
+                        if got is not None:
+                            state, _ = self._net.feed(state, got)
+                            busy = True
+                    elif self._active:
+                        # feed only when something is queued — an idle
+                        # batched loop must not churn MBs/iteration
+                        vals, counts = self._build_feed(ctrs)
+                        if counts.any():
+                            state = self._net.feed_batched(state, vals, counts)
+                            busy = True
                     if self._trace is not None:
                         state, self._trace = self._net.run_traced(
                             state, self._trace, self._chunk,
@@ -980,7 +1028,7 @@ class MasterNode:
                                if self._batch is not None else {}),
                         )
                     elif self._runner is not None:
-                        state = self._runner(state)  # the fused Pallas fast path
+                        state = self._runner(state)  # fused / mesh runner
                     else:
                         state = self._net.run(state, self._chunk)
                     self._mark_ticks()

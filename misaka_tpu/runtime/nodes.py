@@ -740,6 +740,14 @@ class MasterNodeProcess:
                                 f"{arr.size} value(s) after {timeout}s"
                             )
                         self._io_cond.wait(remaining)
+                    if self._epoch != epoch:
+                        # outputs now in the queue belong to the NEW epoch:
+                        # consuming them would fabricate results for wiped
+                        # inputs and starve the next request (the fused
+                        # master re-checks per chunk for the same reason)
+                        raise ComputeTimeout(
+                            "request wiped by reset/load mid-collect"
+                        )
                     v = self._out_q.popleft()
                     if self._stale_outputs:
                         self._stale_outputs -= 1
