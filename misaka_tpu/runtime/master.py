@@ -607,6 +607,7 @@ class MasterNode:
                 stack_top = stack_top.sum(axis=0)
             in_depth = int(np.asarray(state.in_wr - state.in_rd).sum())
             out_depth = int(np.asarray(state.out_wr - state.out_rd).sum())
+            stack_cap = self._net.stack_cap
         # Gauge-quality depth reads; each queue's internal mutex is held only
         # long enough to snapshot its deque (iterating unlocked can raise
         # "deque mutated during iteration" under concurrent traffic).
@@ -632,6 +633,9 @@ class MasterNode:
             "stack_depth": {
                 name: int(stack_top[i]) for name, i in topo.stack_ids().items()
             },
+            # current per-compile capacity — observable growth (auto-grow
+            # doubles this when a full stack wedges the network)
+            "stack_cap": stack_cap,
             "in_queue": host_in + in_depth,
             "out_queue": host_out + out_depth,
             "nodes": dict(topo.node_info),

@@ -54,6 +54,8 @@ def test_autogrow_unbatched():
     # capacity actually grew (8 -> >= 64 for depth 40) and topology followed
     assert master._net.stack_cap >= 64
     assert master._topology.stack_cap == master._net.stack_cap
+    # growth is observable on the metrics surface
+    assert master.status()["stack_cap"] == master._net.stack_cap
 
 
 def test_autogrow_batched():
