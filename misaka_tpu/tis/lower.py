@@ -14,10 +14,16 @@ Symbol resolution happens here, at compile time:
   * Jump labels were validated by the parser; here they become absolute line
     indices (the reference looks them up per-execution, program.go:318).
 
-Immediates are wrapped to int32.  The reference holds locals as 64-bit Go ints
-but every wire transfer truncates to sint32 (messenger.proto:34-41,
-program.go:498); we use int32 end-to-end.  Documented divergence: local
-overflow wraps at 2^31 instead of 2^63.
+Register ARITHMETIC is 64-bit everywhere — acc/bak are carried as int32
+(hi, lo) planes on device (core/regs64.py) and int64 on hosts, with
+truncation to sint32 exactly at wire transfers (messenger.proto:34-41,
+program.go:498), matching the reference's Go-int locals.  IMMEDIATES,
+however, are wrapped to int32 in the tables (one field per instruction);
+the reference's Atoi yields a 64-bit int, so a source literal outside
+int32 (e.g. `ADD 4000000000`) diverges — kernel tables sign-extend the
+wrapped int32.  Documented corner: TIS-dialect programs use small
+literals (the original language clamps at ±999), and 64-bit magnitudes
+remain reachable the same way the tests build them, by accumulation.
 """
 
 from __future__ import annotations
