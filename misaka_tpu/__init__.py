@@ -38,3 +38,12 @@ Beyond-parity subsystems (SURVEY.md §5 — the reference has none of these):
 """
 
 __version__ = "0.1.0"
+
+# Parent pid captured at the earliest importable moment — before any jax
+# import gets a chance to spend seconds booting a backend.  If the launching
+# shell dies during that boot, runtime/lifecycle.py compares getppid()
+# against this to catch the orphaning (VERDICT r3 weak #1: leaked servers
+# wedged the single-client TPU relay).
+import os as _os
+
+PPID_AT_IMPORT = _os.getppid()

@@ -105,6 +105,29 @@ def mesh8(**kw) -> Topology:
     return Topology(node_info=node_info, programs=programs, **kw)
 
 
+def pipeline(n: int = 8, **kw) -> Topology:
+    """An n-stage add-1 chain: the lane-scaling workload.
+
+    Unlike ring(), every stage holds a different value in flight, so steady
+    state retires one value per ~3 ticks regardless of n — which isolates the
+    per-tick routing cost as the lane axis grows (the scan engine's one-hot
+    dest matrix is O(N·4N)); this is the workload behind the bench's
+    lane-ceiling numbers.  Edges are strictly lane i -> i+1, so a contiguous
+    model-parallel sharding sees only boundary-crossing traffic ("arbitrary
+    number of program nodes", README.md:10-18).  Output per value: v + n.
+    """
+    if n < 2:
+        raise ValueError(f"pipeline needs at least 2 stages, got {n}")
+    names = [f"p{i}" for i in range(n)]
+    programs = {names[0]: f"IN ACC\nADD 1\nMOV ACC, {names[1]}:R0\n"}
+    for i in range(1, n - 1):
+        programs[names[i]] = f"MOV R0, ACC\nADD 1\nMOV ACC, {names[i + 1]}:R0\n"
+    programs[names[-1]] = "MOV R0, ACC\nADD 1\nOUT ACC\n"
+    return Topology(
+        node_info={name: "program" for name in names}, programs=programs, **kw
+    )
+
+
 BASELINE_CONFIGS = {
     "add2": add2,
     "acc_loop": acc_loop,

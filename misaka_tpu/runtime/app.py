@@ -67,6 +67,12 @@ import logging
 import os
 import sys
 
+# Captured at package import, before the heavy jax imports below: if our
+# launching shell dies during the multi-second boot,
+# lifecycle.install_guards compares against this and exits instead of
+# leaking (runtime/lifecycle.py).
+from misaka_tpu import PPID_AT_IMPORT as _PPID_AT_START
+from misaka_tpu.runtime.lifecycle import install_guards
 from misaka_tpu.runtime.master import MasterNode, make_http_server
 from misaka_tpu.runtime.topology import Topology
 
@@ -146,6 +152,7 @@ def main() -> None:
             key_file=key,
             grpc_port=int(environ.get("MISAKA_GRPC_PORT", "8001")),
         )
+        install_guards(node.close, environ, start_ppid=_PPID_AT_START)
         program = environ.get("PROGRAM")
         if program:
             try:
@@ -164,6 +171,7 @@ def main() -> None:
             key_file=key,
             grpc_port=int(environ.get("MISAKA_GRPC_PORT", "8001")),
         )
+        install_guards(node.close, environ, start_ppid=_PPID_AT_START)
         node.start()
         threading_event_forever()
     elif node_type == "master" and environ.get("MISAKA_MODE") == "distributed":
@@ -179,6 +187,7 @@ def main() -> None:
             key_file=key,
             grpc_port=int(environ.get("MISAKA_GRPC_PORT", "8001")),
         )
+        install_guards(master.close, environ, start_ppid=_PPID_AT_START)
         master.start()
         if environ.get("MISAKA_AUTORUN") == "1":
             try:
@@ -204,6 +213,7 @@ def main() -> None:
             # unless disabled (MISAKA_STACK_AUTOGROW=0)
             stack_autogrow=environ.get("MISAKA_STACK_AUTOGROW", "1") != "0",
         )
+        install_guards(master.pause, environ, start_ppid=_PPID_AT_START)
         if environ.get("MISAKA_AUTORUN") == "1":
             master.run()
         _serve_http(

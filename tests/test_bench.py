@@ -30,3 +30,14 @@ def test_bench_add2_alias():
 def test_bench_latency_tiny():
     lat = bench.bench_latency(samples=10, warmup=2)
     assert lat["p50_us"] > 0 and lat["p99_us"] >= lat["p50_us"]
+
+
+def test_bench_lanes_tiny():
+    r = bench.bench_lanes(8, batch=16, per_instance=4)
+    assert r["ticks_per_sec"] > 0 and r["throughput"] > 0
+
+
+def test_bench_lanes_parity_guard():
+    # the pipeline oracle is v + n: make sure the asserted path really runs
+    r = bench.bench_lanes(4, batch=8, per_instance=4)
+    assert r["lanes"] == 4
