@@ -1,0 +1,207 @@
+"""Shared lane-local phases of the superstep kernel.
+
+Three kernels execute the same TIS semantics over different agreement
+fabrics: core/step.py (single chip, dense one-hot arbitration),
+parallel/sharded.py (multi-chip, occupancy all_gather) and
+parallel/routed.py (multi-chip, compact-slot pmin/psum).  What differs
+between them is ONLY how same-tick conflicts are agreed; everything a lane
+does locally — fetch/decode, the phase-A hold-latch consume, source
+resolution, and the commit-time register/PC update — is identical, and any
+ISA change must hit all three identically (the bit-identical invariant
+tests/test_parallel.py and tests/test_differential.py pin).  Those shared
+phases live here, once.
+
+Semantics documentation lives with the canonical kernel (core/step.py's
+module docstring, mapping each rule to program.go / stack.go / master.go);
+this module is the mechanism.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from misaka_tpu.core import regs64
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.tis import isa
+
+_I32 = jnp.int32
+
+
+class Decoded(NamedTuple):
+    """Per-lane decode + phase-A results (all arrays [N_lanes_local])."""
+
+    op: jnp.ndarray
+    src: jnp.ndarray
+    imm: jnp.ndarray
+    dst: jnp.ndarray
+    tgt: jnp.ndarray
+    tport: jnp.ndarray
+    jmp: jnp.ndarray
+    src_val: jnp.ndarray   # resolved low (wire) word of the source operand
+    src_hi: jnp.ndarray    # 64-bit high word of the source (regs64.py)
+    src_ok: jnp.ndarray    # source available (port sources: latched)
+    holding: jnp.ndarray   # hold latch AFTER this tick's consumes
+    hold_val: jnp.ndarray
+    port_full_after_reads: jnp.ndarray  # [N, 4] occupancy after phase A
+
+
+def decode_and_consume(code: jnp.ndarray, state: NetworkState) -> Decoded:
+    """Fetch/decode at each lane's PC + phase A (consume ready port sources
+    into the hold latch, resolve the source operand).
+
+    See core/step.py's docstring for the two-phase hold-latch rationale
+    (getFromSrc drains before delivery blocks, program.go:441-468).
+    """
+    n_lanes = code.shape[0]
+    n_ports = isa.NUM_PORTS
+    lane = jnp.arange(n_lanes)
+
+    fields = code[lane, state.pc]
+    op = fields[:, isa.F_OP]
+    src = fields[:, isa.F_SRC]
+    imm = fields[:, isa.F_IMM]
+
+    is_port_src = src >= isa.SRC_R0
+    pidx = jnp.clip(src - isa.SRC_R0, 0, n_ports - 1)
+    port_v = state.port_val[lane, pidx]
+    port_f = state.port_full[lane, pidx]
+    reads_src = jnp.isin(op, jnp.asarray(isa.READS_SRC, dtype=_I32))
+    reads_port = reads_src & is_port_src
+    consume_now = reads_port & ~state.holding & port_f
+    holding = state.holding | consume_now
+    hold_val = jnp.where(consume_now, port_v, state.hold_val)
+    src_val = jnp.where(
+        src == isa.SRC_IMM,
+        imm,
+        jnp.where(
+            src == isa.SRC_ACC,
+            state.acc,
+            jnp.where(src == isa.SRC_NIL, jnp.zeros_like(imm), hold_val),
+        ),
+    )
+    # 64-bit source view: ACC carries its real high word; every other source
+    # (imm, NIL, port values) is an int32 that sign-extends (regs64.py).
+    # src_val (the low word) remains THE wire value for sends/stack/OUT.
+    src_hi = jnp.where(src == isa.SRC_ACC, state.acc_hi, regs64.sext(src_val))
+    src_ok = ~reads_port | holding
+
+    # Ports cleared by this tick's consumes are visible to this tick's sends
+    # (consume-then-send interleaving, one tick per pipeline hop).
+    consume_onehot = consume_now[:, None] & (
+        pidx[:, None] == jnp.arange(n_ports)[None, :]
+    )
+    port_full_after_reads = state.port_full & ~consume_onehot
+
+    return Decoded(
+        op=op, src=src, imm=imm,
+        dst=fields[:, isa.F_DST], tgt=fields[:, isa.F_TGT],
+        tport=fields[:, isa.F_PORT], jmp=fields[:, isa.F_JMP],
+        src_val=src_val, src_hi=src_hi, src_ok=src_ok,
+        holding=holding, hold_val=hold_val,
+        port_full_after_reads=port_full_after_reads,
+    )
+
+
+def commit_lane_state(
+    d: Decoded,
+    prog_len: jnp.ndarray,
+    state: NetworkState,
+    commit: jnp.ndarray,
+    pop_val_lane: jnp.ndarray,
+    in_val: jnp.ndarray,
+) -> dict:
+    """The commit-time register file + PC update (begin-of-tick reads).
+
+    Returns the new values of acc/bak (hi+lo), pc, holding as a dict of
+    NetworkState field updates.  64-bit (hi, lo) arithmetic per regs64.py:
+    ADD/SUB/NEG wrap at 64 bits like Go's int; values arriving from the
+    network/stack/IN are int32 and sign-extend; local MOV ACC keeps width.
+    Jump conditions evaluate the FULL 64-bit acc (program.go:300-340).
+    """
+    op, dst = d.op, d.dst
+    is_pop = op == isa.OP_POP
+    incoming = jnp.where(
+        is_pop, pop_val_lane, jnp.where(op == isa.OP_IN, in_val, d.src_val)
+    )
+    incoming_hi = jnp.where(op == isa.OP_MOV_LOCAL, d.src_hi, regs64.sext(incoming))
+    writes_acc = ((op == isa.OP_MOV_LOCAL) | is_pop | (op == isa.OP_IN)) & (
+        dst == isa.DST_ACC
+    )
+    acc = state.acc
+    acc_hi = state.acc_hi
+    add_hi, add_lo = regs64.add64(acc_hi, acc, d.src_hi, d.src_val)
+    sub_hi, sub_lo = regs64.sub64(acc_hi, acc, d.src_hi, d.src_val)
+    neg_hi, neg_lo = regs64.neg64(acc_hi, acc)
+    new_acc = jnp.where(commit & writes_acc, incoming, acc)
+    new_acc_hi = jnp.where(commit & writes_acc, incoming_hi, acc_hi)
+    new_acc = jnp.where(commit & (op == isa.OP_ADD), add_lo, new_acc)
+    new_acc_hi = jnp.where(commit & (op == isa.OP_ADD), add_hi, new_acc_hi)
+    new_acc = jnp.where(commit & (op == isa.OP_SUB), sub_lo, new_acc)
+    new_acc_hi = jnp.where(commit & (op == isa.OP_SUB), sub_hi, new_acc_hi)
+    new_acc = jnp.where(commit & (op == isa.OP_NEG), neg_lo, new_acc)
+    new_acc_hi = jnp.where(commit & (op == isa.OP_NEG), neg_hi, new_acc_hi)
+    new_acc = jnp.where(commit & (op == isa.OP_SWP), state.bak, new_acc)
+    new_acc_hi = jnp.where(commit & (op == isa.OP_SWP), state.bak_hi, new_acc_hi)
+    saves_bak = commit & ((op == isa.OP_SWP) | (op == isa.OP_SAV))
+    new_bak = jnp.where(saves_bak, acc, state.bak)
+    new_bak_hi = jnp.where(saves_bak, acc_hi, state.bak_hi)
+
+    jump_taken = (
+        (op == isa.OP_JMP)
+        | ((op == isa.OP_JEZ) & regs64.is_zero(acc_hi, acc))
+        | ((op == isa.OP_JNZ) & ~regs64.is_zero(acc_hi, acc))
+        | ((op == isa.OP_JGZ) & regs64.is_pos(acc_hi, acc))
+        | ((op == isa.OP_JLZ) & regs64.is_neg(acc_hi, acc))
+    )
+    pc_inc = (state.pc + 1) % prog_len                          # program.go:429
+    pc_jro = regs64.jro_target(state.pc, d.src_hi, d.src_val, prog_len)  # :354
+    new_pc = jnp.where(jump_taken, d.jmp, jnp.where(op == isa.OP_JRO, pc_jro, pc_inc))
+    new_pc = jnp.where(commit, new_pc, state.pc)
+
+    return dict(
+        acc=new_acc, bak=new_bak, acc_hi=new_acc_hi, bak_hi=new_bak_hi,
+        pc=new_pc, hold_val=d.hold_val, holding=d.holding & ~commit,
+    )
+
+
+def apply_stack_ring_updates(
+    state: NetworkState,
+    push_per_stack: jnp.ndarray,
+    pop_per_stack: jnp.ndarray,
+    push_val: jnp.ndarray,
+    in_any: jnp.ndarray,
+    out_any: jnp.ndarray,
+    out_val: jnp.ndarray,
+) -> dict:
+    """Stack memory + master I/O ring updates from agreed per-tick winners.
+
+    At most one push OR pop per stack, one IN, one OUT per tick (the
+    lowest-lane arbitration discipline); all reads are begin-of-tick.
+    Returns NetworkState field updates.
+    """
+    n_stacks, stack_cap = state.stack_mem.shape
+    out_cap = state.out_buf.shape[0]
+
+    stack_ids = jnp.arange(n_stacks)
+    push_slot = jnp.clip(state.stack_top, 0, stack_cap - 1)
+    cur_slot_val = state.stack_mem[stack_ids, push_slot]
+    new_stack_mem = state.stack_mem.at[stack_ids, push_slot].set(
+        jnp.where(push_per_stack, push_val, cur_slot_val)
+    )
+    new_stack_top = (
+        state.stack_top + push_per_stack.astype(_I32) - pop_per_stack.astype(_I32)
+    )
+
+    new_in_rd = state.in_rd + in_any.astype(_I32)
+    out_slot = state.out_wr % out_cap
+    new_out_buf = state.out_buf.at[out_slot].set(
+        jnp.where(out_any, out_val, state.out_buf[out_slot])
+    )
+    new_out_wr = state.out_wr + out_any.astype(_I32)
+
+    return dict(
+        stack_mem=new_stack_mem, stack_top=new_stack_top,
+        in_rd=new_in_rd, out_buf=new_out_buf, out_wr=new_out_wr,
+    )

@@ -38,13 +38,24 @@ tick.  Visibility rule: consumers (port reads, pops, IN) see begin-of-tick
 state; producers (sends, pushes, OUT) require begin-of-tick free space.  Every
 superstep therefore corresponds to one legal interleaving of the reference's
 concurrent semantics — parity tests exploit this.
+
+The lane-LOCAL phases (decode, hold-latch consume, commit-time register/PC
+update, stack/ring writes) are shared with the multi-chip kernels via
+core/phases.py; what is unique here is the single-chip agreement fabric:
+dense one-hot election matrices over the full dest axis, the right shape
+when N is small (the multi-chip kernels and the compact large-N variant
+replace exactly this part).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from misaka_tpu.core import regs64
+from misaka_tpu.core.phases import (
+    apply_stack_ring_updates,
+    commit_lane_state,
+    decode_and_consume,
+)
 from misaka_tpu.core.state import NetworkState
 from misaka_tpu.tis import isa
 
@@ -70,55 +81,16 @@ def step(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState) -> Netwo
     n_stacks, stack_cap = state.stack_mem.shape
     in_cap = state.in_buf.shape[0]
     out_cap = state.out_buf.shape[0]
-    lane = jnp.arange(n_lanes)
 
-    # --- fetch & decode ----------------------------------------------------
-    fields = code[lane, state.pc]  # [N, NFIELDS]
-    op = fields[:, isa.F_OP]
-    src = fields[:, isa.F_SRC]
-    imm = fields[:, isa.F_IMM]
-    dst = fields[:, isa.F_DST]
-    tgt = fields[:, isa.F_TGT]
-    tport = fields[:, isa.F_PORT]
-    jmp = fields[:, isa.F_JMP]
-
-    # --- phase A: source resolution + port consume into the hold latch -----
-    is_port_src = src >= isa.SRC_R0
-    pidx = jnp.clip(src - isa.SRC_R0, 0, n_ports - 1)
-    port_v = state.port_val[lane, pidx]
-    port_f = state.port_full[lane, pidx]
-    reads_src = jnp.isin(op, jnp.asarray(isa.READS_SRC, dtype=_I32))
-    reads_port = reads_src & is_port_src
-    consume_now = reads_port & ~state.holding & port_f
-    holding = state.holding | consume_now
-    hold_val = jnp.where(consume_now, port_v, state.hold_val)
-    src_val = jnp.where(
-        src == isa.SRC_IMM,
-        imm,
-        jnp.where(
-            src == isa.SRC_ACC,
-            state.acc,
-            jnp.where(src == isa.SRC_NIL, jnp.zeros_like(imm), hold_val),
-        ),
-    )
-    # 64-bit source view: ACC carries its real high word; every other
-    # source (imm, NIL, port values) is an int32 sign-extended (regs64.py).
-    # src_val (the low word) remains THE wire value for sends/stack/OUT —
-    # Go truncates to int32 exactly by taking the low word.
-    src_hi = jnp.where(src == isa.SRC_ACC, state.acc_hi, regs64.sext(src_val))
-    src_ok = ~reads_port | holding
-
-    # Ports cleared by this tick's consumes are visible to this tick's sends
-    # (consume-then-send is a legal interleaving; improves pipelining to one
-    # tick per hop).
-    consume_onehot = consume_now[:, None] & (pidx[:, None] == jnp.arange(n_ports)[None, :])
-    port_full_after_reads = state.port_full & ~consume_onehot
+    # --- fetch & decode + phase A (shared: core/phases.py) -----------------
+    d = decode_and_consume(code, state)
+    op, src_ok, src_val, tgt = d.op, d.src_ok, d.src_val, d.tgt
 
     # --- phase B: network sends (OP_MOV_NET): one-hot routing + arbitration
     want_send = (op == isa.OP_MOV_NET) & src_ok
-    dest = tgt * n_ports + tport
+    dest = tgt * n_ports + d.tport
     dest_onehot = want_send[:, None] & (dest[:, None] == jnp.arange(n_dests)[None, :])
-    dest_free = ~port_full_after_reads.reshape(n_dests)
+    dest_free = ~d.port_full_after_reads.reshape(n_dests)
     send_win = _first_true_per_column(dest_onehot & dest_free[None, :])  # [N, D]
     send_won = send_win.any(axis=1)
     delivered = send_win.any(axis=0)                                    # [D]
@@ -169,95 +141,24 @@ def step(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState) -> Netwo
     )
     commit = src_ok & dst_ok
 
-    # --- register file updates (all read begin-of-tick state) --------------
-    # acc/bak are 64-bit (hi, lo) pairs: ADD/SUB/NEG wrap at 64 bits like
-    # Go's int; values ARRIVING from the network/stack/IN are int32 and
-    # sign-extend; a local MOV ACC, ACC keeps full width (regs64.py).
-    incoming = jnp.where(is_pop, pop_val_lane, jnp.where(op == isa.OP_IN, in_val, src_val))
-    incoming_hi = jnp.where(
-        op == isa.OP_MOV_LOCAL, src_hi, regs64.sext(incoming)
-    )
-    writes_acc = ((op == isa.OP_MOV_LOCAL) | is_pop | (op == isa.OP_IN)) & (
-        dst == isa.DST_ACC
-    )
-    acc = state.acc
-    acc_hi = state.acc_hi
-    add_hi, add_lo = regs64.add64(acc_hi, acc, src_hi, src_val)
-    sub_hi, sub_lo = regs64.sub64(acc_hi, acc, src_hi, src_val)
-    neg_hi, neg_lo = regs64.neg64(acc_hi, acc)
-    new_acc = jnp.where(commit & writes_acc, incoming, acc)
-    new_acc_hi = jnp.where(commit & writes_acc, incoming_hi, acc_hi)
-    new_acc = jnp.where(commit & (op == isa.OP_ADD), add_lo, new_acc)
-    new_acc_hi = jnp.where(commit & (op == isa.OP_ADD), add_hi, new_acc_hi)
-    new_acc = jnp.where(commit & (op == isa.OP_SUB), sub_lo, new_acc)
-    new_acc_hi = jnp.where(commit & (op == isa.OP_SUB), sub_hi, new_acc_hi)
-    new_acc = jnp.where(commit & (op == isa.OP_NEG), neg_lo, new_acc)
-    new_acc_hi = jnp.where(commit & (op == isa.OP_NEG), neg_hi, new_acc_hi)
-    new_acc = jnp.where(commit & (op == isa.OP_SWP), state.bak, new_acc)
-    new_acc_hi = jnp.where(commit & (op == isa.OP_SWP), state.bak_hi, new_acc_hi)
-    saves_bak = commit & ((op == isa.OP_SWP) | (op == isa.OP_SAV))
-    new_bak = jnp.where(saves_bak, acc, state.bak)
-    new_bak_hi = jnp.where(saves_bak, acc_hi, state.bak_hi)
-
     # --- port updates: phase-A consumes cleared, winning sends fill --------
-    flat_full = port_full_after_reads.reshape(n_dests)
+    flat_full = d.port_full_after_reads.reshape(n_dests)
     new_port_full = (flat_full | delivered).reshape(n_lanes, n_ports)
     new_port_val = jnp.where(delivered, deliver_val, state.port_val.reshape(n_dests)).reshape(
         n_lanes, n_ports
     )
 
-    # --- stack updates -----------------------------------------------------
-    stack_ids = jnp.arange(n_stacks)
-    push_slot = jnp.clip(state.stack_top, 0, stack_cap - 1)
-    cur_slot_val = state.stack_mem[stack_ids, push_slot]
-    new_stack_mem = state.stack_mem.at[stack_ids, push_slot].set(
-        jnp.where(push_per_stack, push_val, cur_slot_val)
+    # --- commit-time register/PC + stack/ring writes (shared) --------------
+    updates = commit_lane_state(d, prog_len, state, commit, pop_val_lane, in_val)
+    updates.update(
+        apply_stack_ring_updates(
+            state, push_per_stack, pop_per_stack, push_val, in_any, out_any, out_val
+        )
     )
-    new_stack_top = (
-        state.stack_top + push_per_stack.astype(_I32) - pop_per_stack.astype(_I32)
-    )
-
-    # --- I/O ring updates --------------------------------------------------
-    new_in_rd = state.in_rd + in_any.astype(_I32)
-    out_slot = state.out_wr % out_cap
-    new_out_buf = state.out_buf.at[out_slot].set(
-        jnp.where(out_any, out_val, state.out_buf[out_slot])
-    )
-    new_out_wr = state.out_wr + out_any.astype(_I32)
-
-    # --- PC update ---------------------------------------------------------
-    # conditions evaluate the FULL 64-bit acc (Go compares the int, not a
-    # truncation, program.go:300-340)
-    jump_taken = (
-        (op == isa.OP_JMP)
-        | ((op == isa.OP_JEZ) & regs64.is_zero(acc_hi, acc))
-        | ((op == isa.OP_JNZ) & ~regs64.is_zero(acc_hi, acc))
-        | ((op == isa.OP_JGZ) & regs64.is_pos(acc_hi, acc))
-        | ((op == isa.OP_JLZ) & regs64.is_neg(acc_hi, acc))
-    )
-    pc_inc = (state.pc + 1) % prog_len                       # program.go:429
-    pc_jro = regs64.jro_target(state.pc, src_hi, src_val, prog_len)  # :354
-    new_pc = jnp.where(jump_taken, jmp, jnp.where(op == isa.OP_JRO, pc_jro, pc_inc))
-    new_pc = jnp.where(commit, new_pc, state.pc)
-
-    return NetworkState(
-        acc=new_acc,
-        bak=new_bak,
-        acc_hi=new_acc_hi,
-        bak_hi=new_bak_hi,
-        pc=new_pc,
+    return state._replace(
         port_val=new_port_val,
         port_full=new_port_full,
-        hold_val=hold_val,
-        holding=holding & ~commit,
-        stack_mem=new_stack_mem,
-        stack_top=new_stack_top,
-        in_buf=state.in_buf,
-        in_rd=new_in_rd,
-        in_wr=state.in_wr,
-        out_buf=new_out_buf,
-        out_rd=state.out_rd,
-        out_wr=new_out_wr,
         tick=state.tick + 1,
         retired=state.retired + commit.astype(_I32),
+        **updates,
     )

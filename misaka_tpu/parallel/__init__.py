@@ -8,6 +8,7 @@ from misaka_tpu.parallel.mesh import (
     state_specs,
 )
 from misaka_tpu.parallel.sharded import make_sharded_runner, step_local
+from misaka_tpu.parallel.routed import build_route_table, make_routed_runner
 from misaka_tpu.parallel.multihost import (
     hybrid_mesh,
     initialize_from_env,
@@ -22,6 +23,8 @@ __all__ = [
     "shard_state",
     "state_specs",
     "make_sharded_runner",
+    "make_routed_runner",
+    "build_route_table",
     "step_local",
     "hybrid_mesh",
     "initialize_from_env",

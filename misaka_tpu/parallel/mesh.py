@@ -16,11 +16,13 @@ by having every shard apply the identical (collectively agreed) update.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from misaka_tpu.core.state import NetworkState
+from misaka_tpu.core.state import NetworkState, rebase_rings
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -65,3 +67,51 @@ def shard_state(state: NetworkState, mesh: Mesh, batched: bool = True) -> Networ
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), state, specs
     )
+
+
+def build_lane_sharded_runner(step1, code, prog_len, mesh, num_steps: int,
+                              batched: bool = True):
+    """Shared scaffolding for the lane-sharded chunk runners.
+
+    `step1(code_local, prog_len_local, state) -> state` is one per-shard
+    superstep (an unbatched single instance); this wraps it in the scan
+    chunk, vmaps the batch axis, shard_maps over the mesh with the canonical
+    state specs, places the code tables, and jits with donated state.  Both
+    multi-chip kernels (parallel/sharded.py, parallel/routed.py) differ only
+    in `step1` — everything else lives here, once.
+    """
+    n_total = code.shape[0]
+    mp = mesh.shape[MODEL_AXIS]
+    if n_total % mp:
+        raise ValueError(f"{n_total} lanes not divisible by model axis size {mp}")
+
+    specs = state_specs(batched)
+    step_fn = step1 if not batched else jax.vmap(step1, in_axes=(None, None, 0))
+
+    def chunk(code_l, prog_len_l, state):
+        def body(s, _):
+            return step_fn(code_l, prog_len_l, s), None
+
+        out, _ = jax.lax.scan(body, state, None, length=num_steps)
+        return rebase_rings(out)
+
+    sharded = jax.shard_map(
+        chunk,
+        mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None, None), P(MODEL_AXIS), specs),
+        out_specs=specs,
+        check_vma=False,
+    )
+
+    # make_array_from_callback (not device_put): each process contributes only
+    # the table shards its local devices own, so the same path works on a
+    # single host and across a multi-host DCN mesh (parallel/multihost.py).
+    def _put(arr, spec):
+        arr = np.asarray(arr, dtype=np.int32)
+        return jax.make_array_from_callback(
+            arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx]
+        )
+
+    code_sh = _put(code, P(MODEL_AXIS, None, None))
+    len_sh = _put(prog_len, P(MODEL_AXIS))
+    return jax.jit(functools.partial(sharded, code_sh, len_sh), donate_argnums=(0,))

@@ -1,4 +1,10 @@
-"""Lane-sharded superstep: shard_map + explicit XLA collectives over ICI.
+"""Lane-sharded superstep, first generation: per-tick occupancy all_gather.
+
+NOTE: this kernel is no longer the default model-parallel engine — the
+statically-routed two-collective kernel (parallel/routed.py) replaced it
+after it measured 0.73x single-chip speed at mp=8 (BENCH_sharded r3).  It
+stays servable behind MasterNode(engine="gather") as the A/B baseline the
+routed design is benched against.
 
 This is the multi-chip version of core/step.py.  Each shard owns a contiguous
 slice of program-node lanes (their registers, ports, hold latches, and code);
@@ -31,12 +37,14 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
-from misaka_tpu.core import regs64
-from misaka_tpu.core.state import NetworkState, rebase_rings
-from misaka_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, state_specs
+from misaka_tpu.core.phases import (
+    apply_stack_ring_updates,
+    commit_lane_state,
+    decode_and_consume,
+)
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.parallel.mesh import MODEL_AXIS, build_lane_sharded_runner
 from misaka_tpu.tis import isa
 
 _I32 = jnp.int32
@@ -81,50 +89,18 @@ def step_local(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState,
     out_cap = state.out_buf.shape[0]
     shard = jax.lax.axis_index(MODEL_AXIS)
     lane_offset = shard * n_local
-    lane_l = jnp.arange(n_local)
-    lane_global = lane_offset + lane_l
+    lane_global = lane_offset + jnp.arange(n_local)
 
-    # --- fetch & decode (local) -------------------------------------------
-    fields = code[lane_l, state.pc]
-    op = fields[:, isa.F_OP]
-    src = fields[:, isa.F_SRC]
-    imm = fields[:, isa.F_IMM]
-    dst = fields[:, isa.F_DST]
-    tgt = fields[:, isa.F_TGT]
-    tport = fields[:, isa.F_PORT]
-    jmp = fields[:, isa.F_JMP]
-
-    # --- phase A: consume ready port sources into the hold latch (local) ---
-    is_port_src = src >= isa.SRC_R0
-    pidx = jnp.clip(src - isa.SRC_R0, 0, n_ports - 1)
-    port_v = state.port_val[lane_l, pidx]
-    port_f = state.port_full[lane_l, pidx]
-    reads_src = jnp.isin(op, jnp.asarray(isa.READS_SRC, dtype=_I32))
-    reads_port = reads_src & is_port_src
-    consume_now = reads_port & ~state.holding & port_f
-    holding = state.holding | consume_now
-    hold_val = jnp.where(consume_now, port_v, state.hold_val)
-    src_val = jnp.where(
-        src == isa.SRC_IMM,
-        imm,
-        jnp.where(
-            src == isa.SRC_ACC,
-            state.acc,
-            jnp.where(src == isa.SRC_NIL, jnp.zeros_like(imm), hold_val),
-        ),
-    )
-    # 64-bit source view (core/regs64.py): src_val stays the wire word
-    src_hi = jnp.where(src == isa.SRC_ACC, state.acc_hi, regs64.sext(src_val))
-    src_ok = ~reads_port | holding
-
-    consume_onehot = consume_now[:, None] & (pidx[:, None] == jnp.arange(n_ports)[None, :])
-    port_full_after_reads = state.port_full & ~consume_onehot
+    # --- fetch & decode + phase A (shared: core/phases.py) -----------------
+    d = decode_and_consume(code, state)
+    op, src_ok, src_val, tgt = d.op, d.src_ok, d.src_val, d.tgt
+    port_full_after_reads = d.port_full_after_reads
 
     # --- phase B: sends — the collective routing fabric --------------------
     # Senders need every shard's occupancy: all_gather [mp, Nl, 4] -> [D].
     global_full = jax.lax.all_gather(port_full_after_reads, MODEL_AXIS).reshape(n_dests)
     want_send = (op == isa.OP_MOV_NET) & src_ok
-    dest = tgt * n_ports + tport
+    dest = tgt * n_ports + d.tport
     dest_onehot = want_send[:, None] & (dest[:, None] == jnp.arange(n_dests)[None, :])
     contender = dest_onehot & ~global_full[None, :]
     send_key, send_win = _elect(contender, lane_global)
@@ -174,7 +150,7 @@ def step_local(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState,
     out_any = out_key[0] < _BIG
     out_val = _winner_val(out_win_m, src_val)[0]
 
-    # --- commit + local register/pc updates --------------------------------
+    # --- commit decision ---------------------------------------------------
     dst_ok = jnp.where(
         op == isa.OP_MOV_NET,
         send_won,
@@ -186,68 +162,19 @@ def step_local(code: jnp.ndarray, prog_len: jnp.ndarray, state: NetworkState,
     )
     commit = src_ok & dst_ok
 
-    # 64-bit (hi, lo) register arithmetic — identical discipline to
-    # core/step.py; see core/regs64.py
-    incoming = jnp.where(is_pop, pop_val_lane, jnp.where(op == isa.OP_IN, in_val, src_val))
-    incoming_hi = jnp.where(op == isa.OP_MOV_LOCAL, src_hi, regs64.sext(incoming))
-    writes_acc = ((op == isa.OP_MOV_LOCAL) | is_pop | (op == isa.OP_IN)) & (dst == isa.DST_ACC)
-    acc = state.acc
-    acc_hi = state.acc_hi
-    add_hi, add_lo = regs64.add64(acc_hi, acc, src_hi, src_val)
-    sub_hi, sub_lo = regs64.sub64(acc_hi, acc, src_hi, src_val)
-    neg_hi, neg_lo = regs64.neg64(acc_hi, acc)
-    new_acc = jnp.where(commit & writes_acc, incoming, acc)
-    new_acc_hi = jnp.where(commit & writes_acc, incoming_hi, acc_hi)
-    new_acc = jnp.where(commit & (op == isa.OP_ADD), add_lo, new_acc)
-    new_acc_hi = jnp.where(commit & (op == isa.OP_ADD), add_hi, new_acc_hi)
-    new_acc = jnp.where(commit & (op == isa.OP_SUB), sub_lo, new_acc)
-    new_acc_hi = jnp.where(commit & (op == isa.OP_SUB), sub_hi, new_acc_hi)
-    new_acc = jnp.where(commit & (op == isa.OP_NEG), neg_lo, new_acc)
-    new_acc_hi = jnp.where(commit & (op == isa.OP_NEG), neg_hi, new_acc_hi)
-    new_acc = jnp.where(commit & (op == isa.OP_SWP), state.bak, new_acc)
-    new_acc_hi = jnp.where(commit & (op == isa.OP_SWP), state.bak_hi, new_acc_hi)
-    saves_bak = commit & ((op == isa.OP_SWP) | (op == isa.OP_SAV))
-    new_bak = jnp.where(saves_bak, acc, state.bak)
-    new_bak_hi = jnp.where(saves_bak, acc_hi, state.bak_hi)
-
-    # --- replicated stack/ring updates (identical on every shard) ----------
-    stack_ids = jnp.arange(n_stacks)
-    push_slot = jnp.clip(state.stack_top, 0, stack_cap - 1)
-    cur_slot_val = state.stack_mem[stack_ids, push_slot]
-    new_stack_mem = state.stack_mem.at[stack_ids, push_slot].set(
-        jnp.where(push_per_stack, push_val, cur_slot_val)
+    # --- commit-time register/PC + stack/ring writes (shared) --------------
+    updates = commit_lane_state(d, prog_len, state, commit, pop_val_lane, in_val)
+    updates.update(
+        apply_stack_ring_updates(
+            state, push_per_stack, pop_per_stack, push_val, in_any, out_any, out_val
+        )
     )
-    new_stack_top = state.stack_top + push_per_stack.astype(_I32) - pop_per_stack.astype(_I32)
-
-    new_in_rd = state.in_rd + in_any.astype(_I32)
-    out_slot = state.out_wr % out_cap
-    new_out_buf = state.out_buf.at[out_slot].set(
-        jnp.where(out_any, out_val, state.out_buf[out_slot])
-    )
-    new_out_wr = state.out_wr + out_any.astype(_I32)
-
-    jump_taken = (
-        (op == isa.OP_JMP)
-        | ((op == isa.OP_JEZ) & regs64.is_zero(acc_hi, acc))
-        | ((op == isa.OP_JNZ) & ~regs64.is_zero(acc_hi, acc))
-        | ((op == isa.OP_JGZ) & regs64.is_pos(acc_hi, acc))
-        | ((op == isa.OP_JLZ) & regs64.is_neg(acc_hi, acc))
-    )
-    pc_inc = (state.pc + 1) % prog_len
-    pc_jro = regs64.jro_target(state.pc, src_hi, src_val, prog_len)
-    new_pc = jnp.where(jump_taken, jmp, jnp.where(op == isa.OP_JRO, pc_jro, pc_inc))
-    new_pc = jnp.where(commit, new_pc, state.pc)
-
-    return NetworkState(
-        acc=new_acc, bak=new_bak, acc_hi=new_acc_hi, bak_hi=new_bak_hi,
-        pc=new_pc,
-        port_val=new_port_val, port_full=new_port_full,
-        hold_val=hold_val, holding=holding & ~commit,
-        stack_mem=new_stack_mem, stack_top=new_stack_top,
-        in_buf=state.in_buf, in_rd=new_in_rd, in_wr=state.in_wr,
-        out_buf=new_out_buf, out_rd=state.out_rd, out_wr=new_out_wr,
+    return state._replace(
+        port_val=new_port_val,
+        port_full=new_port_full,
         tick=state.tick + 1,
         retired=state.retired + commit.astype(_I32),
+        **updates,
     )
 
 
@@ -257,41 +184,5 @@ def make_sharded_runner(code, prog_len, mesh, num_steps: int, batched: bool = Tr
     code [N,L,F] / prog_len [N] are sharded over `model`; the state follows
     mesh.state_specs.  N must divide evenly by the mesh's model-axis size.
     """
-    n_total = code.shape[0]
-    mp = mesh.shape[MODEL_AXIS]
-    if n_total % mp:
-        raise ValueError(f"{n_total} lanes not divisible by model axis size {mp}")
-
-    specs = state_specs(batched)
-    step1 = functools.partial(step_local, n_total_lanes=n_total)
-
-    def chunk(code_l, prog_len_l, state):
-        step_fn = step1 if not batched else jax.vmap(step1, in_axes=(None, None, 0))
-
-        def body(s, _):
-            return step_fn(code_l, prog_len_l, s), None
-
-        out, _ = jax.lax.scan(body, state, None, length=num_steps)
-        return rebase_rings(out)
-
-    sharded = shard_map(
-        chunk,
-        mesh=mesh,
-        in_specs=(P(MODEL_AXIS, None, None), P(MODEL_AXIS), specs),
-        out_specs=specs,
-        check_vma=False,
-    )
-
-    # make_array_from_callback (not device_put): each process contributes only
-    # the table shards its local devices own, so the same path works on a
-    # single host and across a multi-host DCN mesh (parallel/multihost.py).
-    def _put(arr, spec):
-        arr = np.asarray(arr, dtype=np.int32)
-        return jax.make_array_from_callback(
-            arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx]
-        )
-
-    code_sh = _put(code, P(MODEL_AXIS, None, None))
-    len_sh = _put(prog_len, P(MODEL_AXIS))
-    jitted = jax.jit(functools.partial(sharded, code_sh, len_sh), donate_argnums=(0,))
-    return jitted
+    step1 = functools.partial(step_local, n_total_lanes=code.shape[0])
+    return build_lane_sharded_runner(step1, code, prog_len, mesh, num_steps, batched)

@@ -84,7 +84,11 @@ class MasterNode:
           * "scan"  — always the XLA scan engine;
           * "fused" — require the fused kernel (raise when it can't serve);
           * "fused-interpret" — fused kernel in Pallas interpret mode (slow;
-                      CI coverage of the fused serving path off-TPU).
+                      CI coverage of the fused serving path off-TPU);
+          * "gather" — (model-parallel only) the first-generation sharded
+                      kernel (parallel/sharded.py, per-tick occupancy
+                      all_gather); kept for A/B measurement against the
+                      default statically-routed kernel (parallel/routed.py).
 
         trace_cap with batch traces instance `trace_instance` (instances are
         independent, so its history is exact); tracing always runs the scan
@@ -96,16 +100,22 @@ class MasterNode:
           * data   — the batch axis shards over D chips: D independent
                      engine replicas in one jit, zero cross-chip traffic;
           * model  — program-node lanes shard over M chips; inter-lane MOV /
-                     stack / ring traffic rides ICI collectives
-                     (parallel/sharded.py).
+                     stack / ring traffic rides ICI collectives.  The default
+                     kernel is the statically-routed two-collective one
+                     (parallel/routed.py); engine="gather" selects the
+                     first-generation occupancy-gather kernel
+                     (parallel/sharded.py) for A/B comparison.
         Tracing is single-chip-only (the debug path).
         """
         if batch is not None and batch < 1:
             raise ValueError(f"batch must be >= 1, got {batch}")
-        if engine not in ("auto", "scan", "fused", "fused-interpret"):
+        if engine not in ("auto", "scan", "fused", "fused-interpret", "gather"):
             raise ValueError(
-                f"engine must be auto|scan|fused|fused-interpret, got {engine!r}"
+                f"engine must be auto|scan|fused|fused-interpret|gather, "
+                f"got {engine!r}"
             )
+        if engine == "gather" and not (model_parallel and model_parallel > 1):
+            raise ValueError("engine='gather' requires model_parallel > 1")
         if trace_cap and not (0 <= trace_instance < (batch or 1)):
             raise ValueError(
                 f"trace_instance {trace_instance} out of range [0, {batch or 1})"
@@ -235,16 +245,25 @@ class MasterNode:
         """
         eng = self._engine
         if self._mp > 1:
-            # Lane-sharded serving: the shard_map + ICI-collectives engine is
-            # THE model-parallel path (parallel/sharded.py).
+            # Lane-sharded serving: the statically-routed two-collective
+            # kernel (parallel/routed.py) is THE model-parallel path;
+            # engine="gather" selects the first-generation occupancy-gather
+            # kernel (parallel/sharded.py) for A/B measurement.
             if eng in ("fused", "fused-interpret"):
                 raise ValueError(
-                    "model-parallel serving uses the sharded engine "
-                    "(engine='auto' or 'scan')"
+                    "model-parallel serving uses the routed engine "
+                    "(engine='auto', 'scan', or 'gather')"
                 )
-            from misaka_tpu.parallel.sharded import make_sharded_runner
+            if eng == "gather":
+                from misaka_tpu.parallel.sharded import make_sharded_runner
 
-            return make_sharded_runner(
+                return make_sharded_runner(
+                    net.code, net.prog_len, self._mesh,
+                    num_steps=self._chunk, batched=True,
+                )
+            from misaka_tpu.parallel.routed import make_routed_runner
+
+            return make_routed_runner(
                 net.code, net.prog_len, self._mesh, num_steps=self._chunk,
                 batched=True,
             )
@@ -333,7 +352,7 @@ class MasterNode:
     @property
     def engine_name(self) -> str:
         if self._mp > 1:
-            return "sharded"
+            return "gather" if self._engine == "gather" else "routed"
         if self._runner is not None:
             return "fused"
         return "scan-traced" if self._trace_cap else "scan"

@@ -41,11 +41,30 @@ def test_model_parallel_serving_parity():
         batch=2,
         model_parallel=8,
     )
-    assert master.engine_name == "sharded"
+    assert master.engine_name == "routed"
     assert master.status()["mesh"] == {"data": 1, "model": 8}
     master.run()
     try:
         for v in (0, 5, -3, 100):
+            assert master.compute(v, timeout=60) == v + 4
+    finally:
+        master.pause()
+
+
+def test_model_parallel_gather_engine_parity():
+    # The first-generation occupancy-gather kernel stays servable behind
+    # engine="gather" (A/B surface for the routed-vs-gather bench).
+    master = MasterNode(
+        networks.mesh8(in_cap=8, out_cap=8, stack_cap=8),
+        chunk_steps=64,
+        batch=2,
+        model_parallel=8,
+        engine="gather",
+    )
+    assert master.engine_name == "gather"
+    master.run()
+    try:
+        for v in (0, 5, -3):
             assert master.compute(v, timeout=60) == v + 4
     finally:
         master.pause()

@@ -1,7 +1,9 @@
 """Multi-chip sharding tests on the 8-device virtual CPU mesh.
 
-The gold standard: the lane-sharded shard_map kernel (explicit all_gather/
-pmin/psum collectives over ICI) must produce BIT-IDENTICAL state to the
+The gold standard: BOTH lane-sharded shard_map kernels — the
+first-generation occupancy-gather kernel (parallel/sharded.py) and the
+statically-routed two-collective kernel (parallel/routed.py, the default
+model-parallel engine) — must produce BIT-IDENTICAL state to the
 single-chip kernel for any program, any mesh factorization.
 """
 
@@ -10,7 +12,19 @@ import pytest
 import jax
 
 from misaka_tpu import networks
-from misaka_tpu.parallel import make_mesh, make_sharded_runner, shard_state
+from misaka_tpu.parallel import (
+    make_mesh,
+    make_routed_runner,
+    make_sharded_runner,
+    shard_state,
+)
+
+FACTORIES = {"gather": make_sharded_runner, "routed": make_routed_runner}
+
+
+@pytest.fixture(params=sorted(FACTORIES))
+def make_runner(request):
+    return FACTORIES[request.param]
 
 
 def assert_states_equal(a, b):
@@ -22,7 +36,7 @@ def assert_states_equal(a, b):
         )
 
 
-def run_both(topology, mp, dp, batch, steps, seed=0):
+def run_both(make_runner, topology, mp, dp, batch, steps, seed=0):
     net = topology.compile(batch=batch)
     rng = np.random.default_rng(seed)
     vals = rng.integers(-100, 100, size=(batch, 4)).astype(np.int32)
@@ -34,37 +48,85 @@ def run_both(topology, mp, dp, batch, steps, seed=0):
 
     ref = net.run(prep(net.init_state()), steps)
     mesh = make_mesh(mp * dp, model_parallel=mp)
-    runner = make_sharded_runner(net.code, net.prog_len, mesh, num_steps=steps)
+    runner = make_runner(net.code, net.prog_len, mesh, num_steps=steps)
     sharded = runner(shard_state(prep(net.init_state()), mesh))
     return ref, sharded
 
 
-def test_mesh8_dp2_mp4_bit_identical():
-    ref, sharded = run_both(networks.mesh8(in_cap=8, out_cap=8), mp=4, dp=2, batch=4, steps=60)
+def test_mesh8_dp2_mp4_bit_identical(make_runner):
+    ref, sharded = run_both(
+        make_runner, networks.mesh8(in_cap=8, out_cap=8), mp=4, dp=2, batch=4, steps=60
+    )
     assert_states_equal(ref, sharded)
     assert int(np.asarray(sharded.out_wr).sum()) > 0  # it actually computed
 
 
-def test_mesh8_mp8_pure_lane_parallel():
-    ref, sharded = run_both(networks.mesh8(in_cap=8, out_cap=8), mp=8, dp=1, batch=2, steps=60)
+def test_mesh8_mp8_pure_lane_parallel(make_runner):
+    ref, sharded = run_both(
+        make_runner, networks.mesh8(in_cap=8, out_cap=8), mp=8, dp=1, batch=2, steps=60
+    )
     assert_states_equal(ref, sharded)
 
 
-def test_add2_mp2_bit_identical():
-    ref, sharded = run_both(networks.add2(in_cap=8, out_cap=8), mp=2, dp=4, batch=8, steps=80)
+def test_add2_mp2_bit_identical(make_runner):
+    ref, sharded = run_both(
+        make_runner, networks.add2(in_cap=8, out_cap=8), mp=2, dp=4, batch=8, steps=80
+    )
     assert_states_equal(ref, sharded)
     # every instance finished all 4 values: out_wr == 4 across the batch
     np.testing.assert_array_equal(np.asarray(sharded.out_wr), 4)
 
 
-def test_ring8_mp4_bit_identical():
-    ref, sharded = run_both(networks.ring(8, in_cap=8, out_cap=8), mp=4, dp=2, batch=4, steps=100)
+def test_ring8_mp4_bit_identical(make_runner):
+    ref, sharded = run_both(
+        make_runner, networks.ring(8, in_cap=8, out_cap=8), mp=4, dp=2, batch=4, steps=100
+    )
     assert_states_equal(ref, sharded)
 
 
-def test_dp_only_sharding():
+def test_dp_only_sharding(make_runner):
     # Pure data parallelism: mp=1, the whole lane axis on every shard.
-    ref, sharded = run_both(networks.add2(in_cap=8, out_cap=8), mp=1, dp=8, batch=8, steps=60)
+    ref, sharded = run_both(
+        make_runner, networks.add2(in_cap=8, out_cap=8), mp=1, dp=8, batch=8, steps=60
+    )
+    assert_states_equal(ref, sharded)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzzed_programs_bit_identical(make_runner, seed):
+    """Random TIS programs (every opcode, self-sends, stacks, jumps) through
+    the sharded kernels vs the single-chip engine — the same generator the
+    oracle differential uses, now crossing shard boundaries (mp=4)."""
+    from misaka_tpu.core import CompiledNetwork
+    from misaka_tpu.tis.lower import lower_program, pad_programs
+    from tests.test_differential import random_program
+
+    rng = np.random.default_rng(7000 + seed)
+    n_lanes, n_stacks = 4, int(rng.integers(0, 3))
+    lane_names = [f"n{i}" for i in range(n_lanes)]
+    stack_names = [f"s{i}" for i in range(n_stacks)]
+    lane_ids = {name: i for i, name in enumerate(lane_names)}
+    stack_ids = {name: i for i, name in enumerate(stack_names)}
+    programs = [
+        random_program(rng, lane_names, stack_names, int(rng.integers(1, 9)))
+        for _ in lane_names
+    ]
+    code, lengths = pad_programs([lower_program(p, lane_ids, stack_ids) for p in programs])
+    net = CompiledNetwork(
+        code=code, prog_len=lengths, num_stacks=max(1, n_stacks),
+        stack_cap=4, in_cap=8, out_cap=8, batch=2,
+    )
+    vals = rng.integers(-100, 100, size=(2, 6)).astype(np.int32)
+
+    def prep(state):
+        return state._replace(
+            in_buf=state.in_buf.at[:, :6].set(vals), in_wr=state.in_wr + 6
+        )
+
+    ref = net.run(prep(net.init_state()), 48)
+    mesh = make_mesh(8, model_parallel=4)
+    runner = make_runner(net.code, net.prog_len, mesh, num_steps=48)
+    sharded = runner(shard_state(prep(net.init_state()), mesh))
     assert_states_equal(ref, sharded)
 
 
@@ -73,22 +135,42 @@ def test_make_mesh_validates_divisibility():
         make_mesh(8, model_parallel=3)
 
 
-def test_lane_count_must_divide_model_axis():
+def test_lane_count_must_divide_model_axis(make_runner):
     net = networks.add2().compile()  # 2 lanes
     mesh = make_mesh(8, model_parallel=4)
     with pytest.raises(ValueError, match="not divisible"):
-        make_sharded_runner(net.code, net.prog_len, mesh, num_steps=4)
+        make_runner(net.code, net.prog_len, mesh, num_steps=4)
 
 
-def test_collectives_actually_cross_shards():
+def test_collectives_actually_cross_shards(make_runner):
     # Sanity: on mp=4, a value injected at lane a0 (shard 0) arrives at lane
     # a3 (shard 3) — the routing genuinely crosses shard boundaries.
     top = networks.mesh8(in_cap=8, out_cap=8)
     net = top.compile(batch=1)
     mesh = make_mesh(4, model_parallel=4)
-    runner = make_sharded_runner(net.code, net.prog_len, mesh, num_steps=40)
+    runner = make_runner(net.code, net.prog_len, mesh, num_steps=40)
     state = net.init_state()
     state = state._replace(in_buf=state.in_buf.at[:, 0].set(50), in_wr=state.in_wr + 1)
     out = runner(shard_state(state, mesh))
     assert int(np.asarray(out.out_wr)[0]) == 1
     assert int(np.asarray(out.out_buf)[0, 0]) == 54
+
+
+def test_route_table_compactness():
+    # The whole point of the routed kernel: election traffic scales with the
+    # ACTIVE edge set, not the full lane x port dest axis.
+    from misaka_tpu.parallel import build_route_table
+
+    net = networks.mesh8(in_cap=8, out_cap=8).compile()
+    route = build_route_table(net.code, net.prog_len)
+    n_dests = net.num_lanes * 4
+    assert 0 < route.n_send < n_dests
+    # every active slot is a real (lane, port) named by some MOV_NET instr
+    assert route.slot_lane.shape == (route.n_send,)
+    assert (route.slot_lane >= 0).all() and (route.slot_lane < net.num_lanes).all()
+    assert (route.slot_port >= 0).all() and (route.slot_port < 4).all()
+    # dest_to_slot inverts the slot arrays
+    full = route.slot_lane * 4 + route.slot_port
+    np.testing.assert_array_equal(
+        route.dest_to_slot[full], np.arange(route.n_send, dtype=np.int32)
+    )
