@@ -51,6 +51,18 @@ test:
 bench:
 	python bench.py
 
+# Replay the committed parity corpus (tests/corpus/parity/) against the
+# ACTUAL Go reference binary via its own Dockerfile — the SURVEY.md §4
+# check.  Skips cleanly (exit 0) where Docker is unavailable (here); the
+# corpus's engine side is re-verified by tests/test_parity_corpus.py.
+parity-go:
+	python tools/parity_go.py
+
+# Regenerate the parity corpus (rewrites tests/corpus/parity/*.json with
+# freshly recorded engine outputs; commit the result).
+parity-corpus:
+	python tools/gen_parity_corpus.py
+
 # Kill any straggling misaka servers/benches.  The attached TPU relay admits
 # one client: a leaked server wedges every later jax.devices() call
 # (VERDICT r3 weak #1).  runtime/lifecycle.py makes leaks hard to create;
@@ -65,4 +77,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-tpu bench stop clean
+.PHONY: native grpc cert test test-tpu bench parity-go parity-corpus stop clean

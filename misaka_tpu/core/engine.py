@@ -23,28 +23,59 @@ from misaka_tpu.core.step import step
 
 _I32 = jnp.int32
 
+# Lane count at/above which the compact scatter-election kernel
+# (core/routing.py) replaces the dense one-hot kernel (core/step.py) as the
+# auto-selected scan engine.  The dense kernel's election matrices are
+# O(N·4N) per tick — fine for reference-scale networks (2-10 lanes), slow at
+# 64 and enough to fault the TPU worker at 256 lanes under production
+# batches; the compact kernel is O(N + active-dests).  Measured crossover on
+# both CPU and TPU sits between 8 and 64 lanes (bench.py lane_scaling).
+COMPACT_AUTO_LANES = 32
 
-@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
-def _run_chunk(tables, state: NetworkState, num_steps: int) -> NetworkState:
-    code, prog_len = tables
+
+def _chunk_body(step_fn, tables, state: NetworkState, num_steps: int,
+                batched: bool) -> NetworkState:
+    """`num_steps` ticks of `step_fn` under lax.scan (+ vmap when batched).
+
+    The one copy of the chunk contract, shared by the dense/compact jits
+    below and the per-network compact closures."""
+    fn = step_fn if not batched else jax.vmap(step_fn, in_axes=(None, None, 0))
 
     def body(s, _):
-        return step(code, prog_len, s), None
+        return fn(tables[0], tables[1], s), None
 
     out, _ = jax.lax.scan(body, state, None, length=num_steps)
     return rebase_rings(out)
+
+
+def _serve_body(step_fn, tables, state: NetworkState, values, count,
+                num_steps: int):
+    """Feed + run + counter/output snapshot + drain: the ONE copy of the
+    one-dispatch serve contract (see serve_chunk).  `packed` layout
+    [in_rd, in_wr, out_rd, out_wr, out_buf...] is parsed by the device
+    loop's p[:4]/p[4:]; keep them in lockstep."""
+    in_cap = state.in_buf.shape[0]
+    k = values.shape[0]
+    idx = (state.in_wr + jnp.arange(k, dtype=_I32)) % in_cap
+    mask = jnp.arange(k) < count
+    new_buf = state.in_buf.at[idx].set(jnp.where(mask, values, state.in_buf[idx]))
+    state = state._replace(in_buf=new_buf, in_wr=state.in_wr + count.astype(_I32))
+    state = _chunk_body(step_fn, tables, state, num_steps, batched=False)
+    packed = jnp.concatenate([
+        jnp.stack([state.in_rd, state.in_wr, state.out_rd, state.out_wr]),
+        state.out_buf,
+    ])
+    return state._replace(out_rd=state.out_wr), packed
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+def _run_chunk(tables, state: NetworkState, num_steps: int) -> NetworkState:
+    return _chunk_body(step, tables, state, num_steps, batched=False)
 
 
 @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
 def _run_chunk_batched(tables, state: NetworkState, num_steps: int) -> NetworkState:
-    code, prog_len = tables
-    step_b = jax.vmap(step, in_axes=(None, None, 0))
-
-    def body(s, _):
-        return step_b(code, prog_len, s), None
-
-    out, _ = jax.lax.scan(body, state, None, length=num_steps)
-    return rebase_rings(out)
+    return _chunk_body(step, tables, state, num_steps, batched=True)
 
 
 @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(1, 2))
@@ -94,24 +125,7 @@ def _serve_chunk(tables, state: NetworkState, values, count, num_steps: int):
     outputs from the snapshot while the device ring is already drained
     (out_rd := out_wr happens on-device, after the snapshot).
     """
-    code, prog_len = tables
-    in_cap = state.in_buf.shape[0]
-    k = values.shape[0]
-    idx = (state.in_wr + jnp.arange(k, dtype=_I32)) % in_cap
-    mask = jnp.arange(k) < count
-    new_buf = state.in_buf.at[idx].set(jnp.where(mask, values, state.in_buf[idx]))
-    state = state._replace(in_buf=new_buf, in_wr=state.in_wr + count.astype(_I32))
-
-    def body(s, _):
-        return step(code, prog_len, s), None
-
-    state, _ = jax.lax.scan(body, state, None, length=num_steps)
-    state = rebase_rings(state)
-    packed = jnp.concatenate([
-        jnp.stack([state.in_rd, state.in_wr, state.out_rd, state.out_wr]),
-        state.out_buf,
-    ])
-    return state._replace(out_rd=state.out_wr), packed
+    return _serve_body(step, tables, state, values, count, num_steps)
 
 
 @jax.jit
@@ -176,6 +190,8 @@ class CompiledNetwork:
     out_cap: int = 1024
     batch: int | None = None
     _tables: tuple = field(init=False, repr=False)
+    _compact_chunk: object = field(init=False, repr=False, default=None)
+    _compact_serve: object = field(init=False, repr=False, default=None)
 
     def __post_init__(self):
         # At least one (possibly phantom) stack keeps kernel shapes nonempty.
@@ -199,8 +215,47 @@ class CompiledNetwork:
             )
         return s
 
-    def run(self, state: NetworkState, num_steps: int) -> NetworkState:
-        """Advance `num_steps` supersteps in one jitted scan (donated state)."""
+    def step_fn(self):
+        """The auto-selected per-tick step function (single instance):
+        dense one-hot below COMPACT_AUTO_LANES lanes, compact scatter
+        elections (core/routing.py) at/above.  Both are bit-identical;
+        only the arbitration data structure differs."""
+        if self.num_lanes < COMPACT_AUTO_LANES:
+            return step
+        return self._compact_step()
+
+    def _compact_step(self):
+        from misaka_tpu.core.routing import build_route_table, step_slots
+
+        route = build_route_table(self.code, self.prog_len)
+        return functools.partial(step_slots, route)
+
+    def run(
+        self, state: NetworkState, num_steps: int, engine: str | None = None
+    ) -> NetworkState:
+        """Advance `num_steps` supersteps in one jitted scan (donated state).
+
+        engine: None auto-selects by lane count (see step_fn); "dense" /
+        "compact" force a kernel (the bench's lane-ceiling probe).
+        """
+        if engine is None:
+            engine = (
+                "compact" if self.num_lanes >= COMPACT_AUTO_LANES else "dense"
+            )
+        if engine == "compact":
+            if self._compact_chunk is None:
+                step1 = self._compact_step()
+                tables = self._tables
+                batched = self.batch is not None
+
+                @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+                def chunk(s, n):
+                    return _chunk_body(step1, tables, s, n, batched)
+
+                self._compact_chunk = chunk
+            return self._compact_chunk(state, num_steps)
+        if engine != "dense":
+            raise ValueError(f"engine must be dense|compact|None, got {engine!r}")
         runner = _run_chunk if self.batch is None else _run_chunk_batched
         return runner(self._tables, state, num_steps)
 
@@ -286,10 +341,11 @@ class CompiledNetwork:
             raise ValueError("make_batched_serve requires a batched network")
         tables = self._tables
 
+        step_b = jax.vmap(self.step_fn(), in_axes=(None, None, 0))
+
         def advance(state):
             if runner is not None:
                 return runner(state)
-            step_b = jax.vmap(step, in_axes=(None, None, 0))
 
             def body(s, _):
                 return step_b(tables[0], tables[1], s), None
@@ -344,9 +400,25 @@ class CompiledNetwork:
         """
         if self.batch is not None:
             raise ValueError("serve_chunk drives a single network instance")
-        return _serve_chunk(
-            self._tables, state, jnp.asarray(values),
-            jnp.asarray(count, _I32), num_steps,
+        if self.num_lanes < COMPACT_AUTO_LANES:
+            return _serve_chunk(
+                self._tables, state, jnp.asarray(values),
+                jnp.asarray(count, _I32), num_steps,
+            )
+        # Wide networks serve through the compact kernel; the route table is
+        # baked into a per-network jitted closure (it is not hashable, so it
+        # cannot ride as a static arg of the module-level jit).
+        if self._compact_serve is None:
+            step1 = self._compact_step()
+            tables = self._tables
+
+            @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+            def serve(state, values, count, num_steps):
+                return _serve_body(step1, tables, state, values, count, num_steps)
+
+            self._compact_serve = serve
+        return self._compact_serve(
+            state, jnp.asarray(values), jnp.asarray(count, _I32), num_steps
         )
 
     # --- host-side I/O (chunk-boundary only) -------------------------------

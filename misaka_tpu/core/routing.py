@@ -1,0 +1,231 @@
+"""Compact-slot routing: the scatter-election superstep kernel.
+
+The dense kernel (core/step.py) arbitrates with one-hot matrices over the
+FULL dest axis — [N, 4N] per tick — which is the right shape when N is a
+handful of lanes but quadratic in the lane count ("arbitrary number of
+program nodes", README.md:10-18; at N=256 the dense matrices are large
+enough to fault the TPU worker at production batch sizes).  A TIS network's
+route table is static: every MOV_NET instruction names its destination
+(lane, port) at assembly time (program.go:242-275).  This kernel exploits
+that: elections run as scatter-min of encoded lane keys into a compact slot
+vector of the `Da` ACTIVE dest slots + one slot per stack + IN + OUT —
+O(N + Da) per tick.
+
+One parameterized function serves two execution modes:
+
+  * `axis=None` — single chip.  The "global" reduction is the local scatter
+    itself; the occupancy veto (key -1 for full ports) replaces the dense
+    kernel's contender exclusion with identical semantics (no winner on a
+    full port either way).
+  * `axis="model"` — lane-sharded multi-chip (parallel/routed.py).  The
+    scatter results are combined across shards with exactly TWO collectives
+    per tick: pmin(keys) — election + occupancy veto in one reduction —
+    and psum(values).
+
+Arbitration, hold latch, and visibility semantics are EXACTLY core/step.py's
+(its module docstring maps each rule to program.go / stack.go / master.go);
+bit-identity is pinned by tests/test_parallel.py, tests/test_scale.py and
+the fuzzed differential suites.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from misaka_tpu.core.phases import (
+    apply_stack_ring_updates,
+    commit_lane_state,
+    decode_and_consume,
+)
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.tis import isa
+
+_I32 = jnp.int32
+# "no contender" sentinel for min-elections (numpy, not jnp: a module-level
+# jnp constant would initialize the XLA backend at import time, breaking
+# jax.distributed.initialize — see parallel/multihost.py).
+BIG = np.int32(2**31 - 1)
+
+
+class RouteTable(NamedTuple):
+    """Static routing metadata extracted from the lowered code tables.
+
+    All arrays are host numpy; they become jit-time constants inside the
+    kernel closure (never traced, never transferred per tick).
+    """
+
+    dest_to_slot: np.ndarray  # [N*4] int32: full dest id -> send slot, or n_send
+    slot_lane: np.ndarray     # [n_send] int32: dest lane of each send slot
+    slot_port: np.ndarray     # [n_send] int32: dest port of each send slot
+    n_send: int               # Da — number of active dest slots
+
+
+def build_route_table(code: np.ndarray, prog_len: np.ndarray) -> RouteTable:
+    """Scan the lowered programs for every MOV_NET destination.
+
+    Only rows below each lane's true length count (pc wraps at prog_len,
+    program.go:429, so padding rows never execute — and they are NOP anyway).
+    """
+    code = np.asarray(code)
+    prog_len = np.asarray(prog_len)
+    n_lanes = code.shape[0]
+    n_ports = isa.NUM_PORTS
+    n_dests = n_lanes * n_ports
+
+    live = np.arange(code.shape[1])[None, :] < prog_len[:, None]  # [N, L]
+    is_send = (code[:, :, isa.F_OP] == isa.OP_MOV_NET) & live
+    dest = code[:, :, isa.F_TGT] * n_ports + code[:, :, isa.F_PORT]
+    active = np.unique(dest[is_send]).astype(np.int32)
+    if active.size and (active.min() < 0 or active.max() >= n_dests):
+        raise ValueError("MOV_NET destination out of range in lowered code")
+
+    dest_to_slot = np.full((n_dests,), active.size, dtype=np.int32)
+    dest_to_slot[active] = np.arange(active.size, dtype=np.int32)
+    return RouteTable(
+        dest_to_slot=dest_to_slot,
+        slot_lane=(active // n_ports).astype(np.int32),
+        slot_port=(active % n_ports).astype(np.int32),
+        n_send=int(active.size),
+    )
+
+
+def step_slots(
+    route: RouteTable,
+    code: jnp.ndarray,
+    prog_len: jnp.ndarray,
+    state: NetworkState,
+    axis: str | None = None,
+    n_total_lanes: int | None = None,
+) -> NetworkState:
+    """One superstep via compact-slot scatter elections (single instance).
+
+    axis=None runs the whole network on one device; axis=<mesh axis name>
+    runs inside shard_map on this shard's lane slice (code/state are the
+    local shards, n_total_lanes the global lane count).
+    """
+    n_local, _, _ = code.shape
+    n_ports = isa.NUM_PORTS
+    if n_total_lanes is None:
+        n_total_lanes = n_local
+    n_dests = n_total_lanes * n_ports
+    n_stacks, stack_cap = state.stack_mem.shape
+    in_cap = state.in_buf.shape[0]
+    out_cap = state.out_buf.shape[0]
+    if axis is None:
+        lane_offset = jnp.asarray(0, _I32)
+    else:
+        lane_offset = jax.lax.axis_index(axis) * n_local
+    lane_global = lane_offset + jnp.arange(n_local)
+
+    # Election-vector slot layout (K live slots + 1 trash):
+    da = route.n_send
+    in_slot = da + n_stacks
+    out_slot = in_slot + 1
+    trash = out_slot + 1
+    kv = trash + 1
+
+    # --- fetch & decode + phase A (shared: core/phases.py) -----------------
+    d = decode_and_consume(code, state)
+    op, src_ok, src_val, tgt = d.op, d.src_ok, d.src_val, d.tgt
+    port_full_after_reads = d.port_full_after_reads
+
+    # --- contender classification (all local) ------------------------------
+    want_send = (op == isa.OP_MOV_NET) & src_ok
+    dest = tgt * n_ports + d.tport
+    send_slot = jnp.asarray(route.dest_to_slot)[jnp.clip(dest, 0, n_dests - 1)]
+
+    is_push = op == isa.OP_PUSH
+    is_pop = op == isa.OP_POP
+    tgt_stack = jnp.clip(tgt, 0, n_stacks - 1)
+    top_at_tgt = state.stack_top[tgt_stack]
+    want_sop = (is_push & src_ok & (top_at_tgt < stack_cap)) | (is_pop & (top_at_tgt > 0))
+
+    in_avail = (state.in_wr - state.in_rd) > 0
+    want_in = (op == isa.OP_IN) & in_avail
+    out_free = (state.out_wr - state.out_rd) < out_cap
+    want_out = (op == isa.OP_OUT) & src_ok & out_free
+
+    slot = jnp.where(
+        want_send,
+        send_slot,
+        jnp.where(
+            want_sop,
+            da + tgt_stack,
+            jnp.where(want_in, in_slot, jnp.where(want_out, out_slot, trash)),
+        ),
+    )
+    contend = want_send | want_sop | want_in | want_out
+    # key = lane*2 + bit: monotone in lane (lowest lane still wins) while
+    # carrying the push/pop discriminator every shard needs for the
+    # replicated stack update.
+    my_key = lane_global * 2 + (want_sop & is_push).astype(_I32)
+
+    # --- election: scatter-min keys (+ pmin across shards) -----------------
+    keys = jnp.full((kv,), BIG, _I32).at[slot].min(jnp.where(contend, my_key, BIG))
+    slot_lane = jnp.asarray(route.slot_lane)
+    slot_port = jnp.asarray(route.slot_port)
+    local_row = slot_lane - lane_offset
+    mine = (local_row >= 0) & (local_row < n_local)
+    occ = port_full_after_reads[jnp.clip(local_row, 0, n_local - 1), slot_port]
+    veto = jnp.where(mine & occ, jnp.asarray(-1, _I32), BIG)
+    keys = keys.at[jnp.arange(da)].min(veto)
+    keys_global = keys if axis is None else jax.lax.pmin(keys, axis)
+
+    gathered = keys_global[slot]
+    won = contend & (gathered == my_key)
+
+    # --- winner values: scatter-add (+ psum across shards) -----------------
+    carries_val = won & (want_send | is_push | want_out)
+    vals = jnp.zeros((kv,), _I32).at[slot].add(jnp.where(carries_val, src_val, 0))
+    vals_global = vals if axis is None else jax.lax.psum(vals, axis)
+
+    # --- port delivery (owner shard applies its own slots) -----------------
+    sk = keys_global[:da]
+    delivered = (sk != BIG) & (sk >= 0)  # a sender won and the port was free
+    row = jnp.where(mine & delivered, jnp.clip(local_row, 0, n_local - 1), n_local)
+    pf_pad = jnp.concatenate(
+        [port_full_after_reads, jnp.zeros((1, n_ports), bool)], axis=0
+    )
+    pv_pad = jnp.concatenate([state.port_val, jnp.zeros((1, n_ports), _I32)], axis=0)
+    new_port_full = pf_pad.at[row, slot_port].set(True)[:n_local]
+    new_port_val = pv_pad.at[row, slot_port].set(vals_global[:da])[:n_local]
+
+    # --- stack agreement (replicated update, identical on every shard) -----
+    skeys = keys_global[da : da + n_stacks]
+    stack_live = skeys != BIG
+    push_per_stack = stack_live & ((skeys & 1) == 1)
+    pop_per_stack = stack_live & ((skeys & 1) == 0)
+    push_val = vals_global[da : da + n_stacks]
+    pop_val_lane = state.stack_mem[tgt_stack, jnp.clip(top_at_tgt - 1, 0, stack_cap - 1)]
+
+    # --- master I/O rings ---------------------------------------------------
+    in_any = keys_global[in_slot] != BIG
+    in_val = state.in_buf[state.in_rd % in_cap]
+    out_any = keys_global[out_slot] != BIG
+    out_val = vals_global[out_slot]
+
+    # --- commit decision ---------------------------------------------------
+    commit = src_ok & jnp.where(
+        (op == isa.OP_MOV_NET) | is_push | is_pop | (op == isa.OP_IN) | (op == isa.OP_OUT),
+        won,
+        True,
+    )
+
+    # --- commit-time register/PC + stack/ring writes (shared) --------------
+    updates = commit_lane_state(d, prog_len, state, commit, pop_val_lane, in_val)
+    updates.update(
+        apply_stack_ring_updates(
+            state, push_per_stack, pop_per_stack, push_val, in_any, out_any, out_val
+        )
+    )
+    return state._replace(
+        port_val=new_port_val,
+        port_full=new_port_full,
+        tick=state.tick + 1,
+        retired=state.retired + commit.astype(_I32),
+        **updates,
+    )

@@ -2,242 +2,53 @@
 
 The first-generation sharded kernel (parallel/sharded.py) reaches global
 agreement with an `all_gather` of the ENTIRE port-occupancy axis plus ~9
-per-tick collectives (four pmin elections, five psum broadcasts), and its
-on-shard election math is dense one-hot over the full [Nl, 4N] dest axis —
-so adding chips subtracted speed (BENCH_sharded r3: ratio 0.73).  None of
-that traffic is necessary: a TIS network's route table is STATIC.  Every
-MOV_NET instruction names its destination (lane, port) at assembly time
-(program.go:242-275), so the set of port slots that can EVER receive a value
-is known before the first tick.  This kernel exploits that three ways:
+per-tick collectives, with dense one-hot election matrices over the full
+[Nl, 4N] dest axis on every shard — so adding chips subtracted speed
+(BENCH_sharded r3: ratio 0.73).  None of that traffic is necessary: a TIS
+network's route table is STATIC — every MOV_NET instruction names its
+destination (lane, port) at assembly time (program.go:242-275).
 
-  1. **Compact slot space.**  Elections run over the `Da` *active* dest
-     slots (those named by some MOV_NET instruction) + one slot per stack +
-     one IN + one OUT slot — not the full `4N` dest axis.  For a pipeline,
-     Da ~ N; for sparse graphs Da << 4N.
-
-  2. **Scatter elections, not one-hot matrices.**  Each lane contends for
-     at most one slot per tick, so lowest-lane arbitration is a scatter-min
-     of encoded keys into a [K] vector — O(Nl) work — instead of the
-     [Nl, 4N] mask-and-cumsum of the gather kernel.
-
-  3. **Occupancy veto folded into the election.**  Senders must not win a
-     FULL port.  Instead of gathering every shard's occupancy, the shard
-     that OWNS a dest slot contributes key `-1` ("vetoed") when the port is
-     full; pmin makes -1 beat every real contender, so fullness and
-     arbitration resolve in the same reduction.
-
-Per tick that leaves exactly TWO collectives, both over a [K] vector with
-K = Da + num_stacks + 2:
+The compact-slot kernel that exploits this lives in core/routing.py (shared
+with the single-chip large-N engine); this module binds it to a mesh axis,
+where agreement costs exactly TWO collectives per tick, both over one
+compact [Da + num_stacks + 2] vector (Da = dest slots actually named by
+some MOV_NET; for a pipeline Da ~ N, for sparse graphs Da << 4N):
 
   pmin(keys)   — election + occupancy veto for sends, stacks, IN, OUT
                  (key = global_lane*2 + is_push keeps lowest-lane order
                  while telling every shard whether a stack winner pushes
-                 or pops)
+                 or pops; the shard OWNING a dest port contributes key -1
+                 when the port is full, so fullness and arbitration
+                 resolve in the same reduction)
   psum(values) — the unique winners' wire values reach the dest shard
                  (sends) and the replicated stack/ring state (push, OUT)
 
 Both ride ICI inside one jitted scan.  Stack memories and master I/O rings
-stay `model`-replicated (a few dozen words; the O(N) traffic the verdict
-flagged was the dest-axis gather, not these), and every shard applies the
-identical collectively-agreed update, so state remains bit-identical to
-core/step.py — pinned by tests/test_parallel.py running both kernels.
+stay `model`-replicated (a few dozen words; what made gen 1 slow was the
+dest-axis gather, not these), and every shard applies the identical
+collectively-agreed update, so state remains bit-identical to
+core/step.py — pinned by tests/test_parallel.py running both generations.
 
-Semantics (arbitration, hold latch, consume-then-send visibility) are
-EXACTLY core/step.py's; see its module docstring for the reference mapping
-(program.go:78-92, :219-432, stack.go:133-155, master.go:233-246).
+Measured on the 8-device virtual mesh (mesh8, mp=8): 1.53x the single-chip
+scan engine and 1.82x the gather kernel — model-parallel as a speed
+feature, not just a capacity feature (docs/ARCHITECTURE.md).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from misaka_tpu.core.phases import (
-    apply_stack_ring_updates,
-    commit_lane_state,
-    decode_and_consume,
-)
-from misaka_tpu.core.state import NetworkState
+from misaka_tpu.core.routing import RouteTable, build_route_table, step_slots
 from misaka_tpu.parallel.mesh import MODEL_AXIS, build_lane_sharded_runner
-from misaka_tpu.tis import isa
 
-_I32 = jnp.int32
-# "no contender" sentinel for pmin elections (numpy, not jnp: a module-level
-# jnp constant would initialize the XLA backend at import time, breaking
-# jax.distributed.initialize — see parallel/multihost.py).
-_BIG = np.int32(2**31 - 1)
+__all__ = ["RouteTable", "build_route_table", "make_routed_runner", "step_local"]
 
 
-class RouteTable(NamedTuple):
-    """Static routing metadata extracted from the lowered code tables.
-
-    All arrays are host numpy; they become jit-time constants inside the
-    kernel closure (never traced, never transferred per tick).
-    """
-
-    dest_to_slot: np.ndarray  # [N*4] int32: full dest id -> send slot, or n_send
-    slot_lane: np.ndarray     # [n_send] int32: dest lane of each send slot
-    slot_port: np.ndarray     # [n_send] int32: dest port of each send slot
-    n_send: int               # Da — number of active dest slots
-
-
-def build_route_table(code: np.ndarray, prog_len: np.ndarray) -> RouteTable:
-    """Scan the lowered programs for every MOV_NET destination.
-
-    Only rows below each lane's true length count (pc wraps at prog_len,
-    program.go:429, so padding rows never execute — and they are NOP anyway).
-    """
-    code = np.asarray(code)
-    prog_len = np.asarray(prog_len)
-    n_lanes = code.shape[0]
-    n_ports = isa.NUM_PORTS
-    n_dests = n_lanes * n_ports
-
-    live = np.arange(code.shape[1])[None, :] < prog_len[:, None]  # [N, L]
-    is_send = (code[:, :, isa.F_OP] == isa.OP_MOV_NET) & live
-    dest = code[:, :, isa.F_TGT] * n_ports + code[:, :, isa.F_PORT]
-    active = np.unique(dest[is_send]).astype(np.int32)
-    if active.size and (active.min() < 0 or active.max() >= n_dests):
-        raise ValueError("MOV_NET destination out of range in lowered code")
-
-    dest_to_slot = np.full((n_dests,), active.size, dtype=np.int32)
-    dest_to_slot[active] = np.arange(active.size, dtype=np.int32)
-    return RouteTable(
-        dest_to_slot=dest_to_slot,
-        slot_lane=(active // n_ports).astype(np.int32),
-        slot_port=(active % n_ports).astype(np.int32),
-        n_send=int(active.size),
-    )
-
-
-def step_local(route: RouteTable, code: jnp.ndarray, prog_len: jnp.ndarray,
-               state: NetworkState, n_total_lanes: int) -> NetworkState:
-    """One superstep on this shard's lane slice (single network instance).
-
-    Phase structure mirrors core/step.py line for line; only the agreement
-    fabric differs (compact-slot pmin/psum instead of dense one-hot).
-    """
-    n_local, _, _ = code.shape
-    n_ports = isa.NUM_PORTS
-    n_dests = n_total_lanes * n_ports
-    n_stacks, stack_cap = state.stack_mem.shape
-    in_cap = state.in_buf.shape[0]
-    out_cap = state.out_buf.shape[0]
-    shard = jax.lax.axis_index(MODEL_AXIS)
-    lane_offset = shard * n_local
-    lane_global = lane_offset + jnp.arange(n_local)
-
-    # Election-vector slot layout (K live slots + 1 trash):
-    da = route.n_send
-    in_slot = da + n_stacks
-    out_slot = in_slot + 1
-    trash = out_slot + 1
-    kv = trash + 1
-
-    # --- fetch & decode + phase A (shared: core/phases.py) -----------------
-    d = decode_and_consume(code, state)
-    op, src_ok, src_val, tgt = d.op, d.src_ok, d.src_val, d.tgt
-    port_full_after_reads = d.port_full_after_reads
-
-    # --- contender classification (all local) ------------------------------
-    want_send = (op == isa.OP_MOV_NET) & src_ok
-    dest = tgt * n_ports + d.tport
-    send_slot = jnp.asarray(route.dest_to_slot)[jnp.clip(dest, 0, n_dests - 1)]
-
-    is_push = op == isa.OP_PUSH
-    is_pop = op == isa.OP_POP
-    tgt_stack = jnp.clip(tgt, 0, n_stacks - 1)
-    top_at_tgt = state.stack_top[tgt_stack]
-    want_sop = (is_push & src_ok & (top_at_tgt < stack_cap)) | (is_pop & (top_at_tgt > 0))
-
-    in_avail = (state.in_wr - state.in_rd) > 0
-    want_in = (op == isa.OP_IN) & in_avail
-    out_free = (state.out_wr - state.out_rd) < out_cap
-    want_out = (op == isa.OP_OUT) & src_ok & out_free
-
-    slot = jnp.where(
-        want_send,
-        send_slot,
-        jnp.where(
-            want_sop,
-            da + tgt_stack,
-            jnp.where(want_in, in_slot, jnp.where(want_out, out_slot, trash)),
-        ),
-    )
-    contend = want_send | want_sop | want_in | want_out
-    # key = lane*2 + bit: monotone in lane (lowest lane still wins) while
-    # carrying the push/pop discriminator every shard needs for the
-    # replicated stack update.
-    my_key = lane_global * 2 + (want_sop & is_push).astype(_I32)
-
-    # --- collective 1: pmin election with occupancy veto -------------------
-    keys = jnp.full((kv,), _BIG, _I32).at[slot].min(jnp.where(contend, my_key, _BIG))
-    slot_lane = jnp.asarray(route.slot_lane)
-    slot_port = jnp.asarray(route.slot_port)
-    local_row = slot_lane - lane_offset
-    mine = (local_row >= 0) & (local_row < n_local)
-    occ = port_full_after_reads[jnp.clip(local_row, 0, n_local - 1), slot_port]
-    veto = jnp.where(mine & occ, jnp.asarray(-1, _I32), _BIG)
-    keys = keys.at[jnp.arange(da)].min(veto)
-    keys_global = jax.lax.pmin(keys, MODEL_AXIS)
-
-    gathered = keys_global[slot]
-    won = contend & (gathered == my_key)
-
-    # --- collective 2: psum winner values ----------------------------------
-    carries_val = won & (want_send | is_push | want_out)
-    vals = jnp.zeros((kv,), _I32).at[slot].add(jnp.where(carries_val, src_val, 0))
-    vals_global = jax.lax.psum(vals, MODEL_AXIS)
-
-    # --- port delivery (owner shard applies its own slots) -----------------
-    sk = keys_global[:da]
-    delivered = (sk != _BIG) & (sk >= 0)  # a sender won and the port was free
-    row = jnp.where(mine & delivered, jnp.clip(local_row, 0, n_local - 1), n_local)
-    pf_pad = jnp.concatenate(
-        [port_full_after_reads, jnp.zeros((1, n_ports), bool)], axis=0
-    )
-    pv_pad = jnp.concatenate([state.port_val, jnp.zeros((1, n_ports), _I32)], axis=0)
-    new_port_full = pf_pad.at[row, slot_port].set(True)[:n_local]
-    new_port_val = pv_pad.at[row, slot_port].set(vals_global[:da])[:n_local]
-
-    # --- stack agreement (replicated update, identical on every shard) -----
-    skeys = keys_global[da : da + n_stacks]
-    stack_live = skeys != _BIG
-    push_per_stack = stack_live & ((skeys & 1) == 1)
-    pop_per_stack = stack_live & ((skeys & 1) == 0)
-    push_val = vals_global[da : da + n_stacks]
-    pop_val_lane = state.stack_mem[tgt_stack, jnp.clip(top_at_tgt - 1, 0, stack_cap - 1)]
-
-    # --- master I/O rings ---------------------------------------------------
-    in_any = keys_global[in_slot] != _BIG
-    in_val = state.in_buf[state.in_rd % in_cap]
-    out_any = keys_global[out_slot] != _BIG
-    out_val = vals_global[out_slot]
-
-    # --- commit decision ---------------------------------------------------
-    commit = src_ok & jnp.where(
-        (op == isa.OP_MOV_NET) | is_push | is_pop | (op == isa.OP_IN) | (op == isa.OP_OUT),
-        won,
-        True,
-    )
-
-    # --- commit-time register/PC + stack/ring writes (shared) --------------
-    updates = commit_lane_state(d, prog_len, state, commit, pop_val_lane, in_val)
-    updates.update(
-        apply_stack_ring_updates(
-            state, push_per_stack, pop_per_stack, push_val, in_any, out_any, out_val
-        )
-    )
-    return state._replace(
-        port_val=new_port_val,
-        port_full=new_port_full,
-        tick=state.tick + 1,
-        retired=state.retired + commit.astype(_I32),
-        **updates,
+def step_local(route, code, prog_len, state, n_total_lanes):
+    """One superstep on this shard's lane slice (core/routing.py, bound to
+    the `model` mesh axis)."""
+    return step_slots(
+        route, code, prog_len, state, axis=MODEL_AXIS, n_total_lanes=n_total_lanes
     )
 
 

@@ -848,8 +848,18 @@ class MasterNode:
         preferred engine can't serve the new shape, `engine=auto` falls back
         (e.g. fused -> scan via _make_runner) and a forced engine logs and
         keeps the old capacity.  Called from the device loop thread only.
+
+        Lock discipline: compile AND warm the new engine OUTSIDE _state_lock
+        (a big fused network costs seconds of XLA compile — /status,
+        snapshot() and request ingestion must stay responsive through it,
+        intStack.go's growth never stalls the Go master), then swap the
+        references under the lock (a pad + device put, milliseconds).  Safe
+        because only this (device-loop) thread mutates _net outside the
+        lifecycle path, and every lifecycle mutation first joins this thread
+        via pause().
         """
         import dataclasses
+        import time as _time
 
         import jax.numpy as jnp
 
@@ -858,28 +868,38 @@ class MasterNode:
             tops = np.asarray(self._state.stack_top)
             if not (tops >= net.stack_cap).any():
                 return  # stalled for some other reason (e.g. starvation)
-            new_cap = net.stack_cap * 2
-            new_bytes = (self._batch or 1) * net.num_stacks * new_cap * 4
-            if new_bytes > self._grow_max_bytes:
-                log.warning(
-                    "stack at capacity %d but growing to %d would use %d "
-                    "bytes (> stack_grow_max_bytes=%d); leaving it parked",
-                    net.stack_cap, new_cap, new_bytes, self._grow_max_bytes,
-                )
-                self._grow_blocked = True  # warn once per wedge
-                return
-            new_topology = dataclasses.replace(
-                self._topology, stack_cap=new_cap
+        new_cap = net.stack_cap * 2
+        new_bytes = (self._batch or 1) * net.num_stacks * new_cap * 4
+        if new_bytes > self._grow_max_bytes:
+            log.warning(
+                "stack at capacity %d but growing to %d would use %d "
+                "bytes (> stack_grow_max_bytes=%d); leaving it parked",
+                net.stack_cap, new_cap, new_bytes, self._grow_max_bytes,
             )
-            new_net = new_topology.compile(batch=self._batch)
-            try:
-                new_runner = self._make_runner(new_net)
-            except ValueError as e:
-                log.warning(
-                    "stack at capacity but engine=%s cannot serve "
-                    "stack_cap=%d: %s", self._engine, new_cap, e
-                )
-                self._grow_blocked = True  # warn once per wedge
+            self._grow_blocked = True  # warn once per wedge
+            return
+
+        # --- slow half: lower, build, and WARM the new engine (no lock) ----
+        t0 = _time.monotonic()
+        new_topology = dataclasses.replace(self._topology, stack_cap=new_cap)
+        new_net = new_topology.compile(batch=self._batch)
+        try:
+            new_runner = self._make_runner(new_net)
+        except ValueError as e:
+            log.warning(
+                "stack at capacity but engine=%s cannot serve "
+                "stack_cap=%d: %s", self._engine, new_cap, e
+            )
+            self._grow_blocked = True  # warn once per wedge
+            return
+        new_serve = self._make_serve_fns(new_net, new_runner)
+        self._warm_engine(new_net, new_runner, new_serve)
+        compile_s = _time.monotonic() - t0
+
+        # --- fast half: swap under the lock --------------------------------
+        t0 = _time.monotonic()
+        with self._state_lock:
+            if self._net is not net:  # lifecycle swapped the network under us
                 return
             pad = [(0, 0)] * (self._state.stack_mem.ndim - 1) \
                 + [(0, new_cap - net.stack_cap)]
@@ -889,11 +909,54 @@ class MasterNode:
                 self._state._replace(stack_mem=jnp.pad(self._state.stack_mem, pad))
             )
             self._runner = new_runner
-            self._batched_serve = self._make_serve_fns(new_net, new_runner)
-            log.info(
-                "grew stack capacity %d -> %d (engine=%s)",
-                net.stack_cap, new_cap, self.engine_name,
-            )
+            self._batched_serve = new_serve
+        swap_s = _time.monotonic() - t0
+        log.info(
+            "grew stack capacity %d -> %d (engine=%s): compile+warm %.3fs "
+            "off-lock, swap %.3fs under lock",
+            net.stack_cap, new_cap, self.engine_name, compile_s, swap_s,
+        )
+
+    def _warm_engine(self, net, runner, serve_fns) -> None:
+        """Force the new engine's first-call XLA compiles on a throwaway
+        state so the device loop's next iteration (under _state_lock) runs
+        pre-compiled.  The dummy chunk executes on garbage state and is
+        discarded; the network being grown is wedged anyway, so the extra
+        chunk costs idle time, not serve latency."""
+        import jax
+
+        try:
+            dummy = self._shard(net.init_state())
+            if serve_fns is not None:
+                serve_fn, idle_fn = serve_fns
+                vals = np.zeros((self._batch, net.in_cap), np.int32)
+                counts = np.zeros((self._batch,), np.int32)
+                dummy, packed = serve_fn(dummy, vals, counts)
+                dummy, _ = idle_fn(dummy)
+                jax.block_until_ready(packed)
+            elif self._trace is not None:
+                # the traced loop compiles a DIFFERENT jit than net.run —
+                # warm the one the device loop will actually call
+                trace = net.init_trace(self._trace_cap)
+                dummy, trace = net.run_traced(
+                    dummy, trace, self._chunk, *(
+                        () if self._batch is None else (self._trace_instance,)
+                    )
+                )
+                jax.block_until_ready(trace)
+            elif self._batch is None:
+                vals = np.zeros((net.in_cap,), np.int32)
+                dummy, packed = net.serve_chunk(dummy, vals, 0, self._chunk)
+                jax.block_until_ready(packed)
+            elif runner is not None:
+                dummy = runner(dummy)
+                jax.block_until_ready(dummy)
+            else:
+                dummy = net.run(dummy, self._chunk)
+                jax.block_until_ready(dummy)
+            jax.block_until_ready(net.counters(dummy))
+        except Exception as e:  # pragma: no cover — warm-up is best-effort
+            log.warning("engine warm-up after grow failed (continuing): %s", e)
 
     def _mark_ticks(self) -> None:
         """Advance the tick-rate gauge by one chunk (device loop thread)."""

@@ -92,6 +92,67 @@ def test_autogrow_respects_budget():
     assert master._net.stack_cap == 8
 
 
+def test_status_responsive_during_grow():
+    """/status (and any _state_lock reader) must stay responsive while a
+    grow compiles the new engine: the compile+warm half runs OFF the lock
+    (VERDICT r3 weak #4; intStack.go's growth never stalls the Go master).
+
+    Compile cost is simulated by wrapping Topology.compile with a 1.5s
+    sleep; with the old under-lock grow every status() during the window
+    blocked for the full compile, so the max observed latency is the
+    regression trip-wire.
+    """
+    import threading
+    import time
+
+    from misaka_tpu.runtime.topology import Topology as T
+
+    master = MasterNode(reverser_top(), chunk_steps=32)
+    real_compile = T.compile
+    grew = threading.Event()
+
+    def slow_compile(self, *a, **k):
+        if self.stack_cap > 8:  # only the grow path compiles a bigger cap
+            grew.set()
+            time.sleep(1.5)
+        return real_compile(self, *a, **k)
+
+    latencies = []
+    poll_errors = []
+    stop = threading.Event()
+
+    def poll_status():
+        try:
+            while not stop.is_set():
+                t0 = time.monotonic()
+                st = master.status()
+                latencies.append(time.monotonic() - t0)
+                assert "stack_cap" in st
+                time.sleep(0.02)
+        except BaseException as e:  # pragma: no cover — must not pass silently
+            poll_errors.append(e)
+
+    T.compile = slow_compile
+    poller = threading.Thread(target=poll_status)
+    try:
+        master.run()
+        poller.start()
+        run_reverser(master, n=40, timeout=90)
+    finally:
+        stop.set()
+        poller.join()
+        T.compile = real_compile
+        master.pause()
+    assert not poll_errors, f"status poller died: {poll_errors[0]!r}"
+    assert grew.is_set(), "the grow path never ran"
+    assert master._net.stack_cap >= 64
+    worst = max(latencies)
+    print(f"grow-window status latency: worst={worst * 1e3:.1f}ms over {len(latencies)} polls")
+    # Old behavior: >= 1.5s (one poll blocks for the whole simulated
+    # compile).  Allow generous slack for CI scheduling noise.
+    assert worst < 1.0, f"status blocked {worst:.2f}s during grow"
+
+
 def test_restore_pads_pre_grow_snapshot():
     # a snapshot taken BEFORE a grow must restore against the grown engine
     # (zero-padded), not crash the device loop on its next chunk

@@ -257,6 +257,81 @@ def gen_contended(seed):
     return node_info, programs, k
 
 
+def gen_contended_in(seed):
+    """Multiple lanes execute IN against ONE input stream — the remaining
+    arbitration surface (master.go:233-242's GetInput races) — with MIXED
+    sinks inside one network: direct OUT, a shared port into a tail lane,
+    and a shared stack drained by a dedicated popper.  After sinking its
+    value every consumer OUTs a lane TAG (1000+w), so each mode's per-lane
+    consumption counts are observable in its own output stream: exactly one
+    tag per consumed input, tags only from real consumer lanes.
+    """
+    rng = np.random.default_rng(seed)
+    k = int(rng.integers(-20, 20))
+    n_workers = int(rng.integers(2, 5))
+    node_info, programs = {}, {}
+    uses_port = uses_stack = False
+    for w in range(n_workers):
+        sink = rng.choice(["out", "port", "stack"])
+        lines = ["IN ACC", f"ADD {k}"]
+        if sink == "out":
+            lines.append("OUT ACC")
+        elif sink == "port":
+            lines.append("MOV ACC, tail:R0")
+            uses_port = True
+        else:
+            lines.append("PUSH ACC, st")
+            uses_stack = True
+        lines.append(f"OUT {1000 + w}")  # the lane tag
+        node_info[f"w{w}"] = "program"
+        programs[f"w{w}"] = "\n".join(lines)
+    if uses_port:
+        node_info["tail"] = "program"
+        programs["tail"] = "MOV R0, ACC\nOUT ACC\n"
+    if uses_stack:
+        node_info["st"] = "stack"
+        node_info["drain"] = "program"
+        programs["drain"] = "POP st, ACC\nOUT ACC\n"
+    return node_info, programs, k, n_workers
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_contended_multi_in_conservation(seed):
+    """2-4 lanes race IN for one input stream (mixed stack/port/OUT sinks):
+    in BOTH modes every input must be consumed exactly once (value multiset
+    conserved) and must emit exactly one consumer-lane tag (per-lane-count
+    conservation) — which lane wins may differ between the engine's
+    lowest-lane rule and the cluster's free-running race, but values can
+    never be lost, duplicated, or consumed by a phantom lane."""
+    node_info, programs, k, n_workers = gen_contended_in(seed)
+    inputs = np.random.default_rng(3000 + seed).integers(
+        -100, 100, size=N_INPUTS
+    ).tolist()
+    expect_vals = sorted(v + k for v in inputs)  # all < 1000, tags >= 1000
+    valid_tags = set(range(1000, 1000 + n_workers))
+
+    def check(outs, mode):
+        vals = sorted(o for o in outs if o < 1000)
+        tags = [o for o in outs if o >= 1000]
+        assert vals == expect_vals, (
+            f"seed {seed} [{mode}]: value multiset wrong\n{outs}\nprograms:\n"
+            + "\n---\n".join(programs.values())
+        )
+        assert len(tags) == N_INPUTS and set(tags) <= valid_tags, (
+            f"seed {seed} [{mode}]: per-lane consumption tags wrong "
+            f"({tags})\nprograms:\n" + "\n---\n".join(programs.values())
+        )
+
+    engine_outs = run_engine(node_info, programs, inputs)
+    assert len(engine_outs) == 2 * N_INPUTS, (
+        f"seed {seed}: engine emitted {len(engine_outs)}/{2 * N_INPUTS}\n"
+        + "\n---\n".join(programs.values())
+    )
+    check(engine_outs, "engine")
+    cluster_outs = run_cluster(node_info, programs, inputs, 2 * N_INPUTS)
+    check(cluster_outs, "cluster")
+
+
 @pytest.mark.parametrize("seed", range(40))
 def test_contended_multiset_equal(seed):
     """Two+ lanes share a stack (and possibly a port and the OUT grant):

@@ -3,12 +3,18 @@
 The BASELINE configs top out at 8 lanes and tiny stacks; these tests pin the
 dimensions a user would actually grow — stack depth (the reference's
 unbounded IntStack is the long-context analogue, SURVEY.md §5) and lane
-count (deeper pipelines) — including the lane-sharded multi-chip path.
+count (deeper pipelines) — including the lane-sharded multi-chip path and
+the compact scatter-election kernel that auto-replaces the dense one-hot
+kernel at/above COMPACT_AUTO_LANES lanes (core/routing.py; the dense
+kernel's O(N·4N) election matrices fault the TPU worker at 256 lanes under
+production batches).
 """
 
 import numpy as np
+import pytest
 
 from misaka_tpu import networks
+from misaka_tpu.core.engine import COMPACT_AUTO_LANES
 from misaka_tpu.runtime.topology import Topology
 
 
@@ -43,6 +49,110 @@ def test_wide_pipeline_32_lanes():
     state = net.init_state()
     state, outs = net.compute_stream(state, [0, 100, -5], max_steps=100_000)
     assert outs == [32, 132, 27]
+
+
+def _fuzz_wide_net(seed, n_lanes, batch=None):
+    """A random multi-opcode network WIDE enough to land in compact-kernel
+    territory (>= COMPACT_AUTO_LANES lanes)."""
+    from misaka_tpu.core import CompiledNetwork
+    from misaka_tpu.tis.lower import lower_program, pad_programs
+    from tests.test_differential import random_program
+
+    rng = np.random.default_rng(seed)
+    n_stacks = int(rng.integers(1, 3))
+    lane_names = [f"n{i}" for i in range(n_lanes)]
+    stack_names = [f"s{i}" for i in range(n_stacks)]
+    lane_ids = {name: i for i, name in enumerate(lane_names)}
+    stack_ids = {name: i for i, name in enumerate(stack_names)}
+    programs = [
+        random_program(rng, lane_names, stack_names, int(rng.integers(1, 9)))
+        for _ in lane_names
+    ]
+    code, lengths = pad_programs(
+        [lower_program(p, lane_ids, stack_ids) for p in programs]
+    )
+    net = CompiledNetwork(
+        code=code, prog_len=lengths, num_stacks=n_stacks,
+        stack_cap=4, in_cap=8, out_cap=8, batch=batch,
+    )
+    return net, rng
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_compact_matches_dense_fuzzed(seed):
+    """engine='compact' vs engine='dense' bit-identity on random 40-lane
+    networks (every opcode, contended stacks/ports/IN/OUT)."""
+    n_lanes = 40
+    assert n_lanes >= COMPACT_AUTO_LANES
+    net, rng = _fuzz_wide_net(3000 + seed, n_lanes)
+    vals = rng.integers(-100, 100, size=6).astype(np.int32)
+
+    def prep(state):
+        return state._replace(
+            in_buf=state.in_buf.at[:6].set(vals), in_wr=state.in_wr + 6
+        )
+
+    dense = net.run(prep(net.init_state()), 64, engine="dense")
+    compact = net.run(prep(net.init_state()), 64, engine="compact")
+    for name in dense._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dense, name)),
+            np.asarray(getattr(compact, name)),
+            err_msg=f"state field '{name}' diverged (seed {seed})",
+        )
+
+
+def test_compact_matches_dense_batched():
+    """Batched (vmapped) compact kernel matches dense on a fuzzed network."""
+    net, rng = _fuzz_wide_net(4242, 36, batch=3)
+    vals = rng.integers(-100, 100, size=(3, 6)).astype(np.int32)
+
+    def prep(state):
+        return state._replace(
+            in_buf=state.in_buf.at[:, :6].set(vals), in_wr=state.in_wr + 6
+        )
+
+    dense = net.run(prep(net.init_state()), 64, engine="dense")
+    compact = net.run(prep(net.init_state()), 64, engine="compact")
+    for name in dense._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dense, name)),
+            np.asarray(getattr(compact, name)),
+            err_msg=f"state field '{name}' diverged",
+        )
+
+
+def test_wide_pipeline_served_batched():
+    """pipeline(64) through a batched MasterNode: the batched serve path's
+    scan fallback auto-selects the compact step for wide networks."""
+    from misaka_tpu.runtime.master import MasterNode
+
+    master = MasterNode(
+        networks.pipeline(64, in_cap=8, out_cap=8, stack_cap=8),
+        chunk_steps=256, batch=2, engine="scan",
+    )
+    master.run()
+    try:
+        vals = list(range(-3, 5))
+        assert master.compute_spread(vals, timeout=120) == [v + 64 for v in vals]
+    finally:
+        master.pause()
+
+
+def test_wide_pipeline_served_unbatched():
+    """pipeline(48) through an unbatched MasterNode: serve_chunk routes wide
+    networks through the per-network compact serve closure."""
+    from misaka_tpu.runtime.master import MasterNode
+
+    master = MasterNode(
+        networks.pipeline(48, in_cap=8, out_cap=8, stack_cap=8), chunk_steps=192
+    )
+    master.run()
+    try:
+        assert master.compute(5, timeout=120) == 53
+        assert master.compute(-10, timeout=120) == 38
+    finally:
+        master.pause()
 
 
 def test_wide_pipeline_sharded():

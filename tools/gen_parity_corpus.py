@@ -1,0 +1,93 @@
+"""Generate the Go-parity corpus: serialized networks + recorded engine outputs.
+
+Each corpus case is one JSON file under tests/corpus/parity/:
+
+  {"name", "node_info", "programs", "inputs", "engine_outputs", "compare"}
+
+`engine_outputs` is what THIS rebuild's engine produced (recorded at
+generation time, re-verified by tests/test_parity_corpus.py on every run);
+`compare` is "stream" (deterministic Kahn networks: exact output order) or
+"multiset" (contended networks: order is schedule-dependent, the multiset is
+not).  tools/parity_go.py replays the same cases against the actual Go
+reference binary via its own Dockerfile/compose deployment — the check
+SURVEY.md §4 promises, runnable the moment an environment has Docker.
+
+Cases are restricted to 1-output-per-input topologies because the replay
+feeds the reference through serialized POST /compute (master.go:197-224),
+where pairing is unambiguous only at one output per input.
+
+Usage: python tools/gen_parity_corpus.py  (writes tests/corpus/parity/)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+import numpy as np
+
+OUT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "corpus", "parity",
+)
+N_INPUTS = 8
+
+
+def main():
+    from tests.test_cross_mode import gen_contended, gen_network, run_engine
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    cases = []
+
+    # deterministic Kahn networks: exact stream equality (only 1:1 cadences)
+    picked = 0
+    seed = 0
+    while picked < 8 and seed < 200:
+        node_info, programs, outs_per_input = gen_network(seed)
+        if outs_per_input == 1:
+            cases.append((f"kahn_{seed:03d}", node_info, programs, "stream", 1000 + seed))
+            picked += 1
+        seed += 1
+
+    # contended networks: multiset equality (1 value out per input)
+    for seed in range(4):
+        node_info, programs, _k = gen_contended(seed)
+        cases.append((f"contended_{seed:03d}", node_info, programs, "multiset", 2000 + seed))
+
+    # the flagship compose network itself
+    from misaka_tpu import networks
+
+    add2 = networks.add2()
+    cases.append(("add2", add2.node_info, add2.programs, "stream", 42))
+
+    for name, node_info, programs, compare, in_seed in cases:
+        node_info = {
+            n: (k if isinstance(k, str) else k["type"]) for n, k in node_info.items()
+        }
+        inputs = np.random.default_rng(in_seed).integers(
+            -100, 100, size=N_INPUTS
+        ).tolist()
+        outs = run_engine(node_info, programs, inputs)
+        assert len(outs) == len(inputs), (name, len(outs))
+        path = os.path.join(OUT_DIR, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "name": name,
+                    "node_info": node_info,
+                    "programs": programs,
+                    "inputs": inputs,
+                    "engine_outputs": outs,
+                    "compare": compare,
+                },
+                f, indent=1,
+            )
+        print(f"wrote {path}: {len(inputs)} inputs, compare={compare}")
+
+
+if __name__ == "__main__":
+    main()

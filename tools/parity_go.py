@@ -1,0 +1,160 @@
+"""Replay the parity corpus against the ACTUAL Go reference binary.
+
+The check SURVEY.md §4 promises but this environment cannot run (no Go
+toolchain, no Docker — re-verified every round): build the reference via
+its own Dockerfile (/root/reference/Dockerfile, golang:1.14-alpine) and
+drive each corpus case through its real deployment — one container per
+node, Docker DNS for name resolution (the reference dials peers by bare
+node name on fixed ports :8000/:8001, master.go:19-20,178), values fed
+through serialized POST /compute exactly like its README.
+
+Skips cleanly (exit 0, "SKIP") when Docker or the reference checkout is
+absent, so `make parity-go` is safe everywhere; the corpus itself is
+committed (tests/corpus/parity/) and its engine side is re-verified in CI
+by tests/test_parity_corpus.py.
+
+Env:
+  MISAKA_REFERENCE   reference checkout (default /root/reference)
+  MISAKA_PARITY_TIMEOUT  per-case seconds (default 120)
+
+Usage: python tools/parity_go.py [case ...]   (default: every corpus case)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tests", "corpus", "parity")
+REFERENCE = os.environ.get("MISAKA_REFERENCE", "/root/reference")
+TIMEOUT = float(os.environ.get("MISAKA_PARITY_TIMEOUT", "120"))
+
+
+def _compose_cmd() -> list[str] | None:
+    if shutil.which("docker"):
+        probe = subprocess.run(
+            ["docker", "compose", "version"], capture_output=True
+        )
+        if probe.returncode == 0:
+            return ["docker", "compose"]
+    if shutil.which("docker-compose"):
+        return ["docker-compose"]
+    return None
+
+
+def _compose_file(case: dict, master_port: int) -> str:
+    """One service per node, reference-style env config (docker-compose.yml)."""
+    def indent(text: str, pad: str) -> str:
+        return "\n".join(pad + line for line in text.splitlines())
+
+    lines = ["services:"]
+    node_info_json = json.dumps(
+        {n: {"type": k} for n, k in case["node_info"].items()}
+    )
+    lines += [
+        "  last_order:",
+        "    build: " + REFERENCE,
+        "    image: misaka_net_parity",
+        f'    ports: ["{master_port}:8000"]',
+        "    environment:",
+        "      NODE_TYPE: master",
+        f"      NODE_INFO: '{node_info_json}'",
+        "      CERT_FILE: ./openssl/service.pem",
+        "      KEY_FILE: ./openssl/service.key",
+        "    command: ./app",
+    ]
+    for name, kind in case["node_info"].items():
+        lines += [
+            f"  {name}:",
+            "    image: misaka_net_parity",
+            "    environment:",
+            f"      NODE_TYPE: {kind}",
+            "      CERT_FILE: ./openssl/service.pem",
+            "      KEY_FILE: ./openssl/service.key",
+        ]
+        if kind == "program":
+            lines += [
+                "      MASTER_URI: last_order",
+                "      PROGRAM: |",
+                indent(case["programs"][name], "        ") or "        NOP",
+            ]
+        lines += ["    command: ./app"]
+    return "\n".join(lines) + "\n"
+
+
+def _post(url: str, data: bytes, timeout: float) -> bytes:
+    req = urllib.request.Request(url, data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read()
+
+
+def run_case(compose, case: dict, master_port: int = 18800) -> bool:
+    name = case["name"]
+    with tempfile.TemporaryDirectory(prefix=f"parity_{name}_") as tmp:
+        cf = os.path.join(tmp, "docker-compose.yml")
+        with open(cf, "w") as f:
+            f.write(_compose_file(case, master_port))
+        up = compose + ["-f", cf, "up", "--build", "-d"]
+        try:
+            subprocess.run(up, check=True, capture_output=True, timeout=600)
+            base = f"http://127.0.0.1:{master_port}"
+            deadline = time.monotonic() + TIMEOUT
+            while True:  # wait for the master's HTTP surface
+                try:
+                    _post(base + "/run", b"", 2)
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"{name}: master never came up")
+                    time.sleep(1)
+            outs = []
+            for v in case["inputs"]:  # serialized /compute: unambiguous pairing
+                raw = _post(base + "/compute", f"value={v}".encode(), TIMEOUT)
+                outs.append(int(json.loads(raw)["value"]))
+        finally:
+            subprocess.run(
+                compose + ["-f", cf, "down", "-t", "2"],
+                capture_output=True, timeout=120,
+            )
+    want = case["engine_outputs"]
+    ok = (outs == want) if case["compare"] == "stream" else (sorted(outs) == sorted(want))
+    marker = "OK " if ok else "FAIL"
+    print(f"{marker} {name} [{case['compare']}]: go={outs} engine={want}")
+    return ok
+
+
+def main() -> int:
+    if not os.path.isdir(os.path.join(REFERENCE, "cmd")):
+        print(f"SKIP: reference checkout not found at {REFERENCE}")
+        return 0
+    compose = _compose_cmd()
+    if compose is None:
+        print("SKIP: docker / docker-compose not available in this environment")
+        return 0
+    wanted = set(sys.argv[1:])
+    files = sorted(glob.glob(os.path.join(CORPUS, "*.json")))
+    if not files:
+        print(f"no corpus at {CORPUS}; run tools/gen_parity_corpus.py first")
+        return 2
+    failures = 0
+    for path in files:
+        with open(path) as f:
+            case = json.load(f)
+        if wanted and case["name"] not in wanted:
+            continue
+        if not run_case(compose, case):
+            failures += 1
+    print(f"parity-go: {failures} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
