@@ -190,6 +190,7 @@ class CompiledNetwork:
     out_cap: int = 1024
     batch: int | None = None
     _tables: tuple = field(init=False, repr=False)
+    _compact_fn: object = field(init=False, repr=False, default=None)
     _compact_chunk: object = field(init=False, repr=False, default=None)
     _compact_serve: object = field(init=False, repr=False, default=None)
 
@@ -225,10 +226,12 @@ class CompiledNetwork:
         return self._compact_step()
 
     def _compact_step(self):
-        from misaka_tpu.core.routing import build_route_table, step_slots
+        if self._compact_fn is None:
+            from misaka_tpu.core.routing import build_route_table, step_slots
 
-        route = build_route_table(self.code, self.prog_len)
-        return functools.partial(step_slots, route)
+            route = build_route_table(self.code, self.prog_len)
+            self._compact_fn = functools.partial(step_slots, route)
+        return self._compact_fn
 
     def run(
         self, state: NetworkState, num_steps: int, engine: str | None = None
@@ -341,17 +344,12 @@ class CompiledNetwork:
             raise ValueError("make_batched_serve requires a batched network")
         tables = self._tables
 
-        step_b = jax.vmap(self.step_fn(), in_axes=(None, None, 0))
+        scan_step = None if runner is not None else self.step_fn()
 
         def advance(state):
             if runner is not None:
                 return runner(state)
-
-            def body(s, _):
-                return step_b(tables[0], tables[1], s), None
-
-            out, _ = jax.lax.scan(body, state, None, length=num_steps)
-            return rebase_rings(out)
+            return _chunk_body(scan_step, tables, state, num_steps, batched=True)
 
         def ctrs_of(state):
             return jnp.stack(

@@ -150,7 +150,12 @@ def main() -> int:
             case = json.load(f)
         if wanted and case["name"] not in wanted:
             continue
-        if not run_case(compose, case):
+        try:
+            ok = run_case(compose, case)
+        except Exception as e:  # infra failure: count, keep replaying
+            print(f"FAIL {case['name']}: {type(e).__name__}: {e}")
+            ok = False
+        if not ok:
             failures += 1
     print(f"parity-go: {failures} failure(s)")
     return 1 if failures else 0
