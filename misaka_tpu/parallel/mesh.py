@@ -114,4 +114,11 @@ def build_lane_sharded_runner(step1, code, prog_len, mesh, num_steps: int,
 
     code_sh = _put(code, P(MODEL_AXIS, None, None))
     len_sh = _put(prog_len, P(MODEL_AXIS))
-    return jax.jit(functools.partial(sharded, code_sh, len_sh), donate_argnums=(0,))
+    # The un-jitted chunk (tables bound): callable INSIDE another jit, so the
+    # master can fuse feed + sharded chunk + counter/ring snapshot into its
+    # one-dispatch serve iteration (engine.make_batched_serve) instead of
+    # paying four device interactions per loop on the mesh path.
+    inner = functools.partial(sharded, code_sh, len_sh)
+    jitted = jax.jit(inner, donate_argnums=(0,))
+    jitted.inner = inner
+    return jitted

@@ -18,7 +18,10 @@ so the master's env surface is what survives:
   MISAKA_ENGINE    device-loop chunk runner: "auto" (default — the fused
                    Pallas kernel when batched+untraced+on-TPU+within budget,
                    the XLA scan engine otherwise), "scan", "fused" (require
-                   the kernel), "fused-interpret" (CI coverage off-TPU)
+                   the kernel), "fused-interpret" (CI coverage off-TPU),
+                   "gather" (model-parallel only: the first-generation
+                   occupancy-gather sharded kernel, kept for A/B runs
+                   against the default statically-routed kernel)
   MISAKA_DATA_PARALLEL   shard the batch axis over N chips (requires
                    MISAKA_BATCH divisible by N); MISAKA_MODEL_PARALLEL
                    shards program-node lanes over M chips via the ICI-

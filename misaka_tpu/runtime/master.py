@@ -312,10 +312,21 @@ class MasterNode:
 
     def _make_serve_fns(self, net, runner):
         """The batched one-dispatch (serve, idle) jit pair, or None where
-        the piecewise loop must run (unbatched, tracing, or mesh serving —
-        mesh state carries shardings the combined jit does not manage)."""
-        if self._batch is None or self._trace_cap or self._mesh is not None:
+        the piecewise loop must run (unbatched or tracing).
+
+        Mesh serving fuses too: the sharded chunk's un-jitted body
+        (runner.inner) is inlined into the combined serve jit, so a mesh
+        iteration costs one dispatch + one packed read exactly like the
+        single-chip batched path — XLA propagates the state shardings
+        through the feed/snapshot ops around the shard_map'd chunk.
+        """
+        if self._batch is None or self._trace_cap:
             return None
+        if self._mesh is not None:
+            inner = getattr(runner, "inner", None)
+            if inner is None:  # a runner shape without a fusable body
+                return None
+            return net.make_batched_serve(inner, self._chunk)
         return net.make_batched_serve(runner, self._chunk)
 
     def _make_dp_fused_runner(self, net):
@@ -341,13 +352,13 @@ class MasterNode:
             interpret=(self._engine == "fused-interpret"),
         )
         specs = state_specs(batched=True)
-        return jax.jit(
-            shard_map(
-                local, mesh=self._mesh, in_specs=(specs,), out_specs=specs,
-                check_vma=False,
-            ),
-            donate_argnums=(0,),
+        inner = shard_map(
+            local, mesh=self._mesh, in_specs=(specs,), out_specs=specs,
+            check_vma=False,
         )
+        jitted = jax.jit(inner, donate_argnums=(0,))
+        jitted.inner = inner  # fusable into the one-dispatch serve jit
+        return jitted
 
     @property
     def engine_name(self) -> str:
