@@ -400,10 +400,11 @@ def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1
         batch = 4096 if on_tpu else 64
         if engine == "dense":
             # Keep the dense one-hot intermediate (batch x N x 4N bool) under
-            # ~16 MiB: 64 lanes x 4096 batch (67 MiB) was measured to wedge
-            # or fault the r4 TPU worker; 1 GiB (256 x 4096) faults it
-            # reliably.
-            batch = min(batch, max(64, 2**24 // (4 * n_lanes * n_lanes)))
+            # ~4 MiB: 64 lanes x 4096 batch (67 MiB) was measured to wedge
+            # or fault the r4 TPU worker (for 1h+, unrecoverable locally);
+            # 1 GiB (256 x 4096) faults it reliably.  Wide margin on purpose
+            # — the artifact matters more than dense wide-lane fidelity.
+            batch = min(batch, max(16, 2**22 // (4 * n_lanes * n_lanes)))
         elif engine == "compact":
             # Scatter elections are linear in batch*N; cap the index space
             # at the measured-safe region (256 lanes x 1024 batch ran clean;

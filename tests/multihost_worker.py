@@ -40,6 +40,7 @@ def main() -> None:
         hybrid_mesh,
         initialize_from_env,
         make_global_state,
+        make_routed_runner,
         make_sharded_runner,
     )
 
@@ -56,33 +57,42 @@ def main() -> None:
 
     top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
     net = top.compile(batch=BATCH)
-    state = net.init_state()
 
     vals = (np.arange(BATCH)[:, None] * 10 + np.arange(PER_INSTANCE)[None, :]).astype(
         np.int32
     )
     in_buf = np.zeros((BATCH, 8), np.int32)
     in_buf[:, :PER_INSTANCE] = vals
-    state = state._replace(
-        in_buf=in_buf,
-        in_wr=np.full((BATCH,), PER_INSTANCE, np.int32),
-    )
 
-    gstate = make_global_state(state, mesh, batched=True)
-    runner = make_sharded_runner(net.code, net.prog_len, mesh, num_steps=TICKS)
-    gstate = runner(gstate)
+    # Both lane-sharded kernels must work across the real process boundary:
+    # the statically-routed default AND the first-generation gather variant.
+    for label, factory in (
+        ("routed", make_routed_runner), ("gather", make_sharded_runner)
+    ):
+        state = net.init_state()._replace(
+            in_buf=in_buf,
+            in_wr=np.full((BATCH,), PER_INSTANCE, np.int32),
+        )
+        gstate = make_global_state(state, mesh, batched=True)
+        runner = factory(net.code, net.prog_len, mesh, num_steps=TICKS)
+        gstate = runner(gstate)
 
-    # Every locally-owned instance must have emitted all values, +2 each.
-    expected_out = vals + 2
-    checked = 0
-    for shard in gstate.out_wr.addressable_shards:
-        np.testing.assert_array_equal(np.asarray(shard.data), PER_INSTANCE)
-    for shard in gstate.out_buf.addressable_shards:
-        idx = shard.index[0]
-        got = np.asarray(shard.data)[:, :PER_INSTANCE]
-        np.testing.assert_array_equal(got, expected_out[idx])
-        checked += got.shape[0]
-    assert checked > 0
+        # Every locally-owned instance must have emitted all values, +2 each.
+        expected_out = vals + 2
+        checked = 0
+        for shard in gstate.out_wr.addressable_shards:
+            np.testing.assert_array_equal(
+                np.asarray(shard.data), PER_INSTANCE,
+                err_msg=f"kernel {label}: out_wr",
+            )
+        for shard in gstate.out_buf.addressable_shards:
+            idx = shard.index[0]
+            got = np.asarray(shard.data)[:, :PER_INSTANCE]
+            np.testing.assert_array_equal(
+                got, expected_out[idx], err_msg=f"kernel {label}: out_buf"
+            )
+            checked += got.shape[0]
+        assert checked > 0, f"kernel {label}: no local shards checked"
     print("MULTIHOST_OK", flush=True)
 
 

@@ -87,7 +87,7 @@ def build_random_network(seed):
     return code, lengths, n_stacks, inputs, programs
 
 
-def compare(seed, steps=48, fused=False):
+def compare(seed, steps=48, fused=False, engine=None):
     code, lengths, n_stacks, inputs, programs = build_random_network(seed)
     net = CompiledNetwork(
         code=code,
@@ -109,7 +109,7 @@ def compare(seed, steps=48, fused=False):
         pick = lambda x: np.asarray(x)[0]
     else:
         state, _ = net.feed(state, inputs)
-        state = net.run(state, steps)
+        state = net.run(state, steps, engine=engine)
         pick = np.asarray
 
     oracle = Oracle(code, lengths, max(1, n_stacks), STACK_CAP, IN_CAP, OUT_CAP)
@@ -163,3 +163,12 @@ def test_xla_kernel_matches_oracle(seed):
 @pytest.mark.parametrize("seed", range(0, 40, 5))
 def test_fused_kernel_matches_oracle(seed):
     compare(seed, fused=True)
+
+
+@pytest.mark.parametrize("seed", range(0, 40, 3))
+def test_compact_kernel_matches_oracle(seed):
+    """The compact scatter-election kernel (core/routing.py) against the
+    independent Python oracle — not merely against core/step.py (that
+    equality is pinned by tests/test_scale.py); a shared misunderstanding
+    between the two jitted kernels would still be caught here."""
+    compare(seed, engine="compact")
