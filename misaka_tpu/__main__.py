@@ -72,19 +72,15 @@ def cmd_disasm(args) -> int:
 
 def cmd_compute(args) -> int:
     import urllib.error
-    import urllib.parse
-    import urllib.request
 
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+
+    client = MisakaClient(args.url, timeout=args.timeout)
     for v in args.values:
-        body = urllib.parse.urlencode({"value": v}).encode()
-        req = urllib.request.Request(
-            args.url.rstrip("/") + "/compute", data=body, method="POST"
-        )
         try:
-            with urllib.request.urlopen(req, timeout=args.timeout) as resp:
-                print(resp.read().decode().strip())
-        except urllib.error.HTTPError as e:
-            print(f"error: {e.read().decode().strip()}", file=sys.stderr)
+            print(json.dumps({"value": client.compute(v)}))
+        except MisakaClientError as e:
+            print(f"error: {e.body}", file=sys.stderr)
             return 1
         except urllib.error.URLError as e:
             print(f"error: cannot reach {args.url}: {e.reason}", file=sys.stderr)

@@ -1,0 +1,136 @@
+"""Typed Python client for the master's HTTP surface.
+
+The reference ships no client at all — its README drives the five routes
+with curl (README.md "Usage"; master.go:90-224).  This wraps those five
+byte-compatible routes plus every additive route this build serves, with
+the two bulk lanes a throughput client actually wants:
+
+  compute(v)          POST /compute        one value, int -> int
+  compute_batch(vals) POST /compute_batch  decimal text, vectorized codec
+  compute_raw(vals)   POST /compute_raw    raw little-endian int32 bodies
+                                           (the fleet-client wire format)
+  run/pause/reset     POST /run /pause /reset
+  load(target, prog)  POST /load
+  status()/trace()    GET  /status /trace
+  checkpoint/restore  POST /checkpoint /restore  (server-side .npz)
+  profile_start/stop  POST /profile/start /profile/stop
+
+The module imports stdlib only (numpy lazily, inside the two bulk
+methods) and none of the jax-backed misaka_tpu packages — the scalar and
+lifecycle surface is importable on any ops box.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+class MisakaClientError(RuntimeError):
+    """Non-2xx response from the master (carries status + body text)."""
+
+    def __init__(self, status: int, body: str):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+class MisakaClient:
+    """A client session against one master (`base_url`, default port 8000).
+
+    Methods raise MisakaClientError on any non-2xx response (e.g. 400
+    "network is not running", 500 compute timeout) and propagate socket
+    errors (urllib.error.URLError) unchanged.
+    """
+
+    def __init__(self, base_url: str = "http://localhost:8000", timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # --- plumbing ----------------------------------------------------------
+
+    def _request(self, path: str, data: bytes | None, method: str) -> bytes:
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise MisakaClientError(
+                e.code, e.read().decode(errors="replace").strip()
+            ) from None
+
+    def _post_form(self, path: str, **fields) -> bytes:
+        return self._request(
+            path, urllib.parse.urlencode(fields).encode(), "POST"
+        )
+
+    # --- the reference's five routes (master.go:90-224) --------------------
+
+    def run(self) -> None:
+        self._post_form("/run")
+
+    def pause(self) -> None:
+        self._post_form("/pause")
+
+    def reset(self) -> None:
+        self._post_form("/reset")
+
+    def load(self, target: str, program: str) -> None:
+        """Reprogram one node (resets the network, like the reference)."""
+        self._post_form("/load", targetURI=target, program=program)
+
+    def compute(self, value: int) -> int:
+        raw = self._post_form("/compute", value=str(int(value)))
+        return int(json.loads(raw)["value"])
+
+    # --- bulk compute lanes -------------------------------------------------
+
+    def compute_batch(self, values, spread: bool = True):
+        """A value stream in ONE round trip (decimal text wire format).
+        Returns an int32 numpy array (numpy imported here, not at module
+        scope — the scalar/lifecycle surface stays stdlib-only)."""
+        import numpy as np
+
+        vals = np.ascontiguousarray(values, dtype=np.int32)
+        body = b"values=" + b"+".join(b"%d" % v for v in vals.tolist())
+        if spread:
+            body += b"&spread=1"
+        raw = self._request("/compute_batch", body, "POST")
+        return np.asarray(json.loads(raw)["values"], dtype=np.int32)
+
+    def compute_raw(self, values, spread: bool = True):
+        """The wire-efficient lane: raw little-endian int32 both ways.
+        Returns an int32 numpy array."""
+        import numpy as np
+
+        vals = np.ascontiguousarray(values, dtype="<i4")
+        path = "/compute_raw?spread=" + ("1" if spread else "0")
+        raw = self._request(path, vals.tobytes(), "POST")
+        return np.frombuffer(raw, dtype="<i4").copy()
+
+    # --- observability ------------------------------------------------------
+
+    def status(self) -> dict:
+        return json.loads(self._request("/status", None, "GET"))
+
+    def trace(self, last: int | None = None) -> list[dict]:
+        path = "/trace" if last is None else f"/trace?last={int(last)}"
+        return json.loads(self._request(path, None, "GET"))["entries"]
+
+    # --- checkpoint / profiling (additive; server must have dirs enabled) --
+
+    def checkpoint(self, name: str) -> None:
+        self._post_form("/checkpoint", name=name)
+
+    def restore(self, name: str) -> None:
+        self._post_form("/restore", name=name)
+
+    def profile_start(self, name: str = "profile") -> None:
+        self._post_form("/profile/start", name=name)
+
+    def profile_stop(self) -> str:
+        return self._request("/profile/stop", b"", "POST").decode()

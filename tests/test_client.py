@@ -1,0 +1,118 @@
+"""The Python client SDK against a live master (every route, both bulk lanes).
+
+The reference ships curl snippets only; misaka_tpu.client is the typed
+session a fleet client actually uses.  These tests drive a real
+MasterNode + make_http_server on a loopback port through the client —
+lifecycle, scalar and bulk compute, observability, checkpoints, and the
+documented error shapes.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.client import MisakaClient, MisakaClientError
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+
+@pytest.fixture
+def served(tmp_path):
+    master = MasterNode(
+        networks.add2(in_cap=16, out_cap=16, stack_cap=16),
+        chunk_steps=32, batch=4, trace_cap=None,
+    )
+    httpd = make_http_server(master, port=0, checkpoint_dir=str(tmp_path))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = MisakaClient(f"http://127.0.0.1:{httpd.server_address[1]}", timeout=60)
+    try:
+        yield master, client
+    finally:
+        master.pause()
+        httpd.shutdown()
+
+
+def test_lifecycle_and_compute(served):
+    master, client = served
+    # not running yet: the documented 400 shape
+    with pytest.raises(MisakaClientError) as e:
+        client.compute(1)
+    assert e.value.status == 400 and "not running" in e.value.body
+
+    client.run()
+    assert client.compute(5) == 7
+    assert client.compute(-9) == -7
+
+    st = client.status()
+    assert st["running"] is True and st["batch"] == 4
+
+    client.pause()
+    assert client.status()["running"] is False
+    client.reset()
+    client.run()
+    assert client.compute(0) == 2
+
+
+def test_bulk_lanes_roundtrip(served):
+    master, client = served
+    client.run()
+    vals = np.arange(-40, 40, dtype=np.int32)
+    np.testing.assert_array_equal(client.compute_raw(vals), vals + 2)
+    np.testing.assert_array_equal(client.compute_batch(vals), vals + 2)
+    # unspread (single-instance FIFO) still round-trips in order
+    np.testing.assert_array_equal(
+        client.compute_raw(vals[:16], spread=False), vals[:16] + 2
+    )
+
+
+def test_load_reprograms(served):
+    master, client = served
+    client.load("misaka1", "IN ACC\nADD 10\nOUT ACC")
+    client.run()
+    assert client.compute(1) == 11
+
+
+def test_checkpoint_restore_roundtrip(served):
+    master, client = served
+    client.run()
+    assert client.compute(3) == 5
+    client.pause()
+    client.checkpoint("snap1")
+    client.load("misaka1", "IN ACC\nADD 100\nOUT ACC")  # diverge
+    client.run()
+    assert client.compute(3) == 103
+    client.pause()
+    client.restore("snap1")
+    client.run()
+    assert client.compute(3) == 5  # original program state back
+
+    with pytest.raises(MisakaClientError) as e:
+        client.restore("no/such..name")
+    assert e.value.status == 400
+
+
+def test_profiling_disabled_shape(served):
+    # server was built without profile_dir: documented 403
+    master, client = served
+    with pytest.raises(MisakaClientError) as e:
+        client.profile_start()
+    assert e.value.status == 403
+
+
+def test_trace_route_shape(tmp_path):
+    master = MasterNode(
+        networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+        chunk_steps=16, trace_cap=32,
+    )
+    httpd = make_http_server(master, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = MisakaClient(f"http://127.0.0.1:{httpd.server_address[1]}", timeout=60)
+    try:
+        client.run()
+        assert client.compute(4) == 6
+        rows = client.trace(last=8)
+        assert rows and {"tick", "lane", "op", "committed"} <= set(rows[0])
+    finally:
+        master.pause()
+        httpd.shutdown()

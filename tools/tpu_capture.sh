@@ -1,0 +1,28 @@
+#!/bin/bash
+# One-shot TPU evidence capture, for the moment the (wedge-prone) relayed
+# chip is reachable: fused-kernel parity lane, the full default bench, and
+# the roofline sweep — in risk order, each logged, so a mid-sequence wedge
+# keeps everything already captured.  Usage: bash tools/tpu_capture.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_capture}"
+mkdir -p "$OUT"
+
+echo "== 0. chip probe =="
+timeout 120 python -c "import jax; print(jax.devices()[0].platform)" 2>&1 | tail -1 | tee "$OUT/probe.log"
+grep -qi "^tpu$" "$OUT/probe.log" || { echo "chip unreachable; aborting"; exit 3; }
+
+echo "== 1. fused-kernel parity lane (make test-tpu) =="
+timeout 1200 make test-tpu 2>&1 | tail -3 | tee "$OUT/test_tpu.log"
+
+echo "== 2. full default bench =="
+timeout 1300 python bench.py > "$OUT/bench.json.log" 2> "$OUT/bench.stderr.log"
+echo "rc=$?" >> "$OUT/bench.stderr.log"
+tail -1 "$OUT/bench.json.log"
+
+echo "== 3. roofline sweep =="
+timeout 1300 python bench.py --roofline > "$OUT/roofline.json.log" 2> "$OUT/roofline.stderr.log"
+echo "rc=$?" >> "$OUT/roofline.stderr.log"
+tail -1 "$OUT/roofline.json.log"
+
+echo "captured under $OUT"
