@@ -58,6 +58,12 @@ bench:
 parity-go:
 	python tools/parity_go.py
 
+# Same corpus, replayed against OUR wire-compatible per-process gRPC
+# cluster over the identical serialized POST /compute protocol — proves
+# the replay harness end-to-end where Docker is absent.
+parity-local:
+	python tools/parity_go.py --local
+
 # Regenerate the parity corpus (rewrites tests/corpus/parity/*.json with
 # freshly recorded engine outputs; commit the result).
 parity-corpus:
@@ -77,4 +83,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-tpu bench parity-go parity-corpus stop clean
+.PHONY: native grpc cert test test-tpu bench parity-go parity-local parity-corpus stop clean

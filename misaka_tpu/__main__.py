@@ -213,6 +213,10 @@ def main(argv=None) -> int:
 
     args = parser.parse_args(argv)
     if args.command == "serve":
+        # same boot-window signal contract as `python -m misaka_tpu.runtime.app`
+        from misaka_tpu.runtime.lifecycle import arm_boot_handlers
+
+        arm_boot_handlers()
         from misaka_tpu.runtime.app import main as serve_main
 
         serve_main()

@@ -1,6 +1,22 @@
-"""Host runtime: topology config, master HTTP control surface, entrypoint."""
+"""Host runtime: topology config, master HTTP control surface, entrypoint.
 
-from misaka_tpu.runtime.topology import Topology, TopologyError
-from misaka_tpu.runtime.master import MasterNode, make_http_server
+Lazy re-exports (PEP 562): `python -m misaka_tpu.runtime.app` imports THIS
+package before app.py's body can arm its provisional boot-window signal
+handlers — an eager `from .master import ...` here would widen the window
+in which a SIGTERM kills the server with the default disposition instead
+of a clean exit 0 (tests/test_lifecycle.py pins the contract).
+"""
 
 __all__ = ["Topology", "TopologyError", "MasterNode", "make_http_server"]
+
+
+def __getattr__(name):
+    if name in ("Topology", "TopologyError"):
+        from misaka_tpu.runtime import topology
+
+        return getattr(topology, name)
+    if name in ("MasterNode", "make_http_server"):
+        from misaka_tpu.runtime import master
+
+        return getattr(master, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

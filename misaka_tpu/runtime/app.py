@@ -70,6 +70,15 @@ import logging
 import os
 import sys
 
+if __name__ == "__main__":
+    # Provisional boot-window handlers, armed BEFORE the multi-second jax
+    # imports below (exec path only — a library import must not touch the
+    # importer's signal table); `python -m misaka_tpu serve` arms the same
+    # handlers in its own entry (runtime/lifecycle.arm_boot_handlers).
+    from misaka_tpu.runtime.lifecycle import arm_boot_handlers
+
+    arm_boot_handlers()
+
 # Captured at package import, before the heavy jax imports below: if our
 # launching shell dies during the multi-second boot,
 # lifecycle.install_guards compares against this and exits instead of

@@ -35,6 +35,19 @@ _log = logging.getLogger("misaka_tpu.lifecycle")
 _POLL_S = 2.0
 
 
+def arm_boot_handlers() -> None:
+    """Provisional SIGTERM/SIGINT handlers for the boot window.
+
+    Server entrypoints call this BEFORE their heavy jax imports: a signal
+    that lands mid-boot must still exit clean (0 / 130) — nothing holds the
+    chip yet, and the operator contract (TERM => orderly exit) starts at
+    exec, not at "fully booted".  install_guards() replaces these with the
+    stop-aware handlers once the node exists.
+    """
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
+    signal.signal(signal.SIGINT, lambda *_: os._exit(130))
+
+
 def install_guards(stop, environ=os.environ, start_ppid: int | None = None) -> None:
     """Arm all guards around `stop()` (idempotent, must tolerate re-entry).
 

@@ -33,6 +33,28 @@ def test_corpus_engine_outputs_current(path):
         assert sorted(outs) == sorted(case["engine_outputs"]), case["name"]
 
 
+def test_replayer_local_cluster_mode():
+    """tools/parity_go.py --local replays the corpus against OUR
+    wire-compatible per-process gRPC cluster through the same serialized
+    POST /compute feed/compare code the Docker replay uses — the harness
+    itself is exercised end to end, not just written down (a subset of
+    cases keeps the suite fast; the full 13 run in `make parity-local`)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(os.path.dirname(__file__), "..", "tools", "parity_go.py"),
+            "--local", "add2", "kahn_002", "contended_000",
+        ],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("OK ") == 3, out.stdout
+
+
 def test_replayer_skips_cleanly_without_docker():
     """`make parity-go` must be safe everywhere: in an environment without
     Docker (this one) the replayer exits 0 with a SKIP notice."""

@@ -17,7 +17,12 @@ Env:
   MISAKA_REFERENCE   reference checkout (default /root/reference)
   MISAKA_PARITY_TIMEOUT  per-case seconds (default 120)
 
-Usage: python tools/parity_go.py [case ...]   (default: every corpus case)
+Usage: python tools/parity_go.py [--local] [case ...]
+  default   replay against the Go binary via Docker (SKIP if unavailable)
+  --local   replay against THIS build's wire-compatible per-process gRPC
+            cluster (runtime/nodes.py) over the same serialized /compute
+            protocol — proves the harness end to end without Docker
+  case ...  restrict to named corpus cases (default: all)
 """
 
 from __future__ import annotations
@@ -96,6 +101,84 @@ def _post(url: str, data: bytes, timeout: float) -> bytes:
         return resp.read()
 
 
+def _replay_http(base: str, case: dict, timeout: float) -> list[int]:
+    """Feed the case through serialized POST /compute — the same protocol
+    for the Go binary and the local wire-compatible cluster."""
+    deadline = time.monotonic() + timeout
+    while True:  # wait for the master's HTTP surface
+        try:
+            _post(base + "/run", b"", 2)
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"{case['name']}: master never came up")
+            time.sleep(0.5)
+    outs = []
+    for v in case["inputs"]:  # serialized: pairing unambiguous
+        raw = _post(base + "/compute", f"value={v}".encode(), timeout)
+        outs.append(int(json.loads(raw)["value"]))
+    return outs
+
+
+def _check(case: dict, outs: list[int], source: str) -> bool:
+    want = case["engine_outputs"]
+    ok = (outs == want) if case["compare"] == "stream" else (sorted(outs) == sorted(want))
+    marker = "OK " if ok else "FAIL"
+    print(f"{marker} {case['name']} [{case['compare']}]: {source}={outs} engine={want}")
+    return ok
+
+
+def run_case_local(case: dict) -> bool:
+    """Replay one corpus case against OUR per-process gRPC cluster through
+    its real HTTP surface — the replayer's feed/compare half exercised end
+    to end in environments without Docker (the cluster speaks the
+    reference's exact wire protocol, runtime/nodes.py)."""
+    import threading
+
+    from misaka_tpu.runtime.master import make_http_server
+    from misaka_tpu.runtime.nodes import (
+        MasterNodeProcess,
+        ProgramNodeProcess,
+        Resolver,
+        StackNodeProcess,
+    )
+
+    resolver = Resolver()
+    nodes = {}
+    httpd = None
+    try:
+        for name, kind in case["node_info"].items():
+            if kind == "stack":
+                s = StackNodeProcess(grpc_port=0, host="127.0.0.1")
+                resolver.set_addr(name, f"127.0.0.1:{s.start()}")
+                nodes[name] = s
+        for name, kind in case["node_info"].items():
+            if kind == "program":
+                p = ProgramNodeProcess(
+                    master_uri="last_order", resolver=resolver,
+                    grpc_port=0, host="127.0.0.1",
+                )
+                p.load_program(case["programs"][name])
+                resolver.set_addr(name, f"127.0.0.1:{p.start()}")
+                nodes[name] = p
+        master = MasterNodeProcess(
+            node_info={n: {"type": k} for n, k in case["node_info"].items()},
+            resolver=resolver, grpc_port=0, host="127.0.0.1",
+        )
+        resolver.set_addr("last_order", f"127.0.0.1:{master.start()}")
+        nodes["__master__"] = master
+        httpd = make_http_server(master, port=0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        outs = _replay_http(base, case, TIMEOUT)
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        for n in nodes.values():
+            n.close()
+    return _check(case, outs, "cluster")
+
+
 def run_case(compose, case: dict, master_port: int = 18800) -> bool:
     name = case["name"]
     with tempfile.TemporaryDirectory(prefix=f"parity_{name}_") as tmp:
@@ -105,44 +188,45 @@ def run_case(compose, case: dict, master_port: int = 18800) -> bool:
         up = compose + ["-f", cf, "up", "--build", "-d"]
         try:
             subprocess.run(up, check=True, capture_output=True, timeout=600)
-            base = f"http://127.0.0.1:{master_port}"
-            deadline = time.monotonic() + TIMEOUT
-            while True:  # wait for the master's HTTP surface
-                try:
-                    _post(base + "/run", b"", 2)
-                    break
-                except Exception:
-                    if time.monotonic() > deadline:
-                        raise RuntimeError(f"{name}: master never came up")
-                    time.sleep(1)
-            outs = []
-            for v in case["inputs"]:  # serialized /compute: unambiguous pairing
-                raw = _post(base + "/compute", f"value={v}".encode(), TIMEOUT)
-                outs.append(int(json.loads(raw)["value"]))
+            outs = _replay_http(f"http://127.0.0.1:{master_port}", case, TIMEOUT)
         finally:
             subprocess.run(
                 compose + ["-f", cf, "down", "-t", "2"],
                 capture_output=True, timeout=120,
             )
-    want = case["engine_outputs"]
-    ok = (outs == want) if case["compare"] == "stream" else (sorted(outs) == sorted(want))
-    marker = "OK " if ok else "FAIL"
-    print(f"{marker} {name} [{case['compare']}]: go={outs} engine={want}")
-    return ok
+    return _check(case, outs, "go")
 
 
 def main() -> int:
-    if not os.path.isdir(os.path.join(REFERENCE, "cmd")):
-        print(f"SKIP: reference checkout not found at {REFERENCE}")
-        return 0
-    compose = _compose_cmd()
-    if compose is None:
-        print("SKIP: docker / docker-compose not available in this environment")
-        return 0
-    wanted = set(sys.argv[1:])
+    args = sys.argv[1:]
+    local = "--local" in args
+    unknown = [a for a in args if a.startswith("--") and a != "--local"]
+    if unknown:  # a typo'd flag must not silently become a green no-op run
+        print(f"unknown flag(s): {unknown}\n\n{__doc__.split('Usage:')[1]}")
+        return 2
+    wanted = {a for a in args if not a.startswith("--")}
+    if local:
+        sys.path.insert(0, REPO)
+        compose = None
+    else:
+        if not os.path.isdir(os.path.join(REFERENCE, "cmd")):
+            print(f"SKIP: reference checkout not found at {REFERENCE}")
+            return 0
+        compose = _compose_cmd()
+        if compose is None:
+            print(
+                "SKIP: docker / docker-compose not available in this "
+                "environment (tools/parity_go.py --local replays the corpus "
+                "against the wire-compatible per-process cluster instead)"
+            )
+            return 0
     files = sorted(glob.glob(os.path.join(CORPUS, "*.json")))
     if not files:
         print(f"no corpus at {CORPUS}; run tools/gen_parity_corpus.py first")
+        return 2
+    known = {os.path.splitext(os.path.basename(p))[0] for p in files}
+    if wanted - known:  # a typo'd case must not become a green 0-case run
+        print(f"unknown case(s): {sorted(wanted - known)}; corpus has {sorted(known)}")
         return 2
     failures = 0
     for path in files:
@@ -151,13 +235,13 @@ def main() -> int:
         if wanted and case["name"] not in wanted:
             continue
         try:
-            ok = run_case(compose, case)
+            ok = run_case_local(case) if local else run_case(compose, case)
         except Exception as e:  # infra failure: count, keep replaying
             print(f"FAIL {case['name']}: {type(e).__name__}: {e}")
             ok = False
         if not ok:
             failures += 1
-    print(f"parity-go: {failures} failure(s)")
+    print(f"parity-go{' --local' if local else ''}: {failures} failure(s)")
     return 1 if failures else 0
 
 
