@@ -752,6 +752,7 @@ def main():
         unit="inputs/sec",
         vs_baseline=round(headline["throughput"] / NORTH_STAR, 3),
         ticks_per_sec=round(headline["ticks_per_sec"], 1),
+        platform=platform,  # which hardware produced this artifact
     )
     if not run_all:
         payload.pop("configs", None)

@@ -820,3 +820,48 @@ class _MasterServicer:
     def send_output(self, request, context):
         self._node.send_output(request.value)
         return _EMPTY()
+
+
+def build_loopback_cluster(node_info, programs, master_name: str = "last_order"):
+    """Spin the whole wire-compatible cluster on loopback ephemeral ports.
+
+    node_info: {name: "program"|"stack"}; programs: {name: source}.  Returns
+    (master, close): a started (not yet /run) MasterNodeProcess plus a
+    close() that tears everything down in dependency order — master first,
+    then program nodes, then stacks — so no free-running execute loop is
+    left retrying RPCs against an already-closed peer.  Shared by the
+    cross-mode differential suite and the parity replayer's --local mode.
+    """
+    resolver = Resolver()
+    stacks: list[StackNodeProcess] = []
+    progs: list[ProgramNodeProcess] = []
+    master: MasterNodeProcess | None = None
+
+    def close() -> None:
+        for n in ([master] if master is not None else []) + progs + stacks:
+            n.close()
+
+    try:
+        for name, kind in node_info.items():
+            if kind == "stack":
+                s = StackNodeProcess(grpc_port=0, host="127.0.0.1")
+                resolver.set_addr(name, f"127.0.0.1:{s.start()}")
+                stacks.append(s)
+        for name, kind in node_info.items():
+            if kind == "program":
+                p = ProgramNodeProcess(
+                    master_uri=master_name, resolver=resolver,
+                    grpc_port=0, host="127.0.0.1",
+                )
+                p.load_program(programs[name])
+                resolver.set_addr(name, f"127.0.0.1:{p.start()}")
+                progs.append(p)
+        master = MasterNodeProcess(
+            node_info={n: {"type": k} for n, k in node_info.items()},
+            resolver=resolver, grpc_port=0, host="127.0.0.1",
+        )
+        resolver.set_addr(master_name, f"127.0.0.1:{master.start()}")
+    except BaseException:
+        close()
+        raise
+    return master, close

@@ -41,12 +41,7 @@ import time
 import numpy as np
 import pytest
 
-from misaka_tpu.runtime.nodes import (
-    MasterNodeProcess,
-    ProgramNodeProcess,
-    Resolver,
-    StackNodeProcess,
-)
+from misaka_tpu.runtime.nodes import build_loopback_cluster
 from misaka_tpu.runtime.topology import Topology
 
 IN_CAP = OUT_CAP = 32
@@ -158,35 +153,9 @@ def run_engine(node_info, programs, inputs):
 
 def run_cluster(node_info, programs, inputs, expect_n, timeout=30.0):
     """The free-running path: real gRPC nodes on loopback, fed as a stream."""
-    resolver = Resolver()
-    nodes = {}
-    master = None
+    master, close = build_loopback_cluster(node_info, programs)
     try:
-        for name, kind in node_info.items():
-            if kind == "stack":
-                s = StackNodeProcess(grpc_port=0, host="127.0.0.1")
-                resolver.set_addr(name, f"127.0.0.1:{s.start()}")
-                nodes[name] = s
-        for name, kind in node_info.items():
-            if kind == "program":
-                p = ProgramNodeProcess(
-                    master_uri="last_order",
-                    resolver=resolver,
-                    grpc_port=0,
-                    host="127.0.0.1",
-                )
-                p.load_program(programs[name])
-                resolver.set_addr(name, f"127.0.0.1:{p.start()}")
-                nodes[name] = p
-        master = MasterNodeProcess(
-            node_info={n: {"type": k} for n, k in node_info.items()},
-            resolver=resolver,
-            grpc_port=0,
-            host="127.0.0.1",
-        )
-        resolver.set_addr("last_order", f"127.0.0.1:{master.start()}")
         master.run()
-
         # stream all inputs into the master's IN queue (the GetInput side of
         # master.go:233-242) and wait for the output stream
         with master._io_cond:
@@ -204,10 +173,7 @@ def run_cluster(node_info, programs, inputs, expect_n, timeout=30.0):
             f"cluster produced {len(got)}/{expect_n} outputs in {timeout}s: {got}"
         )
     finally:
-        if master is not None:
-            master.close()
-        for n in nodes.values():
-            n.close()
+        close()
 
 
 @pytest.mark.parametrize("seed", range(40))

@@ -75,6 +75,33 @@ def test_mosaic_chunked_mode_parity():
     assert int(np.asarray(out.out_wr).min()) > 0
 
 
+def test_compact_kernel_hw_parity():
+    """The compact scatter-election kernel (core/routing.py) compiled for
+    real TPU vs the dense kernel on the same wide pipeline — scatters lower
+    differently under Mosaic/XLA-TPU than in the CPU suite, and the compact
+    kernel is the auto-selected engine at >= 32 lanes (kept at a
+    measured-safe batch: wide dense/scatter configs at large batch have
+    wedged this chip, see bench.py's caps)."""
+    top = networks.pipeline(64, in_cap=8, out_cap=8, stack_cap=8)
+    net = top.compile(batch=64)
+    rng = np.random.default_rng(3)
+    vals = rng.integers(-1000, 1000, size=(64, 4)).astype(np.int32)
+
+    def prep(state):
+        return state._replace(
+            in_buf=state.in_buf.at[:, :4].set(vals), in_wr=state.in_wr + 4
+        )
+
+    dense = net.run(prep(net.init_state()), 250, engine="dense")
+    compact = net.run(prep(net.init_state()), 250, engine="compact")
+    assert_states_equal(dense, compact)
+    # the pipeline completed: every instance emitted all 4 values, +64 each
+    np.testing.assert_array_equal(np.asarray(compact.out_wr), 4)
+    np.testing.assert_array_equal(
+        np.asarray(compact.out_buf)[:, :4], vals + 64
+    )
+
+
 def test_mosaic_deep_stack_parity():
     # stack depth crosses the 64-slot chunk boundary under Mosaic
     top = Topology(

@@ -136,37 +136,11 @@ def run_case_local(case: dict) -> bool:
     import threading
 
     from misaka_tpu.runtime.master import make_http_server
-    from misaka_tpu.runtime.nodes import (
-        MasterNodeProcess,
-        ProgramNodeProcess,
-        Resolver,
-        StackNodeProcess,
-    )
+    from misaka_tpu.runtime.nodes import build_loopback_cluster
 
-    resolver = Resolver()
-    nodes = {}
+    master, close = build_loopback_cluster(case["node_info"], case["programs"])
     httpd = None
     try:
-        for name, kind in case["node_info"].items():
-            if kind == "stack":
-                s = StackNodeProcess(grpc_port=0, host="127.0.0.1")
-                resolver.set_addr(name, f"127.0.0.1:{s.start()}")
-                nodes[name] = s
-        for name, kind in case["node_info"].items():
-            if kind == "program":
-                p = ProgramNodeProcess(
-                    master_uri="last_order", resolver=resolver,
-                    grpc_port=0, host="127.0.0.1",
-                )
-                p.load_program(case["programs"][name])
-                resolver.set_addr(name, f"127.0.0.1:{p.start()}")
-                nodes[name] = p
-        master = MasterNodeProcess(
-            node_info={n: {"type": k} for n, k in case["node_info"].items()},
-            resolver=resolver, grpc_port=0, host="127.0.0.1",
-        )
-        resolver.set_addr("last_order", f"127.0.0.1:{master.start()}")
-        nodes["__master__"] = master
         httpd = make_http_server(master, port=0)
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
         base = f"http://127.0.0.1:{httpd.server_address[1]}"
@@ -174,8 +148,8 @@ def run_case_local(case: dict) -> bool:
     finally:
         if httpd is not None:
             httpd.shutdown()
-        for n in nodes.values():
-            n.close()
+            httpd.server_close()
+        close()
     return _check(case, outs, "cluster")
 
 
