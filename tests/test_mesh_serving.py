@@ -139,6 +139,88 @@ def test_mesh_checkpoint_roundtrip(tmp_path):
         m2.pause()
 
 
+def test_mesh_mp_load_rebuilds_route_table():
+    """/load on a model-parallel mesh recompiles the routed kernel: the new
+    program's MOV_NET edges produce a NEW static route table (the old one
+    must not leak into the rebuilt runner)."""
+    master = MasterNode(
+        networks.ring(4, in_cap=8, out_cap=8, stack_cap=8),
+        chunk_steps=64, batch=2, model_parallel=4,
+    )
+    master.run()
+    try:
+        assert master.compute(5, timeout=60) == 9  # ring4: v + 4
+    finally:
+        master.pause()
+    # reroute ring0: skip the lap, add 10, emit (edges change: ring0 no
+    # longer sends to ring1 — its dest slot disappears from the table)
+    master.load("ring0", "IN ACC\nADD 10\nOUT ACC")
+    master.run()
+    try:
+        assert master.engine_name == "routed"
+        assert master.compute(5, timeout=60) == 15
+    finally:
+        master.pause()
+
+
+def test_mesh_mp_autogrow():
+    """Stack auto-grow under model-parallel serving: the grow path rebuilds
+    the routed runner for the doubled stack_cap and pads the sharded state."""
+    from misaka_tpu.runtime.topology import Topology
+
+    top = Topology(
+        node_info={"p": "program", "q": "program", "st": "stack"},
+        programs={
+            # p: push until 0 sentinel, then emit sentinel and drain (needs
+            # depth len(values), wedges at stack_cap=8 with 12 values)
+            "p": (
+                "top: IN ACC\nJEZ dump\nPUSH ACC, st\nJMP top\n"
+                "dump: OUT ACC\npop: POP st, ACC\nOUT ACC\nJMP pop\n"
+            ),
+            "q": "NOP\n",  # second lane so the lane axis shards over mp=2
+        },
+        in_cap=32, out_cap=32, stack_cap=8,
+    )
+    master = MasterNode(top, chunk_steps=32, batch=2, model_parallel=2)
+    master.run()
+    try:
+        vals = list(range(1, 13))
+        outs = master.compute_many(vals + [0], timeout=90)
+        assert outs == [0] + vals[::-1]
+    finally:
+        master.pause()
+    assert master._net.stack_cap >= 16
+    assert master.engine_name == "routed"
+
+
+def test_mesh_mp_checkpoint_roundtrip(tmp_path):
+    """Checkpoint/restore with lane-sharded (model-parallel) state: the
+    snapshot gathers sharded arrays to host; restore re-places them on the
+    mesh with the canonical shardings."""
+    def fresh():
+        return MasterNode(
+            networks.mesh8(in_cap=8, out_cap=8, stack_cap=8),
+            chunk_steps=64, batch=2, model_parallel=8,
+        )
+
+    m1 = fresh()
+    m1.run()
+    try:
+        assert m1.compute(7, timeout=60) == 11
+    finally:
+        m1.pause()
+    path = str(tmp_path / "mesh_mp.npz")
+    m1.save_checkpoint(path)
+
+    m2 = fresh()
+    m2.load_checkpoint(path)
+    m2.run()
+    try:
+        assert m2.compute(100, timeout=60) == 104
+    finally:
+        m2.pause()
+
+
 def test_mesh_requires_batch_and_divisibility():
     with pytest.raises(ValueError, match="requires batch"):
         MasterNode(networks.add2(), data_parallel=8)
