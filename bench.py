@@ -135,9 +135,16 @@ def _arm_init_watchdog(environ=os.environ):
             # caller meant for TPU (they cost tens of minutes on CPU)
             argv = [a for a in sys.argv if a not in ("--all", "--roofline")]
             try:
+                # the backend may have come up between the deadline firing and
+                # this point (init completing at ~ttl is exactly when the race
+                # is live); a healthy session must not be thrown away
+                if ready.is_set():
+                    return
                 os.execve(sys.executable, [sys.executable] + argv, env)
             except OSError as e:  # pragma: no cover — then the plain failure
                 print(f"# fallback exec failed: {e}", file=sys.stderr, flush=True)
+        if ready.is_set():  # init beat the deadline after all — keep the session
+            return
         os._exit(3)
 
     t = threading.Timer(ttl, boom)
@@ -164,7 +171,7 @@ def _preflight():
                 cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
         except OSError:
             continue
-        if "misaka_tpu" in cmd or "bench.py" in cmd:
+        if "misaka_tpu" in cmd or "bench.py" in cmd or "chip_probe" in cmd:
             print(
                 f"# WARNING: pid {pid} looks like a live misaka process and may "
                 f"hold the TPU: {cmd[:120]!r} (make stop kills stragglers)",
@@ -182,6 +189,47 @@ def _enable_compile_cache():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception as e:  # pragma: no cover — cache is best-effort
         print(f"# compile cache unavailable: {e}", file=sys.stderr)
+
+
+def _last_tpu_context():
+    """Latest committed platform=="tpu" bench artifact (round, headline), so a
+    CPU-fallback payload stays self-describing across rounds instead of
+    reading as a 1000x regression (VERDICT r4 weak #7)."""
+    import glob
+    import re
+
+    best = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except Exception:
+            continue
+        # the driver wraps the bench line under "parsed" (+ stderr "tail");
+        # rounds 1-2 predate the in-payload platform label, so fall back to
+        # the "platform=tpu" marker bench prints to stderr
+        parsed = data.get("parsed")
+        if not isinstance(parsed, dict) or parsed.get("value") is None:
+            continue  # crashed/partial round: no trustworthy headline
+        on_tpu = parsed.get("platform") == "tpu" or (
+            "platform" not in parsed and "platform=tpu" in data.get("tail", "")
+        )
+        if not on_tpu or parsed.get("fallback") or data.get("rc", 0) != 0:
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd > best["round"]:
+            best = {
+                "round": rnd,
+                "metric": parsed.get("metric"),
+                "value": parsed.get("value"),
+                "unit": parsed.get("unit"),
+                "vs_baseline": parsed.get("vs_baseline"),
+            }
+    return best
 
 
 def _expect_sorter(v):
@@ -486,7 +534,12 @@ def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1
     times = [once()]
     while sum(times) < min_time and len(times) < 6:
         times.append(once())
+    # best-of-reps since r4 (r3 and earlier: single timed run); median is
+    # emitted alongside so single-shot rounds stay comparable
+    import statistics
+
     elapsed = min(times)
+    median = statistics.median(times)
 
     total = batch * per_instance
     return {
@@ -496,6 +549,7 @@ def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1
         "ticks": ticks,
         "reps": len(times),
         "ticks_per_sec": ticks / elapsed,
+        "ticks_per_sec_median": ticks / median,
         "throughput": total / elapsed,
         "elapsed_s": elapsed,
     }
@@ -776,6 +830,11 @@ def main():
     payload["platform"] = platform
     if fallback:
         payload["fallback"] = "cpu (TPU backend unavailable at init)"
+        # a reduced CPU number reads as a 1000x regression unless the artifact
+        # carries the last real TPU measurement alongside it
+        last = _last_tpu_context()
+        if last:
+            payload["last_tpu"] = last
     results = {}
     for name in CONFIGS if run_all else ["add2"]:
         # fallback mode shrinks the batch: the CPU number is an honest
@@ -896,7 +955,9 @@ def main():
                 "lanes": n,
                 "engine": engine,
                 "batch": r["batch"],
+                "reps": r["reps"],
                 "ticks_per_sec": round(r["ticks_per_sec"], 1),
+                "ticks_per_sec_median": round(r["ticks_per_sec_median"], 1),
                 "throughput": round(r["throughput"], 1),
             }
         )

@@ -1,7 +1,8 @@
 """Shared build-and-load scaffolding for the native C++ backends.
 
 Both native components (tis/native.py assembler, core/cinterp.py interpreter)
-follow the same contract: a checked-in .so for zero-setup use, rebuilt
+follow the same contract: the .so is built on demand next to its source
+(binaries are NOT checked in; `make native` prebuilds them), rebuilt
 whenever the binary does not carry the current source's identity hash or
 fails to load (stale/foreign-arch artifact) and a compiler is available; a
 process-wide failure latch so an unavailable toolchain degrades quietly to

@@ -1,0 +1,11 @@
+"""One TPU-health probe: exit 0 + print "OK tpu ..." iff the relayed chip
+answers a tiny jit.  Run under `timeout`; the script path carries the misaka
+repo marker so a live probe holding the chip is greppable (pgrep -f).
+"""
+import jax
+
+d = jax.devices()
+import jax.numpy as jnp
+
+v = jax.jit(lambda x: x * 2)(jnp.ones((8,))).sum()
+print("OK", d[0].platform, float(v))

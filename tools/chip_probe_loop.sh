@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Background chip-health prober: appends one line per probe to .chipprobe.log
+# and EXITS after the first UP (so it never contends with a capture run).
+# Skips a probe while any misaka/bench process is alive — a probe holding the
+# relayed chip for up to 120s would stall a real bench toward its watchdog,
+# and probing while bench holds the chip would log a false DOWN.
+LOG=/root/repo/.chipprobe.log
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  if pgrep -f 'misaka_tpu|bench\.py|tpu_capture' >/dev/null 2>&1; then
+    echo "$ts SKIP (misaka/bench process alive)" >> "$LOG"
+  else
+    out=$(timeout 120 python /root/repo/tools/chip_probe.py 2>&1)
+    rc=$?
+    if [ $rc -eq 0 ] && echo "$out" | grep -q "^OK tpu"; then
+      echo "$ts UP $out" >> "$LOG"
+      exit 0
+    fi
+    echo "$ts DOWN rc=$rc $(echo "$out" | tail -1)" >> "$LOG"
+  fi
+  sleep 600
+done

@@ -153,7 +153,19 @@ def run_case_local(case: dict) -> bool:
     return _check(case, outs, "cluster")
 
 
-def run_case(compose, case: dict, master_port: int = 18800) -> bool:
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_case(compose, case: dict, master_port: int = 0) -> bool:
+    # a fixed host port turns concurrent invocations (or a stale container)
+    # into spurious FAILs; bind an ephemeral one per case instead
+    if not master_port:
+        master_port = _free_port()
     name = case["name"]
     with tempfile.TemporaryDirectory(prefix=f"parity_{name}_") as tmp:
         cf = os.path.join(tmp, "docker-compose.yml")
