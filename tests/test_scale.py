@@ -5,7 +5,8 @@ dimensions a user would actually grow — stack depth (the reference's
 unbounded IntStack is the long-context analogue, SURVEY.md §5) and lane
 count (deeper pipelines) — including the lane-sharded multi-chip path and
 the compact scatter-election kernel that auto-replaces the dense one-hot
-kernel at/above COMPACT_AUTO_LANES lanes (core/routing.py; the dense
+kernel at/above compact_auto_lanes() lanes — platform-dependent, 0 on CPU
+(core/routing.py; the dense
 kernel's O(N·4N) election matrices fault the TPU worker at 256 lanes under
 production batches).
 """
@@ -182,3 +183,36 @@ def test_wide_pipeline_sharded():
     assert out_count == 3
     buf = np.asarray(ref.out_buf)
     assert buf[:3].tolist() == [39, 40, 41]
+
+
+def test_compact_auto_lanes_platform_and_override(monkeypatch):
+    """The dense->compact auto-threshold is platform-dependent (CPU: compact
+    always wins, measured r5) and env-overridable for A/B probes."""
+    import jax
+
+    from misaka_tpu.core.engine import compact_auto_lanes
+
+    monkeypatch.delenv("MISAKA_COMPACT_AUTO_LANES", raising=False)
+    expected = {"cpu": 0, "tpu": COMPACT_AUTO_LANES}.get(
+        jax.default_backend(), COMPACT_AUTO_LANES
+    )
+    assert compact_auto_lanes() == expected
+    monkeypatch.setenv("MISAKA_COMPACT_AUTO_LANES", "7")
+    assert compact_auto_lanes() == 7
+
+
+def test_cpu_auto_selects_compact_small_net():
+    """On CPU even a reference-scale (3-lane) network auto-runs the compact
+    kernel — 1.5-2.4x dense on the serving path (ARCHITECTURE.md)."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("CPU auto-selection probe")
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    net = top.compile()
+    # the auto choice must BE the compact kernel, not just clear the
+    # threshold: step_fn() returns the route-table closure on CPU
+    assert net.step_fn() is net._compact_step()
+    from misaka_tpu.core.engine import compact_auto_lanes
+
+    assert net.num_lanes >= compact_auto_lanes()
