@@ -45,7 +45,13 @@ cert:
 test-tpu:
 	MISAKA_TPU_TESTS=1 python -m pytest tests/test_tpu.py -m tpu -q
 
+# Fast lane: every component smoke-covered, fuzz/scale/multi-process
+# suites excluded (marked slow) — target < 3 min.
 test:
+	python -m pytest tests/ -x -q -m "not slow"
+
+# Everything, including the slow fuzz/scale/multi-process lanes (~20+ min).
+test-all:
 	python -m pytest tests/ -x -q
 
 bench:
@@ -83,4 +89,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-tpu bench parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu bench parity-go parity-local parity-corpus stop clean

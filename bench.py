@@ -514,8 +514,14 @@ def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1
 
     # fill (3 ticks/stage) + drain (3 ticks/value) + slack
     ticks = 3 * n_lanes + 3 * per_instance + 64
+    block_used = None
     if engine == "fused":
-        runner = net.fused_runner(ticks, block_batch=min(batch, 2048))
+        # wide nets blow the VMEM carry budget at big blocks (64 lanes =
+        # 1102 carry rows = 9 MB at block 2048): the shared walk
+        # (engine.fused_runner_walk) picks the largest fitting block
+        runner, block_used = net.fused_runner_walk(
+            ticks, candidates=(2048, 1024, 512, 256, 128)
+        )
     else:
         runner = lambda s: net.run(s, ticks, engine=engine)
 
@@ -542,7 +548,7 @@ def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1
     median = statistics.median(times)
 
     total = batch * per_instance
-    return {
+    out = {
         "lanes": n_lanes,
         "engine": engine,
         "batch": batch,
@@ -553,6 +559,11 @@ def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1
         "throughput": total / elapsed,
         "elapsed_s": elapsed,
     }
+    if block_used is not None:
+        # provenance: a ticks/s shift must be attributable to code vs a
+        # silently different block size picked by the walk
+        out["block_batch"] = block_used
+    return out
 
 
 def bench_roofline(batches=(65536, 262144, 1048576), per_instance=128):
@@ -1006,17 +1017,18 @@ def main():
             f"reps={r['reps']})",
             file=sys.stderr,
         )
-        lanes.append(
-            {
-                "lanes": n,
-                "engine": engine,
-                "batch": r["batch"],
-                "reps": r["reps"],
-                "ticks_per_sec": round(r["ticks_per_sec"], 1),
-                "ticks_per_sec_median": round(r["ticks_per_sec_median"], 1),
-                "throughput": round(r["throughput"], 1),
-            }
-        )
+        entry = {
+            "lanes": n,
+            "engine": engine,
+            "batch": r["batch"],
+            "reps": r["reps"],
+            "ticks_per_sec": round(r["ticks_per_sec"], 1),
+            "ticks_per_sec_median": round(r["ticks_per_sec_median"], 1),
+            "throughput": round(r["throughput"], 1),
+        }
+        if "block_batch" in r:
+            entry["block_batch"] = r["block_batch"]
+        lanes.append(entry)
     payload["lane_scaling"] = lanes
     print(json.dumps(payload))
 

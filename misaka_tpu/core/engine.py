@@ -316,6 +316,7 @@ class CompiledNetwork:
         block_batch: int | None = None,
         interpret: bool = False,
         unroll_cap: int | None = None,
+        elide_dead_hi: bool | None = None,
     ):
         """The Pallas fast path: fn(state) -> state, `num_steps` ticks in ONE
         kernel launch with all state VMEM-resident (batched networks only).
@@ -342,6 +343,38 @@ class CompiledNetwork:
             block_batch=block_batch,
             interpret=interpret,
             unroll_cap=unroll_cap,
+            elide_dead_hi=elide_dead_hi,
+        )
+
+    def fused_runner_walk(
+        self,
+        num_steps: int,
+        candidates=(None, 512, 256, 128),
+        interpret: bool = False,
+    ):
+        """fused_runner, walking `candidates` block sizes down until one
+        fits the VMEM carry budget (big caps / wide lanes reject large
+        blocks — e.g. 64 lanes is 1,102 carry rows, 9 MB at block 2048).
+
+        Returns (runner, block_batch_used); raises the last budget
+        ValueError when nothing fits.  The ONE copy of the walk, shared by
+        the serving path and the bench lane matrix.
+        """
+        err: ValueError | None = None
+        for bb in candidates:
+            if bb is not None and (self.batch % bb or bb > self.batch):
+                continue
+            try:
+                return (
+                    self.fused_runner(
+                        num_steps, block_batch=bb, interpret=interpret
+                    ),
+                    bb,
+                )
+            except ValueError as e:
+                err = e
+        raise err if err is not None else ValueError(
+            f"no block-size candidate applies to batch={self.batch}"
         )
 
     def make_batched_serve(self, runner, num_steps: int):

@@ -21,6 +21,7 @@ realized as static priority chains).  tests/test_fused.py proves it.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import jax
@@ -89,16 +90,48 @@ def make_fused_runner(
     block_batch: int | None = None,
     interpret: bool = False,
     unroll_cap: int | None = None,
+    elide_dead_hi: bool | None = None,
 ):
     """Build `fn(state) -> state` advancing `num_steps` ticks in one kernel.
 
     Operates on the standard batched NetworkState.  `block_batch` (multiple of
     128, divides batch) bounds VMEM residency per grid block.
+
+    elide_dead_hi: opt-in (default off; env MISAKA_FUSED_ELIDE_HI=1): skip
+    all hi-plane arithmetic on lanes that never read the 64-bit high word
+    (see hi_live below).  Wire/output behavior is bit-identical; the
+    CONTRACT CHANGE is that the returned state's acc_hi/bak_hi planes are
+    unspecified on those lanes (they stay at their entry values instead of
+    tracking overflow no reader would ever see).
     """
     n_lanes = code_np.shape[0]
     n_dests = n_lanes * isa.NUM_PORTS
     n_stacks = max(1, num_stacks)
     progs = _decode(code_np, prog_len_np)
+
+    # Static hi-plane liveness, per lane (the r5 cut at the perf model's
+    # named masked-lane waste, ARCHITECTURE.md "Headroom, named").  The
+    # 64-bit high word of ACC/BAK is READ only by conditional jumps
+    # (JEZ/JNZ/JGZ/JLZ see the full 64-bit value) and by JRO-from-ACC; the
+    # wire (ports, stacks, OUT) truncates to int32 = the lo plane, and
+    # add64/sub64/neg64 compute lo from lo alone.  A lane with none of
+    # those readers can skip ALL hi-plane arithmetic bit-identically —
+    # add2/acc_loop/ring lanes are straight-line or JMP-only, so the
+    # headline kernel drops its hi-plane ops entirely.  JRO from imm/port
+    # reads src_hi derived from the STATIC immediate or the int32 port
+    # latch (not the acc plane), so those lines keep their val_hi fold.
+    _COND_JUMPS = (isa.OP_JEZ, isa.OP_JNZ, isa.OP_JGZ, isa.OP_JLZ)
+    if elide_dead_hi is None:
+        elide_dead_hi = os.environ.get("MISAKA_FUSED_ELIDE_HI") == "1"
+    hi_live = [
+        not elide_dead_hi
+        or any(
+            ins.op in _COND_JUMPS
+            or (ins.op == isa.OP_JRO and ins.src == isa.SRC_ACC)
+            for ins in prog
+        )
+        for prog in progs
+    ]
 
     if block_batch is None:
         block_batch = min(batch, 1024)
@@ -277,7 +310,10 @@ def make_fused_runner(
                     vh = new_hv[n] >> 31  # port values are int32: sext
                     ok = ok & (~a | new_ho[n])
                 val = jnp.where(a, v, val)
-                val_hi = jnp.where(a, vh, val_hi)
+                # hi-dead lanes skip the val_hi fold except for JRO lines,
+                # whose src_hi is live even there (see hi_live above)
+                if hi_live[n] or ins.op == isa.OP_JRO:
+                    val_hi = jnp.where(a, vh, val_hi)
             src_ok.append(ok)
             src_val.append(val)
             src_hi.append(val_hi)
@@ -402,38 +438,60 @@ def make_fused_runner(
                 commit_n = commit_n | c
 
                 # register effects (reading begin-of-tick acc/bak; 64-bit
-                # hi/lo arithmetic per core/regs64.py)
+                # hi/lo arithmetic per core/regs64.py).  `hl` gates the hi
+                # plane: on hi-dead lanes (no 64-bit readers, see hi_live)
+                # every hi op is statically elided — lo arithmetic wraps
+                # exactly like the truncating wire, so this is bit-identical.
+                hl = hi_live[n]
                 if op == isa.OP_MOV_LOCAL and ins.dst == isa.DST_ACC:
                     new_acc[n] = jnp.where(c, src_val[n], new_acc[n])
-                    new_acc_hi[n] = jnp.where(c, src_hi[n], new_acc_hi[n])
+                    if hl:
+                        new_acc_hi[n] = jnp.where(c, src_hi[n], new_acc_hi[n])
                 elif op == isa.OP_ADD:
-                    r_hi, r_lo = regs64.add64(acc_hi[n], acc[n], src_hi[n], src_val[n])
+                    if hl:
+                        r_hi, r_lo = regs64.add64(
+                            acc_hi[n], acc[n], src_hi[n], src_val[n]
+                        )
+                        new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
+                    else:
+                        r_lo = acc[n] + src_val[n]
                     new_acc[n] = jnp.where(c, r_lo, new_acc[n])
-                    new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
                 elif op == isa.OP_SUB:
-                    r_hi, r_lo = regs64.sub64(acc_hi[n], acc[n], src_hi[n], src_val[n])
+                    if hl:
+                        r_hi, r_lo = regs64.sub64(
+                            acc_hi[n], acc[n], src_hi[n], src_val[n]
+                        )
+                        new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
+                    else:
+                        r_lo = acc[n] - src_val[n]
                     new_acc[n] = jnp.where(c, r_lo, new_acc[n])
-                    new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
                 elif op == isa.OP_NEG:
-                    r_hi, r_lo = regs64.neg64(acc_hi[n], acc[n])
+                    if hl:
+                        r_hi, r_lo = regs64.neg64(acc_hi[n], acc[n])
+                        new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
+                    else:
+                        r_lo = -acc[n]
                     new_acc[n] = jnp.where(c, r_lo, new_acc[n])
-                    new_acc_hi[n] = jnp.where(c, r_hi, new_acc_hi[n])
                 elif op == isa.OP_SWP:
                     new_acc[n] = jnp.where(c, bak[n], new_acc[n])
-                    new_acc_hi[n] = jnp.where(c, bak_hi[n], new_acc_hi[n])
                     new_bak[n] = jnp.where(c, acc[n], new_bak[n])
-                    new_bak_hi[n] = jnp.where(c, acc_hi[n], new_bak_hi[n])
+                    if hl:
+                        new_acc_hi[n] = jnp.where(c, bak_hi[n], new_acc_hi[n])
+                        new_bak_hi[n] = jnp.where(c, acc_hi[n], new_bak_hi[n])
                 elif op == isa.OP_SAV:
                     new_bak[n] = jnp.where(c, acc[n], new_bak[n])
-                    new_bak_hi[n] = jnp.where(c, acc_hi[n], new_bak_hi[n])
+                    if hl:
+                        new_bak_hi[n] = jnp.where(c, acc_hi[n], new_bak_hi[n])
                 elif op == isa.OP_POP and ins.dst == isa.DST_ACC:
                     new_acc[n] = jnp.where(c, pop_val[ins.tgt], new_acc[n])
-                    new_acc_hi[n] = jnp.where(
-                        c, pop_val[ins.tgt] >> 31, new_acc_hi[n]
-                    )
+                    if hl:
+                        new_acc_hi[n] = jnp.where(
+                            c, pop_val[ins.tgt] >> 31, new_acc_hi[n]
+                        )
                 elif op == isa.OP_IN and ins.dst == isa.DST_ACC:
                     new_acc[n] = jnp.where(c, in_val, new_acc[n])
-                    new_acc_hi[n] = jnp.where(c, in_val >> 31, new_acc_hi[n])
+                    if hl:
+                        new_acc_hi[n] = jnp.where(c, in_val >> 31, new_acc_hi[n])
 
                 # pc effect (conditions see the full 64-bit acc)
                 nxt = jnp.int32((l + 1) % ln)

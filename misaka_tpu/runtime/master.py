@@ -293,22 +293,17 @@ class MasterNode:
         # smaller block trades grid iterations for residency, so walk down
         # before giving up — the chunked storage mode plus a 128-wide block
         # serves everything the scan engine does.
-        err: ValueError | None = None
-        for bb in (None, 512, 256, 128):
-            if bb is not None and (self._batch % bb or bb > self._batch):
-                continue
-            try:
-                return net.fused_runner(
-                    self._chunk, block_batch=bb,
-                    interpret=(eng == "fused-interpret"),
-                )
-            except ValueError as e:
-                err = e
-        if eng == "auto":
-            # nothing fits (or non-TPU shapes): the scan engine serves
-            # everything the kernel can't
-            return None
-        raise err
+        try:
+            runner, _ = net.fused_runner_walk(
+                self._chunk, interpret=(eng == "fused-interpret")
+            )
+            return runner
+        except ValueError:
+            if eng == "auto":
+                # nothing fits (or non-TPU shapes): the scan engine serves
+                # everything the kernel can't
+                return None
+            raise
 
     def _make_serve_fns(self, net, runner):
         """The batched one-dispatch (serve, idle) jit pair, or None where

@@ -31,6 +31,12 @@ else:
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)
+    # Persistent compile cache: the suite compiles the same tiny kernels
+    # every run (single-CPU box — recompilation IS the suite's wall-clock);
+    # repeat runs hit the disk cache instead.  Keyed by JAX on program +
+    # flags, so staleness is JAX's problem, not ours.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/misaka_jax_test_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
 def pytest_configure(config):
@@ -38,4 +44,9 @@ def pytest_configure(config):
         "markers",
         "tpu: runs the compiled Mosaic kernel on real TPU hardware "
         "(requires MISAKA_TPU_TESTS=1; skipped otherwise)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: fuzz / scale / multi-process suites — `make test` skips "
+        "these (fast lane, <3 min); `make test-all` runs everything",
     )

@@ -54,6 +54,7 @@ def test_concurrent_computes_all_correct():
     assert results == {v: v + 2 for v in range(32)}
 
 
+@pytest.mark.slow
 def test_concurrency_spreads_over_instances():
     master = make_master(batch=4)
     master.run()
@@ -84,6 +85,7 @@ def test_status_reports_batch_and_totals():
     assert s["in_queue"] == 0 and s["out_queue"] == 0
 
 
+@pytest.mark.slow
 def test_timeout_keeps_pairing_per_instance():
     master = make_master(batch=2)  # paused: nothing will compute
     with pytest.raises(ComputeTimeout):
@@ -97,6 +99,7 @@ def test_timeout_keeps_pairing_per_instance():
         master.pause()
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip_batched(tmp_path):
     master = make_master(batch=4)
     master.run()
@@ -174,6 +177,7 @@ def test_compute_many_fifo_pairing():
         master.pause()
 
 
+@pytest.mark.slow
 def test_compute_many_concurrent_chunks():
     master = make_master(batch=4)
     master.run()
@@ -228,6 +232,7 @@ def test_compute_spread_small_falls_back():
         master.pause()
 
 
+@pytest.mark.slow
 def test_compute_spread_concurrent_with_compute():
     master = make_master(batch=8)
     master.run()
@@ -269,6 +274,7 @@ def test_compute_many_empty_and_bad_shape():
         master.compute_many([[1, 2]])
 
 
+@pytest.mark.slow
 def test_fused_interpret_engine_serves():
     """The fused Pallas kernel on the REAL serving path (interpret mode off
     TPU): MISAKA_ENGINE=fused-interpret must produce identical results."""
@@ -309,6 +315,7 @@ def test_unbatched_still_serializes():
         master.pause()
 
 
+@pytest.mark.slow
 def test_reset_during_blocked_compute_keeps_slot_healthy():
     """A reset that wipes a waiting request must not poison its slot's
     pairing (phantom stale counter -> every later compute times out)."""
@@ -341,6 +348,7 @@ def test_reset_during_blocked_compute_keeps_slot_healthy():
         master.pause()
 
 
+@pytest.mark.slow
 def test_free_slot_preferred_over_busy():
     """With one instance stuck, requests flow through the free one instead
     of head-of-line blocking behind the round-robin cursor."""

@@ -35,6 +35,10 @@ design, so the invariant asserted is multiset equality — arbitration
 differences may reorder values but must never lose or duplicate one.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # per-process cluster fuzz — `make test-all` lane
+
 import threading
 import time
 

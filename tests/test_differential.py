@@ -8,6 +8,10 @@ ticks needs no liveness.  The generator emits SOURCE TEXT, so the parser and
 lowering are inside the tested pipeline too.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # fuzzed five-way differential — `make test-all` lane
+
 import numpy as np
 import pytest
 

@@ -8,6 +8,10 @@ through the same HTTP surface.  The reference can only test this with a
 ephemeral loopback ports in one process.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # real-gRPC loopback cluster — `make test-all` lane
+
 import json
 import threading
 import time

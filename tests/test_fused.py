@@ -5,6 +5,10 @@ same kernel body.  Every BASELINE network plus stall/backpressure edge cases
 must produce exactly the same NetworkState as core/step.py.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # interpret-mode kernel parity sweeps — `make test-all` lane
+
 import numpy as np
 import pytest
 
@@ -162,3 +166,65 @@ def test_fused_validates_block_batch():
     net = networks.add2().compile(batch=256)
     with pytest.raises(ValueError, match="multiple"):
         net.fused_runner(8, block_batch=100)
+
+
+def _hi_live_lanes(net):
+    from misaka_tpu.tis import isa
+
+    cond = (isa.OP_JEZ, isa.OP_JNZ, isa.OP_JGZ, isa.OP_JLZ)
+    code = np.asarray(net.code)
+    lens = np.asarray(net.prog_len)
+    live = []
+    for n in range(code.shape[0]):
+        ops = code[n, : lens[n], 0]
+        srcs = code[n, : lens[n], 1]
+        live.append(
+            bool(
+                np.isin(ops, cond).any()
+                or ((ops == isa.OP_JRO) & (srcs == isa.SRC_ACC)).any()
+            )
+        )
+    return live
+
+
+@pytest.mark.parametrize(
+    "name,steps",
+    [("add2", 60), ("acc_loop", 50), ("ring4", 80), ("sorter", 50), ("mesh8", 60)],
+)
+def test_fused_elide_dead_hi_wire_identical(name, steps):
+    """elide_dead_hi=True (the r5 VPU-headroom cut): every observable plane
+    stays bit-identical to core/step.py; only acc_hi/bak_hi of hi-DEAD
+    lanes (no cond-jumps / JRO-ACC readers) become unspecified.  sorter is
+    all-live (branch-heavy) so it pins the live path under the flag too."""
+    top = networks.BASELINE_CONFIGS[name](in_cap=8, out_cap=8, stack_cap=8)
+    net = top.compile(batch=128)
+    rng = np.random.default_rng(5)
+    vals = rng.integers(-100, 100, size=(128, 4)).astype(np.int32)
+
+    def prep(state):
+        return state._replace(
+            in_buf=state.in_buf.at[:, :4].set(vals),
+            in_wr=state.in_wr + 4,
+        )
+
+    ref = net.run(prep(net.init_state()), steps)
+    fused = net.fused_runner(
+        steps, block_batch=128, interpret=True, elide_dead_hi=True
+    )
+    out = fused(prep(net.init_state()))
+
+    live = _hi_live_lanes(net)
+    for field in ref._fields:
+        a, b = np.asarray(getattr(ref, field)), np.asarray(getattr(out, field))
+        if field in ("acc_hi", "bak_hi"):
+            for n, is_live in enumerate(live):
+                if is_live:
+                    np.testing.assert_array_equal(
+                        a[:, n], b[:, n], err_msg=f"{field} lane {n} (hi-LIVE)"
+                    )
+            continue
+        np.testing.assert_array_equal(a, b, err_msg=f"field {field}")
+    if name == "sorter":
+        assert all(live)  # branch-heavy: the flag must not elide anything
+    if name in ("add2", "ring4"):
+        assert not any(live)  # straight-line/JMP-only: fully elided

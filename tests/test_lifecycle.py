@@ -7,6 +7,10 @@ single-client TPU relay, so this is product-surface behavior, not test
 hygiene.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # orphan/TTL wall-clock guards — `make test-all` lane
+
 import json
 import os
 import signal

@@ -7,6 +7,10 @@ stand-in for a multi-slice TPU deployment (parallel/multihost.py doctrine:
 batch over DCN, lanes over ICI).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # two-process DCN coordinator run — `make test-all` lane
+
 import os
 import socket
 import subprocess

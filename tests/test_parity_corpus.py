@@ -12,6 +12,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.slow  # covered every `make test-all`; fast lane favors iteration speed
+
 CORPUS = os.path.join(os.path.dirname(__file__), "corpus", "parity")
 CASES = sorted(glob.glob(os.path.join(CORPUS, "*.json")))
 

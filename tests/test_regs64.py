@@ -11,6 +11,10 @@ registers (core/regs64.py hi/lo planes on device; int64 on hosts) with
 truncation exactly at the wire.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # 64-bit fuzz (four-way differential) — `make test-all` lane
+
 import numpy as np
 import pytest
 

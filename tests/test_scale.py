@@ -11,6 +11,10 @@ kernel's O(N·4N) election matrices fault the TPU worker at 256 lanes under
 production batches).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # wide-lane / deep-stack envelopes — `make test-all` lane
+
 import numpy as np
 import pytest
 

@@ -7,8 +7,21 @@
 LOG=/root/repo/.chipprobe.log
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  if pgrep -f 'misaka_tpu|bench\.py|tpu_capture' >/dev/null 2>&1; then
-    echo "$ts SKIP (misaka/bench process alive)" >> "$LOG"
+  busy=""
+  # only PYTHON processes count: supervisor/tool shells legitimately carry
+  # these strings inside longer command lines (same rule as bench _preflight)
+  for pid in $(pgrep -f 'misaka_tpu|bench\.py|tpu_capture' 2>/dev/null); do
+    case "$(cat /proc/"$pid"/comm 2>/dev/null)" in python*) busy=$pid ;; esac
+  done
+  # a capture run holds the chip end-to-end via its lockfile (covers heredoc
+  # steps whose cmdline carries no misaka marker); honor locks < 2h old
+  LOCKF=/root/repo/.tpu_capture_active
+  if [ -z "$busy" ] && [ -f "$LOCKF" ]; then
+    now=$(date -u +%s); stamp=$(cat "$LOCKF" 2>/dev/null || echo 0)
+    [ $((now - stamp)) -lt 7200 ] && busy="capture-lock"
+  fi
+  if [ -n "$busy" ]; then
+    echo "$ts SKIP (python misaka/bench pid $busy alive)" >> "$LOG"
   else
     out=$(timeout 120 python /root/repo/tools/chip_probe.py 2>&1)
     rc=$?

@@ -37,6 +37,114 @@ OUT_DIR = os.path.join(
 N_INPUTS = 8
 
 
+# Hand-written ISA-edge cases (VERDICT r4 item 9): 64-bit register overflow,
+# JRO clamping on both edges and through ACC, deep + 64-bit stack traffic,
+# and full grammar-form coverage (every MOV/ADD/SUB/JRO/PUSH/POP/OUT form
+# plus all five jumps appears in at least one case).  All are deterministic
+# single-lane-cadence networks: exact stream compare, 1 output per input —
+# replayable against the real Go binary through serialized POST /compute.
+HAND_CASES = [
+    (
+        # 64-bit registers OBSERVED through a branch: acc accumulates to
+        # 4e9 (int32-safe imms only — literals past int32 are a documented
+        # lowering divergence, lower.py:21-27).  64-bit JGZ sees +4e9 and
+        # takes BIG -> outputs x; an int32-only engine sees the wrapped
+        # NEGATIVE lo (-294967296), skips the branch, and outputs -1.
+        "regs64_jgz_overflow",
+        {"p": "program"},
+        {"p": "START: IN ACC\nSAV\nMOV 2000000000, ACC\nADD 2000000000\n"
+              "JGZ BIG\nOUT -1\nJMP START\nBIG: SWP\nOUT ACC\nJMP START\n"},
+    ),
+    (
+        # negative side: acc reaches -4e9 via NEG+SUB; 64-bit JLZ taken
+        # (outputs x), int32 lo is +294967296 so a broken engine outputs -1
+        "regs64_jlz_overflow",
+        {"p": "program"},
+        {"p": "START: IN ACC\nSAV\nMOV 2000000000, ACC\nNEG\n"
+              "SUB 2000000000\nJLZ NEGB\nOUT -1\nJMP START\n"
+              "NEGB: SWP\nOUT ACC\nJMP START\n"},
+    ),
+    (
+        # acc = 2^32 exactly (hi=1, lo=0): 64-bit JEZ must NOT fire on a
+        # zero lo word alone (is_zero checks both planes, regs64.py)
+        "regs64_jez_2pow32",
+        {"p": "program"},
+        {"p": "START: IN ACC\nSAV\nMOV 2000000000, ACC\nADD 2000000000\n"
+              "ADD 294967296\nJEZ BAD\nSWP\nOUT ACC\nJMP START\n"
+              "BAD: OUT -1\nJMP START\n"},
+    ),
+    (
+        # the int32 WIRE boundary on stacks: pushing an overflowed acc
+        # (4e9) truncates to -294967296 on the wire (messenger.proto int32,
+        # exactly like the reference's gRPC hop to its stack process), so
+        # the popped value is negative — JLZ observes the truncation
+        "push_wire_truncation",
+        {"p": "program", "s": "stack"},
+        {"p": "START: IN ACC\nSAV\nMOV 2000000000, ACC\nADD 2000000000\n"
+              "PUSH ACC, s\nPOP s, ACC\nJLZ TR\nOUT -1\nJMP START\n"
+              "TR: SWP\nOUT ACC\nJMP START\n"},
+    ),
+    (
+        # JRO +100 clamps to the LAST instruction (program.go:354); the
+        # skipped SUB would corrupt the value if the clamp missed.  NO
+        # trailing newline: the trailing-NOP quirk (strings.Split parity)
+        # would otherwise BE the last slot and swallow the OUT
+        "jro_clamp_forward",
+        {"p": "program"},
+        {"p": "IN ACC\nADD 7\nJRO 100\nSUB 1000\nNOP\nOUT ACC"},
+    ),
+    (
+        # JRO -100 clamps to instruction 0: the loop-back edge
+        "jro_clamp_backward",
+        {"p": "program"},
+        {"p": "IN ACC\nADD 3\nOUT ACC\nJRO -100\n"},
+    ),
+    (
+        # JRO ACC (register form): |x|+3 >= 3 always over-jumps past the
+        # trap lines and clamps onto the final OUT (no trailing newline —
+        # see jro_clamp_forward); covers JGZ + NEG too
+        "jro_acc_clamp",
+        {"p": "program"},
+        {"p": "START: IN ACC\nJGZ P\nNEG\nP: ADD 3\nJRO ACC\nOUT 999\n"
+              "JMP START\nOUT ACC"},
+    ),
+    (
+        # sign classifier: JEZ/JGZ/JMP + SWP + OUT with immediate
+        "branch_sign",
+        {"p": "program"},
+        {"p": "START: IN ACC\nJEZ ZERO\nJGZ POS\nOUT -111\nJMP START\n"
+              "ZERO: SWP\nSWP\nOUT 0\nJMP START\nPOS: OUT 111\nJMP START\n"},
+    ),
+    (
+        # JNZ never taken (ACC forced to 0 by SUB ACC), SAV/SWP restore
+        "jnz_sav_swp",
+        {"p": "program"},
+        {"p": "IN ACC\nSAV\nSUB ACC\nJNZ NEVER\nSWP\nNEVER: OUT ACC\n"},
+    ),
+    (
+        # 24-deep per-input stack excursion (LIFO through the HBM plane;
+        # int32-safe imms — wire truncation is push_wire_truncation's job)
+        "deep_stack_24",
+        {"p": "program", "s": "stack"},
+        {"p": "IN ACC\n"
+              + "PUSH ACC, s\n" * 23
+              + "PUSH 1000000000, s\nPOP s, ACC\nSUB 999999958\n"
+              + "POP s, NIL\n" * 22
+              + "POP s, ACC\nOUT ACC\n"},
+    ),
+    (
+        # two-lane port traffic: MOV imm->port, MOV ACC->port, MOV port->ACC,
+        # ADD ACC (doubling), ADD R1, SUB NIL
+        "ports_all_mov_forms",
+        {"a": "program", "b": "program"},
+        {
+            "a": "IN ACC\nMOV ACC, b:R0\nMOV 7, b:R1\n",
+            "b": "MOV 5, NIL\nMOV R0, ACC\nADD ACC\nADD R1\nSUB NIL\nOUT ACC\n",
+        },
+    ),
+]
+
+
 def main():
     from tests.test_cross_mode import gen_contended, gen_network, run_engine
 
@@ -63,6 +171,9 @@ def main():
 
     add2 = networks.add2()
     cases.append(("add2", add2.node_info, add2.programs, "stream", 42))
+
+    for name, node_info, programs in HAND_CASES:
+        cases.append((name, node_info, programs, "stream", 7000 + len(name)))
 
     for name, node_info, programs, compare, in_seed in cases:
         node_info = {

@@ -8,6 +8,14 @@ cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/tpu_capture}"
 mkdir -p "$OUT"
 
+# Hold the chip for the whole capture: the background prober
+# (tools/chip_probe_loop.sh) skips while this lockfile is fresh, so a probe
+# can never contend with (and potentially wedge) a capture step — including
+# the heredoc steps whose cmdline carries no misaka marker.
+LOCK=.tpu_capture_active
+date -u +%s > "$LOCK"
+trap 'rm -f "$LOCK"' EXIT
+
 echo "== 0. chip probe =="
 timeout 120 python -c "import jax; print(jax.devices()[0].platform)" 2>&1 | tail -1 | tee "$OUT/probe.log"
 grep -qi "^tpu$" "$OUT/probe.log" || { echo "chip unreachable; aborting"; exit 3; }
@@ -24,5 +32,30 @@ echo "== 3. roofline sweep =="
 timeout 1300 python bench.py --roofline > "$OUT/roofline.json.log" 2> "$OUT/roofline.stderr.log"
 echo "rc=$?" >> "$OUT/roofline.stderr.log"
 tail -1 "$OUT/roofline.json.log"
+
+echo "== 4. hi-plane elision A/B (the r5 cut at the named 4x VPU headroom) =="
+timeout 900 python - > "$OUT/elide_ab.json.log" 2> "$OUT/elide_ab.stderr.log" <<'PY'
+import json
+import os
+
+import bench
+
+# an inherited MISAKA_FUSED_ELIDE_HI=1 would silently turn this into
+# elide-vs-elide with speedup 1.0 — pin the baseline to OFF explicitly
+os.environ["MISAKA_FUSED_ELIDE_HI"] = "0"
+base = bench.bench_config("add2", batch=262144)
+os.environ["MISAKA_FUSED_ELIDE_HI"] = "1"
+el = bench.bench_config("add2", batch=262144)
+print(json.dumps({
+    "metric": "add2_elide_hi_ab",
+    "baseline_ticks_per_sec": round(base["ticks_per_sec"], 1),
+    "elide_ticks_per_sec": round(el["ticks_per_sec"], 1),
+    "baseline_throughput": round(base["throughput"], 1),
+    "elide_throughput": round(el["throughput"], 1),
+    "speedup": round(el["ticks_per_sec"] / base["ticks_per_sec"], 4),
+}))
+PY
+echo "rc=$?" >> "$OUT/elide_ab.stderr.log"
+tail -1 "$OUT/elide_ab.json.log"
 
 echo "captured under $OUT"
