@@ -45,6 +45,11 @@ cert:
 test-tpu:
 	MISAKA_TPU_TESTS=1 python -m pytest tests/test_tpu.py -m tpu -q
 
+# One-shot TPU evidence capture (probe, hardware test lane, full bench,
+# roofline, hi-elision A/B) — run the moment the relayed chip answers.
+capture:
+	bash tools/tpu_capture.sh
+
 # Fast lane: every component smoke-covered, fuzz/scale/multi-process
 # suites excluded (marked slow) — target < 3 min.
 test:
@@ -89,4 +94,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu bench parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench parity-go parity-local parity-corpus stop clean

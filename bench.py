@@ -179,13 +179,36 @@ def _preflight():
             )
 
 
+def _cpu_cache_dir(prefix: str) -> str:
+    """Cache dir keyed by this host's CPU identity: /tmp outlives machine
+    migrations between rounds, and stale entries compiled for a different
+    CPU make XLA's AOT loader flood stderr with machine-mismatch errors
+    (drowning the bench's own stderr provenance in the driver's tail).
+
+    The whole of /proc/cpuinfo is hashed (x86 "flags", aarch64 "Features",
+    model names — all of it) plus platform.machine(), so hosts without an
+    x86-style flags line still get distinct dirs."""
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo", "rb") as f:
+            ident = f.read()
+    except OSError:  # pragma: no cover — no /proc (e.g. macOS)
+        ident = platform.processor().encode()
+    ident += platform.machine().encode()
+    return f"{prefix}_{hashlib.sha1(ident).hexdigest()[:8]}"
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache: repeat runs (driver after manual
     warm-up) skip the 20-40s first-compile cost per engine."""
     try:
         import jax
 
-        jax.config.update("jax_compilation_cache_dir", "/tmp/misaka_jax_cache")
+        jax.config.update(
+            "jax_compilation_cache_dir", _cpu_cache_dir("/tmp/misaka_jax_cache")
+        )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception as e:  # pragma: no cover — cache is best-effort
         print(f"# compile cache unavailable: {e}", file=sys.stderr)
@@ -678,7 +701,15 @@ def _sharded_worker(n_devices, batch, per_instance):
         net.code, net.prog_len, mesh, num_steps=steps, batched=True
     )
     dt_gather = timed(gather, lambda s: shard_state(s, mesh, batched=True))
+    # TWO single-chip baselines since r5: the platform-auto kernel (what a
+    # user actually gets — compact on CPU since the crossover change) and
+    # dense (r4-and-earlier's auto at 8 lanes, kept for cross-round
+    # continuity).  The auto baseline moving is exactly why ratios must
+    # name their denominator.
     dt_single = timed(lambda s: net.run(s, steps), lambda s: s)
+    dt_single_dense = timed(
+        lambda s: net.run(s, steps, engine="dense"), lambda s: s
+    )
 
     # Mesh serving through the product path: MasterNode + compute_spread,
     # SUSTAINED (8 client threads x waves keep the pipeline full) and
@@ -749,9 +780,15 @@ def _sharded_worker(n_devices, batch, per_instance):
         "sharded_engine": "routed",
         "routed_ticks_per_sec": round(steps / dt_routed, 1),
         "gather_ticks_per_sec": round(steps / dt_gather, 1),
+        # single_* = the platform-AUTO kernel (compact on CPU since the r5
+        # crossover change; r4's auto at 8 lanes was dense).
+        # single_dense_* keeps r4's denominator comparable across rounds.
+        "single_engine": "auto",
         "single_ticks_per_sec": round(steps / dt_single, 1),
+        "single_dense_ticks_per_sec": round(steps / dt_single_dense, 1),
         "sharded_ticks_per_sec": round(steps / dt_routed, 1),
         "sharded_vs_single": round(dt_single / dt_routed, 4),
+        "sharded_vs_single_dense": round(dt_single_dense / dt_routed, 4),
         "gather_vs_single": round(dt_single / dt_gather, 4),
         "routed_vs_gather": round(dt_gather / dt_routed, 4),
         "sharded_throughput": round(total / dt_routed, 1),
@@ -922,6 +959,28 @@ def main():
     )
     if not run_all:
         payload.pop("configs", None)
+    if platform == "tpu" and os.environ.get("MISAKA_FUSED_ELIDE_HI") != "1":
+        # The hi-plane elision A/B rides the DEFAULT TPU run: the driver's
+        # plain `python bench.py` may be the round's only hardware session,
+        # and the r5 VPU-headroom cut needs a measured delta, not a flag
+        # someone must remember (ARCHITECTURE.md "Headroom, named").
+        try:
+            os.environ["MISAKA_FUSED_ELIDE_HI"] = "1"
+            el = bench_config("add2", batch=headline["batch"])
+            payload["elide_hi_ticks_per_sec"] = round(el["ticks_per_sec"], 1)
+            payload["elide_hi_speedup"] = round(
+                el["ticks_per_sec"] / headline["ticks_per_sec"], 4
+            )
+            print(
+                f"# elide-hi A/B: {el['ticks_per_sec']:.0f} vs "
+                f"{headline['ticks_per_sec']:.0f} ticks/s "
+                f"({payload['elide_hi_speedup']:.3f}x)",
+                file=sys.stderr,
+            )
+        except Exception as e:  # pragma: no cover — A/B must not cost the run
+            print(f"# elide-hi A/B failed: {e}", file=sys.stderr)
+        finally:
+            os.environ.pop("MISAKA_FUSED_ELIDE_HI", None)
     # Served throughput is part of the DEFAULT run: the north-star metric
     # must reach the driver's captured artifact through the product surface,
     # not live only behind a flag (VERDICT r2 weak #5).
@@ -1004,6 +1063,9 @@ def main():
             (256, "compact"),
         ]
     lanes = []
+    # bind BEFORE the loop: a TTL dump mid-matrix then carries the configs
+    # that already finished (the list mutates in place)
+    payload["lane_scaling"] = lanes
     for n, engine in lane_matrix:
         try:
             r = bench_lanes(n, engine=engine)
@@ -1029,7 +1091,6 @@ def main():
         if "block_batch" in r:
             entry["block_batch"] = r["block_batch"]
         lanes.append(entry)
-    payload["lane_scaling"] = lanes
     print(json.dumps(payload))
 
 

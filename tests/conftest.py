@@ -34,8 +34,16 @@ else:
     # Persistent compile cache: the suite compiles the same tiny kernels
     # every run (single-CPU box — recompilation IS the suite's wall-clock);
     # repeat runs hit the disk cache instead.  Keyed by JAX on program +
-    # flags, so staleness is JAX's problem, not ours.
-    jax.config.update("jax_compilation_cache_dir", "/tmp/misaka_jax_test_cache")
+    # flags; the dir carries a CPU fingerprint because /tmp can outlive a
+    # machine migration and foreign-CPU entries make XLA's AOT loader
+    # spam machine-mismatch errors.  ONE copy of the fingerprint logic:
+    # bench._cpu_cache_dir (tests run from the repo root).
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _cpu_cache_dir
+
+    jax.config.update(
+        "jax_compilation_cache_dir", _cpu_cache_dir("/tmp/misaka_jax_test_cache")
+    )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 

@@ -1,0 +1,70 @@
+"""Fast-lane smoke for the fused kernel's r5 additions.
+
+test_fused.py (the full interpret-mode parity sweep) is slow-marked, so the
+hi-plane elision gating and the shared block-size walk need one small
+unmarked case each — a regression in either must fail `make test`, not
+surface 20 minutes into `make test-all` (or on the rarely-reachable TPU).
+"""
+
+import numpy as np
+
+from misaka_tpu import networks
+
+
+def _prep(net, vals):
+    state = net.init_state()
+    return state._replace(
+        in_buf=state.in_buf.at[:, : vals.shape[1]].set(vals),
+        in_wr=state.in_wr + vals.shape[1],
+    )
+
+
+def test_elide_dead_hi_smoke():
+    """add2 (fully hi-dead) under elision: every observable plane identical
+    to the scan engine; sorter keeps a JRO/cond-jump reader so the same
+    flag must leave it fully live (pinned via acc_hi equality)."""
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    net = top.compile(batch=128)
+    vals = np.random.default_rng(0).integers(
+        -100, 100, size=(128, 3)
+    ).astype(np.int32)
+    ref = net.run(_prep(net, vals), 50)
+    out = net.fused_runner(
+        50, block_batch=128, interpret=True, elide_dead_hi=True
+    )(_prep(net, vals))
+    for field in ref._fields:
+        if field in ("acc_hi", "bak_hi"):
+            continue  # unspecified on hi-dead lanes by contract
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, field)),
+            np.asarray(getattr(out, field)),
+            err_msg=f"field {field} diverged under elide_dead_hi",
+        )
+    assert int(np.asarray(out.out_wr).min()) > 0
+
+    # a hi-LIVE lane (sorter branches on acc) must be untouched by the flag
+    sort = networks.sorter(in_cap=8, out_cap=8, stack_cap=8).compile(batch=128)
+    sref = sort.run(_prep(sort, vals), 40)
+    sout = sort.fused_runner(
+        40, block_batch=128, interpret=True, elide_dead_hi=True
+    )(_prep(sort, vals))
+    np.testing.assert_array_equal(
+        np.asarray(sref.acc_hi), np.asarray(sout.acc_hi),
+        err_msg="hi-live lane's acc_hi must stay exact under the flag",
+    )
+
+
+def test_fused_runner_walk_smoke():
+    """The shared walk skips oversized/non-dividing candidates and returns
+    a runner that actually runs at the block it reports."""
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    net = top.compile(batch=256)
+    runner, bb = net.fused_runner_walk(
+        16, candidates=(1024, 512, 256, 128), interpret=True
+    )
+    assert bb == 256  # 1024/512 > batch are skipped, 256 fits the budget
+    vals = np.random.default_rng(1).integers(
+        -100, 100, size=(256, 2)
+    ).astype(np.int32)
+    out = runner(_prep(net, vals))
+    assert int(np.asarray(out.tick)[0]) == 16

@@ -112,3 +112,58 @@ def test_mosaic_deep_stack_parity():
     ref, out = run_both_compiled(top, batch=128, steps=310, n_inputs=100)
     assert_states_equal(ref, out)
     np.testing.assert_array_equal(np.asarray(out.stack_top)[:, 0], 100)
+
+
+def test_mosaic_elide_dead_hi_parity():
+    """The hi-plane elision (r5 VPU-headroom cut) through the ACTUAL Mosaic
+    compiler: wire/output planes bit-identical to the scan engine on add2
+    (fully hi-dead) and sorter (fully hi-live, so the flag must be a
+    no-op there).  Interpret-mode parity is pinned in test_fused.py; this
+    guards against Mosaic-specific miscompiles of the elided kernel the
+    capture A/B would otherwise hit first."""
+    for name in ("add2", "sorter"):
+        top = networks.BASELINE_CONFIGS[name](in_cap=8, out_cap=8, stack_cap=8)
+        net = top.compile(batch=128)
+        rng = np.random.default_rng(11)
+        vals = rng.integers(-1000, 1000, size=(128, 4)).astype(np.int32)
+
+        def prep(state):
+            return state._replace(
+                in_buf=state.in_buf.at[:, :4].set(vals),
+                in_wr=state.in_wr + 4,
+            )
+
+        ref = net.run(prep(net.init_state()), 60)
+        fused = net.fused_runner(60, block_batch=128, elide_dead_hi=True)
+        out = fused(prep(net.init_state()))
+        for field in ref._fields:
+            if field in ("acc_hi", "bak_hi") and name == "add2":
+                continue  # unspecified on hi-dead lanes by contract
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, field)),
+                np.asarray(getattr(out, field)),
+                err_msg=f"{name}: field '{field}' diverged (elide_dead_hi)",
+            )
+        assert int(np.asarray(out.out_wr).min()) > 0
+
+
+def test_mosaic_block_walk_wide_net():
+    """The shared block-size walk on hardware: a 64-lane pipeline must
+    reject the big blocks (1,102 carry rows) and still compile+run at the
+    block the walk picks — the exact path the lane matrix (64, fused)
+    config takes on TPU."""
+    top = networks.pipeline(64, in_cap=8, out_cap=8, stack_cap=8)
+    net = top.compile(batch=256)
+    runner, bb = net.fused_runner_walk(
+        64, candidates=(2048, 1024, 512, 256, 128)
+    )
+    assert bb is not None and bb <= 512
+    rng = np.random.default_rng(7)
+    vals = rng.integers(-1000, 1000, size=(256, 4)).astype(np.int32)
+    state = net.init_state()
+    state = state._replace(
+        in_buf=state.in_buf.at[:, :4].set(vals), in_wr=state.in_wr + 4
+    )
+    for _ in range(5):  # 5 x 64 = 320 ticks: fill + drain the 64 stages
+        state = runner(state)
+    np.testing.assert_array_equal(np.asarray(state.out_buf)[:, :4], vals + 64)
