@@ -192,11 +192,21 @@ def _cpu_cache_dir(prefix: str) -> str:
     import platform
 
     try:
-        with open("/proc/cpuinfo", "rb") as f:
-            ident = f.read()
+        with open("/proc/cpuinfo") as f:
+            # only STABLE identity lines: cpuinfo also carries volatile
+            # fields ("cpu MHz", bogomips) that change between reads under
+            # frequency scaling and would rename the "persistent" dir
+            # every run
+            ident = "".join(
+                ln
+                for ln in f
+                if ln.split(":")[0].strip()
+                in ("vendor_id", "model name", "flags", "Features",
+                    "CPU implementer", "CPU part")
+            ).encode()
     except OSError:  # pragma: no cover — no /proc (e.g. macOS)
-        ident = platform.processor().encode()
-    ident += platform.machine().encode()
+        ident = b""
+    ident += platform.processor().encode() + platform.machine().encode()
     return f"{prefix}_{hashlib.sha1(ident).hexdigest()[:8]}"
 
 
@@ -984,6 +994,13 @@ def main():
     # Served throughput is part of the DEFAULT run: the north-star metric
     # must reach the driver's captured artifact through the product surface,
     # not live only behind a flag (VERDICT r2 weak #5).
+    # Process-level warm-up first: the first serve cycle in a fresh process
+    # pays one-time costs INSIDE its timed window (compile-cache writes
+    # etc.) — measured 18k -> 91k/s across four identical calls, enough to
+    # invert the raw-vs-text ranking by call order alone.  Skipped in
+    # fallback mode, whose contract is a minimal reduced-TTL artifact.
+    if not fallback:
+        bench_served(mode="raw", waves=1)
     for mode, key in (("raw", "served_throughput"), ("text", "served_text_throughput")):
         served = bench_served(mode=mode, waves=2 if fallback else 6)
         print(

@@ -12,12 +12,11 @@ value until a 0 sentinel, then emit the sentinel and pop everything back
 out.  With stack_cap=8 and 40 values it deadlocks without growth.
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # simulated-compile grow windows — `make test-all` lane
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # simulated-compile grow windows — `make test-all` lane
 
 from misaka_tpu.runtime.master import ComputeTimeout, MasterNode
 from misaka_tpu.runtime.topology import Topology

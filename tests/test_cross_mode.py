@@ -35,15 +35,14 @@ design, so the invariant asserted is multiset equality — arbitration
 differences may reorder values but must never lose or duplicate one.
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # per-process cluster fuzz — `make test-all` lane
 
 import threading
 import time
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # per-process cluster fuzz — `make test-all` lane
 
 from misaka_tpu.runtime.nodes import build_loopback_cluster
 from misaka_tpu.runtime.topology import Topology

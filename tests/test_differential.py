@@ -8,12 +8,11 @@ ticks needs no liveness.  The generator emits SOURCE TEXT, so the parser and
 lowering are inside the tested pipeline too.
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # fuzzed five-way differential — `make test-all` lane
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # fuzzed five-way differential — `make test-all` lane
 
 from misaka_tpu.core import CompiledNetwork
 from misaka_tpu.tis.lower import lower_program, pad_programs

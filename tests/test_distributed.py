@@ -8,9 +8,6 @@ through the same HTTP surface.  The reference can only test this with a
 ephemeral loopback ports in one process.
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # real-gRPC loopback cluster — `make test-all` lane
 
 import json
 import threading
@@ -20,6 +17,8 @@ import urllib.parse
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # real-gRPC loopback cluster — `make test-all` lane
 
 from misaka_tpu.runtime.nodes import (
     BroadcastError,

@@ -5,12 +5,11 @@ same kernel body.  Every BASELINE network plus stall/backpressure edge cases
 must produce exactly the same NetworkState as core/step.py.
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # interpret-mode kernel parity sweeps — `make test-all` lane
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # interpret-mode kernel parity sweeps — `make test-all` lane
 
 from misaka_tpu import networks
 from misaka_tpu.runtime.topology import Topology

@@ -8,12 +8,11 @@ docker-compose scale-out (docker-compose.yml:26-74).  Runs on the 8-device
 virtual CPU mesh (conftest.py), exactly as the driver's dryrun does.
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # virtual-mesh serving lifecycle — `make test-all` lane
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # virtual-mesh serving lifecycle — `make test-all` lane
 
 from misaka_tpu import networks
 from misaka_tpu.runtime.master import MasterNode

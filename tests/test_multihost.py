@@ -7,9 +7,6 @@ stand-in for a multi-slice TPU deployment (parallel/multihost.py doctrine:
 batch over DCN, lanes over ICI).
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # two-process DCN coordinator run — `make test-all` lane
 
 import os
 import socket
@@ -18,6 +15,8 @@ import sys
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # two-process DCN coordinator run — `make test-all` lane
 
 import jax
 

@@ -7,12 +7,11 @@ model-parallel engine) — must produce BIT-IDENTICAL state to the
 single-chip kernel for any program, any mesh factorization.
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # fuzzed sharded-kernel bit-identity — `make test-all` lane
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # fuzzed sharded-kernel bit-identity — `make test-all` lane
 import jax
 
 from misaka_tpu import networks

@@ -11,12 +11,11 @@ registers (core/regs64.py hi/lo planes on device; int64 on hosts) with
 truncation exactly at the wire.
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # 64-bit fuzz (four-way differential) — `make test-all` lane
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # 64-bit fuzz (four-way differential) — `make test-all` lane
 
 from misaka_tpu.core import cinterp
 from misaka_tpu.runtime.topology import Topology

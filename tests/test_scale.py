@@ -11,12 +11,11 @@ kernel's O(N·4N) election matrices fault the TPU worker at 256 lanes under
 production batches).
 """
 
-import pytest
-
-pytestmark = pytest.mark.slow  # wide-lane / deep-stack envelopes — `make test-all` lane
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # wide-lane / deep-stack envelopes — `make test-all` lane
 
 from misaka_tpu import networks
 from misaka_tpu.core.engine import COMPACT_AUTO_LANES
