@@ -1,6 +1,9 @@
 #!/usr/bin/env bash
-# Background chip-health prober: appends one line per probe to .chipprobe.log
-# and EXITS after the first UP (so it never contends with a capture run).
+# Background chip-health prober: appends one line per probe to .chipprobe.log.
+# On the first UP it optionally fires the one-shot evidence capture
+# (MISAKA_PROBE_AUTOCAPTURE=1) — a wedge-prone chip's up-windows can be
+# short, so evidence collection must not wait on a human noticing the log —
+# then EXITS (so it never contends with anything that follows).
 # Skips a probe while any misaka/bench process is alive — a probe holding the
 # relayed chip for up to 120s would stall a real bench toward its watchdog,
 # and probing while bench holds the chip would log a false DOWN.
@@ -27,6 +30,20 @@ while true; do
     rc=$?
     if [ $rc -eq 0 ] && echo "$out" | grep -q "^OK tpu"; then
       echo "$ts UP $out" >> "$LOG"
+      if [ "${MISAKA_PROBE_AUTOCAPTURE:-}" = "1" ]; then
+        echo "$ts AUTOCAPTURE starting (tools/tpu_capture.sh)" >> "$LOG"
+        bash /root/repo/tools/tpu_capture.sh /tmp/tpu_capture_auto \
+          >> "$LOG" 2>&1
+        cap_rc=$?
+        echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) AUTOCAPTURE done rc=$cap_rc" >> "$LOG"
+        if [ "$cap_rc" -ne 0 ]; then
+          # the chip flapped before the capture's own probe (or a step was
+          # killed): keep hunting for the next up-window instead of ending
+          # the watch with no evidence
+          sleep 600
+          continue
+        fi
+      fi
       exit 0
     fi
     echo "$ts DOWN rc=$rc $(echo "$out" | tail -1)" >> "$LOG"

@@ -1,8 +1,14 @@
 #!/bin/bash
 # One-shot TPU evidence capture, for the moment the (wedge-prone) relayed
-# chip is reachable: fused-kernel parity lane, the full default bench, and
-# the roofline sweep — in risk order, each logged, so a mid-sequence wedge
-# keeps everything already captured.  Usage: bash tools/tpu_capture.sh [outdir]
+# chip is reachable.  Ordered by EVIDENCE VALUE PER MINUTE under the
+# assumption the up-window may be short and a mid-sequence wedge ends it:
+#   1. full default bench  — the headline + served + latency + elide A/B +
+#      lane matrix (its own risky sections already run last, per-config
+#      fault-isolated)
+#   2. hardware test lane  — Mosaic-compiled parity incl. elide + walk
+#   3. roofline sweep      — batch-axis character of a number step 1 proved
+# Each step is logged separately so whatever completed survives.
+# Usage: bash tools/tpu_capture.sh [outdir]
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/tpu_capture}"
@@ -16,46 +22,26 @@ LOCK=.tpu_capture_active
 date -u +%s > "$LOCK"
 trap 'rm -f "$LOCK"' EXIT
 
+# An inherited MISAKA_FUSED_ELIDE_HI=1 would make bench.py's default elide
+# A/B silently skip (its guard assumes the flag means "already elided") —
+# clear it so step 1 always measures the A/B.
+unset MISAKA_FUSED_ELIDE_HI
+
 echo "== 0. chip probe =="
 timeout 120 python -c "import jax; print(jax.devices()[0].platform)" 2>&1 | tail -1 | tee "$OUT/probe.log"
 grep -qi "^tpu$" "$OUT/probe.log" || { echo "chip unreachable; aborting"; exit 3; }
 
-echo "== 1. fused-kernel parity lane (make test-tpu) =="
-timeout 1200 make test-tpu 2>&1 | tail -3 | tee "$OUT/test_tpu.log"
-
-echo "== 2. full default bench =="
-timeout 1300 python bench.py > "$OUT/bench.json.log" 2> "$OUT/bench.stderr.log"
+echo "== 1. full default bench (headline, served, latency, elide A/B, lanes) =="
+timeout 1400 python bench.py > "$OUT/bench.json.log" 2> "$OUT/bench.stderr.log"
 echo "rc=$?" >> "$OUT/bench.stderr.log"
 tail -1 "$OUT/bench.json.log"
+
+echo "== 2. fused-kernel parity lane (make test-tpu) =="
+timeout 1200 make test-tpu 2>&1 | tail -3 | tee "$OUT/test_tpu.log"
 
 echo "== 3. roofline sweep =="
 timeout 1300 python bench.py --roofline > "$OUT/roofline.json.log" 2> "$OUT/roofline.stderr.log"
 echo "rc=$?" >> "$OUT/roofline.stderr.log"
 tail -1 "$OUT/roofline.json.log"
-
-echo "== 4. hi-plane elision A/B (the r5 cut at the named 4x VPU headroom) =="
-timeout 900 python - > "$OUT/elide_ab.json.log" 2> "$OUT/elide_ab.stderr.log" <<'PY'
-import json
-import os
-
-import bench
-
-# an inherited MISAKA_FUSED_ELIDE_HI=1 would silently turn this into
-# elide-vs-elide with speedup 1.0 — pin the baseline to OFF explicitly
-os.environ["MISAKA_FUSED_ELIDE_HI"] = "0"
-base = bench.bench_config("add2", batch=262144)
-os.environ["MISAKA_FUSED_ELIDE_HI"] = "1"
-el = bench.bench_config("add2", batch=262144)
-print(json.dumps({
-    "metric": "add2_elide_hi_ab",
-    "baseline_ticks_per_sec": round(base["ticks_per_sec"], 1),
-    "elide_ticks_per_sec": round(el["ticks_per_sec"], 1),
-    "baseline_throughput": round(base["throughput"], 1),
-    "elide_throughput": round(el["throughput"], 1),
-    "speedup": round(el["ticks_per_sec"] / base["ticks_per_sec"], 4),
-}))
-PY
-echo "rc=$?" >> "$OUT/elide_ab.stderr.log"
-tail -1 "$OUT/elide_ab.json.log"
 
 echo "captured under $OUT"
