@@ -1,0 +1,78 @@
+"""Long-running differential soak: random networks vs the hand-written
+oracle across every engine, far past the suite's 40 fixed seeds.
+
+The CI fuzz lanes prove the engines bit-identical on a fixed seed set;
+this soak spends otherwise-idle machine time widening that evidence.  Runs
+until --seconds elapse (or Ctrl-C), cycling random seeds through the same
+compare() harness tests/test_differential.py uses (XLA dense, compact, and
+fused-interpret paths all checked against the oracle).  Any mismatch is
+appended to --log with its seed, which then reproduces under pytest via
+`compare(seed, ...)` directly.
+
+Usage: python tools/soak_differential.py [--seconds 3600] [--log /tmp/soak.log]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3600.0)
+    ap.add_argument("--log", default="/tmp/soak_differential.log")
+    ap.add_argument("--start-seed", type=int, default=100_000)
+    args = ap.parse_args()
+
+    from tests.test_differential import compare
+
+    deadline = time.monotonic() + args.seconds
+    seed = args.start_seed
+    ran = failures = 0
+    t0 = time.monotonic()
+    while time.monotonic() < deadline:
+        # fused-interpret recompiles per network (~10s each on one core):
+        # sample it every 5th seed so dense/compact coverage dominates
+        modes = [
+            ("dense", dict(engine="dense")),
+            ("compact", dict(engine="compact")),
+        ]
+        if seed % 5 == 0:
+            modes.append(("fused", dict(fused=True)))
+        for label, kw in modes:
+            try:
+                compare(seed, steps=48, **kw)
+            except Exception:
+                failures += 1
+                with open(args.log, "a") as f:
+                    f.write(f"=== seed={seed} engine={label}\n")
+                    f.write(traceback.format_exc() + "\n")
+                print(f"MISMATCH seed={seed} engine={label}", flush=True)
+            ran += 1
+        seed += 1
+        if ran % 300 == 0:
+            rate = ran / (time.monotonic() - t0)
+            print(
+                f"# soak: {ran} comparisons ({seed - args.start_seed} seeds), "
+                f"{failures} failures, {rate:.1f} cmp/s",
+                flush=True,
+            )
+    print(
+        f"soak done: {ran} comparisons across {seed - args.start_seed} seeds, "
+        f"{failures} failures (log: {args.log})",
+        flush=True,
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
