@@ -39,7 +39,16 @@ def main() -> int:
     seed = args.start_seed
     ran = failures = 0
     t0 = time.monotonic()
+    lockf = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".tpu_capture_active",
+    )
     while time.monotonic() < deadline:
+        if os.path.exists(lockf):
+            # a TPU evidence capture started: yield the (single) CPU —
+            # depressed host-side capture numbers cost more than soak time
+            print("# soak: yielding to TPU capture (lockfile present)", flush=True)
+            break
         # fused-interpret recompiles per network (~10s each on one core):
         # sample it every 5th seed so dense/compact coverage dominates
         modes = [
