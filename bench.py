@@ -525,10 +525,11 @@ def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1
             # 1 GiB (256 x 4096) faults it reliably.  Wide margin on purpose
             # — the artifact matters more than dense wide-lane fidelity.
             batch = min(batch, max(16, 2**22 // (4 * n_lanes * n_lanes)))
-        elif engine == "compact":
-            # Scatter elections are linear in batch*N; cap the index space
-            # at the measured-safe region (256 lanes x 1024 batch ran clean;
-            # 256 x 4096 has faulted once in a mixed-config sequence).
+        elif engine in ("compact", "chained"):
+            # Elections are linear in batch*N (scatter or chained); cap the
+            # index space at the measured-safe region (256 lanes x 1024
+            # batch ran clean; 256 x 4096 has faulted once in a
+            # mixed-config sequence).
             batch = min(batch, max(128, 2**18 // n_lanes))
     top = networks.pipeline(
         n_lanes, in_cap=per_instance, out_cap=per_instance, stack_cap=8
@@ -1066,18 +1067,24 @@ def main():
     # 16/32 x {dense, compact} bracket the dense->compact crossover so
     # COMPACT_AUTO_LANES is set from data, not interpolation (VERDICT r4
     # weak #2 / item 3).
+    # "chained" is the scatter-free compact variant (core/routing.py
+    # ChainTable): on CPU it measures ~0.7x compact (XLA CPU scatters are
+    # fine), on TPU it is the A/B against the measured scatter
+    # serialization ceiling — the decision data for flipping the wide-lane
+    # TPU default.
     if platform == "tpu":
         lane_matrix = [
             (8, "dense"), (16, "dense"), (32, "dense"),
             (16, "compact"), (32, "compact"), (64, "compact"),
-            (256, "compact"), (1024, "compact"), (64, "fused"),
+            (256, "compact"), (1024, "compact"),
+            (64, "chained"), (256, "chained"), (64, "fused"),
         ]
     else:
         lane_matrix = [
             (8, "dense"), (16, "dense"), (32, "dense"), (64, "dense"),
             (256, "dense"),
             (16, "compact"), (32, "compact"), (64, "compact"),
-            (256, "compact"),
+            (256, "compact"), (64, "chained"), (256, "chained"),
         ]
     lanes = []
     # bind BEFORE the loop: a TTL dump mid-matrix then carries the configs

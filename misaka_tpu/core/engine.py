@@ -218,6 +218,8 @@ class CompiledNetwork:
     _tables: tuple = field(init=False, repr=False)
     _compact_fn: object = field(init=False, repr=False, default=None)
     _compact_chunk: object = field(init=False, repr=False, default=None)
+    _chained_fn: object = field(init=False, repr=False, default=None)
+    _chained_chunk: object = field(init=False, repr=False, default=None)
     _compact_serve: object = field(init=False, repr=False, default=None)
 
     def __post_init__(self):
@@ -260,6 +262,24 @@ class CompiledNetwork:
             self._compact_fn = functools.partial(step_slots, route)
         return self._compact_fn
 
+    def _chained_step(self):
+        """The scatter-free compact variant: elections as statically
+        unrolled min/sum chains (core/routing.py ChainTable) — the r5
+        probe at the TPU wide-lane scatter ceiling."""
+        if self._chained_fn is None:
+            from misaka_tpu.core.routing import (
+                build_chain_table,
+                build_route_table,
+                step_slots,
+            )
+
+            route = build_route_table(self.code, self.prog_len)
+            chain = build_chain_table(
+                self.code, self.prog_len, route, self.num_stacks
+            )
+            self._chained_fn = functools.partial(step_slots, route, chain=chain)
+        return self._chained_fn
+
     def run(
         self, state: NetworkState, num_steps: int, engine: str | None = None
     ) -> NetworkState:
@@ -272,9 +292,14 @@ class CompiledNetwork:
             engine = (
                 "compact" if self.num_lanes >= compact_auto_lanes() else "dense"
             )
-        if engine == "compact":
-            if self._compact_chunk is None:
-                step1 = self._compact_step()
+        if engine in ("compact", "chained"):
+            cache_attr = "_compact_chunk" if engine == "compact" else "_chained_chunk"
+            if getattr(self, cache_attr) is None:
+                step1 = (
+                    self._compact_step()
+                    if engine == "compact"
+                    else self._chained_step()
+                )
                 tables = self._tables
                 batched = self.batch is not None
 
@@ -282,10 +307,12 @@ class CompiledNetwork:
                 def chunk(s, n):
                     return _chunk_body(step1, tables, s, n, batched)
 
-                self._compact_chunk = chunk
-            return self._compact_chunk(state, num_steps)
+                setattr(self, cache_attr, chunk)
+            return getattr(self, cache_attr)(state, num_steps)
         if engine != "dense":
-            raise ValueError(f"engine must be dense|compact|None, got {engine!r}")
+            raise ValueError(
+                f"engine must be dense|compact|chained|None, got {engine!r}"
+            )
         runner = _run_chunk if self.batch is None else _run_chunk_batched
         return runner(self._tables, state, num_steps)
 

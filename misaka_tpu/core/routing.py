@@ -93,6 +93,59 @@ def build_route_table(code: np.ndarray, prog_len: np.ndarray) -> RouteTable:
     )
 
 
+class ChainTable(NamedTuple):
+    """Static contender structure for the chained (scatter-free) election.
+
+    Derived once from the lowered code: which lanes can EVER contend for
+    each election slot, and which slots each lane can ever address.  Python
+    tuples of ints — pure trace-time constants.
+    """
+
+    slot_contenders: tuple  # [kv-1] tuples of lane ids
+    lane_slots: tuple       # [N] tuples of slot ids
+
+
+def build_chain_table(
+    code: np.ndarray, prog_len: np.ndarray, route: RouteTable, n_stacks: int
+) -> ChainTable:
+    """Invert the route table into per-slot contender lists.
+
+    Slots follow step_slots' layout: [0, Da) sends, [Da, Da+S) stacks,
+    then IN, then OUT (trash excluded — it never elects)."""
+    code = np.asarray(code)
+    prog_len = np.asarray(prog_len)
+    n_lanes = code.shape[0]
+    n_ports = isa.NUM_PORTS
+    da = route.n_send
+    kv_live = da + max(1, n_stacks) + 2
+
+    slot_sets: list[set] = [set() for _ in range(kv_live)]
+    lane_sets: list[set] = [set() for _ in range(n_lanes)]
+    live = np.arange(code.shape[1])[None, :] < prog_len[:, None]
+    for n in range(n_lanes):
+        for l in range(code.shape[1]):
+            if not live[n, l]:
+                continue
+            op = code[n, l, isa.F_OP]
+            if op == isa.OP_MOV_NET:
+                dest = code[n, l, isa.F_TGT] * n_ports + code[n, l, isa.F_PORT]
+                s = int(route.dest_to_slot[dest])
+            elif op in (isa.OP_PUSH, isa.OP_POP):
+                s = da + int(np.clip(code[n, l, isa.F_TGT], 0, max(1, n_stacks) - 1))
+            elif op == isa.OP_IN:
+                s = da + max(1, n_stacks)
+            elif op == isa.OP_OUT:
+                s = da + max(1, n_stacks) + 1
+            else:
+                continue
+            slot_sets[s].add(n)
+            lane_sets[n].add(s)
+    return ChainTable(
+        slot_contenders=tuple(tuple(sorted(s)) for s in slot_sets),
+        lane_slots=tuple(tuple(sorted(s)) for s in lane_sets),
+    )
+
+
 def step_slots(
     route: RouteTable,
     code: jnp.ndarray,
@@ -100,13 +153,25 @@ def step_slots(
     state: NetworkState,
     axis: str | None = None,
     n_total_lanes: int | None = None,
+    chain: ChainTable | None = None,
 ) -> NetworkState:
-    """One superstep via compact-slot scatter elections (single instance).
+    """One superstep via compact-slot elections (single instance).
 
     axis=None runs the whole network on one device; axis=<mesh axis name>
     runs inside shard_map on this shard's lane slice (code/state are the
     local shards, n_total_lanes the global lane count).
+
+    chain=None elects via scatter-min/scatter-add (the r4 kernel — XLA CPU
+    lowers these well; TPU serializes them).  Passing a ChainTable replaces
+    every scatter/gather with STATICALLY-UNROLLED min/sum chains over the
+    slots' possible contenders (O(total network-op instructions) dense
+    vector ops per tick, no scatters at all) — the r5 cut at the measured
+    TPU wide-lane ceiling (ARCHITECTURE.md "Wide-network design
+    position").  Single-chip only: per-shard contender structure is not
+    uniform, so the sharded kernel keeps scatter + pmin/psum.
     """
+    if chain is not None and axis is not None:
+        raise ValueError("chained election is single-chip (axis=None) only")
     n_local, _, _ = code.shape
     n_ports = isa.NUM_PORTS
     if n_total_lanes is None:
@@ -164,23 +229,80 @@ def step_slots(
     # replicated stack update.
     my_key = lane_global * 2 + (want_sop & is_push).astype(_I32)
 
-    # --- election: scatter-min keys (+ pmin across shards) -----------------
-    keys = jnp.full((kv,), BIG, _I32).at[slot].min(jnp.where(contend, my_key, BIG))
+    # --- election: keys per slot (+ pmin across shards) --------------------
+    key_masked = jnp.where(contend, my_key, BIG)
     slot_lane = jnp.asarray(route.slot_lane)
     slot_port = jnp.asarray(route.slot_port)
     local_row = slot_lane - lane_offset
     mine = (local_row >= 0) & (local_row < n_local)
     occ = port_full_after_reads[jnp.clip(local_row, 0, n_local - 1), slot_port]
     veto = jnp.where(mine & occ, jnp.asarray(-1, _I32), BIG)
-    keys = keys.at[jnp.arange(da)].min(veto)
+    if chain is None:
+        keys = jnp.full((kv,), BIG, _I32).at[slot].min(key_masked)
+        keys = keys.at[jnp.arange(da)].min(veto)
+    else:
+        # per-slot terms stacked then min-reduced (log-depth tree, not a
+        # linear dependency chain — contended slots would otherwise
+        # serialize over their contender count, the very cost this
+        # election exists to remove)
+        ks = []
+        for s_idx, lanes_for in enumerate(chain.slot_contenders):
+            if not lanes_for:
+                ks.append(jnp.asarray(BIG))
+                continue
+            terms = jnp.stack(
+                [jnp.where(slot[c] == s_idx, key_masked[c], BIG) for c in lanes_for]
+            )
+            ks.append(jnp.min(terms, axis=0))
+        ks.append(jnp.asarray(BIG))  # trash
+        keys = jnp.stack(ks)
+        keys = jnp.concatenate([jnp.minimum(keys[:da], veto), keys[da:]])
     keys_global = keys if axis is None else jax.lax.pmin(keys, axis)
 
-    gathered = keys_global[slot]
+    if chain is None:
+        gathered = keys_global[slot]
+    else:
+        # exactly one slot matches each lane's current classification, so a
+        # min over (match ? key : BIG) terms is the gather (tree-reduced)
+        gs = []
+        for n in range(n_local):
+            slots_n = chain.lane_slots[n]
+            if not slots_n:
+                gs.append(jnp.asarray(BIG))
+                continue
+            terms = jnp.stack(
+                [
+                    jnp.where(slot[n] == s_idx, keys_global[s_idx], BIG)
+                    for s_idx in slots_n
+                ]
+            )
+            gs.append(jnp.min(terms, axis=0))
+        gathered = jnp.stack(gs)
     won = contend & (gathered == my_key)
 
-    # --- winner values: scatter-add (+ psum across shards) -----------------
+    # --- winner values: per-slot sums (+ psum across shards) ---------------
     carries_val = won & (want_send | is_push | want_out)
-    vals = jnp.zeros((kv,), _I32).at[slot].add(jnp.where(carries_val, src_val, 0))
+    if chain is None:
+        vals = jnp.zeros((kv,), _I32).at[slot].add(
+            jnp.where(carries_val, src_val, 0)
+        )
+    else:
+        vs = []
+        for s_idx, lanes_for in enumerate(chain.slot_contenders):
+            if not lanes_for:
+                vs.append(jnp.asarray(np.int32(0)))
+                continue
+            terms = jnp.stack(
+                [
+                    jnp.where(
+                        carries_val[c] & (slot[c] == s_idx), src_val[c], 0
+                    )
+                    for c in lanes_for
+                ]
+            )
+            vs.append(jnp.sum(terms, axis=0))
+        vs.append(jnp.asarray(np.int32(0)))  # trash
+        vals = jnp.stack(vs).astype(_I32)
     vals_global = vals if axis is None else jax.lax.psum(vals, axis)
 
     # --- port delivery (owner shard applies its own slots) -----------------

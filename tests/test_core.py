@@ -449,3 +449,31 @@ def test_batched_serve_equals_piecewise():
     s5, outs5 = net.drain_batched(s5, rd=c[:, 2], wr=c[:, 3])
     assert [(b, o.tolist()) for b, o in outs5] \
         == [(0, [11]), (1, [21]), (2, [31]), (3, [41])]
+
+
+def test_chained_election_smoke():
+    """Fast-lane pin for the scatter-free chained election (the full fuzz
+    lives in test_scale's slow lane): bit-identical to compact on add2 and
+    the branch-heavy sorter, end to end through run()."""
+    from misaka_tpu import networks
+
+    for name in ("add2", "sorter"):
+        net = networks.BASELINE_CONFIGS[name](
+            in_cap=8, out_cap=8, stack_cap=8
+        ).compile()
+        vals = np.random.default_rng(4).integers(-100, 100, size=6).astype(np.int32)
+        state0 = net.init_state()
+        prep = state0._replace(
+            in_buf=state0.in_buf.at[:6].set(vals), in_wr=state0.in_wr + 6
+        )
+        a = net.run(prep, 80, engine="compact")
+        state0 = net.init_state()
+        prep = state0._replace(
+            in_buf=state0.in_buf.at[:6].set(vals), in_wr=state0.in_wr + 6
+        )
+        b = net.run(prep, 80, engine="chained")
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f"{name}.{f}",
+            )

@@ -97,13 +97,15 @@ def test_compact_matches_dense_fuzzed(seed):
         )
 
     dense = net.run(prep(net.init_state()), 64, engine="dense")
-    compact = net.run(prep(net.init_state()), 64, engine="compact")
-    for name in dense._fields:
-        np.testing.assert_array_equal(
-            np.asarray(getattr(dense, name)),
-            np.asarray(getattr(compact, name)),
-            err_msg=f"state field '{name}' diverged (seed {seed})",
-        )
+    for engine in ("compact", "chained"):
+        other = net.run(prep(net.init_state()), 64, engine=engine)
+        for name in dense._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dense, name)),
+                np.asarray(getattr(other, name)),
+                err_msg=f"state field '{name}' diverged "
+                        f"({engine}, seed {seed})",
+            )
 
 
 def test_compact_matches_dense_batched():

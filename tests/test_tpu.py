@@ -95,6 +95,11 @@ def test_compact_kernel_hw_parity():
     dense = net.run(prep(net.init_state()), 250, engine="dense")
     compact = net.run(prep(net.init_state()), 250, engine="compact")
     assert_states_equal(dense, compact)
+    # the scatter-free chained election through the TPU compiler too — the
+    # r5 A/B candidate against scatter serialization must be parity-pinned
+    # on hardware before its lane numbers mean anything
+    chained = net.run(prep(net.init_state()), 250, engine="chained")
+    assert_states_equal(dense, chained)
     # the pipeline completed: every instance emitted all 4 values, +64 each
     np.testing.assert_array_equal(np.asarray(compact.out_wr), 4)
     np.testing.assert_array_equal(

@@ -22,8 +22,17 @@ import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# FORCE cpu — setdefault is not enough: the axon sitecustomize runs at
+# interpreter start and overwrites JAX_PLATFORMS whenever
+# PALLAS_AXON_POOL_IPS is set, so an inherited env pointed the first soak
+# at the wedged TPU (its only "mismatch" was the backend init failing).
+# The config API works post-import as long as no computation has run.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> int:
@@ -43,17 +52,32 @@ def main() -> int:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         ".tpu_capture_active",
     )
+
+    def capture_running() -> bool:
+        # honor only FRESH locks (same 2h rule as chip_probe_loop.sh): a
+        # SIGKILLed capture leaves the file behind, and a stale lock must
+        # not turn every future soak into a silent 0-comparison no-op
+        try:
+            stamp = float(open(lockf).read().strip() or 0)
+        except OSError:
+            return False
+        except ValueError:
+            stamp = 0.0
+        return (time.time() - stamp) < 7200
+
     while time.monotonic() < deadline:
-        if os.path.exists(lockf):
+        if capture_running():
             # a TPU evidence capture started: yield the (single) CPU —
             # depressed host-side capture numbers cost more than soak time
             print("# soak: yielding to TPU capture (lockfile present)", flush=True)
             break
         # fused-interpret recompiles per network (~10s each on one core):
-        # sample it every 5th seed so dense/compact coverage dominates
+        # sample it every 5th seed so dense/compact/chained coverage
+        # dominates
         modes = [
             ("dense", dict(engine="dense")),
             ("compact", dict(engine="compact")),
+            ("chained", dict(engine="chained")),
         ]
         if seed % 5 == 0:
             modes.append(("fused", dict(fused=True)))
