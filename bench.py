@@ -531,6 +531,12 @@ def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1
             # batch ran clean; 256 x 4096 has faulted once in a
             # mixed-config sequence).
             batch = min(batch, max(128, 2**18 // n_lanes))
+        elif engine == "fused" and on_tpu and n_lanes >= 64:
+            # 64 lanes = 1,102 carry rows: the 4 MB carry budget rejects
+            # every >=1024 block and Mosaic tiling rejects every partial
+            # <1024 block (fused.py eager check), so the only viable wide
+            # fused config is single-block with batch <= 512.
+            batch = min(batch, 512)
     top = networks.pipeline(
         n_lanes, in_cap=per_instance, out_cap=per_instance, stack_cap=8
     )
@@ -1073,10 +1079,17 @@ def main():
     # serialization ceiling — the decision data for flipping the wide-lane
     # TPU default.
     if platform == "tpu":
+        # (1024, "compact") is NOT in the default matrix: it reproducibly
+        # crashed the TPU worker in both r5 captures (error entries in
+        # BENCH_tpu_r05*.json lane_scaling), and a crash kills every config
+        # after it in-process — including, twice, the chained/fused A/Bs
+        # that used to sit behind it.  The measured 1024-lane fault IS the
+        # documented ceiling; re-crashing the shared worker every bench run
+        # buys nothing.
         lane_matrix = [
             (8, "dense"), (16, "dense"), (32, "dense"),
             (16, "compact"), (32, "compact"), (64, "compact"),
-            (256, "compact"), (1024, "compact"),
+            (256, "compact"),
             (64, "chained"), (256, "chained"), (64, "fused"),
         ]
     else:

@@ -34,13 +34,16 @@ _I32 = jnp.int32
 #        (add2 shape, batch 512) 9.6k vs 4.0k, 16 lanes 15.1k vs 6.1k,
 #        64 lanes 5.1k vs 0.16k (bench.py lane_scaling + r5 session
 #        measurements) — threshold 0, always compact.
-#   tpu: scatters serialize (compact is scatter-throughput-bound at
-#        ~11M lane-instance-ticks/s, r4 memory of r2-era probes) while the
-#        dense one-hot rides the VPU at small N — but dense at >=64 lanes x
-#        production batch WEDGES the shared worker (67 MiB one-hot/tick).
-#        32 stays the conservative TPU threshold until the r5 capture's
-#        8/16/32-lane matrix lands; safety (never hand a wide dense config
-#        to the chip) dominates the open 16-vs-32 question.
+#   tpu: measured r5 (BENCH_tpu_r05.json lane_scaling + artifacts/r05):
+#        the dense one-hot rides the VPU and beats compact at every
+#        measured small width — 16 lanes 176k vs 106k values/s, 32 lanes
+#        524k vs 332k lane-batch-normalized inst-ticks/s — but its
+#        election matrix is O(N^2 x batch) bytes: 64 lanes x 4096 batch
+#        (67 MiB/tick) reproducibly crashed/wedged the worker in r4 and
+#        both r5 captures.  At PRODUCTION batches that memory wall sits
+#        below 32 lanes, so 32 stays the auto threshold on safety — the
+#        measured 1.6x dense win at 32 lanes only exists at bench-sized
+#        batches the footprint cap admits.
 #
 # COMPACT_AUTO_LANES is the TPU/default constant; decision sites go through
 # compact_auto_lanes(), which reads the live backend (and the
@@ -48,15 +51,39 @@ _I32 = jnp.int32
 COMPACT_AUTO_LANES = 32
 _COMPACT_AUTO_BY_PLATFORM = {"cpu": 0, "tpu": COMPACT_AUTO_LANES}
 
+# Which kernel serves networks at/above the threshold.  Measured r5 on
+# hardware (artifacts/r05/lane_followup.json): the CHAINED election —
+# scatter-free, statically-unrolled min/sum chains (core/routing.py
+# ChainTable) — beats the scatter kernel 1.40x at 64 lanes and 1.44x at
+# 256 lanes on TPU (56 vs 40 and 59 vs 41 ticks/s, same batch), exactly
+# the scatter-serialization ceiling it was built to dodge.  On CPU, XLA
+# lowers scatters well and chained measures ~0.7x compact, so compact
+# stays the CPU wide kernel.
+_WIDE_ENGINE_BY_PLATFORM = {"cpu": "compact", "tpu": "chained"}
+
 
 def compact_auto_lanes() -> int:
-    """Platform-dependent dense->compact auto-switch threshold."""
+    """Platform-dependent dense->wide-kernel auto-switch threshold."""
     env = os.environ.get("MISAKA_COMPACT_AUTO_LANES")
     if env:
         return int(env)
     return _COMPACT_AUTO_BY_PLATFORM.get(
         jax.default_backend(), COMPACT_AUTO_LANES
     )
+
+
+def wide_engine() -> str:
+    """Platform-dependent wide-network kernel: "chained" on TPU (1.4x the
+    scatter kernel at 64/256 lanes, measured r5), "compact" on CPU.
+    Override with MISAKA_WIDE_ENGINE=compact|chained."""
+    env = os.environ.get("MISAKA_WIDE_ENGINE")
+    if env:
+        if env not in ("compact", "chained"):
+            raise ValueError(
+                f"MISAKA_WIDE_ENGINE must be compact|chained, got {env!r}"
+            )
+        return env
+    return _WIDE_ENGINE_BY_PLATFORM.get(jax.default_backend(), "compact")
 
 
 def _chunk_body(step_fn, tables, state: NetworkState, num_steps: int,
@@ -247,11 +274,14 @@ class CompiledNetwork:
     def step_fn(self):
         """The auto-selected per-tick step function (single instance):
         dense one-hot below compact_auto_lanes() lanes (platform-dependent:
-        0 on CPU, so CPU always runs compact), compact scatter elections
-        (core/routing.py) at/above.  Both are bit-identical; only the
+        0 on CPU, so CPU never runs dense), the platform wide kernel
+        (wide_engine(): scatter elections on CPU, chained elections on
+        TPU — core/routing.py) at/above.  All are bit-identical; only the
         arbitration data structure differs."""
         if self.num_lanes < compact_auto_lanes():
             return step
+        if wide_engine() == "chained":
+            return self._chained_step()
         return self._compact_step()
 
     def _compact_step(self):
@@ -290,7 +320,9 @@ class CompiledNetwork:
         """
         if engine is None:
             engine = (
-                "compact" if self.num_lanes >= compact_auto_lanes() else "dense"
+                wide_engine()
+                if self.num_lanes >= compact_auto_lanes()
+                else "dense"
             )
         if engine in ("compact", "chained"):
             cache_attr = "_compact_chunk" if engine == "compact" else "_chained_chunk"
@@ -490,11 +522,18 @@ class CompiledNetwork:
                 self._tables, state, jnp.asarray(values),
                 jnp.asarray(count, _I32), num_steps,
             )
-        # Wide networks serve through the compact kernel; the route table is
-        # baked into a per-network jitted closure (it is not hashable, so it
-        # cannot ride as a static arg of the module-level jit).
+        # Wide networks serve through the platform wide kernel (scatter
+        # elections on CPU, chained on TPU — bit-identical, so a cached
+        # closure surviving an env flip is a perf nuance, not a wrong
+        # answer); the route table is baked into a per-network jitted
+        # closure (it is not hashable, so it cannot ride as a static arg
+        # of the module-level jit).
         if self._compact_serve is None:
-            step1 = self._compact_step()
+            step1 = (
+                self._chained_step()
+                if wide_engine() == "chained"
+                else self._compact_step()
+            )
             tables = self._tables
 
             @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))

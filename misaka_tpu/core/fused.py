@@ -140,6 +140,24 @@ def make_fused_runner(
             f"batch {batch} must be a multiple of block_batch {block_batch}, "
             f"itself a multiple of {LANE}"
         )
+    # Mosaic tiling: state arrays are (rows, batch//LANE, LANE) and the
+    # grid blocks the middle axis at block_batch//LANE sublane-rows.  The
+    # TPU lowering requires the -2 block dim to be a multiple of 8 (the
+    # int32 sublane tile) unless the block spans the whole axis — raised
+    # EAGERLY here (the lowering only raises at compile) so
+    # fused_runner_walk can skip past an untileable candidate the same way
+    # it skips past a budget-rejected one.
+    if (
+        not interpret
+        and jax.default_backend() == "tpu"
+        and block_batch != batch
+        and block_batch % (8 * LANE)
+    ):
+        raise ValueError(
+            f"block_batch={block_batch} is not Mosaic-tileable: partial "
+            f"batch blocks must be multiples of {8 * LANE} (8 sublanes x "
+            f"{LANE} lanes, int32 tile) unless block_batch == batch"
+        )
     # Storage-mode split (see UNROLL_CAP above): small caps live in the
     # fori_loop carry and pay unrolled select chains; big caps stay in VMEM
     # refs and pay chunked dynamic-slice scans.

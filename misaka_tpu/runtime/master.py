@@ -364,12 +364,14 @@ class MasterNode:
         if self._trace_cap:
             return "scan-traced"
         # which arbitration kernel the scan engine auto-selected (platform-
-        # dependent since r5: CPU always compact) — observability for the
-        # crossover, not a distinct engine
-        from misaka_tpu.core.engine import compact_auto_lanes
+        # dependent since r5: CPU always compact, TPU wide nets chained) —
+        # observability for the crossover, not a distinct engine
+        from misaka_tpu.core.engine import compact_auto_lanes, wide_engine
 
         kernel = (
-            "compact" if self._net.num_lanes >= compact_auto_lanes() else "dense"
+            wide_engine()
+            if self._net.num_lanes >= compact_auto_lanes()
+            else "dense"
         )
         return f"scan-{kernel}"
 

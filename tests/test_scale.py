@@ -206,6 +206,32 @@ def test_compact_auto_lanes_platform_and_override(monkeypatch):
     assert compact_auto_lanes() == 7
 
 
+def test_wide_engine_platform_and_override(monkeypatch):
+    """The wide-network kernel choice is platform-dependent (chained beats
+    the scatter kernel 1.40-1.44x on TPU at 64/256 lanes, measured r5
+    artifacts/r05/lane_followup.json; compact wins on CPU) and
+    env-overridable; the auto path and step_fn must honor it."""
+    import jax
+
+    from misaka_tpu.core.engine import wide_engine
+
+    monkeypatch.delenv("MISAKA_WIDE_ENGINE", raising=False)
+    expected = {"cpu": "compact", "tpu": "chained"}.get(
+        jax.default_backend(), "compact"
+    )
+    assert wide_engine() == expected
+    monkeypatch.setenv("MISAKA_WIDE_ENGINE", "chained")
+    assert wide_engine() == "chained"
+    # step_fn must return the chained closure for a wide net under the
+    # override (bit-identical kernels — selection is the observable)
+    monkeypatch.setenv("MISAKA_COMPACT_AUTO_LANES", "2")
+    net = networks.pipeline(4, in_cap=8, out_cap=8, stack_cap=8).compile()
+    assert net.step_fn() is net._chained_step()
+    monkeypatch.setenv("MISAKA_WIDE_ENGINE", "bogus")
+    with pytest.raises(ValueError, match="MISAKA_WIDE_ENGINE"):
+        wide_engine()
+
+
 def test_cpu_auto_selects_compact_small_net():
     """On CPU even a reference-scale (3-lane) network auto-runs the compact
     kernel — 1.5-2.4x dense on the serving path (ARCHITECTURE.md)."""

@@ -153,22 +153,30 @@ def test_mosaic_elide_dead_hi_parity():
 
 
 def test_mosaic_block_walk_wide_net():
-    """The shared block-size walk on hardware: a 64-lane pipeline must
-    reject the big blocks (1,102 carry rows) and still compile+run at the
-    block the walk picks — the exact path the lane matrix (64, fused)
-    config takes on TPU."""
-    # batch 2048 so the 2048/1024 candidates pass the divisibility pre-check
-    # and must be REJECTED by the VMEM budget (1,102 carry rows = 9/4.5 MB)
-    # — the walk's continue-past-ValueError mechanism, not just its size
-    # filter, is what runs here
+    """The shared block-size walk on hardware: at 64 lanes (1,102 carry
+    rows) the VMEM budget rejects every >=1024 block and Mosaic tiling
+    rejects every partial <1024 block (the -2 block dim must be a multiple
+    of 8 sublanes unless the block spans the batch — enforced eagerly in
+    fused.py so the walk can skip, not die at compile).  The only viable
+    wide fused config is single-block with batch <= 512 — the exact path
+    the lane matrix (64, fused) config takes on TPU."""
     top = networks.pipeline(64, in_cap=8, out_cap=8, stack_cap=8)
-    net = top.compile(batch=2048)
+    # batch 2048: 2048/1024 pass the divisibility pre-check and are
+    # REJECTED by the VMEM budget (9/4.5 MB carry); 512/256/128 are
+    # tileable on CPU-interpret but NOT on hardware (4/2/1 sublane-rows) —
+    # the walk must exhaust its candidates with a budget/tiling error, not
+    # return a block that faults at compile (the pre-fix behavior).
+    with pytest.raises(ValueError, match="Mosaic-tileable|budget exceeded"):
+        top.compile(batch=2048).fused_runner_walk(
+            64, candidates=(2048, 1024, 512, 256, 128)
+        )
+    net = top.compile(batch=512)
     runner, bb = net.fused_runner_walk(
         64, candidates=(2048, 1024, 512, 256, 128)
     )
-    assert bb == 512  # largest block the carry budget admits at 64 lanes
+    assert bb == 512  # == batch: whole-axis block, tiling-exempt, 2.3 MB
     rng = np.random.default_rng(7)
-    vals = rng.integers(-1000, 1000, size=(2048, 4)).astype(np.int32)
+    vals = rng.integers(-1000, 1000, size=(512, 4)).astype(np.int32)
     state = net.init_state()
     state = state._replace(
         in_buf=state.in_buf.at[:, :4].set(vals), in_wr=state.in_wr + 4
