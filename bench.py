@@ -399,7 +399,13 @@ def bench_served(
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if batch is None:
-        batch = 8192 if on_tpu else 256
+        # 32768 measured best on the relayed r5 chip (batch sweep,
+        # artifacts/r05/served_batch_probe.json): 8192 -> 379-813k/s,
+        # 32768 -> 1.49M/s (the serving record, past the 1M/s north star
+        # through HTTP), 65536 -> 1.32M/s — bigger waves amortize the
+        # 72-103ms per-dispatch relay latency until device compute per
+        # wave dominates.
+        batch = 32768 if on_tpu else 256
     top = networks.add2(in_cap=in_cap, out_cap=in_cap, stack_cap=16)
     master = MasterNode(
         top, chunk_steps=chunk_steps, batch=batch, engine="auto", stripe=stripe
