@@ -302,7 +302,7 @@ def _repeat_best(once, first, min_time, max_reps):
 
 def bench_config(
     name, batch=262144, per_instance=128, block_batch=2048, max_attempts=3,
-    min_time=1.5, max_reps=4,
+    min_time=3.0, max_reps=6,
 ):
     """Measure one BASELINE config: B instances drain Q values each.
 
@@ -374,7 +374,7 @@ def bench_config(
         )
 
     # Per-rep verification without a full-buffer host pull (out_buf is
-    # ~128MB at headline batch — seconds through the relay per rep): every
+    # ~512MB at headline batch — seconds through the relay per rep): every
     # rep must complete exactly (out_wr == per_instance) and match an
     # order-invariant mod-2^32 checksum computed ON DEVICE; the final
     # state additionally gets the full elementwise parity check below.
@@ -1013,7 +1013,18 @@ def main():
     for name in CONFIGS if run_all else ["add2"]:
         # fallback mode shrinks the batch: the CPU number is an honest
         # label, not a target, and the artifact must fit a tight budget
-        r = bench_config(name, batch=32768 if fallback else 262144)
+        # TPU headline batch 1048576 since late r5: the batch probe
+        # measured 262144 -> 153.0M/s, 524288 -> 157.0M/s, 1048576 ->
+        # 163.3M/s (artifacts/r05/headline_batch_probe.json) — per-tick
+        # fixed cost keeps amortizing past 262k, matching the roofline
+        # sweep's shape.  CPU keeps 262144: a 4x bigger batch would eat
+        # the outage-round artifact's TTL for no headline (CPU is
+        # host-bound) and break comparability with BENCH_cpu_r04/r05.
+        r = bench_config(
+            name,
+            batch=32768 if fallback
+            else (1048576 if platform == "tpu" else 262144),
+        )
         results[name] = r
         print(
             f"# {name}: platform={platform} batch={r['batch']} "
