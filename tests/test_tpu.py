@@ -184,3 +184,45 @@ def test_mosaic_block_walk_wide_net():
     for _ in range(5):  # 5 x 64 = 320 ticks: fill + drain the 64 stages
         state = runner(state)
     np.testing.assert_array_equal(np.asarray(state.out_buf)[:, :4], vals + 64)
+
+
+def test_chained_wide_default_serves_on_hardware(monkeypatch):
+    """The r5 default flip end-to-end on the chip: a wide (40-lane) net's
+    auto path must select the CHAINED election on TPU (wide_engine(),
+    1.40-1.44x the scatter kernel measured at 64/256 lanes,
+    artifacts/r05/lane_followup.json) and produce reference-correct
+    results through BOTH run(engine=None) and the serve_chunk surface the
+    MasterNode drives (program.go:80-92 semantics per lane)."""
+    from misaka_tpu.core.engine import compact_auto_lanes, wide_engine
+
+    # assert the platform DEFAULTS: clear the A/B override knobs a probe
+    # shell may still export (test_scale.py precedent)
+    monkeypatch.delenv("MISAKA_WIDE_ENGINE", raising=False)
+    monkeypatch.delenv("MISAKA_COMPACT_AUTO_LANES", raising=False)
+
+    assert wide_engine() == "chained"  # the TPU platform default
+    n = 40
+    top = networks.pipeline(n, in_cap=8, out_cap=8, stack_cap=8)
+    net = top.compile()  # single instance: the serving shape
+    assert net.num_lanes >= compact_auto_lanes()
+    assert net.step_fn() is net._chained_step()
+
+    vals = np.array([7, -3, 250, -999], dtype=np.int32)
+    state = net.init_state()
+    ticks = 3 * n + 3 * len(vals) + 64
+    state, packed = net.serve_chunk(state, vals, len(vals), ticks)
+    packed = np.asarray(packed)
+    out_rd, out_wr = int(packed[2]), int(packed[3])
+    assert out_wr - out_rd == len(vals)
+    got = packed[4:][np.arange(out_rd, out_wr) % net.out_cap]
+    np.testing.assert_array_equal(got, vals + n)
+
+    # and the batched auto path (run engine=None -> chained on TPU)
+    netb = top.compile(batch=128)
+    b_vals = np.tile(vals, (128, 1))
+    sb = netb.init_state()
+    sb = sb._replace(
+        in_buf=sb.in_buf.at[:, :4].set(b_vals), in_wr=sb.in_wr + 4
+    )
+    sb = netb.run(sb, ticks)  # engine=None: the flipped default
+    np.testing.assert_array_equal(np.asarray(sb.out_buf)[:, :4], b_vals + n)
