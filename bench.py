@@ -1020,10 +1020,13 @@ def main():
         # sweep's shape.  CPU keeps 262144: a 4x bigger batch would eat
         # the outage-round artifact's TTL for no headline (CPU is
         # host-bound) and break comparability with BENCH_cpu_r04/r05.
+        # Only the add2 HEADLINE runs at the 1048576 measured-best batch:
+        # five configs at 1M (one fresh ~60s compile each + 4 reps of ~0.8s)
+        # measured past the 1140s whole-run TTL (BENCH_tpu_r05_all_b1m.json
+        # is the resulting honest partial) — secondary configs keep 262144.
+        big = platform == "tpu" and name == "add2"
         r = bench_config(
-            name,
-            batch=32768 if fallback
-            else (1048576 if platform == "tpu" else 262144),
+            name, batch=32768 if fallback else (1048576 if big else 262144)
         )
         results[name] = r
         print(
