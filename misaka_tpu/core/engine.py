@@ -419,6 +419,8 @@ class CompiledNetwork:
         ValueError when nothing fits.  The ONE copy of the walk, shared by
         the serving path and the bench lane matrix.
         """
+        if self.batch is None:
+            raise ValueError("fused_runner requires a batched network")
         err: ValueError | None = None
         for bb in candidates:
             if bb is not None and (self.batch % bb or bb > self.batch):

@@ -232,13 +232,17 @@ def test_wide_engine_platform_and_override(monkeypatch):
         wide_engine()
 
 
-def test_cpu_auto_selects_compact_small_net():
+def test_cpu_auto_selects_compact_small_net(monkeypatch):
     """On CPU even a reference-scale (3-lane) network auto-runs the compact
     kernel — 1.5-2.4x dense on the serving path (ARCHITECTURE.md)."""
     import jax
 
     if jax.default_backend() != "cpu":
         pytest.skip("CPU auto-selection probe")
+    # a shell still carrying A/B-probe overrides must not flip the auto
+    # choice under the test (same guard as the test_tpu.py hardware lane)
+    monkeypatch.delenv("MISAKA_WIDE_ENGINE", raising=False)
+    monkeypatch.delenv("MISAKA_COMPACT_AUTO_LANES", raising=False)
     top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
     net = top.compile()
     # the auto choice must BE the compact kernel, not just clear the
