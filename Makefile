@@ -4,7 +4,7 @@
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC
 
-native: native/libmisaka_assembler.so native/libmisaka_interp.so
+native: native/libmisaka_assembler.so native/libmisaka_interp.so native/libmisaka_textcodec.so
 
 # -DMISAKA_SRC_HASH must match utils/nativelib.py's _build (sha256[:16] of
 # the source): the loader trusts a .so only when its embedded tag matches
@@ -13,6 +13,9 @@ native/libmisaka_assembler.so: native/assembler.cpp
 	$(CXX) $(CXXFLAGS) -DMISAKA_SRC_HASH="\"$$(sha256sum $< | cut -c1-16)\"" $< -o $@
 
 native/libmisaka_interp.so: native/interpreter.cpp
+	$(CXX) $(CXXFLAGS) -DMISAKA_SRC_HASH="\"$$(sha256sum $< | cut -c1-16)\"" $< -o $@
+
+native/libmisaka_textcodec.so: native/textcodec.cpp
 	$(CXX) $(CXXFLAGS) -DMISAKA_SRC_HASH="\"$$(sha256sum $< | cut -c1-16)\"" $< -o $@
 
 # Regenerate protobuf message classes for the per-process transport.  The

@@ -20,13 +20,64 @@ passes over the byte stream, GIL mostly released):
   stream is fixed-stride (everything `ints_to_dec` emits), a reshape-based
   parser handles it in ~10 vector passes; anything ragged falls back to a
   general parser.  Malformed input raises ValueError either way.
+
+Both directions carry a native single-pass C++ fast path
+(native/textcodec.cpp, loaded via utils/nativelib.py, same degrade-to-
+Python contract as the native assembler): ~10x the numpy passes and the
+GIL is released for the whole call, so serving threads overlap with the
+codec.  `MISAKA_NATIVE_CODEC=0` forces the numpy path (A/B and fallback
+coverage); `=1` requires native (raises when no toolchain).  Byte-exact
+equivalence is pinned by tests/test_textcodec.py's differential lane.
 """
 
 from __future__ import annotations
 
+import ctypes
+import os
 import warnings
 
 import numpy as np
+
+from misaka_tpu.utils.nativelib import NativeLib
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.misaka_fmt_i32.restype = ctypes.c_int64
+    lib.misaka_fmt_i32.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_uint8,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ]
+    lib.misaka_parse_i32.restype = ctypes.c_int64
+    lib.misaka_parse_i32.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+    ]
+
+
+_NATIVE = NativeLib(
+    os.path.join(_REPO_ROOT, "native", "textcodec.cpp"),
+    os.path.join(_REPO_ROOT, "native", "libmisaka_textcodec.so"),
+    _configure,
+)
+
+
+def _native_lib() -> ctypes.CDLL | None:
+    """The codec .so per MISAKA_NATIVE_CODEC: auto (default), 0=off, 1=require."""
+    knob = os.environ.get("MISAKA_NATIVE_CODEC", "").strip()
+    if knob == "0":
+        return None
+    lib = _NATIVE.load()
+    if lib is None and knob == "1":
+        raise RuntimeError("MISAKA_NATIVE_CODEC=1 but no native codec (no g++?)")
+    return lib
+
+
+def native_available() -> bool:
+    return _NATIVE.available()
 
 _SEPS = (ord(" "), ord(","), ord("+"), ord("\t"), ord("\n"), ord("\r"))
 _SEP_TABLE = bytes.maketrans(b",+\t\n\r", b"     ")
@@ -53,6 +104,19 @@ def ints_to_dec(arr: np.ndarray, sep: bytes = b" ", zero_pad: bool = False) -> b
     n = a.size
     if n == 0:
         return b""
+    if a.dtype == np.int32:
+        lib = _native_lib()
+        if lib is not None:
+            src = np.ascontiguousarray(a.ravel())
+            # width <= 11 (10 digits + sign column) -> field+sep <= 12 bytes
+            out = np.empty(12 * n, np.uint8)
+            rc = lib.misaka_fmt_i32(
+                src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+                sep[0], int(zero_pad),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), out.size,
+            )
+            if rc >= 0:
+                return out[:rc].tobytes()
     v = a.astype(np.int64).ravel()
     neg = v < 0
     mag = np.where(neg, -v, v).astype(np.uint32)  # int32 min fits unsigned
@@ -194,6 +258,20 @@ def dec_to_ints(text: bytes | str) -> np.ndarray:
     raw = np.frombuffer(text, np.uint8)
     if raw.size == 0:
         return np.empty((0,), np.int32)
+    lib = _native_lib()
+    if lib is not None:
+        if not isinstance(text, bytes):  # bytearray/memoryview: c_char_p wants bytes
+            text = bytes(text)
+        # every token but the last needs >= 1 separator byte, so
+        # (len+1)//2 bounds the token count — -2 (capacity) is unreachable
+        out = np.empty((raw.size + 1) // 2, np.int32)
+        rc = lib.misaka_parse_i32(
+            text, raw.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), out.size,
+        )
+        if rc < 0:
+            raise ValueError("cannot parse values")
+        return out[:rc].copy()
     fixed = _parse_fixed(raw)
     if fixed is not None:
         return fixed

@@ -4,6 +4,10 @@ The codec is the /compute_batch wire format (utils/textcodec.py) — its
 output must stay loadable by ordinary json/int() clients, and its parser
 must reject exactly what the round-2 per-value parser rejected (pinned by
 test_runtime.py's 400-path tests, which now route through it).
+
+Every behavioral test runs against BOTH backends (the numpy passes and the
+native/textcodec.cpp single-pass C++, forced via MISAKA_NATIVE_CODEC), and
+the differential lane pins them byte-identical on fuzzed streams.
 """
 
 import json
@@ -11,7 +15,20 @@ import json
 import numpy as np
 import pytest
 
+from misaka_tpu.utils import textcodec
 from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
+
+
+@pytest.fixture(params=["numpy", "native"])
+def codec_backend(request, monkeypatch):
+    """Force one codec backend for the test (skip native sans toolchain)."""
+    if request.param == "native":
+        if not textcodec.native_available():
+            pytest.skip("no C++ toolchain for the native codec")
+        monkeypatch.setenv("MISAKA_NATIVE_CODEC", "1")
+    else:
+        monkeypatch.setenv("MISAKA_NATIVE_CODEC", "0")
+    return request.param
 
 EDGES = np.array(
     [0, 1, -1, 9, 10, -10, 99, 100, 2**31 - 1, -(2**31), 123456789, -987654321],
@@ -20,13 +37,13 @@ EDGES = np.array(
 
 
 @pytest.mark.parametrize("sep", [b" ", b",", b"+"])
-def test_roundtrip_edges(sep):
+def test_roundtrip_edges(sep, codec_backend):
     txt = ints_to_dec(EDGES, sep)
     np.testing.assert_array_equal(dec_to_ints(txt), EDGES)
 
 
 @pytest.mark.parametrize("lo,hi", [(-10, 10), (-1000, 1000), (-2**31, 2**31)])
-def test_roundtrip_random(lo, hi):
+def test_roundtrip_random(lo, hi, codec_backend):
     rng = np.random.default_rng(42)
     arr = rng.integers(lo, hi, size=10_000).astype(np.int32)
     for sep in (b" ", b",", b"+"):
@@ -47,13 +64,13 @@ def test_comma_sep_is_valid_json_array():
     assert json.loads(body) == {"values": EDGES.tolist()}
 
 
-def test_empty():
+def test_empty(codec_backend):
     assert ints_to_dec(np.empty((0,), np.int32)) == b""
     assert dec_to_ints(b"").size == 0
     assert dec_to_ints("  , \t\n").size == 0
 
 
-def test_accepts_mixed_separators():
+def test_accepts_mixed_separators(codec_backend):
     np.testing.assert_array_equal(
         dec_to_ints("1, 2 3,4\t5\n-6"), np.array([1, 2, 3, 4, 5, -6], np.int32)
     )
@@ -67,11 +84,100 @@ def test_accepts_mixed_separators():
      # 12+ char field: must 400 (ValueError), not crash (round-3 regression)
      "999999999999 999999999999", "999999999999,999999999999"],
 )
-def test_rejects_malformed(bad):
+def test_rejects_malformed(bad, codec_backend):
     with pytest.raises(ValueError):
         dec_to_ints(bad)
 
 
-def test_rejects_non_ascii():
+def test_rejects_non_ascii(codec_backend):
     with pytest.raises((ValueError, UnicodeEncodeError)):
         dec_to_ints("１２３")  # fullwidth digits must not silently parse
+
+
+# --- native/numpy differential lane ------------------------------------
+
+needs_native = pytest.mark.skipif(
+    not textcodec.native_available(),
+    reason="no C++ toolchain for the native codec",
+)
+
+
+def _both(monkeypatch, fn):
+    monkeypatch.setenv("MISAKA_NATIVE_CODEC", "0")
+    ref = fn()
+    monkeypatch.setenv("MISAKA_NATIVE_CODEC", "1")
+    nat = fn()
+    return ref, nat
+
+
+@needs_native
+@pytest.mark.parametrize("zero_pad", [False, True])
+@pytest.mark.parametrize("sep", [b" ", b",", b"+"])
+def test_native_format_byte_exact(monkeypatch, sep, zero_pad):
+    rng = np.random.default_rng(11)
+    for arr in (
+        EDGES,
+        np.zeros(7, np.int32),
+        rng.integers(-9, 10, size=501).astype(np.int32),
+        rng.integers(-(2**31), 2**31, size=5000).astype(np.int32),
+    ):
+        ref, nat = _both(monkeypatch, lambda: ints_to_dec(arr, sep, zero_pad))
+        assert ref == nat
+
+
+@needs_native
+def test_native_parse_identical(monkeypatch):
+    rng = np.random.default_rng(12)
+    arr = rng.integers(-(2**31), 2**31, size=5000).astype(np.int32)
+    streams = [
+        ints_to_dec(arr, b" "),
+        ints_to_dec(arr, b"+", zero_pad=True),
+        b"1, 2 3,4\t5\n-6",
+        b"-2147483648 2147483647",
+        b"0000005 -08 -0 0000000000005",  # leading zeros, ragged widths
+        b"7",            # single token, no separator
+        b"7\n",          # trailing separator
+        b"  , \t\n",     # separators only -> empty
+    ]
+    for txt in streams:
+        ref, nat = _both(monkeypatch, lambda: dec_to_ints(txt))
+        np.testing.assert_array_equal(ref, nat)
+
+
+@needs_native
+def test_native_rejects_match(monkeypatch):
+    # the native parser must reject exactly the numpy parser's reject set
+    for bad in ["1 two 3", "5x", "--5", "5-", "5-6", "1.5", "2147483648",
+                "-2147483649", "-", "- 5", "99999999999999999999 1",
+                "999999999999,999999999999", "\x005"]:
+        for knob in ("0", "1"):
+            monkeypatch.setenv("MISAKA_NATIVE_CODEC", knob)
+            with pytest.raises(ValueError):
+                dec_to_ints(bad)
+
+
+@needs_native
+def test_native_fuzz_roundtrip(monkeypatch):
+    """Random arrays through every (backend-pair, sep, pad) combination:
+    format bytes identical, parse returns the input."""
+    rng = np.random.default_rng(13)
+    for trial in range(25):
+        n = int(rng.integers(1, 2000))
+        lo, hi = sorted(rng.integers(-(2**31), 2**31, size=2).tolist())
+        arr = rng.integers(lo, hi + 1, size=n, dtype=np.int64).astype(np.int32)
+        sep = [b" ", b",", b"+"][trial % 3]
+        zp = bool(trial % 2)
+        ref, nat = _both(monkeypatch, lambda: ints_to_dec(arr, sep, zp))
+        assert ref == nat, f"trial {trial}"
+        for knob in ("0", "1"):
+            monkeypatch.setenv("MISAKA_NATIVE_CODEC", knob)
+            np.testing.assert_array_equal(dec_to_ints(nat), arr)
+
+
+@needs_native
+def test_native_accepts_bytearray(monkeypatch):
+    # c_char_p wants bytes; the wrapper must normalize other buffer types
+    # instead of leaking a ctypes.ArgumentError past the ValueError contract
+    ref, nat = _both(monkeypatch, lambda: dec_to_ints(bytearray(b"1 2 -3")))
+    np.testing.assert_array_equal(ref, nat)
+    np.testing.assert_array_equal(nat, np.array([1, 2, -3], np.int32))

@@ -46,7 +46,7 @@ def main() -> int:
 
     deadline = time.monotonic() + args.seconds
     seed = args.start_seed
-    ran = failures = 0
+    ran = failures = reported = 0
     t0 = time.monotonic()
     lockf = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -125,8 +125,9 @@ def main() -> int:
             ran += 1
         seed += 1
         # ran advances 3-4 per seed, so an exact `% 300 == 0` milestone is
-        # usually stepped over — fire whenever a 300 boundary was crossed
-        if ran % 300 < 4:
+        # usually stepped over — report each 300-block once as it's crossed
+        if ran // 300 != reported:
+            reported = ran // 300
             rate = ran / (time.monotonic() - t0)
             print(
                 f"# soak: {ran} comparisons ({seed - args.start_seed} seeds), "
