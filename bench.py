@@ -879,10 +879,12 @@ def _sharded_worker(n_devices, batch, per_instance):
     }))
 
 
-def bench_latency_http(samples=200, warmup=20):
+def bench_latency_http(samples=200, warmup=20, engine="auto"):
     """p50/p99 of a REAL single-value HTTP POST /compute against a running
     master — the number a reference client would see (the kernel-floor
-    variant below strips the HTTP+queue layers)."""
+    variant below strips the HTTP+queue layers).  engine="native" measures
+    the host-interpreter latency tier (core/native_serve.py): zero device
+    dispatches on the request path."""
     import threading as _threading
     import urllib.request
     from urllib.parse import urlencode
@@ -891,7 +893,7 @@ def bench_latency_http(samples=200, warmup=20):
     from misaka_tpu.runtime.master import MasterNode, make_http_server
 
     top = networks.add2(in_cap=16, out_cap=16, stack_cap=16)
-    master = MasterNode(top, chunk_steps=16)
+    master = MasterNode(top, chunk_steps=16, engine=engine)
     httpd = make_http_server(master, port=0)
     _threading.Thread(target=httpd.serve_forever, daemon=True).start()
     base = f"http://127.0.0.1:{httpd.server_address[1]}"
@@ -1121,6 +1123,23 @@ def main():
     )
     payload["http_latency_us_p50"] = round(hlat["p50_us"], 1)
     payload["http_latency_us_p99"] = round(hlat["p99_us"], 1)
+    # The native (host C++) engine's latency tier: on a relayed chip the
+    # device-dispatch floor dominates http_latency_us_*, and this lane is
+    # the measured answer (zero dispatches on the request path).
+    try:
+        from misaka_tpu.core import native_serve
+
+        if native_serve.available():
+            nlat = bench_latency_http(samples=100, warmup=10, engine="native")
+            print(
+                f"# latency HTTP native engine: p50={nlat['p50_us']:.0f}us "
+                f"p99={nlat['p99_us']:.0f}us (n={nlat['samples']})",
+                file=sys.stderr,
+            )
+            payload["native_http_latency_us_p50"] = round(nlat["p50_us"], 1)
+            payload["native_http_latency_us_p99"] = round(nlat["p99_us"], 1)
+    except Exception as e:  # the latency tier must not cost the artifact
+        print(f"# native latency lane failed: {e}", file=sys.stderr)
 
     # The sharded engine runs in a CPU subprocess (virtual mesh), so it is
     # immune to TPU wedges — keep it before the riskier lane matrix.

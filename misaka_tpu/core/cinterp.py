@@ -43,6 +43,13 @@ def _configure(lib: ctypes.CDLL) -> None:
         _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
         _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P,
     ]
+    lib.misaka_interp_read_in.restype = None
+    lib.misaka_interp_read_in.argtypes = [ctypes.c_void_p, _I32P]
+    lib.misaka_interp_write.restype = ctypes.c_int
+    lib.misaka_interp_write.argtypes = [ctypes.c_void_p] + [
+        _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
+        _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P,
+    ]
 
 
 _NATIVE = NativeLib(
@@ -149,48 +156,109 @@ class NativeInterpreter:
                 f"out {out_rd}/{out_wr} (cap {self.out_cap})"
             )
 
+    def _read_raw(self) -> dict:
+        """One misaka_interp_read into fresh buffers: the shared read path
+        of state_arrays (differential view) and export_arrays (serving
+        view) — a signature change lands in exactly one place."""
+        n, s, cap = self.n_lanes, self.num_stacks, self.stack_cap
+        d = {
+            "acc": np.zeros(n, np.int32), "bak": np.zeros(n, np.int32),
+            "acc_hi": np.zeros(n, np.int32), "bak_hi": np.zeros(n, np.int32),
+            "pc": np.zeros(n, np.int32),
+            "port_val": np.zeros((n, isa.NUM_PORTS), np.int32),
+            "port_full": np.zeros((n, isa.NUM_PORTS), np.uint8),
+            "hold_val": np.zeros(n, np.int32),
+            "holding": np.zeros(n, np.uint8),
+            "stack_mem": np.zeros((s, cap), np.int32),
+            "stack_top": np.zeros(s, np.int32),
+            "out_buf": np.zeros(self.out_cap, np.int32),
+            "counters": np.zeros(5, np.int32),
+            "retired": np.zeros(n, np.int32),
+        }
+        self._lib.misaka_interp_read(
+            self._handle(),
+            _as_i32p(d["acc"]), _as_i32p(d["bak"]), _as_i32p(d["pc"]),
+            _as_i32p(d["port_val"]), d["port_full"].ctypes.data_as(_U8P),
+            _as_i32p(d["hold_val"]), d["holding"].ctypes.data_as(_U8P),
+            _as_i32p(d["stack_mem"]), _as_i32p(d["stack_top"]),
+            _as_i32p(d["out_buf"]), _as_i32p(d["counters"]),
+            _as_i32p(d["retired"]), _as_i32p(d["acc_hi"]),
+            _as_i32p(d["bak_hi"]),
+        )
+        return d
+
     def state_arrays(self) -> dict:
         """Mirror tests/oracle.py state_arrays for differential comparison."""
-        self._handle()
-        n, s, cap = self.n_lanes, self.num_stacks, self.stack_cap
-        acc = np.zeros(n, np.int32)
-        bak = np.zeros(n, np.int32)
-        pc = np.zeros(n, np.int32)
-        port_val = np.zeros((n, isa.NUM_PORTS), np.int32)
-        port_full = np.zeros((n, isa.NUM_PORTS), np.uint8)
-        hold_val = np.zeros(n, np.int32)
-        holding = np.zeros(n, np.uint8)
-        stack_mem = np.zeros((s, cap), np.int32)
-        stack_top = np.zeros(s, np.int32)
-        out_buf = np.zeros(self.out_cap, np.int32)
-        counters = np.zeros(5, np.int32)
-        retired = np.zeros(n, np.int32)
-        acc_hi = np.zeros(n, np.int32)
-        bak_hi = np.zeros(n, np.int32)
-        self._lib.misaka_interp_read(
-            self._h,
+        d = self._read_raw()
+        counters = d.pop("counters")
+        d["port_full"] = d["port_full"].astype(bool)
+        d["holding"] = d["holding"].astype(bool)
+        d["stack_mem_used"] = d.pop("stack_mem")
+        d["in_rd"] = counters[0]
+        d["out_wr"] = counters[3]
+        d["tick"] = counters[4]
+        return d
+
+    def export_arrays(self) -> dict:
+        """COMPLETE state export for the serving engine: every NetworkState
+        field (core/state.py), stack_mem zero-padded above each top.  The
+        superset of state_arrays (which keeps its differential-comparison
+        key set and naming)."""
+        d = self._read_raw()
+        counters = d.pop("counters")
+        d["port_full"] = d["port_full"].astype(bool)
+        d["holding"] = d["holding"].astype(bool)
+        in_buf = np.zeros(self.in_cap, np.int32)
+        self._lib.misaka_interp_read_in(self._handle(), _as_i32p(in_buf))
+        d["in_buf"] = in_buf
+        d["in_rd"], d["in_wr"] = counters[0], counters[1]
+        d["out_rd"], d["out_wr"] = counters[2], counters[3]
+        d["tick"] = counters[4]
+        return d
+
+    def import_arrays(self, d: dict) -> None:
+        """Bulk state write — the inverse of export_arrays.  Raises
+        ValueError (interpreter unchanged) on out-of-range pc/top/counters."""
+        n, s = self.n_lanes, self.num_stacks
+
+        def i32arr(key, shape):
+            a = np.ascontiguousarray(np.asarray(d[key]), dtype=np.int32)
+            if a.shape != shape:
+                raise ValueError(f"{key}: expected shape {shape}, got {a.shape}")
+            return a
+
+        def u8arr(key, shape):
+            a = np.ascontiguousarray(np.asarray(d[key])).astype(np.uint8)
+            if a.shape != shape:
+                raise ValueError(f"{key}: expected shape {shape}, got {a.shape}")
+            return a
+
+        acc = i32arr("acc", (n,)); bak = i32arr("bak", (n,))
+        acc_hi = i32arr("acc_hi", (n,)); bak_hi = i32arr("bak_hi", (n,))
+        pc = i32arr("pc", (n,))
+        port_val = i32arr("port_val", (n, isa.NUM_PORTS))
+        port_full = u8arr("port_full", (n, isa.NUM_PORTS))
+        hold_val = i32arr("hold_val", (n,))
+        holding = u8arr("holding", (n,))
+        stack_mem = i32arr("stack_mem", (s, self.stack_cap))
+        stack_top = i32arr("stack_top", (s,))
+        in_buf = i32arr("in_buf", (self.in_cap,))
+        out_buf = i32arr("out_buf", (self.out_cap,))
+        retired = i32arr("retired", (n,))
+        counters = np.ascontiguousarray(
+            [int(d["in_rd"]), int(d["in_wr"]), int(d["out_rd"]),
+             int(d["out_wr"]), int(d["tick"])], dtype=np.int32,
+        )
+        rc = self._lib.misaka_interp_write(
+            self._handle(),
             _as_i32p(acc), _as_i32p(bak), _as_i32p(pc),
             _as_i32p(port_val), port_full.ctypes.data_as(_U8P),
             _as_i32p(hold_val), holding.ctypes.data_as(_U8P),
             _as_i32p(stack_mem), _as_i32p(stack_top),
-            _as_i32p(out_buf), _as_i32p(counters), _as_i32p(retired),
-            _as_i32p(acc_hi), _as_i32p(bak_hi),
+            _as_i32p(in_buf), _as_i32p(out_buf), _as_i32p(counters),
+            _as_i32p(retired), _as_i32p(acc_hi), _as_i32p(bak_hi),
         )
-        return {
-            "acc": acc,
-            "bak": bak,
-            "acc_hi": acc_hi,
-            "bak_hi": bak_hi,
-            "pc": pc,
-            "port_val": port_val,
-            "port_full": port_full.astype(bool),
-            "hold_val": hold_val,
-            "holding": holding.astype(bool),
-            "stack_top": stack_top,
-            "stack_mem_used": stack_mem,
-            "in_rd": counters[0],
-            "out_wr": counters[3],
-            "out_buf": out_buf,
-            "tick": counters[4],
-            "retired": retired,
-        }
+        if rc != 0:
+            raise ValueError(
+                "invalid state import (pc/stack_top/ring counters out of range)"
+            )

@@ -1,0 +1,85 @@
+"""The native serving engine: serve_chunk on the C++ interpreter.
+
+`MasterNode(engine="native")` serves an unbatched network entirely on the
+host — no XLA dispatch anywhere on the request path.  The motivation is
+interactive latency: the reference's primary route is one `POST /compute`
+at a time (master.go:197-224), and on a relayed TPU every device dispatch
+costs a 72-103ms round trip (docs/BENCH_HISTORY.md), so the measured
+single-value floor was ~66ms p50 no matter how fast the kernel.  The C++
+superstep interpreter (native/interpreter.cpp — the same third
+implementation the differential suite pins against the XLA kernels) runs
+a 128-tick serve chunk in single-digit microseconds, which puts /compute
+latency at queue-hop cost instead of dispatch cost.
+
+Design: the master's canonical state stays the NetworkState pytree.  Each
+serve iteration imports the pytree into the interpreter, feeds, runs the
+chunk, and exports back — a few KB of memcpy, microseconds, and it makes
+the engine STATELESS between calls: checkpoint/restore, /load, stack
+auto-grow, and engine swaps all keep working on the pytree with zero
+native-specific code.  The serve_chunk contract (feed `count` values,
+advance `num_steps`, return (state-with-drained-out-ring, packed
+[in_rd, in_wr, out_rd, out_wr, out_buf...])) is byte-compatible with
+core/engine.py's `_serve_body`, pinned by tests/test_native_engine.py.
+
+This is the LATENCY tier of the three serving engines (native for
+interactive, fused Pallas for throughput, routed mesh for scale-out); it
+trades batch throughput away by construction (one instance, one host
+core).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from misaka_tpu.core import cinterp
+from misaka_tpu.core.state import NetworkState
+
+
+def available() -> bool:
+    return cinterp.available()
+
+
+class NativeServe:
+    """serve_chunk twin for one CompiledNetwork, backed by NativeInterpreter."""
+
+    def __init__(self, net):
+        if net.batch is not None:
+            raise ValueError("the native engine serves a single network instance")
+        self._interp = cinterp.NativeInterpreter(
+            np.asarray(net.code), np.asarray(net.prog_len),
+            net.num_stacks, net.stack_cap, net.in_cap, net.out_cap,
+        )
+        self._out_cap = net.out_cap
+
+    def close(self) -> None:
+        self._interp.close()
+
+    def validate_state(self, state: NetworkState) -> None:
+        """Raise ValueError on a state this engine cannot execute (pc beyond
+        the program, stack_top beyond capacity, broken ring counters).
+        Importing IS the validation — the interpreter is stateless between
+        serve calls, so the imported content is simply overwritten next."""
+        self._interp.import_arrays({
+            f: np.asarray(getattr(state, f)) for f in NetworkState._fields
+        })
+
+    def serve_chunk(self, state: NetworkState, values, count, num_steps: int):
+        """See core/engine.py serve_chunk — same contract, host execution."""
+        it = self._interp
+        it.import_arrays({
+            f: np.asarray(getattr(state, f)) for f in NetworkState._fields
+        })
+        count = int(count)
+        if count:
+            fed = it.feed(np.asarray(values[:count], np.int32))
+            if fed != count:  # caller cut to free space; a miss is a bug
+                raise RuntimeError(f"native feed accepted {fed}/{count}")
+        it.run(int(num_steps))
+        d = it.export_arrays()
+        packed = np.concatenate([
+            np.array([d["in_rd"], d["in_wr"], d["out_rd"], d["out_wr"]],
+                     np.int32),
+            d["out_buf"],
+        ])
+        d["out_rd"] = d["out_wr"]  # the returned state's ring is drained
+        return NetworkState(**{f: d[f] for f in NetworkState._fields}), packed

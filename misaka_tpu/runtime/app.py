@@ -21,7 +21,10 @@ so the master's env surface is what survives:
                    the kernel), "fused-interpret" (CI coverage off-TPU),
                    "gather" (model-parallel only: the first-generation
                    occupancy-gather sharded kernel, kept for A/B runs
-                   against the default statically-routed kernel)
+                   against the default statically-routed kernel), "native"
+                   (the host C++ interpreter, core/native_serve.py — the
+                   interactive-latency tier: zero device dispatches per
+                   /compute; unbatched, single-chip, needs g++)
   MISAKA_DATA_PARALLEL   shard the batch axis over N chips (requires
                    MISAKA_BATCH divisible by N); MISAKA_MODEL_PARALLEL
                    shards program-node lanes over M chips via the ICI-

@@ -88,7 +88,15 @@ class MasterNode:
           * "gather" — (model-parallel only) the first-generation sharded
                       kernel (parallel/sharded.py, per-tick occupancy
                       all_gather); kept for A/B measurement against the
-                      default statically-routed kernel (parallel/routed.py).
+                      default statically-routed kernel (parallel/routed.py);
+          * "native" — the host C++ interpreter (core/native_serve.py):
+                      unbatched single-chip serving with ZERO device
+                      dispatches on the request path — the interactive-
+                      latency tier (a /compute costs queue hops + a ~us
+                      host chunk instead of a device round trip, which on
+                      a relayed chip is 72-103ms).  Requires batch=None,
+                      no tracing, no mesh; needs a C++ toolchain
+                      (raises otherwise).
 
         trace_cap with batch traces instance `trace_instance` (instances are
         independent, so its history is exact); tracing always runs the scan
@@ -109,11 +117,25 @@ class MasterNode:
         """
         if batch is not None and batch < 1:
             raise ValueError(f"batch must be >= 1, got {batch}")
-        if engine not in ("auto", "scan", "fused", "fused-interpret", "gather"):
+        if engine not in (
+            "auto", "scan", "fused", "fused-interpret", "gather", "native"
+        ):
             raise ValueError(
-                f"engine must be auto|scan|fused|fused-interpret|gather, "
-                f"got {engine!r}"
+                f"engine must be auto|scan|fused|fused-interpret|gather|"
+                f"native, got {engine!r}"
             )
+        if engine == "native":
+            # the host-interpreter latency tier (core/native_serve.py):
+            # single instance, single chip, untraced by construction
+            if batch is not None:
+                raise ValueError("engine='native' serves a single instance "
+                                 "(batch=None)")
+            if trace_cap:
+                raise ValueError("tracing runs the scan engine (the debug "
+                                 "path), not the native engine")
+            if data_parallel or model_parallel:
+                raise ValueError("engine='native' is single-chip (host) "
+                                 "serving")
         if engine == "gather" and not (model_parallel and model_parallel > 1):
             raise ValueError("engine='gather' requires model_parallel > 1")
         if trace_cap and not (0 <= trace_instance < (batch or 1)):
@@ -244,6 +266,12 @@ class MasterNode:
         surface, not just the bench/test harnesses.
         """
         eng = self._engine
+        if eng == "native":
+            # __init__ already rejected batch/trace/mesh combinations; the
+            # serve loop dispatches on the returned object's .serve_chunk
+            from misaka_tpu.core.native_serve import NativeServe
+
+            return NativeServe(net)
         if self._mp > 1:
             # Lane-sharded serving: the statically-routed two-collective
             # kernel (parallel/routed.py) is THE model-parallel path;
@@ -359,6 +387,8 @@ class MasterNode:
     def engine_name(self) -> str:
         if self._mp > 1:
             return "gather" if self._engine == "gather" else "routed"
+        if getattr(self._runner, "serve_chunk", None) is not None:
+            return "native"
         if self._runner is not None:
             return "fused"
         if self._trace_cap:
@@ -775,6 +805,11 @@ class MasterNode:
             new_net = new_topology.compile(batch=self._batch)
             new_runner = self._make_runner(new_net)  # before any swap (a
             # failure here must leave the old net/state/runner intact)
+            validate = getattr(new_runner, "validate_state", None)
+            if validate is not None:
+                # native engine: reject value-corrupt checkpoint content
+                # (pc/top/ring violations) here, not in the device loop
+                validate(state)
             with self._state_lock:
                 self._topology = new_topology
                 self._net = new_net
@@ -827,6 +862,14 @@ class MasterNode:
                     f"snapshot shapes do not match the compiled network "
                     f"(fields {mismatch}); reset/load first"
                 )
+            validate = getattr(self._runner, "validate_state", None)
+            if validate is not None:
+                # the native engine rejects value-corrupt states (pc beyond
+                # the program, stack_top beyond capacity, broken rings) at
+                # import; surface that HERE as the documented ValueError —
+                # inside the device loop it would stop the network instead
+                # (the XLA engines clamp OOB indices and keep serving)
+                validate(state)
             self._state = self._shard(state)
 
     # --- the device loop ----------------------------------------------------
@@ -945,6 +988,11 @@ class MasterNode:
 
         try:
             dummy = self._shard(net.init_state())
+            native = getattr(runner, "serve_chunk", None)
+            if native is not None:
+                # no XLA to warm; one throwaway chunk validates the new tables
+                native(dummy, np.zeros((net.in_cap,), np.int32), 0, self._chunk)
+                return
             if serve_fns is not None:
                 serve_fn, idle_fn = serve_fns
                 vals = np.zeros((self._batch, net.in_cap), np.int32)
@@ -1056,7 +1104,9 @@ class MasterNode:
                     # ONE device dispatch + ONE read for the whole iteration
                     # (feed+run+counters+drain fused, engine.serve_chunk):
                     # on a relayed device this is the difference between ~2
-                    # and ~6 round trips per quiet /compute.
+                    # and ~6 round trips per quiet /compute.  engine="native"
+                    # swaps in the host interpreter's serve_chunk twin
+                    # (core/native_serve.py) — same contract, ZERO dispatches.
                     free = self._net.in_cap - int(ctrs[1] - ctrs[0])
                     got = self._cut_pending(0, free)
                     vals = np.zeros((self._net.in_cap,), np.int32)
@@ -1065,9 +1115,9 @@ class MasterNode:
                         vals[: len(got)] = got
                         count = len(got)
                         busy = True
-                    state, packed = self._net.serve_chunk(
-                        state, vals, count, self._chunk
-                    )
+                    serve = getattr(self._runner, "serve_chunk", None) \
+                        or self._net.serve_chunk
+                    state, packed = serve(state, vals, count, self._chunk)
                     self._mark_ticks()
                     p = np.asarray(packed)  # the single device read
                     ctrs = p[:4]

@@ -381,6 +381,66 @@ int misaka_interp_drain(void* h, int32_t* out, int max_out) {
   return got;
 }
 
+// The input ring's contents (misaka_interp_read exposes everything else;
+// full-state export for the serving engine needs the undelivered inputs too).
+void misaka_interp_read_in(void* h, int32_t* in_buf) {
+  auto* it = (Interp*)h;
+  std::memcpy(in_buf, it->in_buf.data(), (size_t)it->in_cap * 4);
+}
+
+// Bulk state write — the inverse of misaka_interp_read (+ in_buf), used by
+// the native serving engine to import a NetworkState pytree before a chunk
+// (runtime/master.py engine="native") and by checkpoint restore.  Validates
+// EVERYTHING it indexes with before touching the interpreter (pc within the
+// lane's program, stack tops within capacity, ring invariants); returns 0
+// on success, -1 with the state unchanged on any violation.
+int misaka_interp_write(void* h, const int32_t* acc, const int32_t* bak,
+                        const int32_t* pc, const int32_t* port_val,
+                        const uint8_t* port_full, const int32_t* hold_val,
+                        const uint8_t* holding, const int32_t* stack_mem,
+                        const int32_t* stack_top, const int32_t* in_buf,
+                        const int32_t* out_buf, const int32_t* counters /*[5]*/,
+                        const int32_t* retired, const int32_t* acc_hi,
+                        const int32_t* bak_hi) {
+  auto* it = (Interp*)h;
+  const int n = it->n_lanes;
+  for (int l = 0; l < n; ++l)
+    if (pc[l] < 0 || pc[l] >= it->prog_len[l]) return -1;
+  for (int s = 0; s < it->num_stacks; ++s)
+    if (stack_top[s] < 0 || stack_top[s] > it->stack_cap) return -1;
+  const int32_t in_rd = counters[0], in_wr = counters[1];
+  const int32_t out_rd = counters[2], out_wr = counters[3];
+  if (in_rd < 0 || in_wr < in_rd || in_wr - in_rd > it->in_cap ||
+      out_rd < 0 || out_wr < out_rd || out_wr - out_rd > it->out_cap)
+    return -1;
+  for (int l = 0; l < n; ++l) {
+    it->acc[l] = (int64_t)(((uint64_t)(uint32_t)acc_hi[l] << 32) |
+                           (uint32_t)acc[l]);
+    it->bak[l] = (int64_t)(((uint64_t)(uint32_t)bak_hi[l] << 32) |
+                           (uint32_t)bak[l]);
+  }
+  std::memcpy(it->pc.data(), pc, n * 4);
+  std::memcpy(it->port_val.data(), port_val, (size_t)n * kPorts * 4);
+  std::memcpy(it->port_full.data(), port_full, (size_t)n * kPorts);
+  for (size_t i = 0; i < it->port_full.size(); ++i)
+    it->port_full[i] = it->port_full[i] ? 1 : 0;
+  std::memcpy(it->hold_val.data(), hold_val, n * 4);
+  for (int l = 0; l < n; ++l) it->holding[l] = holding[l] ? 1 : 0;
+  for (int s = 0; s < it->num_stacks; ++s) {
+    it->stacks[s].assign(stack_mem + (size_t)s * it->stack_cap,
+                         stack_mem + (size_t)s * it->stack_cap + stack_top[s]);
+  }
+  std::memcpy(it->in_buf.data(), in_buf, (size_t)it->in_cap * 4);
+  std::memcpy(it->out_buf.data(), out_buf, (size_t)it->out_cap * 4);
+  it->in_rd = in_rd;
+  it->in_wr = in_wr;
+  it->out_rd = out_rd;
+  it->out_wr = out_wr;
+  it->tick_count = counters[4];
+  std::memcpy(it->retired.data(), retired, n * 4);
+  return 0;
+}
+
 // Bulk state read-back for differential comparison.  stack_mem is
 // [num_stacks][stack_cap], zero-padded above each stack's top.
 void misaka_interp_read(void* h, int32_t* acc, int32_t* bak, int32_t* pc,

@@ -1,0 +1,205 @@
+"""The native serving engine (core/native_serve.py + engine="native").
+
+Pins the three contracts the latency tier rests on:
+  * serve_chunk parity — the host interpreter's serve iteration is
+    field-for-field equivalent to the XLA `_serve_body` one (same packed
+    snapshot, same state; stack_mem compared below each top since pops
+    leave residue above it on the device path);
+  * state portability — import/export round-trips every NetworkState
+    field, rejects corrupt states, and checkpoints cross engines in both
+    directions (native master -> scan master and back);
+  * lifecycle — run/pause/reset/load/auto-grow behave identically under
+    engine="native".
+"""
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.core import native_serve
+from misaka_tpu.runtime.master import MasterNode
+from misaka_tpu.runtime.topology import Topology
+
+pytestmark = pytest.mark.skipif(
+    not native_serve.available(), reason="native interpreter unavailable (no g++)"
+)
+
+
+def masked_stack(state):
+    """stack_mem with above-top residue zeroed (pops do not scrub slots)."""
+    mem = np.asarray(state.stack_mem)
+    top = np.asarray(state.stack_top)
+    col = np.arange(mem.shape[-1])
+    return np.where(col[None, :] < top[:, None], mem, 0)
+
+
+def assert_states_equal(a, b):
+    for f in type(a)._fields:
+        if f == "stack_mem":
+            np.testing.assert_array_equal(masked_stack(a), masked_stack(b), err_msg=f)
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), err_msg=f
+            )
+
+
+def test_serve_chunk_parity_add2():
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    ns = native_serve.NativeServe(net)
+    s_dev = net.init_state()
+    s_nat = net.init_state()
+    rng = np.random.default_rng(7)
+    for it in range(12):
+        # mixed schedule: feeds of varying size, including idle chunks
+        count = int(rng.integers(0, 4)) if it % 3 else 0
+        vals = np.zeros((net.in_cap,), np.int32)
+        vals[:count] = rng.integers(-1000, 1000, size=count)
+        free = net.in_cap - int(np.asarray(s_nat.in_wr) - np.asarray(s_nat.in_rd))
+        count = min(count, free)
+        s_dev, p_dev = net.serve_chunk(s_dev, vals, count, 16)
+        s_nat, p_nat = ns.serve_chunk(s_nat, vals, count, 16)
+        np.testing.assert_array_equal(np.asarray(p_dev), p_nat, err_msg=f"iter {it}")
+        assert_states_equal(s_dev, s_nat)
+
+
+def test_serve_chunk_parity_stack_net():
+    # PUSH/POP traffic exercises stack export/import mid-flight
+    top = Topology(
+        node_info={"p": "program", "st": "stack"},
+        programs={"p": "IN ACC\nPUSH ACC, st\nPUSH ACC, st\nPOP st, ACC\n"
+                       "POP st, ACC\nOUT ACC"},
+        in_cap=8, out_cap=8, stack_cap=4,
+    )
+    net = top.compile()
+    ns = native_serve.NativeServe(net)
+    s_dev, s_nat = net.init_state(), net.init_state()
+    for i in range(10):
+        vals = np.zeros((net.in_cap,), np.int32)
+        vals[0] = i + 1
+        s_dev, p_dev = net.serve_chunk(s_dev, vals, 1, 24)
+        s_nat, p_nat = ns.serve_chunk(s_nat, vals, 1, 24)
+        np.testing.assert_array_equal(np.asarray(p_dev), p_nat)
+        assert_states_equal(s_dev, s_nat)
+
+
+def test_import_export_roundtrip_and_rejects():
+    from misaka_tpu.core.cinterp import NativeInterpreter
+
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    with NativeInterpreter(
+        np.asarray(net.code), np.asarray(net.prog_len),
+        net.num_stacks, net.stack_cap, net.in_cap, net.out_cap,
+    ) as it:
+        it.feed(np.array([5, 6, 7], np.int32))
+        it.run(13)
+        d = it.export_arrays()
+        it2_kw = dict(d)
+        it.import_arrays(it2_kw)          # self-roundtrip
+        d2 = it.export_arrays()
+        for k in d:
+            np.testing.assert_array_equal(d[k], d2[k], err_msg=k)
+        # corrupt states are rejected with the interpreter unchanged
+        for k, v in [
+            ("pc", np.full_like(d["pc"], 99)),
+            ("stack_top", np.full_like(d["stack_top"], net.stack_cap + 1)),
+            ("in_rd", np.int32(-1)),
+            ("out_wr", np.int32(d["out_rd"] - 1)),
+        ]:
+            bad = dict(d)
+            bad[k] = v
+            with pytest.raises(ValueError):
+                it.import_arrays(bad)
+        d3 = it.export_arrays()
+        for k in d:
+            np.testing.assert_array_equal(d[k], d3[k], err_msg=f"mutated by {k}")
+
+
+def test_master_native_matches_scan():
+    streams = [list(range(1, 30)), [0, -5, 2**31 - 3, -(2**31) + 1]]
+    outs = {}
+    for eng in ("scan", "native"):
+        m = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+                       chunk_steps=16, engine=eng)
+        if eng == "native":
+            assert m.engine_name == "native"
+        m.run()
+        try:
+            outs[eng] = [m.compute_many(s) for s in streams]
+            st = m.status()
+            assert st["running"] and st["tick"] > 0
+            assert st["engine"] == m.engine_name
+        finally:
+            m.pause()
+    assert outs["scan"] == outs["native"]
+
+
+def test_checkpoint_crosses_engines(tmp_path):
+    # half the stream through a NATIVE master, checkpoint, finish on a SCAN
+    # master restored from it (then the reverse direction)
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    for first, second in (("native", "scan"), ("scan", "native")):
+        path = str(tmp_path / f"{first}-{second}.npz")
+        m1 = MasterNode(top, chunk_steps=16, engine=first)
+        m1.run()
+        a = m1.compute_many([1, 2, 3])
+        m1.pause()
+        m1.save_checkpoint(path)
+        m2 = MasterNode(top, chunk_steps=16, engine=second)
+        m2.load_checkpoint(path)
+        m2.run()
+        b = m2.compute_many([10, 20, 30])
+        m2.pause()
+        assert a == [3, 4, 5] and b == [12, 22, 32], (first, second)
+
+
+def test_native_lifecycle_reset_and_load():
+    m = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+                   chunk_steps=16, engine="native")
+    m.run()
+    assert m.compute(5) == 7
+    m.reset()
+    m.run()
+    assert m.compute(5) == 7
+    # live reprogram keeps the native engine
+    m.load("misaka1", "IN ACC\nADD 10\nOUT ACC")
+    m.run()
+    assert m.compute(5) == 15
+    assert m.engine_name == "native"
+    m.pause()
+
+
+@pytest.mark.slow
+def test_native_autogrow():
+    from tests.test_autogrow import reverser_top, run_reverser
+
+    m = MasterNode(reverser_top(), chunk_steps=32, engine="native")
+    m.run()
+    run_reverser(m)
+    assert m._net.stack_cap >= 64
+    assert m.engine_name == "native"
+
+
+def test_native_rejects_invalid_combos():
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    with pytest.raises(ValueError, match="single instance"):
+        MasterNode(top, engine="native", batch=4)
+    with pytest.raises(ValueError, match="scan engine"):
+        MasterNode(top, engine="native", trace_cap=16)
+    with pytest.raises(ValueError, match="single-chip"):
+        MasterNode(top, engine="native", batch=None, model_parallel=2)
+
+
+def test_native_restore_rejects_corrupt_state():
+    # a value-corrupt snapshot (shapes fine, pc beyond the program) must be
+    # rejected AT restore() — inside the device loop it would stop serving
+    m = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+                   chunk_steps=16, engine="native")
+    snap = m.snapshot()
+    bad = snap._replace(pc=np.full_like(np.asarray(snap.pc), 99))
+    with pytest.raises(ValueError):
+        m.restore(bad)
+    m.run()
+    try:
+        assert m.compute(5) == 7  # the master kept its good state and serves
+    finally:
+        m.pause()
