@@ -23,8 +23,30 @@
 //    other byte, or a value outside int32, rejects the whole stream.
 
 #include <cstdint>
+#include <cstring>
 
 namespace {
+
+// two-digit pairs "00".."99": halves the divide chain per token
+const char kPairs[] =
+    "00010203040506070809101112131415161718192021222324"
+    "25262728293031323334353637383940414243444546474849"
+    "50515253545556575859606162636465666768697071727374"
+    "75767778798081828384858687888990919293949596979899";
+
+// write exactly nd decimal digits of m ending at end[-1] (zero-padded on
+// the left when m has fewer than nd digits)
+inline void write_digits(uint8_t* end, uint32_t m, int nd) {
+  uint8_t* p = end;
+  while (nd >= 2) {
+    const uint32_t q = m / 100u, r = m - q * 100u;
+    p -= 2;
+    std::memcpy(p, kPairs + 2 * r, 2);
+    m = q;
+    nd -= 2;
+  }
+  if (nd) *--p = (uint8_t)('0' + m % 10u);
+}
 
 inline bool is_sep(uint8_t c) {
     return c == ' ' || c == ',' || c == '+' || c == '\t' || c == '\n' ||
@@ -75,18 +97,12 @@ int64_t misaka_fmt_i32(const int32_t* v, int64_t n, uint8_t sep,
         uint32_t m = mag_u32(x);
         uint8_t* f = p;
         if (zero_pad) {
-            for (int j = width - 1; j >= 1; j--) {
-                f[j] = (uint8_t)('0' + m % 10u);
-                m /= 10u;
-            }
+            write_digits(f + width, m, width - 1);
             f[0] = x < 0 ? (uint8_t)'-' : (uint8_t)'0';
         } else {
             const int nd = ndigits_u32(m);
             for (int j = 0; j < width - nd; j++) f[j] = pad;
-            for (int j = width - 1; j >= width - nd; j--) {
-                f[j] = (uint8_t)('0' + m % 10u);
-                m /= 10u;
-            }
+            write_digits(f + width, m, nd);
             if (x < 0) f[width - 1 - nd] = '-';
         }
         p += width;
