@@ -117,3 +117,19 @@ def test_examples_disassemble_cleanly():
             np.testing.assert_array_equal(
                 again.code, net.code[i, : again.length], err_msg=f"{name}:{lane}"
             )
+
+
+def test_running_total_on_native_engine():
+    # the README's interactive-tier claim: examples serve unchanged on
+    # MISAKA_ENGINE=native, stateful across requests (running total)
+    from misaka_tpu.core import native_serve
+    from misaka_tpu.runtime.master import MasterNode
+
+    if not native_serve.available():
+        pytest.skip("no C++ toolchain for the native engine")
+    m = MasterNode(load("running_total.json"), chunk_steps=32, engine="native")
+    m.run()
+    try:
+        assert [m.compute(v) for v in (5, 3, -2)] == [5, 8, 6]
+    finally:
+        m.pause()
