@@ -203,3 +203,42 @@ def test_native_restore_rejects_corrupt_state():
         assert m.compute(5) == 7  # the master kept its good state and serves
     finally:
         m.pause()
+
+
+def compare_serve(seed, iters=10, chunk=16):
+    """Random net through BOTH serve paths (device serve_chunk vs the
+    native engine's twin) under a randomized feed schedule: packed
+    snapshots byte-equal, states field-equal (live stack slots).  The
+    soak tool (tools/soak_differential.py) cycles this past CI's seeds."""
+    from tests.test_differential import (
+        IN_CAP, OUT_CAP, STACK_CAP, build_random_network,
+    )
+    from misaka_tpu.core import CompiledNetwork
+
+    code, lengths, n_stacks, inputs, programs = build_random_network(seed)
+    net = CompiledNetwork(
+        code=code, prog_len=lengths, num_stacks=max(1, n_stacks),
+        stack_cap=STACK_CAP, in_cap=IN_CAP, out_cap=OUT_CAP, batch=None,
+    )
+    ns = native_serve.NativeServe(net)
+    rng = np.random.default_rng(seed ^ 0x5EEDE)
+    s_dev, s_nat = net.init_state(), net.init_state()
+    for it in range(iters):
+        free = net.in_cap - int(np.asarray(s_nat.in_wr) - np.asarray(s_nat.in_rd))
+        count = min(int(rng.integers(0, 5)), free) if it % 4 else 0
+        vals = np.zeros((net.in_cap,), np.int32)
+        vals[:count] = rng.integers(-100, 100, size=count)
+        s_dev, p_dev = net.serve_chunk(s_dev, vals, count, chunk)
+        s_nat, p_nat = ns.serve_chunk(s_nat, vals, count, chunk)
+        np.testing.assert_array_equal(
+            np.asarray(p_dev), p_nat,
+            err_msg=f"seed {seed} iter {it}\n" + "\n---\n".join(programs),
+        )
+        assert_states_equal(s_dev, s_nat)
+    ns.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(1000, 1015))
+def test_serve_fuzz(seed):
+    compare_serve(seed)

@@ -44,6 +44,14 @@ def main() -> int:
 
     from tests.test_differential import compare
 
+    try:  # the native serve twin soaks too, where a toolchain exists
+        from misaka_tpu.core import native_serve
+        from tests.test_native_engine import compare_serve
+
+        has_native = native_serve.available()
+    except Exception:
+        has_native = False
+
     deadline = time.monotonic() + args.seconds
     seed = args.start_seed
     ran = failures = reported = 0
@@ -113,9 +121,14 @@ def main() -> int:
         ]
         if seed % 5 == 0:
             modes.append(("fused", dict(fused=True)))
+        if has_native and seed % 3 == 0:
+            modes.append(("serve", None))  # native serve_chunk vs device
         for label, kw in modes:
             try:
-                compare(seed, steps=48, **kw)
+                if kw is None:
+                    compare_serve(seed)
+                else:
+                    compare(seed, steps=48, **kw)
             except Exception:
                 failures += 1
                 with open(args.log, "a") as f:
