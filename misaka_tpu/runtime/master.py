@@ -835,6 +835,10 @@ class MasterNode:
     def restore(self, state) -> None:
         """Reinstall a snapshot() pytree.
 
+        A snapshot is STATE only (registers, ports, stacks, rings) —
+        programs are topology and do NOT roll back; use checkpoints
+        (save_checkpoint/load_checkpoint) to carry programs with state.
+
         A snapshot taken before a stack auto-grow has narrower stack_mem
         than the live engine compiles for — pad it (zero slots above the
         restored tops are exactly the grown state's invariant).  Any other

@@ -1,0 +1,105 @@
+"""Randomized lifecycle fuzz: the master's state machine under fire.
+
+Fixed lifecycle scenarios live in test_lifecycle/test_runtime; this lane
+drives RANDOM interleavings of the whole control surface — compute,
+compute_many, pause/run cycles, reset, live /load reprograms, snapshot/
+restore, checkpoint save/load — against a behavioral model (the add-K
+pipeline: after `load`ing misaka1 with ADD k, every compute(v) must
+return v + k + 1), on both the scan and native engines.  Every output is
+checked; a wedge surfaces as a ComputeTimeout, a state-machine bug as a
+wrong value.  This is the failure class behind the round-3 post-mortem
+(lifecycle guards), now fuzzed instead of only scripted.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # many run/pause/compile cycles per seed
+
+from misaka_tpu import networks
+from misaka_tpu.runtime.master import MasterNode
+
+
+def _m1_program(k: int) -> str:
+    return f"IN ACC\nADD {k}\nMOV ACC, misaka2:R0\nMOV R0, ACC\nOUT ACC"
+
+
+def lifecycle_fuzz(seed: int, n_ops: int = 25) -> None:
+    rng = np.random.default_rng(seed)
+    engine = "native" if seed % 2 else "scan"
+    if engine == "native":
+        from misaka_tpu.core import native_serve
+
+        if not native_serve.available():
+            pytest.skip("no C++ toolchain for the native engine")
+    m = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+                   chunk_steps=16, engine=engine)
+    m.run()
+    delta = 2              # add2: v -> v + 2
+    snap = None            # last snapshot() pytree.  NOTE: a snapshot is
+    # STATE only — programs are topology, carried by checkpoints, not
+    # snapshots — so restore() after a /load keeps the LOADED program and
+    # delta does not roll back (found by this very fuzz, seed 2006).
+    try:
+        for _ in range(n_ops):
+            op = int(rng.integers(7))
+            if op == 0:
+                v = int(rng.integers(-1000, 1000))
+                assert m.compute(v, timeout=30) == v + delta, (seed, "compute")
+            elif op == 1:
+                vals = rng.integers(-1000, 1000, size=int(rng.integers(1, 6)))
+                got = m.compute_many(vals.tolist(), timeout=30)
+                assert got == [int(v) + delta for v in vals], (seed, "many")
+            elif op == 2:
+                m.pause()
+                m.run()
+            elif op == 3:
+                m.reset()
+                m.run()
+            elif op == 4:
+                k = int(rng.integers(1, 10))
+                m.load("misaka1", _m1_program(k))  # resets + stops (reference order)
+                delta = k + 1
+                m.run()
+            elif op == 5:
+                m.pause()
+                snap = m.snapshot()
+                m.run()
+            elif snap is not None:
+                m.pause()
+                m.restore(snap)  # registers/rings roll back; programs stay
+                m.run()
+        # the network must still be live and exact at the end
+        assert m.compute(7, timeout=30) == 7 + delta, (seed, "final")
+    finally:
+        m.pause()
+
+
+@pytest.mark.parametrize("seed", range(2000, 2010))
+def test_lifecycle_fuzz(seed):
+    lifecycle_fuzz(seed)
+
+
+def test_lifecycle_fuzz_checkpoint_roundtrip(tmp_path):
+    # checkpoint mid-fuzz and resume on a FRESH master with the OTHER engine
+    from misaka_tpu.core import native_serve
+
+    rng = np.random.default_rng(77)
+    m = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+                   chunk_steps=16, engine="scan")
+    m.run()
+    k = int(rng.integers(2, 9))
+    m.load("misaka1", _m1_program(k))
+    m.run()
+    assert m.compute(1) == 1 + k + 1
+    m.pause()
+    path = str(tmp_path / "mid.npz")
+    m.save_checkpoint(path)
+    if not native_serve.available():
+        pytest.skip("no C++ toolchain for the native engine")
+    m2 = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+                    chunk_steps=16, engine="native")
+    m2.load_checkpoint(path)  # programs travel in the checkpoint
+    m2.run()
+    assert m2.compute(5) == 5 + k + 1
+    m2.pause()
