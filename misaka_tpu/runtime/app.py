@@ -42,6 +42,9 @@ so the master's env surface is what survives:
                    recording costs one extra store per tick and forces the
                    scan engine).  With MISAKA_BATCH, traces the instance
                    selected by MISAKA_TRACE_INSTANCE (default 0)
+  MISAKA_NATIVE_CODEC  /compute_batch decimal codec backend: unset = auto
+                   (native C++ when a toolchain exists), "0" = numpy,
+                   "1" = require native (utils/textcodec.py)
   MISAKA_PROFILE_DIR  enable jax.profiler capture of the live device loop via
                    POST /profile/start + /profile/stop, traces written under
                    this directory (disabled when unset)
