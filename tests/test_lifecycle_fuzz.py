@@ -24,9 +24,9 @@ def _m1_program(k: int) -> str:
     return f"IN ACC\nADD {k}\nMOV ACC, misaka2:R0\nMOV R0, ACC\nOUT ACC"
 
 
-def lifecycle_fuzz(seed: int, n_ops: int = 25) -> None:
+def lifecycle_fuzz(seed: int, n_ops: int = 25, engine: str | None = None) -> None:
     rng = np.random.default_rng(seed)
-    engine = "native" if seed % 2 else "scan"
+    engine = engine or ("native" if seed % 2 else "scan")
     if engine == "native":
         from misaka_tpu.core import native_serve
 

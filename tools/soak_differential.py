@@ -51,6 +51,7 @@ def main() -> int:
         has_native = native_serve.available()
     except Exception:
         has_native = False
+    from tests.test_lifecycle_fuzz import lifecycle_fuzz
 
     deadline = time.monotonic() + args.seconds
     seed = args.start_seed
@@ -122,11 +123,17 @@ def main() -> int:
         if seed % 5 == 0:
             modes.append(("fused", dict(fused=True)))
         if has_native and seed % 3 == 0:
-            modes.append(("serve", None))  # native serve_chunk vs device
+            modes.append(("serve", "serve"))  # native serve_chunk vs device
+        if seed % 7 == 0:
+            # the runtime state machine under random lifecycle interleavings
+            eng = "native" if has_native and seed % 2 else "scan"
+            modes.append((f"lifecycle-{eng}", ("lifecycle", eng)))
         for label, kw in modes:
             try:
-                if kw is None:
+                if kw == "serve":
                     compare_serve(seed)
+                elif isinstance(kw, tuple) and kw[0] == "lifecycle":
+                    lifecycle_fuzz(seed, n_ops=12, engine=kw[1])
                 else:
                     compare(seed, steps=48, **kw)
             except Exception:
