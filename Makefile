@@ -2,7 +2,7 @@
 # /root/reference/Makefile:1-12) plus the native components and local QA.
 
 CXX ?= g++
-CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC
+CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC -pthread
 
 native: native/libmisaka_assembler.so native/libmisaka_interp.so native/libmisaka_textcodec.so
 
@@ -65,6 +65,14 @@ test-all:
 bench:
 	python bench.py
 
+# Serving-tier tripwire (~5s): bench_served through the multi-threaded
+# native C++ tier must clear the 1M inputs/s north star on this host, or
+# the target fails — catches a CPU-fallback serving regression BEFORE a
+# driver capture lands on it.  Forced to CPU so it never touches (or
+# wedges on) the TPU relay.
+bench-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python bench.py --smoke
+
 # Replay the committed parity corpus (tests/corpus/parity/) against the
 # ACTUAL Go reference binary via its own Dockerfile — the SURVEY.md §4
 # check.  Skips cleanly (exit 0) where Docker is unavailable (here); the
@@ -97,4 +105,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke parity-go parity-local parity-corpus stop clean

@@ -34,6 +34,10 @@ import numpy as np
 
 NORTH_STAR = 1_000_000.0  # BASELINE.json north_star target, inputs/sec
 
+# Process start: attach-retry re-execs inherit what REMAINS of the
+# whole-run TTL, not a fresh budget (the TTL is a promise to the driver).
+_T0 = time.monotonic()
+
 # Filled incrementally by main(); the TTL watchdog dumps it so a mid-run
 # device wedge (a hung dispatch cannot be interrupted from Python) still
 # leaves every already-measured number in the driver's artifact.
@@ -119,28 +123,19 @@ def _arm_init_watchdog(environ=os.environ):
                 "still carries measured, platform-labeled numbers",
                 file=sys.stderr, flush=True,
             )
-            env = dict(environ)
-            # the whole-run TTL is a promise to the driver: the fallback
-            # child inherits what REMAINS of it, not a fresh budget
-            whole = float(environ.get("MISAKA_BENCH_TTL_S", "1140") or 0)
-            remaining = max(60.0, whole - ttl) if whole else 0.0
-            env.update(
-                JAX_PLATFORMS="cpu",
-                PALLAS_AXON_POOL_IPS="",
-                MISAKA_BENCH_FALLBACK="cpu",
-                MISAKA_INIT_TTL_S="0",
-                MISAKA_BENCH_TTL_S=f"{remaining:g}",
-            )
-            # reduced means reduced: drop the full-config / sweep flags the
-            # caller meant for TPU (they cost tens of minutes on CPU)
-            argv = [a for a in sys.argv if a not in ("--all", "--roofline")]
             try:
                 # the backend may have come up between the deadline firing and
                 # this point (init completing at ~ttl is exactly when the race
                 # is live); a healthy session must not be thrown away
                 if ready.is_set():
                     return
-                os.execve(sys.executable, [sys.executable] + argv, env)
+                # the artifact must say WHY it is a CPU capture — a silent
+                # platform switch reads as a 1000x regression; the child
+                # also inherits only what REMAINS of the whole-run TTL
+                _exec_cpu_fallback(
+                    environ, sys.argv,
+                    f"backend init hang: no TPU attach within {ttl:g}s",
+                )
             except OSError as e:  # pragma: no cover — then the plain failure
                 print(f"# fallback exec failed: {e}", file=sys.stderr, flush=True)
         if ready.is_set():  # init beat the deadline after all — keep the session
@@ -151,6 +146,99 @@ def _arm_init_watchdog(environ=os.environ):
     t.daemon = True
     t.start()
     return ready.set
+
+
+ATTACH_BACKOFF_S = 15.0  # first retry delay; doubles per attempt
+
+
+def _remaining_ttl(environ) -> str | None:
+    """What is left of the whole-run TTL budget, as an env-ready string.
+    Computed from wall-clock elapsed since process start, so sleeps and
+    hangs are charged against the budget (the TTL is a promise to the
+    driver — no child process may be handed a fresh one)."""
+    whole = float(environ.get("MISAKA_BENCH_TTL_S", "1140") or 0)
+    if not whole:
+        return None
+    return f"{max(60.0, whole - (time.monotonic() - _T0)):g}"
+
+
+def _exec_cpu_fallback(environ, argv, reason, execve=os.execve):
+    """The ONE copy of the reduced CPU-fallback exec recipe, shared by the
+    init-hang watchdog and the attach-retry path: CPU platform, fallback
+    label, the failure reason carried into the artifact as
+    `tpu_attach_error`, remaining-TTL inheritance, and the full-config /
+    sweep flags stripped (reduced means reduced — they cost tens of
+    minutes on CPU)."""
+    env = dict(environ)
+    remaining = _remaining_ttl(environ)
+    if remaining is not None:
+        env["MISAKA_BENCH_TTL_S"] = remaining
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        MISAKA_BENCH_FALLBACK="cpu",
+        MISAKA_INIT_TTL_S="0",
+        MISAKA_TPU_ATTACH_ERROR=reason,
+    )
+    argv = [a for a in argv if a not in ("--all", "--roofline")]
+    execve(sys.executable, [sys.executable] + argv, env)
+
+
+def _retry_or_fallback(
+    err, environ=os.environ, execve=os.execve, sleep=time.sleep, argv=None
+):
+    """TPU attach RAISED (round 3's rc=1 was exactly this: a transient
+    backend-init crash that instantly cost the round its TPU number).
+
+    Bounded retries with exponential backoff, each attempt a re-exec of
+    this bench (a failed JAX backend is cached in-process, so only a fresh
+    process genuinely retries the attach); when the attempts are spent,
+    degrade to the reduced CPU capture with the failure reason carried into
+    the artifact as `tpu_attach_error` — a retried attach or a labeled
+    fallback, never a silent platform switch.  MISAKA_ATTACH_RETRIES
+    (default 2) bounds the retries; the re-exec inherits what remains of
+    the whole-run TTL so retrying cannot eat the driver's budget.
+
+    Dependencies are injectable for the unit tests (tests/test_bench.py);
+    in production every path except NO_FALLBACK execve()s and never
+    returns.
+    """
+    argv = list(sys.argv if argv is None else argv)
+    reason = f"{type(err).__name__}: {err}"[:500]
+    if (
+        environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+        or environ.get("MISAKA_BENCH_FALLBACK") == "cpu"
+    ):
+        raise err  # CPU-only init failing is a real bug, not an attach blip
+    attempt = int(environ.get("MISAKA_ATTACH_ATTEMPT", "0") or 0)
+    retries = int(environ.get("MISAKA_ATTACH_RETRIES", "2") or 0)
+    if attempt < retries:
+        backoff = ATTACH_BACKOFF_S * (2 ** attempt)
+        print(
+            f"# TPU attach failed ({reason}); retrying attach "
+            f"{attempt + 1}/{retries} in {backoff:g}s",
+            file=sys.stderr, flush=True,
+        )
+        sleep(backoff)
+        env = dict(environ)
+        # remaining TTL is computed AFTER the backoff sleep, so the wait
+        # itself is charged against the driver's budget
+        remaining = _remaining_ttl(environ)
+        if remaining is not None:
+            env["MISAKA_BENCH_TTL_S"] = remaining
+        env["MISAKA_TPU_ATTACH_ERROR"] = reason
+        env["MISAKA_ATTACH_ATTEMPT"] = str(attempt + 1)
+        execve(sys.executable, [sys.executable] + argv, env)
+        return  # only reached when execve is stubbed (tests)
+    if environ.get("MISAKA_BENCH_NO_FALLBACK") == "1":
+        raise err
+    print(
+        f"# TPU attach failed after {attempt + 1} attempt(s) ({reason}); "
+        "re-executing on CPU (reduced sections) so the artifact still "
+        "carries measured, platform-labeled numbers",
+        file=sys.stderr, flush=True,
+    )
+    _exec_cpu_fallback(environ, argv, reason, execve=execve)
 
 
 def _preflight():
@@ -438,10 +526,13 @@ def bench_served(
     timeout=120.0,
     mode="raw",
     stripe=None,
+    engine="auto",
 ):
     """Throughput through the PRODUCT surface: a real MasterNode + HTTP
     server + /compute_raw (or /compute_batch with mode="text") requests,
-    fused Pallas engine when on TPU.
+    fused Pallas engine when on TPU, the multi-threaded native C++ tier
+    when not (engine="auto" prefers it off-TPU since r6 — the fallback
+    that keeps this metric past the 1M/s north star with no chip).
 
     Round-1's 106M/s was a harness number (kernel-only); this drives the
     actual serve path the way a client fleet would: `threads` concurrent
@@ -466,10 +557,14 @@ def bench_served(
         # north star through HTTP), 65536 -> 1.32M/s — bigger waves
         # amortize the 72-103ms per-dispatch relay latency until device
         # compute per wave dominates.
-        batch = 32768 if on_tpu else 256
+        # CPU default 1024 since r6 (native-tier sweep, this host, raw
+        # mode): 256 -> 1.06M/s, 512 -> 1.76M/s, 1024 -> 2.57M/s — batch
+        # sizes the per-thread request, and bigger waves amortize the
+        # HTTP round trips over the thread-pooled replicas.
+        batch = 32768 if on_tpu else 1024
     top = networks.add2(in_cap=in_cap, out_cap=in_cap, stack_cap=16)
     master = MasterNode(
-        top, chunk_steps=chunk_steps, batch=batch, engine="auto", stripe=stripe
+        top, chunk_steps=chunk_steps, batch=batch, engine=engine, stripe=stripe
     )
     httpd = make_http_server(master, port=0)
     server_thread = _threading.Thread(target=httpd.serve_forever, daemon=True)
@@ -561,6 +656,113 @@ def bench_served(
         "per_request": per_request,
         "mode": mode,
     }
+
+
+def bench_native_pool(
+    threads=None, batch=256, in_cap=128, chunk_steps=2048, rounds=4
+):
+    """Direct (no-HTTP) throughput of the multi-threaded native C++ tier:
+    B replica interpreters × `rounds` full ring refills each, sharded
+    across `threads` OS threads (core/native_serve.NativeServePool).
+    Every round must fully drain and parity-check, like every other lane.
+    """
+    from misaka_tpu import networks
+    from misaka_tpu.core.native_serve import NativeServePool
+
+    net = networks.add2(in_cap=in_cap, out_cap=in_cap, stack_cap=16).compile(
+        batch=batch
+    )
+    pool = NativeServePool(net, chunk_steps=chunk_steps, threads=threads)
+    rng = np.random.default_rng(5)
+    counts = np.full((batch,), in_cap, np.int32)
+    rows = np.arange(batch)[:, None]
+    cols = np.arange(in_cap)[None, :]
+
+    def one_round(state):
+        vals = rng.integers(-1000, 1000, size=(batch, in_cap)).astype(np.int32)
+        state, packed = pool.serve(state, vals, counts)
+        rd, wr = packed[:, 2], packed[:, 3]
+        if not (wr - rd == in_cap).all():
+            raise RuntimeError(
+                f"native pool round incomplete: min drained "
+                f"{int((wr - rd).min())}/{in_cap}"
+            )
+        outs = packed[:, 4:][rows, (rd[:, None] + cols) % in_cap]
+        np.testing.assert_array_equal(outs, vals + 2)
+        return state
+
+    state = one_round(net.init_state())  # warm (first-touch, page faults)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        state = one_round(state)
+    elapsed = time.perf_counter() - t0
+    used = pool.threads
+    pool.close()
+    total = rounds * batch * in_cap
+    return {
+        "throughput": total / elapsed,
+        "values": total,
+        "elapsed_s": elapsed,
+        "threads": used,
+        "batch": batch,
+        "in_cap": in_cap,
+    }
+
+
+def bench_native_scaling(max_threads=None):
+    """Per-thread scaling of the native tier — the evidence that the CPU
+    fallback's >=1M/s serving number rides the thread pool, not a fluke:
+    [{threads, throughput, speedup_vs_1}] over a 1..n_cores sweep."""
+    if max_threads is None:
+        max_threads = os.cpu_count() or 1
+    sweep, t = [], 1
+    while t < max_threads:
+        sweep.append(t)
+        t *= 2
+    sweep.append(max_threads)
+    out = []
+    for t in sweep:
+        r = bench_native_pool(threads=t)
+        entry = {
+            "threads": r["threads"],
+            "throughput": round(r["throughput"], 1),
+        }
+        if out:
+            entry["speedup_vs_1"] = round(r["throughput"] / out[0]["throughput"], 2)
+        out.append(entry)
+        print(
+            f"# native pool: threads={r['threads']} "
+            f"throughput={r['throughput']:.0f}/s",
+            file=sys.stderr,
+        )
+    return out
+
+
+def bench_smoke(target=NORTH_STAR):
+    """`make bench-smoke`: a ~5s bench_served through the multi-threaded
+    native tier; exits nonzero below the 1M/s north star, so a regression
+    of the CPU-fallback serving path is caught BEFORE a driver capture
+    lands on it (the r4/r5 captures served scan-compact at 0.16-0.34M/s
+    with this tier sitting unused)."""
+    served = bench_served(mode="raw", waves=4, engine="native")
+    line = {
+        "metric": "bench_smoke_served_throughput",
+        "value": round(served["throughput"], 1),
+        "unit": "inputs/sec",
+        "served_engine": served["engine"],
+        "batch": served["batch"],
+        "threads": served["threads"],
+        "target": target,
+        "ok": bool(served["throughput"] >= target and served["engine"] == "native"),
+    }
+    print(json.dumps(line))
+    if not line["ok"]:
+        print(
+            f"# bench-smoke FAILED: {served['engine']} served "
+            f"{served['throughput']:.0f}/s < {target:.0f}/s",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 def bench_lanes(n_lanes, batch=None, per_instance=32, engine="dense", min_time=1.0):
@@ -996,7 +1198,13 @@ def main():
     # reduced means reduced: in fallback mode the full-config sweep is
     # ignored even if the flag leaked through (the exec path also strips it)
     run_all = "--all" in sys.argv and os.environ.get("MISAKA_BENCH_FALLBACK") != "cpu"
-    platform = jax.devices()[0].platform
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:
+        # transient init crash (r3's rc=1): bounded re-exec retries with
+        # backoff, then the labeled CPU fallback — see _retry_or_fallback
+        _retry_or_fallback(e)
+        raise  # unreachable in production (the helper execve()s or raises)
     backend_up()
 
     payload = _PAYLOAD  # module global: the TTL watchdog dumps partial runs
@@ -1004,6 +1212,14 @@ def main():
     # labels go in BEFORE any measuring: a partial TTL dump must never emit
     # CPU numbers indistinguishable from TPU ones
     payload["platform"] = platform
+    attach_err = os.environ.get("MISAKA_TPU_ATTACH_ERROR")
+    if attach_err:
+        # why this capture is (or nearly was) a CPU one: the last attach
+        # failure, surviving retries — on a platform=tpu payload it means
+        # the retry loop RECOVERED the chip
+        payload["tpu_attach_error"] = attach_err
+    if os.environ.get("MISAKA_ATTACH_ATTEMPT"):
+        payload["tpu_attach_attempts"] = int(os.environ["MISAKA_ATTACH_ATTEMPT"])
     if fallback:
         payload["fallback"] = "cpu (TPU backend unavailable at init)"
         # a reduced CPU number reads as a 1000x regression unless the artifact
@@ -1026,10 +1242,20 @@ def main():
         # five configs at 1M (one fresh ~60s compile each + 4 reps of ~0.8s)
         # measured past the 1140s whole-run TTL (BENCH_tpu_r05_all_b1m.json
         # is the resulting honest partial) — secondary configs keep 262144.
+        # CPU headline batch 65536 since r6: this container's XLA-CPU scan
+        # measured 63k values/s (jax 0.4 runtime) — 262144 costs ~530s PER
+        # RUN, so the r4/r5 batch blows the whole-run TTL before a single
+        # served number lands.  The payload records `batch`, and throughput
+        # is amortized-fixed-cost-flat at these sizes, so the headline
+        # stays cross-round comparable.
         big = platform == "tpu" and name == "add2"
-        r = bench_config(
-            name, batch=32768 if fallback else (1048576 if big else 262144)
-        )
+        if fallback:
+            batch = 32768
+        elif platform == "tpu":
+            batch = 1048576 if big else 262144
+        else:
+            batch = 65536
+        r = bench_config(name, batch=batch)
         results[name] = r
         print(
             f"# {name}: platform={platform} batch={r['batch']} "
@@ -1099,6 +1325,18 @@ def main():
         )
         payload[key] = round(served["throughput"], 1)
     payload["served_engine"] = served["engine"]
+
+    if platform != "tpu":
+        # a CPU serving number must be attributable: per-thread scaling of
+        # the native tier proves the >=1M/s fallback rides the thread pool
+        # (and where this host's ceiling is), not a measurement fluke
+        try:
+            from misaka_tpu.core import native_serve
+
+            if native_serve.available():
+                payload["native_scaling"] = bench_native_scaling()
+        except Exception as e:  # pragma: no cover — must not cost the run
+            print(f"# native scaling lane failed: {e}", file=sys.stderr)
 
     if fallback:
         print(json.dumps(payload))
@@ -1231,5 +1469,7 @@ if __name__ == "__main__":
     if "--sharded-worker" in sys.argv:
         i = sys.argv.index("--sharded-worker")
         _sharded_worker(*map(int, sys.argv[i + 1 : i + 4]))
+    elif "--smoke" in sys.argv:
+        bench_smoke()
     else:
         main()

@@ -50,6 +50,18 @@ def _configure(lib: ctypes.CDLL) -> None:
         _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
         _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P,
     ]
+    lib.misaka_pool_create.restype = ctypes.c_void_p
+    lib.misaka_pool_create.argtypes = [_I32P, _I32P] + [ctypes.c_int] * 8
+    lib.misaka_pool_destroy.restype = None
+    lib.misaka_pool_destroy.argtypes = [ctypes.c_void_p]
+    lib.misaka_pool_threads.restype = ctypes.c_int
+    lib.misaka_pool_threads.argtypes = [ctypes.c_void_p]
+    lib.misaka_pool_serve.restype = ctypes.c_int
+    lib.misaka_pool_serve.argtypes = [ctypes.c_void_p] + [
+        _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
+        _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P,
+        _I32P, _I32P, ctypes.c_int, _I32P,
+    ]
 
 
 _NATIVE = NativeLib(
@@ -69,6 +81,54 @@ def available() -> bool:
 
 def _as_i32p(arr: np.ndarray):
     return arr.ctypes.data_as(_I32P)
+
+
+_I32_INFO = np.iinfo(np.int32)
+
+
+def _checked_i32(key: str, value, shape: tuple | None = None) -> np.ndarray:
+    """Contiguous int32 array of `value`, REJECTING lossy casts: a wider
+    integer (hand-edited/corrupt checkpoint) whose values exceed the int32
+    range raises ValueError instead of silently wrapping — wrapped values
+    can pass the C-side range validation while meaning something else."""
+    a = np.asarray(value)
+    if shape is not None and a.shape != shape:
+        raise ValueError(f"{key}: expected shape {shape}, got {a.shape}")
+    if a.dtype != np.int32:
+        if a.dtype.kind not in "iub":
+            raise ValueError(
+                f"{key}: dtype {a.dtype} cannot carry int32 state exactly"
+            )
+        if a.dtype.kind in "iu" and a.size and not np.can_cast(a.dtype, np.int32):
+            mn, mx = int(a.min()), int(a.max())
+            if mn < _I32_INFO.min or mx > _I32_INFO.max:
+                raise ValueError(
+                    f"{key}: values [{mn}, {mx}] out of int32 range "
+                    f"(corrupt or hand-edited state?)"
+                )
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _checked_i32_int(key: str, v) -> int:
+    iv = int(v)
+    if not (_I32_INFO.min <= iv <= _I32_INFO.max):
+        raise ValueError(f"{key}: value {iv} out of int32 range")
+    return iv
+
+
+def _checked_u8(key: str, value, shape: tuple) -> np.ndarray:
+    """Contiguous uint8 FLAG plane: truthiness-preserving conversion.
+
+    astype(uint8) would wrap wide values (256 -> 0), flipping a truthy
+    flag to False with no error — the same lossy-cast class _checked_i32
+    rejects.  Flags are booleans, so convert by `!= 0` (any nonzero stays
+    1) and reject non-integer dtypes outright."""
+    a = np.asarray(value)
+    if a.shape != shape:
+        raise ValueError(f"{key}: expected shape {shape}, got {a.shape}")
+    if a.dtype.kind not in "iub":
+        raise ValueError(f"{key}: dtype {a.dtype} is not a valid flag plane")
+    return np.ascontiguousarray(a != 0).astype(np.uint8)
 
 
 class NativeInterpreter:
@@ -218,20 +278,16 @@ class NativeInterpreter:
 
     def import_arrays(self, d: dict) -> None:
         """Bulk state write — the inverse of export_arrays.  Raises
-        ValueError (interpreter unchanged) on out-of-range pc/top/counters."""
+        ValueError (interpreter unchanged) on out-of-range pc/top/counters
+        AND on wider-integer inputs whose values do not fit int32 (an unsafe
+        cast would wrap them into the valid range — see _checked_i32)."""
         n, s = self.n_lanes, self.num_stacks
 
         def i32arr(key, shape):
-            a = np.ascontiguousarray(np.asarray(d[key]), dtype=np.int32)
-            if a.shape != shape:
-                raise ValueError(f"{key}: expected shape {shape}, got {a.shape}")
-            return a
+            return _checked_i32(key, d[key], shape)
 
         def u8arr(key, shape):
-            a = np.ascontiguousarray(np.asarray(d[key])).astype(np.uint8)
-            if a.shape != shape:
-                raise ValueError(f"{key}: expected shape {shape}, got {a.shape}")
-            return a
+            return _checked_u8(key, d[key], shape)
 
         acc = i32arr("acc", (n,)); bak = i32arr("bak", (n,))
         acc_hi = i32arr("acc_hi", (n,)); bak_hi = i32arr("bak_hi", (n,))
@@ -246,8 +302,9 @@ class NativeInterpreter:
         out_buf = i32arr("out_buf", (self.out_cap,))
         retired = i32arr("retired", (n,))
         counters = np.ascontiguousarray(
-            [int(d["in_rd"]), int(d["in_wr"]), int(d["out_rd"]),
-             int(d["out_wr"]), int(d["tick"])], dtype=np.int32,
+            [_checked_i32_int(k, d[k])
+             for k in ("in_rd", "in_wr", "out_rd", "out_wr", "tick")],
+            dtype=np.int32,
         )
         rc = self._lib.misaka_interp_write(
             self._handle(),
@@ -262,3 +319,155 @@ class NativeInterpreter:
             raise ValueError(
                 "invalid state import (pc/stack_top/ring counters out of range)"
             )
+
+
+class NativePool:
+    """B replica interpreters served by a persistent C++ OS-thread pool.
+
+    The multi-threaded host serving tier: one `serve`/`idle` call runs a
+    whole batched chunk iteration — per replica: import its state slice,
+    feed, run `ticks`, snapshot a packed row, export — with the replica
+    range sharded across threads inside ONE ctypes call (which releases the
+    GIL, so C++ workers saturate cores while Python serves HTTP).  State
+    lives in the caller's batch-major arrays between calls, exactly like
+    the stateless single-instance NativeInterpreter serve path.
+    """
+
+    def __init__(self, code, prog_len, num_stacks, stack_cap, in_cap, out_cap,
+                 replicas, threads: int | None = None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native interpreter unavailable (no g++?)")
+        self._lib = lib
+        code = np.ascontiguousarray(code, dtype=np.int32)
+        prog_len = np.ascontiguousarray(prog_len, dtype=np.int32)
+        if code.ndim != 3 or code.shape[2] != isa.NFIELDS:
+            raise ValueError(
+                f"code must be [n_lanes, max_len, {isa.NFIELDS}], got {code.shape}"
+            )
+        if prog_len.shape != (code.shape[0],):
+            raise ValueError(
+                f"prog_len must have shape ({code.shape[0]},), got {prog_len.shape}"
+            )
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.n_lanes, self.max_len, _ = code.shape
+        self.num_stacks = max(1, num_stacks)
+        self.stack_cap = stack_cap
+        self.in_cap = in_cap
+        self.out_cap = out_cap
+        self.replicas = int(replicas)
+        if threads is None:
+            threads = int(os.environ.get("MISAKA_NATIVE_THREADS", "0") or 0) \
+                or (os.cpu_count() or 1)
+        self._h = lib.misaka_pool_create(
+            _as_i32p(code), _as_i32p(prog_len),
+            self.n_lanes, self.max_len, self.num_stacks,
+            stack_cap, in_cap, out_cap, self.replicas, int(threads),
+        )
+        if not self._h:
+            raise ValueError("invalid network tables")
+        self.threads = int(lib.misaka_pool_threads(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.misaka_pool_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _handle(self):
+        if not self._h:
+            raise RuntimeError("pool is closed")
+        return self._h
+
+    def serve(self, d: dict, values, counts, ticks: int):
+        """One batched serve iteration.  `d` holds batch-major state arrays
+        (export_arrays keys, each with a leading [B] axis); returns
+        (new_d, packed [B, 4+out_cap]) with new_d the post-chunk state —
+        output rings drained (the packed rows carry the pre-drain
+        snapshot, device-twin parity)."""
+        if values is None or counts is None:
+            raise ValueError("serve requires values and counts (use idle)")
+        return self._call(d, values, counts, int(ticks))
+
+    def idle(self, d: dict, ticks: int):
+        """One batched idle iteration: advance `ticks` with no feed; returns
+        (new_d, ctrs [B, 4]) with the output rings NOT drained."""
+        return self._call(d, None, None, int(ticks))
+
+    def _call(self, d, values, counts, ticks):
+        B, n, s = self.replicas, self.n_lanes, self.num_stacks
+
+        # The C++ workers write the post-chunk state back INTO these arrays
+        # (input state is donated, like the jitted twins' donate_argnums).
+        # np.asarray of a jax array can be a read-only view of the XLA
+        # buffer, which must never be mutated — take ownership unless the
+        # array already owns writeable memory (the steady-state round trip
+        # feeds back our own arrays, so no copy happens then).
+        def own(key, shape):
+            a = _checked_i32(key, d[key], shape)
+            if a.base is not None or not a.flags.writeable:
+                a = np.array(a)
+            return a
+
+        def u8arr(key, shape):
+            return _checked_u8(key, d[key], shape)
+
+        acc = own("acc", (B, n))
+        bak = own("bak", (B, n))
+        acc_hi = own("acc_hi", (B, n))
+        bak_hi = own("bak_hi", (B, n))
+        pc = own("pc", (B, n))
+        port_val = own("port_val", (B, n, isa.NUM_PORTS))
+        port_full = u8arr("port_full", (B, n, isa.NUM_PORTS))
+        hold_val = own("hold_val", (B, n))
+        holding = u8arr("holding", (B, n))
+        stack_mem = own("stack_mem", (B, s, self.stack_cap))
+        stack_top = own("stack_top", (B, s))
+        in_buf = own("in_buf", (B, self.in_cap))
+        out_buf = own("out_buf", (B, self.out_cap))
+        retired = own("retired", (B, n))
+        counters = np.empty((B, 5), np.int32)
+        for i, k in enumerate(("in_rd", "in_wr", "out_rd", "out_wr", "tick")):
+            counters[:, i] = _checked_i32(k, d[k], (B,))
+        feeding = counts is not None
+        if feeding:
+            values = _checked_i32("values", values, (B, self.in_cap))
+            counts = _checked_i32("counts", counts, (B,))
+            packed = np.empty((B, 4 + self.out_cap), np.int32)
+            vp, cp = _as_i32p(values), _as_i32p(counts)
+        else:
+            packed = np.empty((B, 4), np.int32)
+            vp = cp = None
+        rc = self._lib.misaka_pool_serve(
+            self._handle(),
+            _as_i32p(acc), _as_i32p(bak), _as_i32p(pc),
+            _as_i32p(port_val), port_full.ctypes.data_as(_U8P),
+            _as_i32p(hold_val), holding.ctypes.data_as(_U8P),
+            _as_i32p(stack_mem), _as_i32p(stack_top),
+            _as_i32p(in_buf), _as_i32p(out_buf), _as_i32p(counters),
+            _as_i32p(retired), _as_i32p(acc_hi), _as_i32p(bak_hi),
+            vp, cp, ticks, _as_i32p(packed),
+        )
+        if rc == -2:
+            raise RuntimeError("native pool feed exceeded ring free space")
+        if rc != 0:
+            raise ValueError(
+                "invalid state import (pc/stack_top/ring counters out of range)"
+            )
+        out = {
+            "acc": acc, "bak": bak, "acc_hi": acc_hi, "bak_hi": bak_hi,
+            "pc": pc, "port_val": port_val, "port_full": port_full,
+            "hold_val": hold_val, "holding": holding,
+            "stack_mem": stack_mem, "stack_top": stack_top,
+            "in_buf": in_buf, "out_buf": out_buf, "retired": retired,
+            "in_rd": counters[:, 0].copy(), "in_wr": counters[:, 1].copy(),
+            "out_rd": counters[:, 2].copy(), "out_wr": counters[:, 3].copy(),
+            "tick": counters[:, 4].copy(),
+        }
+        return out, packed

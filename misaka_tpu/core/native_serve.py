@@ -21,10 +21,14 @@ advance `num_steps`, return (state-with-drained-out-ring, packed
 [in_rd, in_wr, out_rd, out_wr, out_buf...])) is byte-compatible with
 core/engine.py's `_serve_body`, pinned by tests/test_native_engine.py.
 
-This is the LATENCY tier of the three serving engines (native for
+NativeServe is the LATENCY tier of the serving engines (native for
 interactive, fused Pallas for throughput, routed mesh for scale-out); it
 trades batch throughput away by construction (one instance, one host
-core).
+core).  NativeServePool below is the host THROUGHPUT tier: B replica
+interpreters sharded across OS threads (cinterp.NativePool), twin to the
+batched one-dispatch serve jit (core/engine.py make_batched_serve) — the
+tier that keeps a driver-scored bench past the 1M inputs/s north star
+when no TPU is attached.
 """
 
 from __future__ import annotations
@@ -41,6 +45,8 @@ def available() -> bool:
 
 class NativeServe:
     """serve_chunk twin for one CompiledNetwork, backed by NativeInterpreter."""
+
+    is_native = True  # engine_name dispatch marker (runtime/master.py)
 
     def __init__(self, net):
         if net.batch is not None:
@@ -83,3 +89,70 @@ class NativeServe:
         ])
         d["out_rd"] = d["out_wr"]  # the returned state's ring is drained
         return NetworkState(**{f: d[f] for f in NetworkState._fields}), packed
+
+
+class NativeServePool:
+    """Batched serve twins for one CompiledNetwork on the C++ thread pool.
+
+    `serve`/`idle` are drop-in twins of the (serve_fn, idle_fn) pair built
+    by CompiledNetwork.make_batched_serve — same signatures, same packed
+    [B, 4+out_cap] snapshot layout, same drained-on-serve / untouched-on-
+    idle ring discipline — so MasterNode's batched device loop drives this
+    tier through the exact code path it drives the jitted engines through.
+    B network replicas are embarrassingly parallel (independent instances,
+    deterministic per request); the pool shards them across OS threads
+    inside one GIL-releasing call.  The canonical state stays the
+    NetworkState pytree: each call imports/exports batch-major slices, so
+    checkpoint/restore, /load, and stack auto-grow keep working unchanged.
+    """
+
+    is_native = True
+
+    def __init__(self, net, chunk_steps: int = 128, threads: int | None = None):
+        if net.batch is None:
+            raise ValueError("NativeServePool serves a batched network "
+                             "(use NativeServe for batch=None)")
+        self._pool = cinterp.NativePool(
+            np.asarray(net.code), np.asarray(net.prog_len),
+            net.num_stacks, net.stack_cap, net.in_cap, net.out_cap,
+            replicas=net.batch, threads=threads,
+        )
+        self.threads = self._pool.threads
+        self._chunk = int(chunk_steps)
+
+    def close(self) -> None:
+        self._pool.close()
+
+    def _to_dict(self, state: NetworkState) -> dict:
+        return {f: np.asarray(getattr(state, f)) for f in NetworkState._fields}
+
+    def _to_state(self, d: dict) -> NetworkState:
+        d = dict(d)
+        d["port_full"] = d["port_full"].astype(bool)
+        d["holding"] = d["holding"].astype(bool)
+        return NetworkState(**{f: d[f] for f in NetworkState._fields})
+
+    def validate_state(self, state: NetworkState) -> None:
+        """Raise ValueError on a state this engine cannot execute (pc beyond
+        the program, stack_top beyond capacity, broken ring counters) —
+        a zero-tick idle round trip; importing IS the validation."""
+        self._pool.idle(self._to_dict(state), 0)
+
+    def serve(self, state: NetworkState, values, counts, num_steps: int | None = None):
+        """serve_fn twin: feed counts[b] leading entries of values[b] into
+        replica b, advance the chunk, return (state, packed [B, 4+out_cap])
+        with the returned state's output rings drained."""
+        d, packed = self._pool.serve(
+            self._to_dict(state), values, counts,
+            self._chunk if num_steps is None else num_steps,
+        )
+        return self._to_state(d), packed
+
+    def idle(self, state: NetworkState, num_steps: int | None = None):
+        """idle_fn twin: advance the chunk with no feed, return
+        (state, ctrs [B, 4]); output rings left undrained."""
+        d, ctrs = self._pool.idle(
+            self._to_dict(state),
+            self._chunk if num_steps is None else num_steps,
+        )
+        return self._to_state(d), ctrs

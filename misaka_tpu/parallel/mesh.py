@@ -28,6 +28,22 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the public jax.shard_map (keyword
+    check_vma) where it exists, jax.experimental.shard_map (keyword
+    check_rep) on pre-0.5 jax — same relaxed-replication semantics."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
 def make_mesh(n_devices: int | None = None, model_parallel: int = 1) -> Mesh:
     """A (data, model) mesh over the first n_devices."""
     devices = jax.devices()[: n_devices or len(jax.devices())]
@@ -95,12 +111,11 @@ def build_lane_sharded_runner(step1, code, prog_len, mesh, num_steps: int,
         out, _ = jax.lax.scan(body, state, None, length=num_steps)
         return rebase_rings(out)
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         chunk,
         mesh=mesh,
         in_specs=(P(MODEL_AXIS, None, None), P(MODEL_AXIS), specs),
         out_specs=specs,
-        check_vma=False,
     )
 
     # make_array_from_callback (not device_put): each process contributes only

@@ -29,9 +29,18 @@ dest-axis gather, not these), and every shard applies the identical
 collectively-agreed update, so state remains bit-identical to
 core/step.py — pinned by tests/test_parallel.py running both generations.
 
-Measured on the 8-device virtual mesh (mesh8, mp=8): 1.53x the single-chip
-scan engine and 1.82x the gather kernel — model-parallel as a speed
-feature, not just a capacity feature (docs/ARCHITECTURE.md).
+Measured on the 8-device virtual mesh (mesh8, mp=8, r5 artifacts): the
+routed kernel beats the gather kernel 1.7-2.0x (`routed_vs_gather`,
+BENCH_tpu_r05_final*.json / BENCH_cpu_r05.json) but runs at 0.47-0.54x the
+single-chip PLATFORM-AUTO scan engine (`sharded_vs_single` 0.48 in the
+final r5 capture) — the r5 crossover change made CPU auto-select the
+compact kernel, which is ~2.7x the dense baseline the earlier 1.5x claim
+was measured against (routed still beats that legacy dense denominator,
+`sharded_vs_single_dense` ~1.5x).  On the loopback mesh the two
+collectives per tick cost more than 8x one core's compact arithmetic, so
+today model-parallel is a CAPACITY feature; whether it pays for per-tick
+SPEED is a real-ICI question (docs/ARCHITECTURE.md "Measured scaling
+character").
 """
 
 from __future__ import annotations

@@ -16,15 +16,20 @@ so the master's env surface is what survives:
                    concurrent /compute requests round-robin across them
                    (default: one instance, strictly serialized /compute)
   MISAKA_ENGINE    device-loop chunk runner: "auto" (default — the fused
-                   Pallas kernel when batched+untraced+on-TPU+within budget,
+                   Pallas kernel when batched+untraced+on-TPU+within budget;
+                   the native C++ host tier when NO TPU is attached and a
+                   toolchain exists [MISAKA_NATIVE_AUTO=0 disables,
+                   MISAKA_NATIVE_AUTO_MAX_BATCH caps it, default 4096];
                    the XLA scan engine otherwise), "scan", "fused" (require
                    the kernel), "fused-interpret" (CI coverage off-TPU),
                    "gather" (model-parallel only: the first-generation
                    occupancy-gather sharded kernel, kept for A/B runs
                    against the default statically-routed kernel), "native"
-                   (the host C++ interpreter, core/native_serve.py — the
-                   interactive-latency tier: zero device dispatches per
-                   /compute; unbatched, single-chip, needs g++)
+                   (the host C++ interpreter, core/native_serve.py — zero
+                   device dispatches per /compute; unbatched = the
+                   interactive-latency tier, MISAKA_BATCH=B = B replicas
+                   sharded over OS threads [MISAKA_NATIVE_THREADS], the
+                   host throughput tier; single-chip, needs g++)
   MISAKA_DATA_PARALLEL   shard the batch axis over N chips (requires
                    MISAKA_BATCH divisible by N); MISAKA_MODEL_PARALLEL
                    shards program-node lanes over M chips via the ICI-

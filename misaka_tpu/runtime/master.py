@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -90,13 +91,17 @@ class MasterNode:
                       all_gather); kept for A/B measurement against the
                       default statically-routed kernel (parallel/routed.py);
           * "native" — the host C++ interpreter (core/native_serve.py):
-                      unbatched single-chip serving with ZERO device
-                      dispatches on the request path — the interactive-
-                      latency tier (a /compute costs queue hops + a ~us
+                      ZERO device dispatches on the request path.
+                      batch=None serves one instance (the interactive-
+                      latency tier: a /compute costs queue hops + a ~us
                       host chunk instead of a device round trip, which on
-                      a relayed chip is 72-103ms).  Requires batch=None,
-                      no tracing, no mesh; needs a C++ toolchain
-                      (raises otherwise).
+                      a relayed chip is 72-103ms); batch=B serves B
+                      replica interpreters sharded across OS threads
+                      (the host THROUGHPUT tier — the fallback that keeps
+                      served throughput past 1M/s with no TPU attached).
+                      No tracing, no mesh; needs a C++ toolchain (raises
+                      otherwise).  engine="auto" prefers this tier
+                      whenever no TPU is attached (see _use_native_auto).
 
         trace_cap with batch traces instance `trace_instance` (instances are
         independent, so its history is exact); tracing always runs the scan
@@ -125,11 +130,9 @@ class MasterNode:
                 f"native, got {engine!r}"
             )
         if engine == "native":
-            # the host-interpreter latency tier (core/native_serve.py):
-            # single instance, single chip, untraced by construction
-            if batch is not None:
-                raise ValueError("engine='native' serves a single instance "
-                                 "(batch=None)")
+            # the host-interpreter tier (core/native_serve.py): single
+            # instance (latency) or B thread-pooled replicas (throughput);
+            # single chip, untraced by construction
             if trace_cap:
                 raise ValueError("tracing runs the scan engine (the debug "
                                  "path), not the native engine")
@@ -256,22 +259,74 @@ class MasterNode:
 
         return shard_state(state, self._mesh, batched=True)
 
+    @staticmethod
+    def _owned_device_state(state):
+        """Every leaf as an XLA-OWNED buffer (device copy).
+
+        jnp.asarray of a host numpy array (np.load'ed checkpoints, native-
+        engine exports, snapshot copies) can be a ZERO-COPY alias of the
+        numpy buffer on CPU.  The serve jits DONATE their state argument,
+        and donating a borrowed buffer lets XLA reuse memory the numpy
+        owner later frees — observed on jax 0.4.x CPU as flaky stale-ring
+        outputs and heap corruption after /restore.  One copy per
+        lifecycle event (restore/load_checkpoint only) is cheap insurance
+        on every version."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(lambda x: jnp.asarray(x).copy(), state)
+
+    def _use_native_auto(self) -> bool:
+        """Should engine="auto" serve through the host C++ tier?
+
+        Yes whenever no TPU is attached (the XLA scan engines measured
+        ~0.2-0.3M served inputs/s on CPU while the native tier clears the
+        1M north star), the toolchain can build the interpreter, and the
+        configuration is one the native tier supports: no tracing, no
+        mesh, and a batch small enough that per-replica bookkeeping stays
+        cheap (MISAKA_NATIVE_AUTO_MAX_BATCH, default 4096 — an explicit
+        engine="native" accepts any batch).  Disable outright with
+        MISAKA_NATIVE_AUTO=0.
+        """
+        if self._trace_cap or self._mesh is not None:
+            return False
+        if os.environ.get("MISAKA_NATIVE_AUTO", "1") == "0":
+            return False
+        import jax
+
+        if jax.devices()[0].platform == "tpu":
+            return False
+        from misaka_tpu.core import native_serve
+
+        if not native_serve.available():
+            return False
+        max_batch = int(
+            os.environ.get("MISAKA_NATIVE_AUTO_MAX_BATCH", "") or "4096"
+        )
+        return self._batch is None or self._batch <= max_batch
+
     def _make_runner(self, net):
         """Bind the device-loop chunk runner for `net` (see __init__ docstring).
 
         Returns fn(state) -> state advancing exactly self._chunk ticks via the
-        fused Pallas kernel or the mesh-sharded engine, or None to run the
-        XLA scan engine.  This is the round-2 closure of the round-1 gaps:
-        the fast kernel and the multi-chip path now serve the product HTTP
-        surface, not just the bench/test harnesses.
+        fused Pallas kernel or the mesh-sharded engine, a native host engine
+        (NativeServe / NativeServePool — dispatched on .is_native), or None
+        to run the XLA scan engine.  This is the round-2 closure of the
+        round-1 gaps: the fast kernel and the multi-chip path now serve the
+        product HTTP surface, not just the bench/test harnesses.
         """
         eng = self._engine
+        if eng == "auto" and self._use_native_auto():
+            eng = "native"
         if eng == "native":
-            # __init__ already rejected batch/trace/mesh combinations; the
-            # serve loop dispatches on the returned object's .serve_chunk
-            from misaka_tpu.core.native_serve import NativeServe
+            # __init__ already rejected trace/mesh combinations; the serve
+            # loop dispatches on the returned object's .serve_chunk
+            # (unbatched) or the (serve, idle) twin pair (batched pool)
+            from misaka_tpu.core.native_serve import NativeServe, NativeServePool
 
-            return NativeServe(net)
+            if self._batch is None:
+                return NativeServe(net)
+            return NativeServePool(net, chunk_steps=self._chunk)
         if self._mp > 1:
             # Lane-sharded serving: the statically-routed two-collective
             # kernel (parallel/routed.py) is THE model-parallel path;
@@ -345,6 +400,10 @@ class MasterNode:
         """
         if self._batch is None or self._trace_cap:
             return None
+        if getattr(runner, "is_native", False):
+            # the host pool IS the batched serve pair: same signatures,
+            # same packed layout, zero dispatches (core/native_serve.py)
+            return runner.serve, runner.idle
         if self._mesh is not None:
             inner = getattr(runner, "inner", None)
             if inner is None:  # a runner shape without a fusable body
@@ -357,11 +416,9 @@ class MasterNode:
         chip runs the whole kernel on its batch shard (pure DP — pallas_call
         cannot be auto-partitioned, so the mesh split is explicit)."""
         import jax
-        from jax.sharding import PartitionSpec as P
-        from jax import shard_map
 
         from misaka_tpu.core.fused import make_fused_runner
-        from misaka_tpu.parallel.mesh import state_specs
+        from misaka_tpu.parallel.mesh import shard_map_compat, state_specs
 
         local = make_fused_runner(
             net.code,
@@ -375,9 +432,8 @@ class MasterNode:
             interpret=(self._engine == "fused-interpret"),
         )
         specs = state_specs(batched=True)
-        inner = shard_map(
+        inner = shard_map_compat(
             local, mesh=self._mesh, in_specs=(specs,), out_specs=specs,
-            check_vma=False,
         )
         jitted = jax.jit(inner, donate_argnums=(0,))
         jitted.inner = inner  # fusable into the one-dispatch serve jit
@@ -387,7 +443,7 @@ class MasterNode:
     def engine_name(self) -> str:
         if self._mp > 1:
             return "gather" if self._engine == "gather" else "routed"
-        if getattr(self._runner, "serve_chunk", None) is not None:
+        if getattr(self._runner, "is_native", False):
             return "native"
         if self._runner is not None:
             return "fused"
@@ -404,6 +460,20 @@ class MasterNode:
             else "dense"
         )
         return f"scan-{kernel}"
+
+    @staticmethod
+    def _close_runner(runner) -> None:
+        """Release a replaced engine's native resources promptly: the C++
+        interpreter/pool handles otherwise wait for GC __del__ — prompt on
+        CPython, unspecified on other runtimes or under reference cycles.
+        Jitted runners have no close(); no-op for them."""
+        close = getattr(runner, "close", None)
+        if close is None:
+            return
+        try:
+            close()
+        except Exception:  # pragma: no cover — best-effort cleanup
+            log.warning("closing replaced runner failed", exc_info=True)
 
     # --- lifecycle (the broadcastCommand surface, master.go:269-351) -------
 
@@ -463,6 +533,7 @@ class MasterNode:
                 self._drain_queues()
                 raise
             with self._state_lock:
+                old_runner = self._runner
                 self._topology = new_topology
                 self._net = new_net
                 self._state = self._shard(new_net.init_state())
@@ -470,6 +541,7 @@ class MasterNode:
                     self._trace = new_net.init_trace(self._trace_cap)
                 self._runner = new_runner
                 self._batched_serve = self._make_serve_fns(new_net, new_runner)
+            self._close_runner(old_runner)
             self._drain_queues()
             log.info("successfully loaded program")
 
@@ -809,15 +881,21 @@ class MasterNode:
             if validate is not None:
                 # native engine: reject value-corrupt checkpoint content
                 # (pc/top/ring violations) here, not in the device loop
-                validate(state)
+                try:
+                    validate(state)
+                except Exception:
+                    self._close_runner(new_runner)  # the reject keeps the old engine
+                    raise
             with self._state_lock:
+                old_runner = self._runner
                 self._topology = new_topology
                 self._net = new_net
-                self._state = self._shard(state)
+                self._state = self._shard(self._owned_device_state(state))
                 if self._trace_cap:
                     self._trace = new_net.init_trace(self._trace_cap)
                 self._runner = new_runner
                 self._batched_serve = self._make_serve_fns(new_net, new_runner)
+            self._close_runner(old_runner)
             self._drain_queues()
         log.info("checkpoint restored from %s", path)
 
@@ -848,7 +926,11 @@ class MasterNode:
         import jax.numpy as jnp
 
         with self._state_lock:
-            state = jax.tree.map(lambda x: x.copy(), state)
+            # owned device copies: (a) the device loop donates state buffers,
+            # which would invalidate the caller's snapshot; (b) donating a
+            # numpy-aliased buffer corrupts the heap on jax 0.4.x CPU (see
+            # _owned_device_state)
+            state = self._owned_device_state(state)
             want_cap = self._net.stack_cap
             have_cap = state.stack_mem.shape[-1]
             if have_cap < want_cap:
@@ -965,9 +1047,11 @@ class MasterNode:
         t0 = _time.monotonic()
         with self._state_lock:
             if self._net is not net:  # lifecycle swapped the network under us
+                self._close_runner(new_runner)
                 return
             pad = [(0, 0)] * (self._state.stack_mem.ndim - 1) \
                 + [(0, new_cap - net.stack_cap)]
+            old_runner = self._runner
             self._topology = new_topology
             self._net = new_net
             self._state = self._shard(
@@ -975,6 +1059,7 @@ class MasterNode:
             )
             self._runner = new_runner
             self._batched_serve = new_serve
+        self._close_runner(old_runner)
         swap_s = _time.monotonic() - t0
         log.info(
             "grew stack capacity %d -> %d (engine=%s): compile+warm %.3fs "
@@ -992,10 +1077,18 @@ class MasterNode:
 
         try:
             dummy = self._shard(net.init_state())
-            native = getattr(runner, "serve_chunk", None)
-            if native is not None:
+            if getattr(runner, "is_native", False):
                 # no XLA to warm; one throwaway chunk validates the new tables
-                native(dummy, np.zeros((net.in_cap,), np.int32), 0, self._chunk)
+                if self._batch is None:
+                    runner.serve_chunk(
+                        dummy, np.zeros((net.in_cap,), np.int32), 0, self._chunk
+                    )
+                else:
+                    runner.serve(
+                        dummy,
+                        np.zeros((self._batch, net.in_cap), np.int32),
+                        np.zeros((self._batch,), np.int32),
+                    )
                 return
             if serve_fns is not None:
                 serve_fn, idle_fn = serve_fns
@@ -1253,11 +1346,17 @@ def make_http_server(
     (MasterNode.save_checkpoint/load_checkpoint) keeps full-path freedom for
     local callers.
     """
-    import os
     import re
     import zipfile
 
+    from misaka_tpu.utils import textcodec
     from misaka_tpu.utils.profiling import Profiler, ProfilerError
+
+    # Warm the native decimal codec at server startup: NativeLib builds the
+    # .so on first use (~1s of g++ under its lock), and without this the
+    # build lands inside the FIRST /compute_batch request's latency instead
+    # of boot (ADVICE r5 #3).
+    textcodec.native_available()
 
     _name_re = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
     profiler = Profiler()

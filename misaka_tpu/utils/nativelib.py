@@ -67,7 +67,9 @@ class NativeLib:
         try:
             subprocess.run(
                 [
-                    cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+                    # -pthread: the interpreter's serving pool runs
+                    # std::thread workers; harmless for the other components
+                    cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                     f'-DMISAKA_SRC_HASH="{self._src_hash()}"',
                     self._src, "-o", tmp,
                 ],

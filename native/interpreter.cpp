@@ -19,8 +19,13 @@
 // and a zero-JAX host executor for tiny control-plane runs.  C ABI for
 // ctypes (misaka_tpu/core/cinterp.py).  Build: make native.
 
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
+#include <thread>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -66,6 +71,17 @@ struct Interp {
   std::vector<int32_t> in_buf, out_buf;
   int32_t in_rd = 0, in_wr = 0, out_rd = 0, out_wr = 0, tick_count = 0;
 
+  // Per-tick scratch, sized once at create and REUSED across ticks: the
+  // multi-threaded serving pool below makes tick() the host throughput hot
+  // path, and ~10 heap allocations per tick measurably cap it.  assign()
+  // on an already-right-sized vector never reallocates.
+  struct Delivery { int tgt, port; int32_t val; };
+  std::vector<int64_t> s_src_val, s_old_acc, s_old_bak;
+  std::vector<uint8_t> s_src_ok, s_granted, s_stack_taken, s_pushed;
+  std::vector<int32_t> s_begin_tops, s_pop_val;
+  std::vector<Delivery> s_deliveries;
+  std::vector<std::pair<int, int32_t>> s_stack_pushes;
+
   const int32_t* ins(int lane) const {
     return &code[(size_t)(lane * max_len + pc[lane]) * NFIELDS];
   }
@@ -88,8 +104,10 @@ struct Interp {
 
     // source resolution (64-bit: an ACC source carries full width; the
     // wire sites below truncate with i32())
-    std::vector<int64_t> src_val(n, 0);
-    std::vector<uint8_t> src_ok(n, 1);
+    std::vector<int64_t>& src_val = s_src_val;
+    std::vector<uint8_t>& src_ok = s_src_ok;
+    src_val.assign(n, 0);
+    src_ok.assign(n, 1);
     for (int l = 0; l < n; ++l) {
       const int32_t* f = ins(l);
       if (!reads_src(f[F_OP])) continue;
@@ -104,17 +122,22 @@ struct Interp {
     }
 
     // arbitration: lowest lane index wins each resource
-    std::vector<uint8_t> granted(n, 0);
-    std::vector<int32_t> begin_tops(num_stacks);
+    std::vector<uint8_t>& granted = s_granted;
+    std::vector<int32_t>& begin_tops = s_begin_tops;
+    std::vector<uint8_t>& stack_taken = s_stack_taken;
+    std::vector<Delivery>& deliveries = s_deliveries;
+    std::vector<std::pair<int, int32_t>>& stack_pushes = s_stack_pushes;
+    std::vector<int32_t>& pop_val = s_pop_val;
+    granted.assign(n, 0);
+    begin_tops.resize(num_stacks);
     for (int s = 0; s < num_stacks; ++s) begin_tops[s] = (int32_t)stacks[s].size();
-    std::vector<uint8_t> stack_taken(num_stacks, 0);
+    stack_taken.assign(num_stacks, 0);
+    deliveries.clear();
+    stack_pushes.clear();  // (stack, value)
+    pop_val.assign(n, 0);
     bool in_taken = false, out_taken = false;
     const bool in_avail = in_wr - in_rd > 0;
     const bool out_free = out_wr - out_rd < out_cap;
-    struct Delivery { int tgt, port; int32_t val; };
-    std::vector<Delivery> deliveries;
-    std::vector<std::pair<int, int32_t>> stack_pushes;  // (stack, value)
-    std::vector<int32_t> pop_val(n, 0);
     int in_winner = -1;
     int32_t out_value = 0;
 
@@ -172,7 +195,10 @@ struct Interp {
     }
 
     // commit + register/pc effects (reading begin-of-tick acc/bak)
-    std::vector<int64_t> old_acc = acc, old_bak = bak;
+    std::vector<int64_t>& old_acc = s_old_acc;
+    std::vector<int64_t>& old_bak = s_old_bak;
+    old_acc = acc;
+    old_bak = bak;
     for (int l = 0; l < n; ++l) {
       const int32_t* f = ins(l);
       int op = f[F_OP];
@@ -230,7 +256,8 @@ struct Interp {
       port_full[d.tgt * kPorts + d.port] = 1;
       port_val[d.tgt * kPorts + d.port] = d.val;
     }
-    std::vector<uint8_t> pushed(num_stacks, 0);
+    std::vector<uint8_t>& pushed = s_pushed;
+    pushed.assign(num_stacks, 0);
     for (const auto& p : stack_pushes) {
       stacks[p.first].push_back(p.second);
       pushed[p.first] = 1;
@@ -246,23 +273,11 @@ struct Interp {
   }
 };
 
-}  // namespace
+// --- internal bodies of the C ABI, shared with the serving pool below ------
 
-extern "C" {
-
-// Source-identity tag scanned from the .so bytes by utils/nativelib.py to
-// detect a binary built from different source (mtime comparison cannot —
-// a fresh checkout gives every file the same timestamp).  The build injects
-// -DMISAKA_SRC_HASH=<sha256[:16] of this file>.
-#ifndef MISAKA_SRC_HASH
-#define MISAKA_SRC_HASH "unbuilt"
-#endif
-__attribute__((used)) const char misaka_src_hash_tag[] =
-    "MISAKA-SRC-HASH:" MISAKA_SRC_HASH;
-
-void* misaka_interp_create(const int32_t* code, const int32_t* prog_len,
-                           int n_lanes, int max_len, int num_stacks,
-                           int stack_cap, int in_cap, int out_cap) {
+Interp* create_interp(const int32_t* code, const int32_t* prog_len,
+                      int n_lanes, int max_len, int num_stacks, int stack_cap,
+                      int in_cap, int out_cap) {
   if (n_lanes <= 0 || max_len <= 0 || stack_cap <= 0 || in_cap <= 0 ||
       out_cap <= 0)
     return nullptr;
@@ -320,10 +335,7 @@ void* misaka_interp_create(const int32_t* code, const int32_t* prog_len,
   return it;
 }
 
-void misaka_interp_destroy(void* h) { delete (Interp*)h; }
-
-int misaka_interp_feed(void* h, const int32_t* values, int count) {
-  auto* it = (Interp*)h;
+int interp_feed(Interp* it, const int32_t* values, int count) {
   int fed = 0;
   for (int i = 0; i < count; ++i) {
     if (it->in_wr - it->in_rd >= it->in_cap) break;
@@ -334,8 +346,7 @@ int misaka_interp_feed(void* h, const int32_t* values, int count) {
   return fed;
 }
 
-void misaka_interp_run(void* h, int ticks) {
-  auto* it = (Interp*)h;
+void interp_run(Interp* it, int ticks) {
   for (int i = 0; i < ticks; ++i) it->tick();
   // Rebase ring counters below the int32 wrap at the chunk boundary, exactly
   // like the device engines (core/state.py rebase_rings): a multiple of the
@@ -352,6 +363,258 @@ void misaka_interp_run(void* h, int ticks) {
     it->out_wr -= base;
   }
 }
+
+int write_state(Interp* it, const int32_t* acc, const int32_t* bak,
+                const int32_t* pc, const int32_t* port_val,
+                const uint8_t* port_full, const int32_t* hold_val,
+                const uint8_t* holding, const int32_t* stack_mem,
+                const int32_t* stack_top, const int32_t* in_buf,
+                const int32_t* out_buf, const int32_t* counters /*[5]*/,
+                const int32_t* retired, const int32_t* acc_hi,
+                const int32_t* bak_hi) {
+  const int n = it->n_lanes;
+  for (int l = 0; l < n; ++l)
+    if (pc[l] < 0 || pc[l] >= it->prog_len[l]) return -1;
+  for (int s = 0; s < it->num_stacks; ++s)
+    if (stack_top[s] < 0 || stack_top[s] > it->stack_cap) return -1;
+  const int32_t in_rd = counters[0], in_wr = counters[1];
+  const int32_t out_rd = counters[2], out_wr = counters[3];
+  if (in_rd < 0 || in_wr < in_rd || in_wr - in_rd > it->in_cap ||
+      out_rd < 0 || out_wr < out_rd || out_wr - out_rd > it->out_cap)
+    return -1;
+  for (int l = 0; l < n; ++l) {
+    it->acc[l] = (int64_t)(((uint64_t)(uint32_t)acc_hi[l] << 32) |
+                           (uint32_t)acc[l]);
+    it->bak[l] = (int64_t)(((uint64_t)(uint32_t)bak_hi[l] << 32) |
+                           (uint32_t)bak[l]);
+  }
+  std::memcpy(it->pc.data(), pc, n * 4);
+  std::memcpy(it->port_val.data(), port_val, (size_t)n * kPorts * 4);
+  std::memcpy(it->port_full.data(), port_full, (size_t)n * kPorts);
+  for (size_t i = 0; i < it->port_full.size(); ++i)
+    it->port_full[i] = it->port_full[i] ? 1 : 0;
+  std::memcpy(it->hold_val.data(), hold_val, n * 4);
+  for (int l = 0; l < n; ++l) it->holding[l] = holding[l] ? 1 : 0;
+  for (int s = 0; s < it->num_stacks; ++s) {
+    it->stacks[s].assign(stack_mem + (size_t)s * it->stack_cap,
+                         stack_mem + (size_t)s * it->stack_cap + stack_top[s]);
+  }
+  std::memcpy(it->in_buf.data(), in_buf, (size_t)it->in_cap * 4);
+  std::memcpy(it->out_buf.data(), out_buf, (size_t)it->out_cap * 4);
+  it->in_rd = in_rd;
+  it->in_wr = in_wr;
+  it->out_rd = out_rd;
+  it->out_wr = out_wr;
+  it->tick_count = counters[4];
+  std::memcpy(it->retired.data(), retired, n * 4);
+  return 0;
+}
+
+void read_state(Interp* it, int32_t* acc, int32_t* bak, int32_t* pc,
+                int32_t* port_val, uint8_t* port_full, int32_t* hold_val,
+                uint8_t* holding, int32_t* stack_mem, int32_t* stack_top,
+                int32_t* out_buf, int32_t* counters /*[5]*/, int32_t* retired,
+                int32_t* acc_hi, int32_t* bak_hi) {
+  int n = it->n_lanes;
+  for (int l = 0; l < n; ++l) {
+    acc[l] = i32(it->acc[l]);
+    acc_hi[l] = (int32_t)(it->acc[l] >> 32);
+    bak[l] = i32(it->bak[l]);
+    bak_hi[l] = (int32_t)(it->bak[l] >> 32);
+  }
+  std::memcpy(pc, it->pc.data(), n * 4);
+  std::memcpy(port_val, it->port_val.data(), (size_t)n * kPorts * 4);
+  std::memcpy(port_full, it->port_full.data(), (size_t)n * kPorts);
+  std::memcpy(hold_val, it->hold_val.data(), n * 4);
+  std::memcpy(holding, it->holding.data(), n);
+  std::memcpy(retired, it->retired.data(), n * 4);
+  for (int s = 0; s < it->num_stacks; ++s) {
+    stack_top[s] = (int32_t)it->stacks[s].size();
+    for (int c = 0; c < it->stack_cap; ++c)
+      stack_mem[s * it->stack_cap + c] =
+          c < (int)it->stacks[s].size() ? it->stacks[s][c] : 0;
+  }
+  std::memcpy(out_buf, it->out_buf.data(), (size_t)it->out_cap * 4);
+  counters[0] = it->in_rd;
+  counters[1] = it->in_wr;
+  counters[2] = it->out_rd;
+  counters[3] = it->out_wr;
+  counters[4] = it->tick_count;
+}
+
+// --- multi-threaded replica pool: the host THROUGHPUT tier -----------------
+//
+// B independent network replicas (the host analog of the engine's vmap batch
+// axis) served by a persistent pool of OS threads.  Replicas are
+// embarrassingly parallel — the TIS network is deterministic per instance and
+// instances never share ports, stacks, or rings — so one pool_serve call
+// shards the replica range across threads via an atomic index dispenser and
+// barriers before returning.  Each replica's serve iteration mirrors the
+// device batched twins (core/engine.py make_batched_serve), keeping the
+// master's canonical state the NetworkState pytree:
+//
+//   serve: import slice -> feed -> run ticks -> packed row
+//          [in_rd, in_wr, out_rd, out_wr, out_buf...] -> drain -> export
+//   idle:  import slice -> run ticks -> counters row (ring NOT drained)
+//
+// All state arrays are batch-major ([B, ...] contiguous), so a replica's
+// slice is a pointer offset — no per-replica marshalling on the Python side.
+
+struct Pool {
+  struct Job {
+    int32_t *acc, *bak, *pc, *port_val;
+    uint8_t* port_full;
+    int32_t* hold_val;
+    uint8_t* holding;
+    int32_t *stack_mem, *stack_top, *in_buf, *out_buf, *counters, *retired;
+    int32_t *acc_hi, *bak_hi;
+    const int32_t* feed_vals;    // [B, in_cap], null when idle
+    const int32_t* feed_counts;  // [B], null when idle
+    int ticks = 0;
+    bool feeding = false;
+    int32_t* packed = nullptr;  // [B, 4+out_cap] serve / [B, 4] idle
+  };
+
+  std::vector<Interp*> replicas;
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  bool shutdown = false;
+  long job_id = 0;
+  int done_threads = 0;
+  std::atomic<int> next{0};
+  // Per-replica result codes (each slot written by exactly one worker):
+  // run_job reports the LOWEST-INDEX failure, so a mixed-failure batch
+  // raises the same Python exception on every run instead of whichever
+  // worker's atomic store landed last.
+  std::vector<int> rep_rc;
+  Job job;
+
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      shutdown = true;
+    }
+    cv_work.notify_all();
+    for (auto& w : workers) w.join();
+    for (auto* it : replicas) delete it;
+  }
+
+  void worker_main() {
+    long seen = 0;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] { return shutdown || job_id != seen; });
+        if (shutdown) return;
+        seen = job_id;
+      }
+      const int n = (int)replicas.size();
+      for (int r; (r = next.fetch_add(1)) < n;) rep_rc[r] = serve_replica(r);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (++done_threads == (int)workers.size()) cv_done.notify_all();
+      }
+    }
+  }
+
+  int serve_replica(int r) {
+    Interp* it = replicas[r];
+    const Job& j = job;
+    const int n = it->n_lanes, s = it->num_stacks;
+    int32_t* acc = j.acc + (size_t)r * n;
+    int32_t* bak = j.bak + (size_t)r * n;
+    int32_t* pc = j.pc + (size_t)r * n;
+    int32_t* port_val = j.port_val + (size_t)r * n * kPorts;
+    uint8_t* port_full = j.port_full + (size_t)r * n * kPorts;
+    int32_t* hold_val = j.hold_val + (size_t)r * n;
+    uint8_t* holding = j.holding + (size_t)r * n;
+    int32_t* stack_mem = j.stack_mem + (size_t)r * s * it->stack_cap;
+    int32_t* stack_top = j.stack_top + (size_t)r * s;
+    int32_t* in_buf = j.in_buf + (size_t)r * it->in_cap;
+    int32_t* out_buf = j.out_buf + (size_t)r * it->out_cap;
+    int32_t* counters = j.counters + (size_t)r * 5;
+    int32_t* retired = j.retired + (size_t)r * n;
+    int32_t* acc_hi = j.acc_hi + (size_t)r * n;
+    int32_t* bak_hi = j.bak_hi + (size_t)r * n;
+    if (write_state(it, acc, bak, pc, port_val, port_full, hold_val, holding,
+                    stack_mem, stack_top, in_buf, out_buf, counters, retired,
+                    acc_hi, bak_hi) != 0)
+      return -1;
+    if (j.feeding) {
+      int count = j.feed_counts[r];
+      if (count > 0 &&
+          interp_feed(it, j.feed_vals + (size_t)r * it->in_cap, count) != count)
+        return -2;  // caller cut to free space; a shortfall is a bug
+    }
+    interp_run(it, j.ticks);
+    if (j.feeding) {
+      int32_t* row = j.packed + (size_t)r * (4 + it->out_cap);
+      row[0] = it->in_rd;
+      row[1] = it->in_wr;
+      row[2] = it->out_rd;
+      row[3] = it->out_wr;
+      std::memcpy(row + 4, it->out_buf.data(), (size_t)it->out_cap * 4);
+      it->out_rd = it->out_wr;  // drain AFTER the snapshot (device parity)
+    } else {
+      int32_t* row = j.packed + (size_t)r * 4;
+      row[0] = it->in_rd;
+      row[1] = it->in_wr;
+      row[2] = it->out_rd;
+      row[3] = it->out_wr;  // idle: counters only, ring untouched
+    }
+    read_state(it, acc, bak, pc, port_val, port_full, hold_val, holding,
+               stack_mem, stack_top, out_buf, counters, retired, acc_hi,
+               bak_hi);
+    std::memcpy(in_buf, it->in_buf.data(), (size_t)it->in_cap * 4);
+    return 0;
+  }
+
+  int run_job() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      next.store(0);
+      rep_rc.assign(replicas.size(), 0);
+      done_threads = 0;
+      ++job_id;
+    }
+    cv_work.notify_all();
+    std::unique_lock<std::mutex> lk(mu);
+    cv_done.wait(lk, [&] { return done_threads == (int)workers.size(); });
+    for (int r : rep_rc)
+      if (r != 0) return r;  // lowest replica index wins (deterministic)
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Source-identity tag scanned from the .so bytes by utils/nativelib.py to
+// detect a binary built from different source (mtime comparison cannot —
+// a fresh checkout gives every file the same timestamp).  The build injects
+// -DMISAKA_SRC_HASH=<sha256[:16] of this file>.
+#ifndef MISAKA_SRC_HASH
+#define MISAKA_SRC_HASH "unbuilt"
+#endif
+__attribute__((used)) const char misaka_src_hash_tag[] =
+    "MISAKA-SRC-HASH:" MISAKA_SRC_HASH;
+
+void* misaka_interp_create(const int32_t* code, const int32_t* prog_len,
+                           int n_lanes, int max_len, int num_stacks,
+                           int stack_cap, int in_cap, int out_cap) {
+  return create_interp(code, prog_len, n_lanes, max_len, num_stacks,
+                       stack_cap, in_cap, out_cap);
+}
+
+void misaka_interp_destroy(void* h) { delete (Interp*)h; }
+
+int misaka_interp_feed(void* h, const int32_t* values, int count) {
+  return interp_feed((Interp*)h, values, count);
+}
+
+void misaka_interp_run(void* h, int ticks) { interp_run((Interp*)h, ticks); }
 
 // Set ring counters directly (checkpoint restore; rebase soak tests).
 // Returns 0 on success, -1 (state unchanged) when the pair violates the
@@ -402,43 +665,9 @@ int misaka_interp_write(void* h, const int32_t* acc, const int32_t* bak,
                         const int32_t* out_buf, const int32_t* counters /*[5]*/,
                         const int32_t* retired, const int32_t* acc_hi,
                         const int32_t* bak_hi) {
-  auto* it = (Interp*)h;
-  const int n = it->n_lanes;
-  for (int l = 0; l < n; ++l)
-    if (pc[l] < 0 || pc[l] >= it->prog_len[l]) return -1;
-  for (int s = 0; s < it->num_stacks; ++s)
-    if (stack_top[s] < 0 || stack_top[s] > it->stack_cap) return -1;
-  const int32_t in_rd = counters[0], in_wr = counters[1];
-  const int32_t out_rd = counters[2], out_wr = counters[3];
-  if (in_rd < 0 || in_wr < in_rd || in_wr - in_rd > it->in_cap ||
-      out_rd < 0 || out_wr < out_rd || out_wr - out_rd > it->out_cap)
-    return -1;
-  for (int l = 0; l < n; ++l) {
-    it->acc[l] = (int64_t)(((uint64_t)(uint32_t)acc_hi[l] << 32) |
-                           (uint32_t)acc[l]);
-    it->bak[l] = (int64_t)(((uint64_t)(uint32_t)bak_hi[l] << 32) |
-                           (uint32_t)bak[l]);
-  }
-  std::memcpy(it->pc.data(), pc, n * 4);
-  std::memcpy(it->port_val.data(), port_val, (size_t)n * kPorts * 4);
-  std::memcpy(it->port_full.data(), port_full, (size_t)n * kPorts);
-  for (size_t i = 0; i < it->port_full.size(); ++i)
-    it->port_full[i] = it->port_full[i] ? 1 : 0;
-  std::memcpy(it->hold_val.data(), hold_val, n * 4);
-  for (int l = 0; l < n; ++l) it->holding[l] = holding[l] ? 1 : 0;
-  for (int s = 0; s < it->num_stacks; ++s) {
-    it->stacks[s].assign(stack_mem + (size_t)s * it->stack_cap,
-                         stack_mem + (size_t)s * it->stack_cap + stack_top[s]);
-  }
-  std::memcpy(it->in_buf.data(), in_buf, (size_t)it->in_cap * 4);
-  std::memcpy(it->out_buf.data(), out_buf, (size_t)it->out_cap * 4);
-  it->in_rd = in_rd;
-  it->in_wr = in_wr;
-  it->out_rd = out_rd;
-  it->out_wr = out_wr;
-  it->tick_count = counters[4];
-  std::memcpy(it->retired.data(), retired, n * 4);
-  return 0;
+  return write_state((Interp*)h, acc, bak, pc, port_val, port_full, hold_val,
+                     holding, stack_mem, stack_top, in_buf, out_buf, counters,
+                     retired, acc_hi, bak_hi);
 }
 
 // Bulk state read-back for differential comparison.  stack_mem is
@@ -449,32 +678,81 @@ void misaka_interp_read(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
                         int32_t* stack_mem, int32_t* stack_top,
                         int32_t* out_buf, int32_t* counters /*[5]*/,
                         int32_t* retired, int32_t* acc_hi, int32_t* bak_hi) {
-  auto* it = (Interp*)h;
-  int n = it->n_lanes;
-  for (int l = 0; l < n; ++l) {
-    acc[l] = i32(it->acc[l]);
-    acc_hi[l] = (int32_t)(it->acc[l] >> 32);
-    bak[l] = i32(it->bak[l]);
-    bak_hi[l] = (int32_t)(it->bak[l] >> 32);
+  read_state((Interp*)h, acc, bak, pc, port_val, port_full, hold_val, holding,
+             stack_mem, stack_top, out_buf, counters, retired, acc_hi, bak_hi);
+}
+
+// --- the multi-threaded serving pool (see struct Pool above) ---------------
+
+// Create `n_replicas` independent interpreter instances for one network,
+// served by `n_threads` persistent worker threads (clamped to [1, replicas]).
+// Null on invalid tables — the same validation as misaka_interp_create, run
+// once per replica.
+void* misaka_pool_create(const int32_t* code, const int32_t* prog_len,
+                         int n_lanes, int max_len, int num_stacks,
+                         int stack_cap, int in_cap, int out_cap,
+                         int n_replicas, int n_threads) {
+  if (n_replicas <= 0) return nullptr;
+  auto* p = new Pool();
+  p->replicas.reserve(n_replicas);
+  for (int r = 0; r < n_replicas; ++r) {
+    Interp* it = create_interp(code, prog_len, n_lanes, max_len, num_stacks,
+                               stack_cap, in_cap, out_cap);
+    if (it == nullptr) {
+      delete p;  // joins zero workers, deletes the replicas built so far
+      return nullptr;
+    }
+    p->replicas.push_back(it);
   }
-  std::memcpy(pc, it->pc.data(), n * 4);
-  std::memcpy(port_val, it->port_val.data(), (size_t)n * kPorts * 4);
-  std::memcpy(port_full, it->port_full.data(), (size_t)n * kPorts);
-  std::memcpy(hold_val, it->hold_val.data(), n * 4);
-  std::memcpy(holding, it->holding.data(), n);
-  std::memcpy(retired, it->retired.data(), n * 4);
-  for (int s = 0; s < it->num_stacks; ++s) {
-    stack_top[s] = (int32_t)it->stacks[s].size();
-    for (int c = 0; c < it->stack_cap; ++c)
-      stack_mem[s * it->stack_cap + c] =
-          c < (int)it->stacks[s].size() ? it->stacks[s][c] : 0;
-  }
-  std::memcpy(out_buf, it->out_buf.data(), (size_t)it->out_cap * 4);
-  counters[0] = it->in_rd;
-  counters[1] = it->in_wr;
-  counters[2] = it->out_rd;
-  counters[3] = it->out_wr;
-  counters[4] = it->tick_count;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n_replicas) n_threads = n_replicas;
+  p->workers.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t)
+    p->workers.emplace_back([p] { p->worker_main(); });
+  return p;
+}
+
+void misaka_pool_destroy(void* h) { delete (Pool*)h; }
+
+int misaka_pool_threads(void* h) { return (int)((Pool*)h)->workers.size(); }
+
+// One batched serve (feed_counts non-null) or idle (both feed pointers null)
+// iteration across every replica.  State arrays are batch-major [B, ...];
+// counters is [B, 5]; packed is [B, 4+out_cap] when feeding, [B, 4] idle.
+// Returns 0, or -1 (some replica's state slice failed import validation) or
+// -2 (a feed exceeded the ring's free space); on error surviving replicas
+// still round-tripped their slices unchanged-or-served, so the caller must
+// treat the whole call as failed.
+int misaka_pool_serve(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
+                      int32_t* port_val, uint8_t* port_full, int32_t* hold_val,
+                      uint8_t* holding, int32_t* stack_mem, int32_t* stack_top,
+                      int32_t* in_buf, int32_t* out_buf, int32_t* counters,
+                      int32_t* retired, int32_t* acc_hi, int32_t* bak_hi,
+                      const int32_t* feed_vals, const int32_t* feed_counts,
+                      int ticks, int32_t* packed) {
+  auto* p = (Pool*)h;
+  Pool::Job& j = p->job;
+  j.acc = acc;
+  j.bak = bak;
+  j.pc = pc;
+  j.port_val = port_val;
+  j.port_full = port_full;
+  j.hold_val = hold_val;
+  j.holding = holding;
+  j.stack_mem = stack_mem;
+  j.stack_top = stack_top;
+  j.in_buf = in_buf;
+  j.out_buf = out_buf;
+  j.counters = counters;
+  j.retired = retired;
+  j.acc_hi = acc_hi;
+  j.bak_hi = bak_hi;
+  j.feed_vals = feed_vals;
+  j.feed_counts = feed_counts;
+  j.ticks = ticks;
+  j.feeding = feed_counts != nullptr;
+  j.packed = packed;
+  return p->run_job();
 }
 
 }  // extern "C"

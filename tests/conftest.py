@@ -26,11 +26,29 @@ else:
     # works post-import as long as no computation has run yet.
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Belt and suspenders for the 8-device mesh: pre-0.5 jax has no
+    # jax_num_cpu_devices config key, so the XLA_FLAGS route must already
+    # be in place before the import in case THIS process is the one that
+    # initializes the backends.
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # pre-0.5 jax: the XLA_FLAGS route above is the only lever; if a
+        # sitecustomize already initialized the backends the mesh suites
+        # will see fewer devices and skip/fail individually rather than
+        # the whole suite dying at collection
+        pass
     # Persistent compile cache: the suite compiles the same tiny kernels
     # every run (single-CPU box — recompilation IS the suite's wall-clock);
     # repeat runs hit the disk cache instead.  Keyed by JAX on program +

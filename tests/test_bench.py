@@ -58,3 +58,103 @@ def test_lane_matrix_reports_median():
     # comparability with pre-r4 single-shot numbers
     assert r["ticks_per_sec_median"] <= r["ticks_per_sec"] * 1.0001
     assert r["reps"] >= 1
+
+
+# --- TPU attach retry / labeled fallback (bench._retry_or_fallback) ---------
+
+
+class _Exec:
+    """Records the execve call _retry_or_fallback would have made."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, path, argv, env):
+        self.calls.append((path, argv, env))
+
+
+def test_attach_failure_retries_with_backoff():
+    ex, slept = _Exec(), []
+    bench._retry_or_fallback(
+        RuntimeError("backend init crash"),
+        environ={"JAX_PLATFORMS": "tpu,cpu"},
+        execve=ex, sleep=slept.append, argv=["bench.py"],
+    )
+    assert slept == [bench.ATTACH_BACKOFF_S]
+    (_, argv, env), = ex.calls
+    assert env["MISAKA_ATTACH_ATTEMPT"] == "1"
+    assert "backend init crash" in env["MISAKA_TPU_ATTACH_ERROR"]
+    # a RETRY keeps the TPU platform; only the spent-attempts path goes CPU
+    assert env.get("JAX_PLATFORMS") == "tpu,cpu"
+    assert env.get("MISAKA_BENCH_FALLBACK") != "cpu"
+
+
+def test_attach_backoff_doubles_per_attempt():
+    ex, slept = _Exec(), []
+    bench._retry_or_fallback(
+        RuntimeError("again"),
+        environ={"MISAKA_ATTACH_ATTEMPT": "1"},
+        execve=ex, sleep=slept.append, argv=["bench.py"],
+    )
+    assert slept == [bench.ATTACH_BACKOFF_S * 2]
+    assert ex.calls[0][2]["MISAKA_ATTACH_ATTEMPT"] == "2"
+
+
+def test_attach_retries_spent_falls_back_to_labeled_cpu():
+    ex = _Exec()
+    bench._retry_or_fallback(
+        RuntimeError("still down"),
+        environ={"MISAKA_ATTACH_ATTEMPT": "2"},
+        execve=ex, sleep=lambda s: None,
+        argv=["bench.py", "--all", "--roofline"],
+    )
+    (_, argv, env), = ex.calls
+    # the fallback capture is CPU, reduced, and LABELED with the reason —
+    # never a silent platform switch (ISSUE r6 acceptance)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["MISAKA_BENCH_FALLBACK"] == "cpu"
+    assert "still down" in env["MISAKA_TPU_ATTACH_ERROR"]
+    assert "--all" not in argv and "--roofline" not in argv
+
+
+def test_attach_no_fallback_reraises_when_spent():
+    with pytest.raises(RuntimeError, match="down for good"):
+        bench._retry_or_fallback(
+            RuntimeError("down for good"),
+            environ={"MISAKA_ATTACH_ATTEMPT": "2",
+                     "MISAKA_BENCH_NO_FALLBACK": "1"},
+            execve=_Exec(), sleep=lambda s: None, argv=["bench.py"],
+        )
+
+
+def test_attach_cpu_only_init_failure_is_a_real_bug():
+    # JAX_PLATFORMS=cpu failing to init is not an attach blip: no retry,
+    # no fallback, the exception propagates
+    with pytest.raises(RuntimeError, match="cpu broke"):
+        bench._retry_or_fallback(
+            RuntimeError("cpu broke"),
+            environ={"JAX_PLATFORMS": "cpu"},
+            execve=_Exec(), sleep=lambda s: None, argv=["bench.py"],
+        )
+
+
+def test_attach_retry_inherits_remaining_ttl():
+    ex = _Exec()
+    bench._retry_or_fallback(
+        RuntimeError("crash"),
+        environ={"MISAKA_BENCH_TTL_S": "1140"},
+        execve=ex, sleep=lambda s: None, argv=["bench.py"],
+    )
+    # the re-exec'd child gets what REMAINS of the driver's TTL budget
+    assert float(ex.calls[0][2]["MISAKA_BENCH_TTL_S"]) <= 1140.0
+
+
+def test_bench_native_pool_tiny():
+    from misaka_tpu.core import native_serve
+
+    if not native_serve.available():
+        pytest.skip("native interpreter unavailable (no g++)")
+    r = bench.bench_native_pool(threads=2, batch=4, in_cap=8,
+                                chunk_steps=256, rounds=2)
+    assert r["throughput"] > 0 and r["values"] == 2 * 4 * 8
+    assert r["threads"] == 2

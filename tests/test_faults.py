@@ -22,12 +22,17 @@ def test_device_loop_crash_stops_cleanly_and_restarts():
 
         real_run = master._net.run
         real_serve = master._net.serve_chunk  # the unbatched loop's one-dispatch path
+        # auto may have picked the native host tier (off-TPU since r6): the
+        # loop then calls the RUNNER's serve_chunk — inject there too
+        native_serve = getattr(master._runner, "serve_chunk", None)
 
         def boom(*a, **k):
             raise RuntimeError("injected device fault")
 
         master._net.run = boom
         master._net.serve_chunk = boom
+        if native_serve is not None:
+            master._runner.serve_chunk = boom
         deadline = time.monotonic() + 10
         while master.is_running and time.monotonic() < deadline:
             time.sleep(0.02)
@@ -41,6 +46,8 @@ def test_device_loop_crash_stops_cleanly_and_restarts():
         # Heal the fault; /run restarts the loop and service resumes.
         master._net.run = real_run
         master._net.serve_chunk = real_serve
+        if native_serve is not None:
+            master._runner.serve_chunk = native_serve
         master.run()
         assert master.compute(5) == 7
     finally:

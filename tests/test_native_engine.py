@@ -181,12 +181,13 @@ def test_native_autogrow():
 
 def test_native_rejects_invalid_combos():
     top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
-    with pytest.raises(ValueError, match="single instance"):
-        MasterNode(top, engine="native", batch=4)
     with pytest.raises(ValueError, match="scan engine"):
         MasterNode(top, engine="native", trace_cap=16)
     with pytest.raises(ValueError, match="single-chip"):
         MasterNode(top, engine="native", batch=None, model_parallel=2)
+    with pytest.raises(ValueError, match="single-chip"):
+        MasterNode(top, engine="native", batch=4, data_parallel=2,
+                   model_parallel=2)
 
 
 def test_native_restore_rejects_corrupt_state():
@@ -242,3 +243,252 @@ def compare_serve(seed, iters=10, chunk=16):
 @pytest.mark.parametrize("seed", range(1000, 1015))
 def test_serve_fuzz(seed):
     compare_serve(seed)
+
+
+# --- the multi-threaded serving pool (NativeServePool) ----------------------
+
+
+def test_pool_matches_batched_scan_twins():
+    """The pool's serve/idle pair is BIT-IDENTICAL to the jitted batched
+    serve twins (engine.make_batched_serve) over a randomized feed schedule
+    — packed snapshots byte-equal, states field-equal (live stack slots)."""
+    B = 4
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile(batch=B)
+    pool = native_serve.NativeServePool(net, chunk_steps=16)
+    serve_fn, idle_fn = net.make_batched_serve(None, 16)
+    s_dev, s_nat = net.init_state(), net.init_state()
+    rng = np.random.default_rng(11)
+    try:
+        for it in range(12):
+            if it % 4 == 3:  # idle iterations interleave with fed ones
+                s_dev, c_dev = idle_fn(s_dev)
+                s_nat, c_nat = pool.idle(s_nat)
+                np.testing.assert_array_equal(
+                    np.asarray(c_dev), c_nat, err_msg=f"idle iter {it}"
+                )
+            else:
+                free = net.in_cap - (
+                    np.asarray(s_nat.in_wr) - np.asarray(s_nat.in_rd)
+                )
+                counts = np.minimum(
+                    rng.integers(0, 5, size=B), free
+                ).astype(np.int32)
+                vals = np.zeros((B, net.in_cap), np.int32)
+                for b in range(B):
+                    vals[b, : counts[b]] = rng.integers(
+                        -1000, 1000, size=counts[b]
+                    )
+                s_dev, p_dev = serve_fn(s_dev, vals, counts)
+                s_nat, p_nat = pool.serve(s_nat, vals, counts)
+                np.testing.assert_array_equal(
+                    np.asarray(p_dev), p_nat, err_msg=f"iter {it}"
+                )
+            assert_states_equal(s_dev, s_nat)
+    finally:
+        pool.close()
+
+
+def test_pool_matches_single_engine_and_oracle():
+    """Each pool replica's output stream is bit-identical to the
+    single-threaded native engine AND the Python oracle fed the same
+    per-replica stream — the multi-threaded tier changes scheduling, never
+    results."""
+    from tests.oracle import Oracle
+
+    B, in_cap = 3, 8
+    net = networks.add2(in_cap=in_cap, out_cap=in_cap, stack_cap=8).compile(
+        batch=B
+    )
+    pool = native_serve.NativeServePool(net, chunk_steps=24, threads=B)
+    single_net = networks.add2(in_cap=in_cap, out_cap=in_cap, stack_cap=8).compile()
+    rng = np.random.default_rng(23)
+    streams = [rng.integers(-1000, 1000, size=6).astype(np.int32) for _ in range(B)]
+
+    # pool: one serve iteration feeds every replica its whole stream
+    vals = np.zeros((B, in_cap), np.int32)
+    counts = np.zeros((B,), np.int32)
+    for b, stream in enumerate(streams):
+        vals[b, : len(stream)] = stream
+        counts[b] = len(stream)
+    state = net.init_state()
+    state, packed = pool.serve(state, vals, counts, num_steps=96)
+    pool.close()
+
+    for b, stream in enumerate(streams):
+        rd, wr = packed[b, 2], packed[b, 3]
+        got = packed[b, 4:][(rd + np.arange(wr - rd)) % in_cap]
+        # single-threaded native engine, same stream
+        ns = native_serve.NativeServe(single_net)
+        sv = np.zeros((in_cap,), np.int32)
+        sv[: len(stream)] = stream
+        s1, p1 = ns.serve_chunk(single_net.init_state(), sv, len(stream), 96)
+        ns.close()
+        srd, swr = p1[2], p1[3]
+        np.testing.assert_array_equal(
+            got, p1[4:][(srd + np.arange(swr - srd)) % in_cap],
+            err_msg=f"replica {b} vs single-threaded engine",
+        )
+        # Python oracle, same stream
+        oracle = Oracle(
+            np.asarray(single_net.code), np.asarray(single_net.prog_len),
+            single_net.num_stacks, single_net.stack_cap, in_cap, in_cap,
+        )
+        oracle.feed([int(v) for v in stream])
+        oracle.run(96)
+        expect = [
+            oracle.out_buf[i % in_cap]
+            for i in range(oracle.out_rd, oracle.out_wr)
+        ]
+        assert got.tolist() == expect, f"replica {b} vs oracle"
+
+
+def test_pool_parity_corpus_replay():
+    """The committed parity corpus through the MULTI-THREADED pool: every
+    case's inputs stream through R replicas at once, and every replica's
+    output stream must equal the committed single-engine recording."""
+    import glob
+    import json
+    import os
+
+    corpus = sorted(glob.glob(os.path.join(
+        os.path.dirname(__file__), "corpus", "parity", "*.json"
+    )))
+    assert corpus, "parity corpus missing"
+    R = 4
+    for path in corpus:
+        with open(path) as f:
+            case = json.load(f)
+        top = Topology(
+            node_info=case["node_info"], programs=case["programs"],
+            stack_cap=64, in_cap=32, out_cap=32,
+        )
+        net = top.compile(batch=R)
+        pool = native_serve.NativeServePool(net, chunk_steps=768)
+        try:
+            inputs = np.asarray(case["inputs"], np.int32)
+            vals = np.zeros((R, net.in_cap), np.int32)
+            vals[:, : len(inputs)] = inputs
+            counts = np.full((R,), len(inputs), np.int32)
+            state, packed = pool.serve(net.init_state(), vals, counts)
+        finally:
+            pool.close()
+        want = case["engine_outputs"]
+        for r in range(R):
+            rd, wr = packed[r, 2], packed[r, 3]
+            got = packed[r, 4:][(rd + np.arange(wr - rd)) % net.out_cap].tolist()
+            if case["compare"] == "stream":
+                assert got == want, f"{case['name']} replica {r}"
+            else:
+                assert sorted(got) == sorted(want), f"{case['name']} replica {r}"
+
+
+def test_pool_rejects_corrupt_state_unchanged():
+    B = 2
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile(batch=B)
+    pool = native_serve.NativeServePool(net, chunk_steps=16)
+    try:
+        good = net.init_state()
+        bad = good._replace(pc=np.full_like(np.asarray(good.pc), 99))
+        with pytest.raises(ValueError):
+            pool.validate_state(bad)
+        pool.validate_state(good)  # and the pool still serves good states
+        s, p = pool.serve(
+            good, np.zeros((B, net.in_cap), np.int32), np.zeros((B,), np.int32)
+        )
+        assert p.shape == (B, 4 + net.out_cap)
+    finally:
+        pool.close()
+
+
+def test_import_rejects_out_of_range_values():
+    """ADVICE r5 #1: a wider-integer state whose values exceed int32 must
+    raise, not silently wrap into the valid range."""
+    from misaka_tpu.core.cinterp import NativeInterpreter
+
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    with NativeInterpreter(
+        np.asarray(net.code), np.asarray(net.prog_len),
+        net.num_stacks, net.stack_cap, net.in_cap, net.out_cap,
+    ) as it:
+        it.feed(np.array([1, 2], np.int32))
+        it.run(8)
+        d = it.export_arrays()
+        for k, v in [
+            ("acc", np.asarray(d["acc"], np.int64) + 2**40),
+            ("in_rd", np.int64(2**33)),
+            ("stack_mem", np.asarray(d["stack_mem"], np.uint64) + 2**32),
+        ]:
+            bad = dict(d)
+            bad[k] = v
+            with pytest.raises(ValueError):
+                it.import_arrays(bad)
+        # int64 VALUES that fit int32 still import fine (e.g. np.load of a
+        # checkpoint edited through a default-int64 tool)
+        ok = dict(d)
+        ok["acc"] = np.asarray(d["acc"], np.int64)
+        it.import_arrays(ok)
+
+
+def test_master_batched_native_serves():
+    """MasterNode(batch=B, engine='native'): the thread-pooled host tier
+    through the real device loop — compute_many, compute_spread, status."""
+    m = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+                   chunk_steps=32, batch=4, engine="native")
+    assert m.engine_name == "native"
+    m.run()
+    try:
+        assert m.compute_many([1, 2, 3]) == [3, 4, 5]
+        vals = np.arange(-40, 40, dtype=np.int32)
+        np.testing.assert_array_equal(
+            m.compute_spread(vals, return_array=True), vals + 2
+        )
+        st = m.status()
+        assert st["engine"] == "native" and st["tick"] > 0
+        assert st["batch"] == 4
+    finally:
+        m.pause()
+
+
+def test_master_batched_native_checkpoint(tmp_path):
+    """Checkpoints cross between the batched native pool and the batched
+    scan engine in both directions (validate_state covers the pool side)."""
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    for first, second in (("native", "scan"), ("scan", "native")):
+        path = str(tmp_path / f"b-{first}-{second}.npz")
+        m1 = MasterNode(top, chunk_steps=16, batch=2, engine=first)
+        m1.run()
+        a = m1.compute_many([1, 2, 3])
+        m1.pause()
+        m1.save_checkpoint(path)
+        m2 = MasterNode(top, chunk_steps=16, batch=2, engine=second)
+        m2.load_checkpoint(path)
+        m2.run()
+        b = m2.compute_many([10, 20, 30])
+        m2.pause()
+        assert a == [3, 4, 5] and b == [12, 22, 32], (first, second)
+
+
+def test_auto_engine_prefers_native_off_tpu(monkeypatch):
+    """With no TPU attached, engine='auto' must serve through the native
+    tier for both unbatched and batched masters (the r4/r5 driver captures
+    served scan-compact at 0.16-0.34M/s with this tier sitting unused) —
+    and MISAKA_NATIVE_AUTO=0 must restore the old behavior."""
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        pytest.skip("auto prefers the device engines on TPU")
+    top = networks.add2(in_cap=8, out_cap=8, stack_cap=8)
+    assert MasterNode(top, chunk_steps=16, engine="auto").engine_name == "native"
+    assert MasterNode(
+        top, chunk_steps=16, batch=2, engine="auto"
+    ).engine_name == "native"
+    monkeypatch.setenv("MISAKA_NATIVE_AUTO", "0")
+    assert MasterNode(
+        top, chunk_steps=16, engine="auto"
+    ).engine_name.startswith("scan-")
+    monkeypatch.delenv("MISAKA_NATIVE_AUTO")
+    # huge batches stay on the XLA engines (per-replica bookkeeping cost)
+    monkeypatch.setenv("MISAKA_NATIVE_AUTO_MAX_BATCH", "2")
+    assert MasterNode(
+        top, chunk_steps=16, batch=4, engine="auto"
+    ).engine_name.startswith("scan-")
