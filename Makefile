@@ -73,6 +73,14 @@ bench:
 bench-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python bench.py --smoke
 
+# Metrics-plane tripwire (~10s): boot a batched master + HTTP server, fire
+# concurrent traffic, assert GET /metrics parses (Prometheus text
+# exposition v0.0.4) and the key series moved (route counters, latency
+# histograms, device-loop ticks).  The same assertions run inside tier-1
+# (tests/test_metrics.py); docs/OBSERVABILITY.md has the metric catalog.
+metrics-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python tools/metrics_smoke.py
+
 # Replay the committed parity corpus (tests/corpus/parity/) against the
 # ACTUAL Go reference binary via its own Dockerfile — the SURVEY.md §4
 # check.  Skips cleanly (exit 0) where Docker is unavailable (here); the
@@ -105,4 +113,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke parity-go parity-local parity-corpus stop clean

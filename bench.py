@@ -517,6 +517,17 @@ def bench_add2(batch=262144, per_instance=128, block_batch=2048):
     return bench_config("add2", batch, per_instance, block_batch)
 
 
+def _scrape_metrics(base: str, timeout: float = 10.0) -> dict:
+    """GET /metrics parsed into {series: value} (utils/metrics.parse_text
+    — the same parser the tests validate the exposition with)."""
+    import urllib.request
+
+    from misaka_tpu.utils import metrics as _metrics
+
+    with urllib.request.urlopen(base + "/metrics", timeout=timeout) as resp:
+        return _metrics.parse_text(resp.read().decode())
+
+
 def bench_served(
     batch=None,
     in_cap=128,
@@ -631,9 +642,29 @@ def bench_served(
 
     try:
         run_wave(warm_reqs)  # warmup (compile + queue plumbing)
+        # Scrape the live metrics plane around the timed window: the delta
+        # embedded in the artifact makes a perf capture carry its own
+        # telemetry (requests/values served, chunk iterations, native pool
+        # calls) — a regression shows WHERE it happened, not just that the
+        # headline moved.  Scrapes sit outside the timed window.
+        try:
+            metrics_before = _scrape_metrics(base)
+        except Exception as e:  # pragma: no cover — telemetry is best-effort
+            print(f"# metrics scrape (before) failed: {e}", file=sys.stderr)
+            metrics_before = None
         t0 = time.perf_counter()
         run_wave(meas_reqs)
         elapsed = time.perf_counter() - t0
+        metrics_delta = None
+        if metrics_before is not None:
+            try:
+                from misaka_tpu.utils import metrics as _metrics
+
+                metrics_delta = _metrics.delta(
+                    metrics_before, _scrape_metrics(base)
+                )
+            except Exception as e:  # pragma: no cover
+                print(f"# metrics scrape (after) failed: {e}", file=sys.stderr)
     finally:
         master.pause()
         httpd.shutdown()
@@ -655,6 +686,7 @@ def bench_served(
         "threads": threads,
         "per_request": per_request,
         "mode": mode,
+        "metrics_delta": metrics_delta,
     }
 
 
@@ -754,6 +786,7 @@ def bench_smoke(target=NORTH_STAR):
         "threads": served["threads"],
         "target": target,
         "ok": bool(served["throughput"] >= target and served["engine"] == "native"),
+        "metrics_delta": served.get("metrics_delta"),
     }
     print(json.dumps(line))
     if not line["ok"]:
@@ -1324,6 +1357,12 @@ def main():
             file=sys.stderr,
         )
         payload[key] = round(served["throughput"], 1)
+        # each serve capture embeds its own /metrics before/after delta:
+        # the artifact carries the telemetry that explains its numbers
+        if served.get("metrics_delta"):
+            payload.setdefault("served_metrics_delta", {})[mode] = served[
+                "metrics_delta"
+            ]
     payload["served_engine"] = served["engine"]
 
     if platform != "tpu":

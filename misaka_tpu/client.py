@@ -12,6 +12,7 @@ the two bulk lanes a throughput client actually wants:
   run/pause/reset     POST /run /pause /reset
   load(target, prog)  POST /load
   status()/trace()    GET  /status /trace
+  healthz()/metrics() GET  /healthz /metrics  (liveness + Prometheus text)
   checkpoint/restore  POST /checkpoint /restore  (server-side .npz)
   profile_start/stop  POST /profile/start /profile/stop
 
@@ -116,6 +117,16 @@ class MisakaClient:
 
     def status(self) -> dict:
         return json.loads(self._request("/status", None, "GET"))
+
+    def healthz(self) -> dict:
+        """Cheap liveness (no server-side state lock): engine + uptime."""
+        return json.loads(self._request("/healthz", None, "GET"))
+
+    def metrics(self) -> str:
+        """Raw Prometheus text exposition from GET /metrics (parse with
+        misaka_tpu.utils.metrics.parse_text where numpy/jax are absent —
+        the parser is stdlib-only like this client)."""
+        return self._request("/metrics", None, "GET").decode()
 
     def trace(self, last: int | None = None) -> list[dict]:
         path = "/trace" if last is None else f"/trace?last={int(last)}"

@@ -17,7 +17,22 @@ import os
 import numpy as np
 
 from misaka_tpu.tis import isa
+from misaka_tpu.utils import metrics
 from misaka_tpu.utils.nativelib import NativeLib
+
+# Lifecycle counters for the C++ handles (GET /metrics): a leak shows as
+# created climbing without closed following — the native pool owns real OS
+# threads, so this pair is the observable for the _close_runner discipline
+# (runtime/master.py replaces engines on load/restore/autogrow).
+_C_CREATED = metrics.counter(
+    "misaka_native_engines_created_total",
+    "Native C++ engine handles created, by kind", ("kind",),
+)
+_C_CLOSED = metrics.counter(
+    "misaka_native_engines_closed_total",
+    "Native C++ engine handles explicitly closed or GC-finalized, by kind",
+    ("kind",),
+)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -166,11 +181,13 @@ class NativeInterpreter:
         )
         if not self._h:
             raise ValueError("invalid network tables")
+        _C_CREATED.labels(kind="interp").inc()
 
     def close(self) -> None:
         if self._h:
             self._lib.misaka_interp_destroy(self._h)
             self._h = None
+            _C_CLOSED.labels(kind="interp").inc()
 
     def __del__(self):
         try:
@@ -368,11 +385,13 @@ class NativePool:
         if not self._h:
             raise ValueError("invalid network tables")
         self.threads = int(lib.misaka_pool_threads(self._h))
+        _C_CREATED.labels(kind="pool").inc()
 
     def close(self) -> None:
         if self._h:
             self._lib.misaka_pool_destroy(self._h)
             self._h = None
+            _C_CLOSED.labels(kind="pool").inc()
 
     def __del__(self):
         try:

@@ -33,10 +33,49 @@ when no TPU is attached.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from misaka_tpu.core import cinterp
 from misaka_tpu.core.state import NetworkState
+from misaka_tpu.utils import metrics
+
+# Native-tier instrumentation (served at GET /metrics): one histogram for
+# every host-interpreter call kind, plus pool-shape gauges.  The label
+# children are resolved once — a pool serve costs single-digit us and must
+# not pay per-call dict lookups for its own telemetry.
+_H_SERVE = metrics.histogram(
+    "misaka_native_serve_seconds",
+    "Host C++ interpreter call duration by kind (chunk = unbatched "
+    "serve_chunk, serve/idle = the thread-pooled batched twins)",
+    ("kind",),
+)
+_H_SERVE_CHUNK = _H_SERVE.labels(kind="chunk")
+_H_SERVE_POOL = _H_SERVE.labels(kind="serve")
+_H_SERVE_IDLE = _H_SERVE.labels(kind="idle")
+_C_CALLS = metrics.counter(
+    "misaka_native_serve_calls_total", "Host C++ interpreter calls by kind",
+    ("kind",),
+)
+_C_CALLS_CHUNK = _C_CALLS.labels(kind="chunk")
+_C_CALLS_POOL = _C_CALLS.labels(kind="serve")
+_C_CALLS_IDLE = _C_CALLS.labels(kind="idle")
+_G_POOL_THREADS = metrics.gauge(
+    "misaka_native_pool_threads", "OS threads in the live native replica pool"
+)
+_G_POOL_REPLICAS = metrics.gauge(
+    "misaka_native_pool_replicas", "Replica interpreters in the live native pool"
+)
+_G_POOL_FILL = metrics.gauge(
+    "misaka_native_pool_fill_ratio",
+    "Fraction of replicas fed on the last pool serve (replica-batch fill)",
+)
+# The pool gauges are weakref callbacks bound at pool construction (last
+# pool wins, like master.py's queue-depth gauges): a closed or collected
+# pool must read 0, not its last live values — an engine swap away from
+# the native tier would otherwise leave /metrics reporting a running pool
+# that no longer exists.
 
 
 def available() -> bool:
@@ -71,6 +110,7 @@ class NativeServe:
 
     def serve_chunk(self, state: NetworkState, values, count, num_steps: int):
         """See core/engine.py serve_chunk — same contract, host execution."""
+        t0 = time.perf_counter()
         it = self._interp
         it.import_arrays({
             f: np.asarray(getattr(state, f)) for f in NetworkState._fields
@@ -88,7 +128,10 @@ class NativeServe:
             d["out_buf"],
         ])
         d["out_rd"] = d["out_wr"]  # the returned state's ring is drained
-        return NetworkState(**{f: d[f] for f in NetworkState._fields}), packed
+        out = NetworkState(**{f: d[f] for f in NetworkState._fields}), packed
+        _C_CALLS_CHUNK.inc()
+        _H_SERVE_CHUNK.observe(time.perf_counter() - t0)
+        return out
 
 
 class NativeServePool:
@@ -119,8 +162,24 @@ class NativeServePool:
         )
         self.threads = self._pool.threads
         self._chunk = int(chunk_steps)
+        self._replicas = net.batch
+        self._closed = False
+        self._last_fill = 0.0
+        import weakref
+
+        ref = weakref.ref(self)
+        _G_POOL_THREADS.set_function(
+            lambda: 0 if (p := ref()) is None or p._closed else p.threads
+        )
+        _G_POOL_REPLICAS.set_function(
+            lambda: 0 if (p := ref()) is None or p._closed else p._replicas
+        )
+        _G_POOL_FILL.set_function(
+            lambda: 0.0 if (p := ref()) is None or p._closed else p._last_fill
+        )
 
     def close(self) -> None:
+        self._closed = True
         self._pool.close()
 
     def _to_dict(self, state: NetworkState) -> dict:
@@ -142,17 +201,28 @@ class NativeServePool:
         """serve_fn twin: feed counts[b] leading entries of values[b] into
         replica b, advance the chunk, return (state, packed [B, 4+out_cap])
         with the returned state's output rings drained."""
+        t0 = time.perf_counter()
         d, packed = self._pool.serve(
             self._to_dict(state), values, counts,
             self._chunk if num_steps is None else num_steps,
         )
-        return self._to_state(d), packed
+        out = self._to_state(d), packed
+        _C_CALLS_POOL.inc()
+        _H_SERVE_POOL.observe(time.perf_counter() - t0)
+        self._last_fill = (
+            float((np.asarray(counts) > 0).sum()) / max(1, self._replicas)
+        )
+        return out
 
     def idle(self, state: NetworkState, num_steps: int | None = None):
         """idle_fn twin: advance the chunk with no feed, return
         (state, ctrs [B, 4]); output rings left undrained."""
+        t0 = time.perf_counter()
         d, ctrs = self._pool.idle(
             self._to_dict(state),
             self._chunk if num_steps is None else num_steps,
         )
-        return self._to_state(d), ctrs
+        out = self._to_state(d), ctrs
+        _C_CALLS_IDLE.inc()
+        _H_SERVE_IDLE.observe(time.perf_counter() - t0)
+        return out

@@ -53,6 +53,13 @@ so the master's env surface is what survives:
   MISAKA_PROFILE_DIR  enable jax.profiler capture of the live device loop via
                    POST /profile/start + /profile/stop, traces written under
                    this directory (disabled when unset)
+  MISAKA_LOG_JSON  "1" for structured JSON logging (utils/jsonlog.py): one
+                   JSON object per line with time/level/logger/msg and the
+                   HTTP route where a request is in scope, so container log
+                   pipelines parse server logs without grok rules.  The
+                   metrics plane itself is always on: GET /metrics serves
+                   Prometheus text exposition, GET /healthz cheap liveness
+                   (docs/OBSERVABILITY.md has the catalog)
   MISAKA_COORDINATOR  join a multi-host jax.distributed runtime before any
                    device touch ("host:port", or "auto" on Cloud TPU pods);
                    with MISAKA_NUM_PROCESSES + MISAKA_PROCESS_ID
@@ -152,9 +159,16 @@ def _serve_http(
 
 
 def main() -> None:
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
-    )
+    if os.environ.get("MISAKA_LOG_JSON") == "1":
+        # structured logs for container pipelines: one JSON object per
+        # line, with the HTTP route attached where a request is in scope
+        from misaka_tpu.utils.jsonlog import install
+
+        install(level=logging.INFO)
+    else:
+        logging.basicConfig(
+            level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+        )
     environ = os.environ
     node_type = environ.get("NODE_TYPE", "master")
     cert, key = environ.get("CERT_FILE"), environ.get("KEY_FILE")

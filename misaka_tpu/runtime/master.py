@@ -34,9 +34,114 @@ import numpy as np
 from misaka_tpu.runtime.topology import Topology, TopologyError
 from misaka_tpu.tis.parser import TISParseError
 from misaka_tpu.tis.lower import TISLowerError
+from misaka_tpu.utils import metrics
 from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
 
 log = logging.getLogger("misaka_tpu.master")
+
+# --- the metrics plane (utils/metrics.py; served at GET /metrics) ----------
+# Process-global series (the Prometheus process model): every MasterNode and
+# HTTP server in this process accumulates into the same registry, so tests
+# and benches that build masters freely still scrape one coherent catalog.
+# Per-master live values (queue depths) ride callback gauges holding
+# weakrefs — last-constructed master wins, dead masters read as 0, and the
+# device-loop hot path pays nothing per iteration for them.
+M_TICKS = metrics.counter(
+    "misaka_device_loop_ticks_total", "Network ticks advanced by the device loop"
+)
+M_LOOP_ITERS = metrics.counter(
+    "misaka_device_loop_iterations_total",
+    "Device-loop iterations by kind (serve = fed or drained, idle = nothing moved)",
+    ("kind",),
+)
+M_CHUNK_SECONDS = metrics.histogram(
+    "misaka_device_loop_chunk_seconds",
+    "Wall time of one device-loop iteration (feed + chunk + drain)",
+)
+# children resolved once: the device loop must not pay label-lookup dict
+# work per iteration (the native tier turns over iterations in ~us)
+M_ITER_SERVE = M_LOOP_ITERS.labels(kind="serve")
+M_ITER_IDLE = M_LOOP_ITERS.labels(kind="idle")
+M_SLOT_OCCUPANCY = metrics.histogram(
+    "misaka_device_loop_fed_slots",
+    "Batch slots fed per serve iteration (batch-slot occupancy)",
+    buckets=metrics.pow2_buckets(1, 65536),
+)
+M_SUBMIT_DEPTH = metrics.gauge(
+    "misaka_submit_queue_depth",
+    "Request chunks waiting in the submission queue (live master)",
+)
+M_OUT_DEPTH = metrics.gauge(
+    "misaka_out_queue_depth",
+    "Output chunks waiting across per-slot output queues (live master)",
+)
+M_WARM_TOTAL = metrics.counter(
+    "misaka_engine_warm_total",
+    "Engine warm-ups COMPLETED (first-call jit compiles forced)",
+)
+M_WARM_FAILED = metrics.counter(
+    "misaka_engine_warm_failed_total",
+    "Engine warm-ups that raised (the device loop then compiles under lock)",
+)
+M_WARM_SECONDS = metrics.histogram(
+    "misaka_engine_warm_seconds",
+    "Completed engine warm-up duration (jit compile + dummy chunk)",
+)
+M_AUTOGROW = metrics.counter(
+    "misaka_stack_autogrow_total", "Successful stack-capacity doublings"
+)
+M_AUTOGROW_BLOCKED = metrics.counter(
+    "misaka_stack_autogrow_blocked_total",
+    "Stack wedges auto-grow could not repair (byte budget or engine limits)",
+)
+M_ENGINE_SWAPS = metrics.counter(
+    "misaka_engine_swap_total",
+    "Runner replacements by cause (load / restore / autogrow)",
+    ("reason",),
+)
+M_CKPT_SAVE_SECONDS = metrics.histogram(
+    "misaka_checkpoint_save_seconds", "save_checkpoint duration"
+)
+M_CKPT_RESTORE_SECONDS = metrics.histogram(
+    "misaka_checkpoint_restore_seconds", "load_checkpoint duration (recompile + swap)"
+)
+M_COMPUTE_REQS = metrics.counter(
+    "misaka_compute_requests_total", "compute/compute_many/compute_spread calls"
+)
+M_COMPUTE_VALUES = metrics.counter(
+    "misaka_compute_values_total", "Values submitted through the compute lanes"
+)
+M_COMPUTE_TIMEOUTS = metrics.counter(
+    "misaka_compute_timeouts_total", "Compute calls that raised ComputeTimeout"
+)
+M_HTTP_REQS = metrics.counter(
+    "misaka_http_requests_total", "HTTP requests by route and method",
+    ("route", "method"),
+)
+M_HTTP_ERRORS = metrics.counter(
+    "misaka_http_errors_total", "HTTP responses with status >= 400",
+    ("route", "code"),
+)
+M_HTTP_INFLIGHT = metrics.gauge(
+    "misaka_http_inflight", "HTTP requests currently being handled"
+)
+M_HTTP_LATENCY = metrics.histogram(
+    "misaka_http_request_duration_seconds", "HTTP request handling time by route",
+    ("route",),
+)
+
+# Bounded route-label cardinality: unknown paths collapse to "other" (an
+# unauthenticated client must not be able to mint unbounded label values).
+_METRIC_ROUTES = frozenset({
+    "/run", "/pause", "/reset", "/load", "/compute", "/compute_batch",
+    "/compute_raw", "/checkpoint", "/restore", "/profile/start",
+    "/profile/stop", "/status", "/trace", "/metrics", "/healthz",
+})
+
+
+def _route_label(path: str) -> str:
+    route = path.split("?", 1)[0]
+    return route if route in _METRIC_ROUTES else "other"
 
 
 class ComputeTimeout(RuntimeError):
@@ -250,6 +355,23 @@ class MasterNode:
         self._rate: float | None = None
         self._rate_mark_tick = 0
         self._rate_mark_time = time.monotonic()
+        # Observability plane: creation time anchors /status uptime_seconds;
+        # requests_total is the per-master cumulative (under _waiters_lock,
+        # which both compute lanes already take).  The process-global queue-
+        # depth gauges read THIS master through weakrefs at scrape time —
+        # zero device-loop cost, and a collected master reads as 0.
+        self._created_mono = time.monotonic()
+        self._requests_total = 0
+        import weakref
+
+        ref = weakref.ref(self)
+        M_SUBMIT_DEPTH.set_function(
+            lambda: m._submit_q.qsize() if (m := ref()) is not None else 0
+        )
+        M_OUT_DEPTH.set_function(
+            lambda: sum(q.qsize() for q in m._out_qs)
+            if (m := ref()) is not None else 0
+        )
 
     def _shard(self, state):
         """Place a state pytree onto the serving mesh (no-op off-mesh)."""
@@ -543,6 +665,7 @@ class MasterNode:
                 self._batched_serve = self._make_serve_fns(new_net, new_runner)
             self._close_runner(old_runner)
             self._drain_queues()
+            M_ENGINE_SWAPS.labels(reason="load").inc()
             log.info("successfully loaded program")
 
     def compute(self, value: int, timeout: float = 30.0) -> int:
@@ -588,6 +711,9 @@ class MasterNode:
             self._compute_locks[slot].acquire()
         with self._waiters_lock:
             self._waiters += 1
+            self._requests_total += 1
+        M_COMPUTE_REQS.inc()
+        M_COMPUTE_VALUES.inc(arr.size)
         try:
             with self._epoch_lock:
                 epoch = self._epoch
@@ -651,6 +777,7 @@ class MasterNode:
             with self._epoch_lock:  # atomic vs _drain_queues' epoch bump
                 if self._epoch == epoch:
                     self._stale[slot] += want - got
+            M_COMPUTE_TIMEOUTS.inc()
             raise ComputeTimeout(
                 f"no output for {want - got}/{want} value(s) "
                 f"after {timeout}s"
@@ -691,6 +818,9 @@ class MasterNode:
             return self.compute_many(arr, timeout=timeout, return_array=return_array)
         with self._waiters_lock:
             self._waiters += 1
+            self._requests_total += 1
+        M_COMPUTE_REQS.inc()
+        M_COMPUTE_VALUES.inc(arr.size)
         try:
             stripes = np.array_split(arr, len(owned))
             with self._epoch_lock:
@@ -761,9 +891,17 @@ class MasterNode:
         host_out = sum(
             sum(len(c) for c in q_depth(q)) for q in self._out_qs
         )
+        with self._waiters_lock:
+            requests_total = self._requests_total
         status = {
             "running": self._running,
             "engine": self.engine_name,
+            # duplicate under the /healthz key so dashboards join on one
+            # name; plus uptime and the cumulative request counter — the
+            # reference's /status was point-in-time gauges only
+            "served_engine": self.engine_name,
+            "uptime_seconds": round(time.monotonic() - self._created_mono, 3),
+            "requests_total": requests_total,
             "tick": tick,
             "ticks_per_sec": self._rate,  # maintained by the device loop
             "retired_per_lane": {
@@ -817,6 +955,7 @@ class MasterNode:
 
         Arrays are materialized under the state lock (see status()).
         """
+        t0 = time.perf_counter()
         with self._state_lock:
             state = self._state
             topo = self._topology
@@ -835,6 +974,7 @@ class MasterNode:
             dtype=np.uint8,
         )
         np.savez(path, **arrays)
+        M_CKPT_SAVE_SECONDS.observe(time.perf_counter() - t0)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore state + programs from a .npz written by save_checkpoint.
@@ -847,6 +987,7 @@ class MasterNode:
 
         from misaka_tpu.core.state import NetworkState
 
+        t0 = time.perf_counter()
         with np.load(path) as data:
             meta = json.loads(bytes(data["__topology__"]).decode())
             fields = {
@@ -897,6 +1038,8 @@ class MasterNode:
                 self._batched_serve = self._make_serve_fns(new_net, new_runner)
             self._close_runner(old_runner)
             self._drain_queues()
+        M_ENGINE_SWAPS.labels(reason="restore").inc()
+        M_CKPT_RESTORE_SECONDS.observe(time.perf_counter() - t0)
         log.info("checkpoint restored from %s", path)
 
     def snapshot(self):
@@ -1024,6 +1167,7 @@ class MasterNode:
                 net.stack_cap, new_cap, new_bytes, self._grow_max_bytes,
             )
             self._grow_blocked = True  # warn once per wedge
+            M_AUTOGROW_BLOCKED.inc()
             return
 
         # --- slow half: lower, build, and WARM the new engine (no lock) ----
@@ -1038,6 +1182,7 @@ class MasterNode:
                 "stack_cap=%d: %s", self._engine, new_cap, e
             )
             self._grow_blocked = True  # warn once per wedge
+            M_AUTOGROW_BLOCKED.inc()
             return
         new_serve = self._make_serve_fns(new_net, new_runner)
         self._warm_engine(new_net, new_runner, new_serve)
@@ -1061,6 +1206,8 @@ class MasterNode:
             self._batched_serve = new_serve
         self._close_runner(old_runner)
         swap_s = _time.monotonic() - t0
+        M_AUTOGROW.inc()
+        M_ENGINE_SWAPS.labels(reason="autogrow").inc()
         log.info(
             "grew stack capacity %d -> %d (engine=%s): compile+warm %.3fs "
             "off-lock, swap %.3fs under lock",
@@ -1075,6 +1222,7 @@ class MasterNode:
         chunk costs idle time, not serve latency."""
         import jax
 
+        t0 = time.perf_counter()
         try:
             dummy = self._shard(net.init_state())
             if getattr(runner, "is_native", False):
@@ -1089,6 +1237,8 @@ class MasterNode:
                         np.zeros((self._batch, net.in_cap), np.int32),
                         np.zeros((self._batch,), np.int32),
                     )
+                M_WARM_TOTAL.inc()
+                M_WARM_SECONDS.observe(time.perf_counter() - t0)
                 return
             if serve_fns is not None:
                 serve_fn, idle_fn = serve_fns
@@ -1118,12 +1268,18 @@ class MasterNode:
                 dummy = net.run(dummy, self._chunk)
                 jax.block_until_ready(dummy)
             jax.block_until_ready(net.counters(dummy))
+            # success only: a failed warm must NOT read as a healthy fast
+            # warm — the failure series is the one worth alerting on
+            M_WARM_TOTAL.inc()
+            M_WARM_SECONDS.observe(time.perf_counter() - t0)
         except Exception as e:  # pragma: no cover — warm-up is best-effort
+            M_WARM_FAILED.inc()
             log.warning("engine warm-up after grow failed (continuing): %s", e)
 
     def _mark_ticks(self) -> None:
         """Advance the tick-rate gauge by one chunk (device loop thread)."""
         self._ticks_done += self._chunk
+        M_TICKS.inc(self._chunk)
         now = time.monotonic()
         if now - self._rate_mark_time > 2:
             self._rate = (self._ticks_done - self._rate_mark_tick) / (
@@ -1194,6 +1350,7 @@ class MasterNode:
         ctrs = self._net.counters(self._state)  # [4] or [4, B]
         while self._running:
             busy = False
+            t_iter = time.perf_counter()
             with self._state_lock:
                 state = self._state
                 self._ingest_submissions()
@@ -1212,6 +1369,7 @@ class MasterNode:
                         vals[: len(got)] = got
                         count = len(got)
                         busy = True
+                        M_SLOT_OCCUPANCY.observe(1)
                     serve = getattr(self._runner, "serve_chunk", None) \
                         or self._net.serve_chunk
                     state, packed = serve(state, vals, count, self._chunk)
@@ -1237,6 +1395,7 @@ class MasterNode:
                         vals, counts = self._build_feed(ctrs)
                         fed = bool(counts.any())
                     if fed:
+                        M_SLOT_OCCUPANCY.observe(int((counts > 0).sum()))
                         state, packed = serve_fn(state, vals, counts)
                         self._mark_ticks()
                         p = np.asarray(packed)  # the single device read
@@ -1270,6 +1429,7 @@ class MasterNode:
                         # batched loop must not churn MBs/iteration
                         vals, counts = self._build_feed(ctrs)
                         if counts.any():
+                            M_SLOT_OCCUPANCY.observe(int((counts > 0).sum()))
                             state = self._net.feed_batched(state, vals, counts)
                             busy = True
                     if self._trace is not None:
@@ -1298,6 +1458,11 @@ class MasterNode:
             for slot, outs in per_slot:
                 self._out_qs[slot].put(outs)
                 busy = True
+            # One observe + one labeled inc per chunk: the instrumentation
+            # cost is a lock and a bisect against a chunk that advances
+            # thousands of ticks — measured <<5% on the native serve path.
+            M_CHUNK_SECONDS.observe(time.perf_counter() - t_iter)
+            (M_ITER_SERVE if busy else M_ITER_IDLE).inc()
             if busy:
                 self._stall_iters = 0
                 self._grow_blocked = False
@@ -1360,6 +1525,7 @@ def make_http_server(
 
     _name_re = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
     profiler = Profiler()
+    boot_mono = time.monotonic()  # /healthz uptime anchor (server, not master)
 
     def resolve_checkpoint(name: str) -> str | None:
         if not checkpoint_dir or not _name_re.match(name) or ".." in name:
@@ -1370,7 +1536,44 @@ def make_http_server(
         protocol_version = "HTTP/1.1"
 
         def log_message(self, fmt, *args):  # route through logging, not stderr
-            log.debug(fmt, *args)
+            # extra["route"] feeds the structured JSON formatter
+            # (utils/jsonlog.py) so container log pipelines can group by
+            # endpoint without re-parsing the request line.  getattr: a
+            # malformed request line reaches send_error(400) -> here BEFORE
+            # self.path is ever assigned (parse_request fails first).
+            log.debug(
+                fmt, *args,
+                extra={"route": _route_label(getattr(self, "path", ""))},
+            )
+
+        def send_response(self, code, message=None):
+            self._metrics_code = code  # read by the _observed wrapper
+            super().send_response(code, message)
+
+        def _observed(self, method: str, inner) -> None:
+            """Per-route request counter + error counter by status code +
+            in-flight gauge + latency histogram around every handler."""
+            route = _route_label(self.path)
+            self._metrics_code = None  # reset: keep-alive reuses the handler
+            M_HTTP_INFLIGHT.inc()
+            t0 = time.perf_counter()
+            try:
+                inner()
+            finally:
+                M_HTTP_LATENCY.labels(route=route).observe(
+                    time.perf_counter() - t0
+                )
+                M_HTTP_REQS.labels(route=route, method=method).inc()
+                code = self._metrics_code or 500
+                if code >= 400:
+                    M_HTTP_ERRORS.labels(route=route, code=str(code)).inc()
+                M_HTTP_INFLIGHT.dec()
+
+        def do_GET(self):
+            self._observed("GET", self._handle_get)
+
+        def do_POST(self):
+            self._observed("POST", self._handle_post)
 
         def _text(self, code: int, body: str) -> None:
             data = body.encode()
@@ -1402,11 +1605,36 @@ def make_http_server(
             """Pre-encoded JSON body (the vectorized /compute_batch path)."""
             self._send(data, "application/json")
 
-        def do_GET(self):
-            # /status and /trace are additive; the reference's routes reject
-            # GET ("method GET not allowed", master.go:104).
+        def _handle_get(self):
+            # /status, /trace, /metrics, /healthz are additive; the
+            # reference's routes reject GET ("method GET not allowed",
+            # master.go:104).
             try:
                 parsed = urlparse(self.path)
+                if parsed.path == "/metrics":
+                    # Prometheus text exposition v0.0.4 from the process
+                    # registry: HTTP surface, device loop, native pool,
+                    # distributed counters — whatever this process runs.
+                    self._send(
+                        metrics.render().encode(), metrics.CONTENT_TYPE
+                    )
+                    return
+                if parsed.path == "/healthz":
+                    # Cheap liveness for load balancers: no state lock, no
+                    # device-array materialization (probing /status
+                    # materializes device arrays under the state lock on
+                    # every call — exactly wrong for a 1s-interval probe).
+                    self._json({
+                        "ok": True,
+                        "engine": getattr(
+                            master, "engine_name", "distributed-grpc"
+                        ),
+                        "running": master.is_running,
+                        "uptime_seconds": round(
+                            time.monotonic() - boot_mono, 3
+                        ),
+                    })
+                    return
                 if parsed.path == "/status":
                     self._json(master.status())
                     return
@@ -1425,7 +1653,13 @@ def make_http_server(
                     try:
                         entries = master.trace(last=last)
                     except RuntimeError as e:
-                        self._text(403, str(e))
+                        # 409 (state conflict), not 403: tracing is a server
+                        # configuration state, not an authorization denial
+                        self._text(
+                            409,
+                            f"{e} (start the server with MISAKA_TRACE_CAP=N "
+                            f"to enable tracing)",
+                        )
                         return
                     self._json({"entries": entries})
                     return
@@ -1437,7 +1671,7 @@ def make_http_server(
                 except Exception:
                     pass
 
-        def do_POST(self):
+        def _handle_post(self):
             try:
                 if self.path == "/run":
                     try:

@@ -44,6 +44,44 @@ from misaka_tpu.runtime.master import BroadcastError, ComputeTimeout
 from misaka_tpu.tis.parser import TISParseError, parse
 from misaka_tpu.transport import rpc
 from misaka_tpu.transport import messenger_pb2 as pb
+from misaka_tpu.utils import metrics
+
+# Distributed-mode metrics (the same registry the fused master renders at
+# GET /metrics; runtime.master.make_http_server serves this control plane
+# too).  One process per node in production, so these are per-node series;
+# the loopback test cluster shares one process and simply aggregates.
+_C_DIST_REQS = metrics.counter(
+    "misaka_dist_compute_requests_total",
+    "compute/compute_many calls on the distributed control plane",
+)
+_C_DIST_VALUES = metrics.counter(
+    "misaka_dist_compute_values_total",
+    "Values submitted through the distributed compute lanes",
+)
+_C_DIST_TIMEOUTS = metrics.counter(
+    "misaka_dist_compute_timeouts_total",
+    "Distributed compute calls that raised ComputeTimeout",
+)
+_C_DIST_INPUTS = metrics.counter(
+    "misaka_dist_inputs_total", "Values handed to program nodes via GetInput"
+)
+_C_DIST_OUTPUTS = metrics.counter(
+    "misaka_dist_outputs_total", "Values received from program nodes via SendOutput"
+)
+_C_DIST_BROADCASTS = metrics.counter(
+    "misaka_dist_broadcasts_total", "Control-plane command fan-outs by command",
+    ("command",),
+)
+_C_STACK_PUSH = metrics.counter(
+    "misaka_stack_push_total", "Stack-node Push RPCs served (this process)"
+)
+_C_STACK_POP = metrics.counter(
+    "misaka_stack_pop_total", "Stack-node Pop RPCs served (this process)"
+)
+_C_PROG_INSTRS = metrics.counter(
+    "misaka_program_instructions_total",
+    "Instructions committed by program nodes in this process",
+)
 
 _M64 = 1 << 64
 
@@ -374,6 +412,7 @@ class ProgramNodeProcess:
 
         self._hold = None  # instruction committed: release the port latch
         self.ptr = (self.ptr + 1) % len(asm)
+        _C_PROG_INSTRS.inc()
 
     def _write_local(self, v: int, dst: str) -> None:
         """ACC stores, NIL discards (program.go:237-239)."""
@@ -510,6 +549,7 @@ class StackNodeProcess:
         with self._cond:
             self._stack.append(int(value))
             self._cond.notify()
+        _C_STACK_PUSH.inc()
 
     def pop_blocking(self, context) -> int:
         """Blocks until a value exists (waitPop, stack.go:133-155); a
@@ -520,6 +560,7 @@ class StackNodeProcess:
                 if self._life.cancelled(gen) or not context.is_active():
                     context.abort(grpc.StatusCode.CANCELLED, "stack pop cancelled")
                 self._cond.wait(_POLL)
+            _C_STACK_POP.inc()
             return self._stack.pop()
 
     def clear(self) -> None:
@@ -609,6 +650,12 @@ class MasterNodeProcess:
         # master.py _collect_slot)
         self._epoch = 0
         self._server: grpc.Server | None = None
+        # /status additions (uptime_seconds / requests_total), mirroring the
+        # fused MasterNode's observability surface
+        import time as _time
+
+        self._created_mono = _time.monotonic()
+        self._requests_total = 0
 
     def start(self) -> int:
         self._server, port = rpc.make_server(
@@ -654,6 +701,7 @@ class MasterNodeProcess:
             t.start()
         for t in threads:
             t.join()
+        _C_DIST_BROADCASTS.labels(command=command).inc()
         if errors:
             raise BroadcastError(str(errors[0]))
 
@@ -715,8 +763,11 @@ class MasterNodeProcess:
             raise ValueError(f"values must be a flat sequence, got shape {arr.shape}")
         if arr.size == 0:
             return np.empty((0,), np.int32) if return_array else []
+        _C_DIST_REQS.inc()
+        _C_DIST_VALUES.inc(arr.size)
         outs: list[int] = []
         with self._compute_lock:
+            self._requests_total += 1  # /status reads the int atomically
             deadline = time.monotonic() + timeout
             with self._io_cond:
                 epoch = self._epoch
@@ -727,6 +778,7 @@ class MasterNodeProcess:
                         if self._epoch != epoch:
                             # reset/load wiped this request: nothing further
                             # is coming and nothing may be marked stale
+                            _C_DIST_TIMEOUTS.inc()
                             raise ComputeTimeout(
                                 "request wiped by reset/load mid-collect"
                             )
@@ -735,6 +787,7 @@ class MasterNodeProcess:
                             # outputs still owed to this request surface later:
                             # mark them stale so pairing survives the failure
                             self._stale_outputs += arr.size - len(outs)
+                            _C_DIST_TIMEOUTS.inc()
                             raise ComputeTimeout(
                                 f"no output for {arr.size - len(outs)}/"
                                 f"{arr.size} value(s) after {timeout}s"
@@ -767,11 +820,16 @@ class MasterNodeProcess:
         return self._life.is_running
 
     def status(self) -> dict:
+        import time as _time
+
         with self._io_cond:
             in_depth, out_depth = len(self._in_q), len(self._out_q)
         return {
             "running": self._life.is_running,
             "mode": "distributed",
+            "served_engine": "distributed-grpc",
+            "uptime_seconds": round(_time.monotonic() - self._created_mono, 3),
+            "requests_total": self._requests_total,
             "nodes": dict(self.node_info),
             "in_queue": in_depth,
             "out_queue": out_depth,
@@ -801,6 +859,7 @@ class MasterNodeProcess:
                 if self._life.cancelled(gen) or not context.is_active():
                     context.abort(grpc.StatusCode.CANCELLED, "main input cancelled")
                 if self._in_q:
+                    _C_DIST_INPUTS.inc()
                     return self._in_q.popleft()
                 self._io_cond.wait(_POLL)
 
@@ -808,6 +867,7 @@ class MasterNodeProcess:
         with self._io_cond:
             self._out_q.append(int(value))
             self._io_cond.notify_all()
+        _C_DIST_OUTPUTS.inc()
 
 
 class _MasterServicer:

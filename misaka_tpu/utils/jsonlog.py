@@ -1,0 +1,53 @@
+"""Opt-in structured JSON logging (MISAKA_LOG_JSON=1, runtime/app.py).
+
+One JSON object per line on stderr — the shape container log pipelines
+(fluentd / vector / CloudWatch) parse without grok rules:
+
+  {"time": "2026-08-03T12:00:00.123Z", "level": "INFO",
+   "logger": "misaka_tpu.master", "msg": "network was run",
+   "route": "/run"}
+
+`route` appears when the record carries one (the HTTP handler passes
+`extra={"route": ...}` in runtime/master.py log_message); exceptions land
+under "exc" as a single escaped string, so a traceback stays ONE log event
+instead of N unparseable lines.  Stdlib-only by design — same constraint
+as the metrics plane (utils/metrics.py): nothing to pip install.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+
+class JsonFormatter(logging.Formatter):
+    """Format every record as one JSON object per line."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            # UTC ISO-8601 with ms: sortable, timezone-unambiguous
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            ) + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        route = getattr(record, "route", None)
+        if route:
+            obj["route"] = route
+        if record.exc_info:
+            obj["exc"] = self.formatException(record.exc_info)
+        # default=str: a log call must never crash on an unserializable arg
+        return json.dumps(obj, ensure_ascii=False, default=str)
+
+
+def install(level: int = logging.INFO, stream=None) -> None:
+    """Replace root handlers with one JSON-formatted stderr handler."""
+    handler = logging.StreamHandler(stream if stream is not None else sys.stderr)
+    handler.setFormatter(JsonFormatter())
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(level)

@@ -1,0 +1,443 @@
+"""The metrics plane (ISSUE 2): registry correctness, exposition-format
+validity, counter monotonicity under concurrent traffic, and /metrics +
+/healthz served in both fused and distributed modes.
+
+Exposition checks go through utils/metrics.parse_text — a strict parser
+that raises on any malformed non-comment line — so "renders" here means
+"every line is valid Prometheus text exposition v0.0.4", not "looks
+plausible".
+"""
+
+import json
+import math
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from misaka_tpu.networks import add2
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.utils import metrics
+
+
+# --- registry unit tests ---------------------------------------------------
+
+
+def test_log_buckets_shape():
+    b = metrics.log_buckets(1e-5, 10.0, per_decade=3)
+    assert b[0] == 1e-5 and b[-1] == 10.0
+    assert all(y > x for x, y in zip(b, b[1:]))
+    # log spacing: constant ratio within float-render tolerance
+    ratios = [y / x for x, y in zip(b, b[1:])]
+    assert all(abs(r - 10 ** (1 / 3)) < 0.01 for r in ratios)
+    assert metrics.pow2_buckets(1, 16) == (1.0, 2.0, 4.0, 8.0, 16.0)
+    with pytest.raises(metrics.MetricError):
+        metrics.log_buckets(10, 1)
+
+
+def test_counter_rejects_negative_and_gauge_callback():
+    r = metrics.Registry()
+    c = metrics.counter("t_total", "h", registry=r)
+    c.inc(2.5)
+    with pytest.raises(metrics.MetricError):
+        c.inc(-1)
+    g = metrics.gauge("t_gauge", "h", registry=r)
+    g.set_function(lambda: 41 + 1)
+    assert g.value == 42
+    # a crashing callback falls back to the stored value, never raises
+    g.set(7)
+    g.set_function(lambda: 1 / 0)
+    assert g.value == 7
+    assert "t_gauge 7" in r.render()
+
+
+def test_get_or_create_idempotent_and_shape_checked():
+    r = metrics.Registry()
+    a = metrics.counter("same_total", "h", ("x",), registry=r)
+    assert metrics.counter("same_total", "h", ("x",), registry=r) is a
+    with pytest.raises(metrics.MetricError):
+        metrics.gauge("same_total", "h", registry=r)  # type mismatch
+    with pytest.raises(metrics.MetricError):
+        metrics.counter("same_total", "h", ("y",), registry=r)  # label mismatch
+    h = metrics.histogram("same_h", "h", buckets=(1, 2), registry=r)
+    assert metrics.histogram("same_h", "h", buckets=(1, 2), registry=r) is h
+    with pytest.raises(metrics.MetricError):
+        metrics.histogram("same_h", "h", buckets=(1, 2, 3), registry=r)
+
+
+def test_labels_validated():
+    r = metrics.Registry()
+    c = metrics.counter("lab_total", "h", ("route",), registry=r)
+    with pytest.raises(metrics.MetricError):
+        c.inc()  # labeled metric used without labels
+    with pytest.raises(metrics.MetricError):
+        c.labels(wrong="x")
+    c.labels(route="/a").inc()
+    assert c.labels(route="/a") is c.labels(route="/a")
+
+
+def test_exposition_roundtrip_with_escaping():
+    r = metrics.Registry()
+    c = metrics.counter("esc_total", "back\\slash and\nnewline", ("v",), registry=r)
+    weird = 'quote " back \\ newline \n end'
+    c.labels(v=weird).inc(3)
+    text = r.render()
+    parsed = metrics.parse_text(text)  # raises on any malformed line
+    [(series, value)] = [kv for kv in parsed.items() if kv[0].startswith("esc")]
+    assert value == 3
+    name, labels = metrics.parse_series(series)
+    assert name == "esc_total" and labels == {"v": weird}
+
+
+def test_histogram_render_consistency():
+    r = metrics.Registry()
+    h = metrics.histogram(
+        "lat_seconds", "h", ("k",), buckets=metrics.log_buckets(0.001, 1.0),
+        registry=r,
+    )
+    rng = np.random.default_rng(0)
+    obs = list(rng.uniform(0.0001, 2.0, size=200))
+    for v in obs:
+        h.labels(k="a").observe(v)
+    parsed = metrics.parse_text(r.render())
+    # bucket monotonicity + le ordering
+    buckets = sorted(
+        (
+            (math.inf if lbl["le"] == "+Inf" else float(lbl["le"]), v)
+            for s, v in parsed.items()
+            for n, lbl in [metrics.parse_series(s)]
+            if n == "lat_seconds_bucket" and lbl["k"] == "a"
+        ),
+    )
+    uppers = [u for u, _ in buckets]
+    counts = [c for _, c in buckets]
+    assert uppers[-1] == math.inf
+    assert counts == sorted(counts), "cumulative buckets must be monotone"
+    # +Inf bucket == _count; _sum matches the observations
+    assert counts[-1] == parsed['lat_seconds_count{k="a"}'] == len(obs)
+    assert parsed['lat_seconds_sum{k="a"}'] == pytest.approx(sum(obs), rel=1e-9)
+    # every bucket's count equals a direct recount of the observations
+    for upper, cum in buckets[:-1]:
+        assert cum == sum(1 for v in obs if v <= upper)
+
+
+def test_registry_thread_safety():
+    r = metrics.Registry()
+    c = metrics.counter("conc_total", "h", registry=r)
+    h = metrics.histogram("conc_seconds", "h", buckets=(1, 2, 4), registry=r)
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(1.5)
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    parsed = metrics.parse_text(r.render())
+    assert parsed["conc_total"] == 8000
+    assert parsed["conc_seconds_count"] == 8000
+    assert parsed["conc_seconds_sum"] == pytest.approx(8000 * 1.5)
+
+
+def test_json_log_formatter():
+    import logging
+
+    from misaka_tpu.utils.jsonlog import JsonFormatter
+
+    fmt = JsonFormatter()
+    rec = logging.LogRecord(
+        "misaka_tpu.master", logging.INFO, __file__, 1, "served %d", (7,), None
+    )
+    rec.route = "/compute"
+    obj = json.loads(fmt.format(rec))
+    assert obj["msg"] == "served 7"
+    assert obj["logger"] == "misaka_tpu.master"
+    assert obj["level"] == "INFO"
+    assert obj["route"] == "/compute"
+    assert obj["time"].endswith("Z")
+    # exceptions collapse into one parseable event
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        import sys
+
+        rec2 = logging.LogRecord(
+            "x", logging.ERROR, __file__, 1, "failed", (), sys.exc_info()
+        )
+    obj2 = json.loads(fmt.format(rec2))
+    assert "boom" in obj2["exc"]
+
+
+# --- the live HTTP surface (fused mode) ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    master = MasterNode(add2(), chunk_steps=32, batch=4)
+    httpd = make_http_server(master, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", master
+    master.pause()
+    httpd.shutdown()
+
+
+def post(base, path, data=None, raw=None):
+    body = raw if raw is not None else urllib.parse.urlencode(data or {}).encode()
+    req = urllib.request.Request(base + path, data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=15) as resp:
+            return resp.status, resp.read(), resp.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+def scrape(base):
+    status, body, ctype = get(base, "/metrics")
+    assert status == 200
+    assert ctype == metrics.CONTENT_TYPE
+    return metrics.parse_text(body.decode())
+
+
+def test_healthz_cheap_liveness(server):
+    base, master = server
+    status, body, _ = get(base, "/healthz")
+    assert status == 200
+    h = json.loads(body)
+    assert h["ok"] is True
+    assert h["engine"] == master.engine_name
+    assert h["uptime_seconds"] >= 0
+    assert isinstance(h["running"], bool)
+
+
+def test_metrics_exposition_valid_and_counters_move(server):
+    base, _ = server
+    before = scrape(server[0])  # parse_text raises on any malformed line
+    post(base, "/run")
+    status, body = post(base, "/compute", {"value": "3"})
+    assert status == 200 and json.loads(body) == {"value": 5}
+    vals = np.arange(8, dtype="<i4")
+    status, body = post(base, "/compute_raw?spread=1", raw=vals.tobytes())
+    assert status == 200
+    assert (np.frombuffer(body, "<i4") == vals + 2).all()
+    after = scrape(base)
+    moved = metrics.delta(before, after)
+    assert moved['misaka_http_requests_total{route="/compute",method="POST"}'] >= 1
+    assert moved['misaka_http_requests_total{route="/compute_raw",method="POST"}'] >= 1
+    assert moved['misaka_http_request_duration_seconds_count{route="/compute"}'] >= 1
+    assert moved["misaka_compute_values_total"] >= 9
+    assert moved["misaka_device_loop_ticks_total"] > 0
+    assert moved["misaka_device_loop_chunk_seconds_count"] > 0
+    # occupancy histogram saw the fed slots
+    assert moved.get("misaka_device_loop_fed_slots_count", 0) >= 1
+    # /status additions
+    st = json.loads(get(base, "/status")[1])
+    assert st["served_engine"] == st["engine"]
+    assert st["uptime_seconds"] > 0
+    assert st["requests_total"] >= 2
+
+
+def test_native_pool_series_present(server):
+    base, master = server
+    if master.engine_name != "native":
+        pytest.skip("native tier unavailable (no toolchain)")
+    post(base, "/run")
+    post(base, "/compute", {"value": "1"})
+    after = scrape(base)
+    assert after["misaka_native_pool_replicas"] == 4
+    assert after["misaka_native_pool_threads"] >= 1
+    assert after['misaka_native_serve_calls_total{kind="serve"}'] >= 1
+    assert after['misaka_native_serve_seconds_count{kind="serve"}'] >= 1
+    assert after['misaka_native_engines_created_total{kind="pool"}'] >= 1
+
+
+def test_native_pool_gauges_zero_after_close():
+    """Pool gauges are weakref callbacks: a closed pool must read 0, not
+    its last live shape (an engine swap away from the native tier must not
+    leave /metrics reporting a running pool)."""
+    from misaka_tpu.core import native_serve
+
+    if not native_serve.available():
+        pytest.skip("native tier unavailable (no toolchain)")
+    net = add2(in_cap=16, out_cap=16, stack_cap=8).compile(batch=2)
+    pool = native_serve.NativeServePool(net, chunk_steps=16)
+    live = metrics.parse_text(metrics.render())
+    assert live["misaka_native_pool_replicas"] == 2
+    assert live["misaka_native_pool_threads"] >= 1
+    pool.close()
+    closed = metrics.parse_text(metrics.render())
+    assert closed["misaka_native_pool_replicas"] == 0
+    assert closed["misaka_native_pool_threads"] == 0
+    assert closed["misaka_native_pool_fill_ratio"] == 0
+
+
+def test_counter_monotonic_under_concurrent_compute(server):
+    base, _ = server
+    post(base, "/run")
+    before = scrape(base)
+    n_threads, per_thread = 8, 4
+    errors = []
+
+    def client(seed):
+        try:
+            for i in range(per_thread):
+                status, body = post(base, "/compute", {"value": str(seed + i)})
+                assert status == 200
+                assert json.loads(body) == {"value": seed + i + 2}
+        except Exception as e:  # pragma: no cover — surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=client, args=(100 * i,)) for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+    after = scrape(base)
+    total = n_threads * per_thread
+    key = 'misaka_http_requests_total{route="/compute",method="POST"}'
+    assert after[key] - before[key] == total
+    assert (
+        after["misaka_compute_values_total"]
+        - before["misaka_compute_values_total"]
+        == total
+    )
+    # monotonicity: no counter series ever decreases
+    decreased = [
+        s for s, v in after.items()
+        if s in before and s.endswith("_total") and v < before[s]
+    ]
+    assert not decreased
+    # in-flight gauge settled: only the scrape request itself is in flight
+    # at render time (it is inside its own _observed window)
+    assert after["misaka_http_inflight"] == 1
+
+
+def test_http_error_counter_and_route_cardinality(server):
+    base, _ = server
+    before = scrape(base)
+    status, _ = post(base, "/compute", {"value": "not-a-number"})
+    assert status == 400
+    status, _, _ = get(base, "/no/such/route")
+    assert status == 405  # reference parity: GET on unknown -> 405
+    after = scrape(base)
+    moved = metrics.delta(before, after)
+    assert moved['misaka_http_errors_total{route="/compute",code="400"}'] >= 1
+    # unknown paths collapse to route="other": scanners cannot mint labels
+    assert moved['misaka_http_requests_total{route="other",method="GET"}'] >= 1
+    assert not any("/no/such/route" in s for s in after)
+
+
+def test_trace_disabled_is_409_with_hint(server):
+    base, _ = server
+    status, body, _ = get(base, "/trace")
+    assert status == 409
+    assert b"MISAKA_TRACE_CAP" in body
+
+
+def test_checkpoint_metrics(tmp_path):
+    m = MasterNode(add2(in_cap=16, out_cap=16, stack_cap=8), chunk_steps=16)
+    before_save = metrics.REGISTRY.get("misaka_checkpoint_save_seconds")
+    b = metrics.parse_text(metrics.render())
+    path = str(tmp_path / "c.npz")
+    m.save_checkpoint(path)
+    m.load_checkpoint(path)
+    a = metrics.parse_text(metrics.render())
+    assert before_save is not None
+    assert a["misaka_checkpoint_save_seconds_count"] - b.get(
+        "misaka_checkpoint_save_seconds_count", 0) == 1
+    assert a["misaka_checkpoint_restore_seconds_count"] - b.get(
+        "misaka_checkpoint_restore_seconds_count", 0) == 1
+    assert a['misaka_engine_swap_total{reason="restore"}'] - b.get(
+        'misaka_engine_swap_total{reason="restore"}', 0) == 1
+
+
+# --- distributed mode ------------------------------------------------------
+
+
+def test_metrics_and_healthz_distributed_mode():
+    """The distributed control plane serves the same observability surface
+    through the shared make_http_server (no gRPC cluster needed for the
+    endpoints themselves; the full-traffic distributed check lives in the
+    slow lane below)."""
+    from misaka_tpu.runtime.nodes import MasterNodeProcess
+
+    master = MasterNodeProcess(
+        node_info={"n1": {"type": "program"}, "s1": {"type": "stack"}}
+    )
+    httpd = make_http_server(master, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        status, body, _ = get(base, "/healthz")
+        assert status == 200
+        h = json.loads(body)
+        assert h["ok"] is True and h["engine"] == "distributed-grpc"
+        parsed = scrape(base)  # valid exposition, same strict parser
+        assert "misaka_dist_compute_values_total" in parsed
+        assert 'misaka_http_requests_total{route="/healthz",method="GET"}' in parsed
+        st = json.loads(get(base, "/status")[1])
+        assert st["served_engine"] == "distributed-grpc"
+        assert st["uptime_seconds"] >= 0 and st["requests_total"] == 0
+    finally:
+        httpd.shutdown()
+        master.close()
+
+
+@pytest.mark.slow
+def test_distributed_counters_move_with_traffic():
+    """Real loopback gRPC cluster: compute traffic moves the distributed
+    control-plane, data-plane, and stack push/pop counters."""
+    from misaka_tpu.runtime.nodes import build_loopback_cluster
+
+    programs = {
+        "misaka1": "IN ACC\nADD 1\nMOV ACC, misaka2:R0\nMOV R0, ACC\nOUT ACC",
+        "misaka2": "MOV R0, ACC\nADD 1\nPUSH ACC, misaka3\nPOP misaka3, ACC\n"
+                   "MOV ACC, misaka1:R0",
+    }
+    master, close = build_loopback_cluster(
+        {"misaka1": "program", "misaka2": "program", "misaka3": "stack"},
+        programs,
+    )
+    httpd = make_http_server(master, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        before = scrape(base)
+        post(base, "/run")
+        status, body = post(base, "/compute", {"value": "40"})
+        assert status == 200 and json.loads(body) == {"value": 42}
+        after = scrape(base)
+        moved = metrics.delta(before, after)
+        assert moved["misaka_dist_compute_requests_total"] == 1
+        assert moved["misaka_dist_compute_values_total"] == 1
+        assert moved["misaka_dist_inputs_total"] >= 1
+        assert moved["misaka_dist_outputs_total"] >= 1
+        assert moved['misaka_dist_broadcasts_total{command="run"}'] >= 1
+        # the loopback cluster shares this process: stack + program-node
+        # series are visible on the same registry
+        assert moved["misaka_stack_push_total"] >= 1
+        assert moved["misaka_stack_pop_total"] >= 1
+        assert moved["misaka_program_instructions_total"] >= 1
+        st = json.loads(get(base, "/status")[1])
+        assert st["requests_total"] == 1
+        post(base, "/pause")
+    finally:
+        httpd.shutdown()
+        close()

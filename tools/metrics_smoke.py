@@ -1,0 +1,141 @@
+"""`make metrics-smoke`: boot a server, fire traffic, assert the metrics
+plane works end to end (~10s, CPU-forced).
+
+Checks, in order:
+  1. GET /healthz answers without the network even running (cheap liveness).
+  2. GET /metrics parses as Prometheus text exposition v0.0.4 — EVERY line,
+     through utils/metrics.parse_text (the strict parser the tests use).
+  3. After concurrent /compute + /compute_batch + /compute_raw traffic, the
+     key series MOVED: http route counters, route latency histogram counts,
+     compute values, device-loop ticks and chunk observations.
+  4. Histogram invariants on the live exposition: cumulative buckets
+     monotone, +Inf bucket == _count.
+
+Exit 0 on success, 1 with a diagnostic on any failure.  The same
+assertions run inside tier-1 (tests/test_metrics.py); this target is the
+out-of-pytest tripwire an operator or CI step can run against the real
+boot path.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.parse
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+    from misaka_tpu.utils import metrics
+
+    master = MasterNode(networks.add2(), chunk_steps=64, batch=8)
+    httpd = make_http_server(master, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=15) as resp:
+            return resp.read()
+
+    def post(path, data=None, raw=None):
+        body = raw if raw is not None else urllib.parse.urlencode(data or {}).encode()
+        req = urllib.request.Request(base + path, data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.read()
+
+    try:
+        health = json.loads(get("/healthz"))
+        assert health["ok"] and "engine" in health and "uptime_seconds" in health, health
+
+        before = metrics.parse_text(get("/metrics").decode())
+
+        post("/run")
+        errors = []
+
+        def client(seed):
+            try:
+                rng = np.random.default_rng(seed)
+                v = int(rng.integers(-99, 99))
+                assert json.loads(post("/compute", {"value": str(v)}))["value"] == v + 2
+                vals = rng.integers(-99, 99, size=64).astype(np.int32)
+                got = json.loads(post("/compute_batch", {
+                    "values": " ".join(map(str, vals.tolist())), "spread": "1",
+                }))["values"]
+                assert got == (vals + 2).tolist()
+                out = np.frombuffer(
+                    post("/compute_raw?spread=1", raw=vals.astype("<i4").tobytes()),
+                    dtype="<i4",
+                )
+                assert (out == vals + 2).all()
+            except Exception as e:  # pragma: no cover — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+        after = metrics.parse_text(get("/metrics").decode())
+        moved = metrics.delta(before, after)
+
+        must_move = [
+            'misaka_http_requests_total{route="/compute",method="POST"}',
+            'misaka_http_requests_total{route="/compute_batch",method="POST"}',
+            'misaka_http_requests_total{route="/compute_raw",method="POST"}',
+            'misaka_http_request_duration_seconds_count{route="/compute"}',
+            "misaka_compute_requests_total",
+            "misaka_compute_values_total",
+            "misaka_device_loop_ticks_total",
+            "misaka_device_loop_chunk_seconds_count",
+        ]
+        missing = [k for k in must_move if moved.get(k, 0) <= 0]
+        assert not missing, f"series did not move: {missing}"
+
+        # histogram invariants on the live exposition
+        hist_counts = 0
+        for series, value in after.items():
+            name, labels = metrics.parse_series(series)
+            if not name.endswith("_count"):
+                continue
+            stem = name[: -len("_count")]
+            inf_key = metrics._series(  # the canonical series string
+                stem + "_bucket",
+                tuple(labels) + ("le",),
+                tuple(labels.values()) + ("+Inf",),
+            )
+            if inf_key in after:
+                hist_counts += 1
+                assert after[inf_key] == value, (series, after[inf_key], value)
+        assert hist_counts > 0, "no histograms found in the exposition"
+
+        print(json.dumps({
+            "metrics_smoke": "ok",
+            "series_total": len(after),
+            "series_moved": len(moved),
+            "histograms_checked": hist_counts,
+            "compute_values": moved.get("misaka_compute_values_total"),
+            "ticks": moved.get("misaka_device_loop_ticks_total"),
+        }))
+        return 0
+    except AssertionError as e:
+        print(f"# metrics-smoke FAILED: {e}", file=sys.stderr)
+        return 1
+    finally:
+        master.pause()
+        httpd.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
