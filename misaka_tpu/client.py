@@ -19,14 +19,23 @@ the two bulk lanes a throughput client actually wants:
 The module imports stdlib only (numpy lazily, inside the two bulk
 methods) and none of the jax-backed misaka_tpu packages — the scalar and
 lifecycle surface is importable on any ops box.
+
+Transport: every request rides a POOLED persistent HTTP/1.1 connection
+(the server keeps keep-alive since r8) — the reference pays TCP setup +
+teardown per transferred value; a fleet client must not.  A connection
+dropped by the server (restart, idle timeout) reconnects cleanly: the
+retry happens only when the failure hit a REUSED pooled socket before a
+response arrived, so a request is never silently replayed against a
+connection that might have executed it.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import threading
 import urllib.error
 import urllib.parse
-import urllib.request
 
 
 class MisakaClientError(RuntimeError):
@@ -42,27 +51,117 @@ class MisakaClient:
     """A client session against one master (`base_url`, default port 8000).
 
     Methods raise MisakaClientError on any non-2xx response (e.g. 400
-    "network is not running", 500 compute timeout) and propagate socket
-    errors (urllib.error.URLError) unchanged.
+    "network is not running", 500 compute timeout) and wrap connection
+    failures in urllib.error.URLError (the documented socket-error shape
+    since r1; the transport is http.client underneath).
+
+    Thread-safe: concurrent callers draw idle connections from a shared
+    pool (LIFO — the hottest socket stays warm) and return them after
+    each response; `pool_size` caps how many idle sockets are retained.
     """
 
-    def __init__(self, base_url: str = "http://localhost:8000", timeout: float = 30.0):
+    def __init__(self, base_url: str = "http://localhost:8000",
+                 timeout: float = 30.0, pool_size: int = 4,
+                 retry_stale: bool = True):
+        """`retry_stale` (default True) replays a request ONCE when a
+        POOLED connection proves dead at send time or before any
+        response byte arrives — the stale-keep-alive case.  This is
+        at-least-once: in the rare window where the server executed the
+        request and died before writing a byte, the replay executes it
+        twice.  Pass False for strict at-most-once (stale pooled sockets
+        then surface as URLError and the caller decides)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retry_stale = bool(retry_stale)
+        split = urllib.parse.urlsplit(self.base_url)
+        if split.scheme not in ("http", ""):
+            raise ValueError(
+                f"unsupported scheme {split.scheme!r} (the master speaks "
+                f"plain HTTP; TLS terminates at the deployment layer)"
+            )
+        self._host = split.hostname or "localhost"
+        self._port = split.port or 80  # urllib's default, kept exactly
+        self._prefix = split.path.rstrip("/")
+        self._pool: list[http.client.HTTPConnection] = []
+        self._pool_lock = threading.Lock()
+        self._pool_size = max(0, int(pool_size))
+
+    def close(self) -> None:
+        """Drop every pooled connection (sessions are reusable after)."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     # --- plumbing ----------------------------------------------------------
 
-    def _request(self, path: str, data: bytes | None, method: str) -> bytes:
-        req = urllib.request.Request(
-            self.base_url + path, data=data, method=method
+    def _checkout(self) -> tuple[http.client.HTTPConnection, bool]:
+        """An idle pooled connection (reused=True) or a fresh one."""
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop(), True
+        return (
+            http.client.HTTPConnection(
+                self._host, self._port, timeout=self.timeout
+            ),
+            False,
         )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            raise MisakaClientError(
-                e.code, e.read().decode(errors="replace").strip()
-            ) from None
+
+    def _checkin(self, conn: http.client.HTTPConnection) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self._pool_size:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def _request(self, path: str, data: bytes | None, method: str) -> bytes:
+        headers = {}
+        if data is not None:
+            # the server's bulk lanes answer 411 without a length;
+            # http.client sets it for bytes bodies, but be explicit
+            headers["Content-Length"] = str(len(data))
+        while True:
+            conn, reused = self._checkout()
+            try:
+                conn.request(method, self._prefix + path, data, headers)
+                resp = conn.getresponse()
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                conn.close()
+                if self.retry_stale and reused and isinstance(
+                    e, (http.client.RemoteDisconnected, ConnectionError,
+                        BrokenPipeError)
+                ):
+                    # a pooled socket the server dropped between requests:
+                    # the send failed or ZERO response bytes arrived —
+                    # replay once on a fresh connection (see __init__'s
+                    # retry_stale for the at-least-once caveat).  Any
+                    # other failure shape (e.g. a garbled partial status
+                    # line) may mean a response was in flight — never
+                    # replay those.
+                    continue
+                raise urllib.error.URLError(e) from e
+            try:
+                body = resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                # response headers arrived: the request executed — a
+                # mid-body failure must surface, never retry
+                conn.close()
+                raise urllib.error.URLError(e) from e
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(conn)
+            if resp.status >= 400:
+                raise MisakaClientError(
+                    resp.status, body.decode(errors="replace").strip()
+                )
+            return body
 
     def _post_form(self, path: str, **fields) -> bytes:
         return self._request(

@@ -75,7 +75,7 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.misaka_pool_serve.argtypes = [ctypes.c_void_p] + [
         _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
         _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P,
-        _I32P, _I32P, ctypes.c_int, _I32P,
+        _I32P, _I32P, ctypes.c_int, _I32P, ctypes.c_int, _I32P,
     ]
 
 
@@ -404,56 +404,88 @@ class NativePool:
             raise RuntimeError("pool is closed")
         return self._h
 
-    def serve(self, d: dict, values, counts, ticks: int):
+    def serve(self, d: dict, values, counts, ticks: int, active=None,
+              trusted: bool = False):
         """One batched serve iteration.  `d` holds batch-major state arrays
         (export_arrays keys, each with a leading [B] axis); returns
         (new_d, packed [B, 4+out_cap]) with new_d the post-chunk state —
         output rings drained (the packed rows carry the pre-drain
-        snapshot, device-twin parity)."""
+        snapshot, device-twin parity).
+
+        `active` (optional, strictly increasing replica indices) is the
+        partial-fill fast path: only those replicas are imported, fed,
+        run, and exported; skipped replicas' state is untouched — except
+        that a skipped replica with an undrained output ring is drained
+        here (its outputs land in its packed row), keeping the
+        drained-on-serve contract uniform — and their packed rows carry
+        their current counters.  Skipped replicas' ticks do NOT advance
+        (instances stop being tick-lockstep under partial fill)."""
         if values is None or counts is None:
             raise ValueError("serve requires values and counts (use idle)")
-        return self._call(d, values, counts, int(ticks))
+        return self._call(d, values, counts, int(ticks), active, trusted)
 
-    def idle(self, d: dict, ticks: int):
+    def idle(self, d: dict, ticks: int, active=None,
+             trusted: bool = False):
         """One batched idle iteration: advance `ticks` with no feed; returns
-        (new_d, ctrs [B, 4]) with the output rings NOT drained."""
-        return self._call(d, None, None, int(ticks))
+        (new_d, ctrs [B, 4]) with the output rings NOT drained.  `active`
+        restricts the pass like serve's (skipped rows: state untouched,
+        ctrs row = current counters)."""
+        return self._call(d, None, None, int(ticks), active, trusted)
 
-    def _call(self, d, values, counts, ticks):
+    def _call(self, d, values, counts, ticks, active=None, trusted=False):
         B, n, s = self.replicas, self.n_lanes, self.num_stacks
 
-        # The C++ workers write the post-chunk state back INTO these arrays
-        # (input state is donated, like the jitted twins' donate_argnums).
-        # np.asarray of a jax array can be a read-only view of the XLA
-        # buffer, which must never be mutated — take ownership unless the
-        # array already owns writeable memory (the steady-state round trip
-        # feeds back our own arrays, so no copy happens then).
-        def own(key, shape):
-            a = _checked_i32(key, d[key], shape)
-            if a.base is not None or not a.flags.writeable:
-                a = np.array(a)
-            return a
+        if trusted:
+            # Identity fast path: `d` is EXACTLY the dict this pool produced
+            # last call (NativeServePool round-trips it and asserts identity
+            # before setting `trusted`) — every array is already contiguous,
+            # writeable int32/uint8 state the C++ side itself exported, and
+            # _counters5 is the live [B, 5] buffer whose public columns went
+            # out as copies.  Re-validating it every iteration was ~40% of
+            # the device-loop's serve-path Python under multi-tenant load.
+            acc, bak = d["acc"], d["bak"]
+            acc_hi, bak_hi = d["acc_hi"], d["bak_hi"]
+            pc = d["pc"]
+            port_val, port_full = d["port_val"], d["port_full"]
+            hold_val, holding = d["hold_val"], d["holding"]
+            stack_mem, stack_top = d["stack_mem"], d["stack_top"]
+            in_buf, out_buf = d["in_buf"], d["out_buf"]
+            retired = d["retired"]
+            counters = d["_counters5"]
+        else:
+            # The C++ workers write the post-chunk state back INTO these
+            # arrays (input state is donated, like the jitted twins'
+            # donate_argnums).  np.asarray of a jax array can be a read-only
+            # view of the XLA buffer, which must never be mutated — take
+            # ownership unless the array already owns writeable memory.
+            def own(key, shape):
+                a = _checked_i32(key, d[key], shape)
+                if a.base is not None or not a.flags.writeable:
+                    a = np.array(a)
+                return a
 
-        def u8arr(key, shape):
-            return _checked_u8(key, d[key], shape)
+            def u8arr(key, shape):
+                return _checked_u8(key, d[key], shape)
 
-        acc = own("acc", (B, n))
-        bak = own("bak", (B, n))
-        acc_hi = own("acc_hi", (B, n))
-        bak_hi = own("bak_hi", (B, n))
-        pc = own("pc", (B, n))
-        port_val = own("port_val", (B, n, isa.NUM_PORTS))
-        port_full = u8arr("port_full", (B, n, isa.NUM_PORTS))
-        hold_val = own("hold_val", (B, n))
-        holding = u8arr("holding", (B, n))
-        stack_mem = own("stack_mem", (B, s, self.stack_cap))
-        stack_top = own("stack_top", (B, s))
-        in_buf = own("in_buf", (B, self.in_cap))
-        out_buf = own("out_buf", (B, self.out_cap))
-        retired = own("retired", (B, n))
-        counters = np.empty((B, 5), np.int32)
-        for i, k in enumerate(("in_rd", "in_wr", "out_rd", "out_wr", "tick")):
-            counters[:, i] = _checked_i32(k, d[k], (B,))
+            acc = own("acc", (B, n))
+            bak = own("bak", (B, n))
+            acc_hi = own("acc_hi", (B, n))
+            bak_hi = own("bak_hi", (B, n))
+            pc = own("pc", (B, n))
+            port_val = own("port_val", (B, n, isa.NUM_PORTS))
+            port_full = u8arr("port_full", (B, n, isa.NUM_PORTS))
+            hold_val = own("hold_val", (B, n))
+            holding = u8arr("holding", (B, n))
+            stack_mem = own("stack_mem", (B, s, self.stack_cap))
+            stack_top = own("stack_top", (B, s))
+            in_buf = own("in_buf", (B, self.in_cap))
+            out_buf = own("out_buf", (B, self.out_cap))
+            retired = own("retired", (B, n))
+            counters = np.empty((B, 5), np.int32)
+            for i, k in enumerate(
+                ("in_rd", "in_wr", "out_rd", "out_wr", "tick")
+            ):
+                counters[:, i] = _checked_i32(k, d[k], (B,))
         feeding = counts is not None
         if feeding:
             values = _checked_i32("values", values, (B, self.in_cap))
@@ -463,6 +495,38 @@ class NativePool:
         else:
             packed = np.empty((B, 4), np.int32)
             vp = cp = None
+        ap, n_active = None, 0
+        if active is not None:
+            active = np.ascontiguousarray(active, dtype=np.int32)
+            if active.ndim != 1:
+                raise ValueError("active must be a flat replica index list")
+            if active.size and (
+                int(active[0]) < 0 or int(active[-1]) >= B
+                or (np.diff(active) <= 0).any()
+            ):
+                raise ValueError(
+                    "active must be strictly increasing replica indices "
+                    f"in [0, {B})"
+                )
+            # skipped replicas never reach the C++ side: their packed rows
+            # carry their current counters here
+            packed[:, :4] = counters[:, :4]
+            skip = np.ones((B,), bool)
+            skip[active] = False
+            if feeding:
+                if (counts[skip] > 0).any():
+                    raise ValueError(
+                        "active must cover every replica with counts > 0 "
+                        "(a skipped feed would silently drop values)"
+                    )
+                # an undrained output ring on a skipped row (possible after
+                # an idle chunk) is snapshotted + drained exactly like a
+                # served row — the drained-on-serve contract stays uniform
+                undrained = skip & (counters[:, 3] > counters[:, 2])
+                if undrained.any():
+                    packed[undrained, 4:] = out_buf[undrained]
+                    counters[undrained, 2] = counters[undrained, 3]
+            ap, n_active = _as_i32p(active), int(active.size)
         rc = self._lib.misaka_pool_serve(
             self._handle(),
             _as_i32p(acc), _as_i32p(bak), _as_i32p(pc),
@@ -471,10 +535,12 @@ class NativePool:
             _as_i32p(stack_mem), _as_i32p(stack_top),
             _as_i32p(in_buf), _as_i32p(out_buf), _as_i32p(counters),
             _as_i32p(retired), _as_i32p(acc_hi), _as_i32p(bak_hi),
-            vp, cp, ticks, _as_i32p(packed),
+            vp, cp, ticks, ap, n_active, _as_i32p(packed),
         )
         if rc == -2:
             raise RuntimeError("native pool feed exceeded ring free space")
+        if rc == -3:  # pragma: no cover — Python validated above
+            raise ValueError("invalid active replica list")
         if rc != 0:
             raise ValueError(
                 "invalid state import (pc/stack_top/ring counters out of range)"
@@ -488,5 +554,9 @@ class NativePool:
             "in_rd": counters[:, 0].copy(), "in_wr": counters[:, 1].copy(),
             "out_rd": counters[:, 2].copy(), "out_wr": counters[:, 3].copy(),
             "tick": counters[:, 4].copy(),
+            # the live counters buffer, for the trusted round-trip fast
+            # path (consumers key NetworkState fields explicitly, so the
+            # private entry never leaks into state construction)
+            "_counters5": counters,
         }
         return out, packed

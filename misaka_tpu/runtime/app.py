@@ -11,6 +11,29 @@ so the master's env surface is what survives:
                    {"nodes": ..., "programs": ...} — or a reference-style
                    docker-compose .yml, imported directly (runtime/compose.py)
   MISAKA_PORT      HTTP port (default 8000 = clientPort, master.go:19)
+  MISAKA_HTTP_WORKERS  N > 0 starts the multi-process serving plane
+                   (runtime/frontends.py): N frontend worker processes
+                   share MISAKA_PORT via SO_REUSEPORT, coalesce their
+                   concurrent compute requests locally, and feed fused
+                   frames to this engine over a unix-socket compute plane
+                   (MISAKA_PLANE_SOCKET, MISAKA_PLANE_CONNS per worker,
+                   MISAKA_PLANE_WINDOW_US coalesce window); non-compute
+                   routes proxy to the engine's own server.  Default 0 =
+                   single-process serving, exactly as before.
+  MISAKA_SERVE_BATCH  "0" disables the in-engine serve scheduler
+                   (ServeBatcher): requests then claim instance slots
+                   directly (the pre-r8 behavior).  Scheduler knobs:
+                   MISAKA_BATCH_WINDOW_US (extra coalesce window while a
+                   pass is in flight, default 0 = purely adaptive),
+                   MISAKA_BATCH_MAX (values per fused pass, default
+                   B x in_cap), MISAKA_BATCH_PASSES (dispatcher workers,
+                   default min(4, B))
+  MISAKA_MAX_BODY  request-body ceiling for the bulk lanes in bytes
+                   (default 64 MiB; oversized bodies answer 413, a
+                   missing Content-Length on /compute_raw answers 411)
+  MISAKA_FAST_HTTP "0" restores the stock stdlib HTTP request parser
+                   (default: the serving-plane fast parser, ~100us less
+                   Python per request)
   MISAKA_AUTORUN   "1" to start running immediately (default: wait for /run)
   MISAKA_BATCH     run N independent network instances in lockstep and serve
                    concurrent /compute requests round-robin across them
@@ -147,10 +170,49 @@ def _serve_http(
     profile_dir: str | None = None,
 ) -> None:
     port = int(environ.get("MISAKA_PORT", "8000"))
+    log_ = logging.getLogger("misaka_tpu.app")
+    workers = int(environ.get("MISAKA_HTTP_WORKERS", "0") or 0)
+    if workers > 0 and hasattr(master, "compute_coalesced"):
+        # The multi-process serving plane (runtime/frontends.py): N
+        # frontend worker processes share the PUBLIC port via SO_REUSEPORT
+        # and feed coalesced frames to this engine over a unix socket; the
+        # engine's own HTTP server moves to a loopback port as the proxy
+        # target for non-compute routes.  One CPython process tops out
+        # near ~3.5k requests/s on pure request handling — this is the
+        # tier that scales the HTTP surface past one GIL.
+        sys.setswitchinterval(0.001)  # many handler threads; avoid convoys
+        from misaka_tpu.runtime import frontends
+
+        server = make_http_server(
+            master, 0, checkpoint_dir=checkpoint_dir, profile_dir=profile_dir
+        )
+        engine_port = server.server_address[1]
+        plane_path = environ.get(
+            "MISAKA_PLANE_SOCKET", f"/tmp/misaka-plane-{os.getpid()}.sock"
+        )
+        plane = frontends.start_compute_plane(master, plane_path)
+        procs = frontends.spawn_frontends(
+            workers, port, f"http://127.0.0.1:{engine_port}", plane_path,
+            plane_conns=int(environ.get("MISAKA_PLANE_CONNS", "2")),
+        )
+        log_.info(
+            "engine http on 127.0.0.1:%d; %d frontend workers on :%d "
+            "(plane %s)", engine_port, workers, port, plane_path,
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            master.pause()
+            sys.exit(0)
+        finally:
+            for p in procs:
+                p.terminate()
+            plane.close()
+        return
     server = make_http_server(
         master, port, checkpoint_dir=checkpoint_dir, profile_dir=profile_dir
     )
-    logging.getLogger("misaka_tpu.app").info("starting http server on :%d", port)
+    log_.info("starting http server on :%d", port)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -1,0 +1,639 @@
+"""HTTP frontend workers + the engine compute plane: the multi-process
+serving tier.
+
+Why this exists: one CPython process tops out near ~3.5k HTTP requests/s
+no matter how fast the engine is — request parsing, handler dispatch, and
+response writes are pure Python, and they all share one GIL.  The r08
+serve-scheduler work made an engine pass cost microseconds, at which
+point the 64-client small-request lane was ENTIRELY GIL-bound.  The fix
+is the same one every production serving stack uses: scale the
+per-request work across processes and keep the engine's work per-FRAME.
+
+    clients ──HTTP/1.1 keep-alive──▶ N frontend processes (SO_REUSEPORT,
+                                     one public port, kernel-balanced)
+        each frontend coalesces its concurrent requests locally
+                    │  one persistent unix-socket connection pair
+                    ▼  carrying fused frames (len-prefixed raw int32)
+              engine process ──ServeBatcher──▶ native pool / XLA engine
+
+Two levels of batching: a frontend packs every request it has in hand
+into one frame; the engine's ServeBatcher fuses frames from all
+frontends into shared engine passes.  The engine's per-request Python
+cost drops to ~amortized microseconds, and HTTP throughput scales with
+frontend count.
+
+The tier is OPT-IN and additive: `make_http_server` alone is unchanged
+(tests, single-process deployments).  A frontend accelerates the hot
+compute routes (POST /compute_raw with spread, POST /compute) and
+transparently PROXIES every other route — lifecycle, /status, /metrics,
+checkpoints — to the engine's own HTTP server, so the public port speaks
+the full surface.  `?spread=0` (pinned single-instance FIFO) also
+proxies: its ordering contract is per-connection, which local coalescing
+would not preserve.
+
+This module imports stdlib only — a frontend process must never pay the
+jax import (or its memory) just to shovel bytes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from misaka_tpu.utils.httpfast import fast_parse_request
+
+log = logging.getLogger("misaka_tpu.frontends")
+
+# Compute-plane wire format (unix SOCK_STREAM, one frame in flight per
+# connection — pipelining comes from running several connections):
+#   request:  <I n_values> <n_values * 4 bytes little-endian int32>
+#   response: <i status> <I length> <payload>
+#     status == 200 -> payload is length*4 bytes of int32 outputs
+#     otherwise     -> payload is `length` bytes of utf-8 error body,
+#                      status is the HTTP code the frontend should answer
+_REQ_HDR = struct.Struct("<I")
+_RESP_HDR = struct.Struct("<iI")
+
+# One frame's value budget.  Big enough that a frontend's whole in-hand
+# backlog ships at once; small enough to bound engine-side buffering.
+MAX_FRAME_VALUES = 1 << 20
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes or raise ConnectionError."""
+    parts = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("compute plane connection closed")
+        parts.append(chunk)
+        n -= len(chunk)
+    return b"".join(parts)
+
+
+# --- engine side ------------------------------------------------------------
+
+
+class ComputePlane:
+    """The engine-side unix-socket listener serving fused compute frames.
+
+    One daemon thread per frontend connection: read a frame, run it
+    through master.compute_coalesced (ONE scheduler submission for the
+    whole frame — the frontend already coalesced its requests), write the
+    outputs back.  Ping-pong per connection keeps the code trivial;
+    frontends hold several connections for overlap.
+    """
+
+    def __init__(self, master, path: str, timeout: float = 30.0):
+        self._master = master
+        self._timeout = timeout
+        self.path = path
+        if os.path.exists(path):
+            os.unlink(path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(64)
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="misaka-plane-accept"
+        )
+        self._accept_thread.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="misaka-plane-conn",
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        master = self._master
+        try:
+            while not self._closed:
+                n = _REQ_HDR.unpack(_recv_exact(conn, 4))[0]
+                if n > MAX_FRAME_VALUES:
+                    body = b"frame exceeds MAX_FRAME_VALUES"
+                    conn.sendall(_RESP_HDR.pack(413, len(body)) + body)
+                    return  # protocol state is unrecoverable past this
+                raw = _recv_exact(conn, n * 4)
+                if not master.is_running:
+                    body = b"network is not running"  # the route's 400 body
+                    conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
+                    continue
+                import numpy as np
+
+                values = np.frombuffer(raw, dtype="<i4")
+                try:
+                    out = master.compute_coalesced(
+                        values, timeout=self._timeout, return_array=True
+                    )
+                except Exception as e:
+                    body = str(e).encode()
+                    conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
+                    continue
+                payload = out.astype("<i4").tobytes()
+                conn.sendall(
+                    _RESP_HDR.pack(200, len(payload) // 4) + payload
+                )
+        except (ConnectionError, OSError) as e:
+            # frontend went away; its requests fail on their side
+            log.debug("compute-plane connection closed: %r", e)
+        except Exception:  # pragma: no cover — must not die silently
+            log.exception("compute-plane connection handler crashed")
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def start_compute_plane(master, path: str, timeout: float = 30.0) -> ComputePlane:
+    return ComputePlane(master, path, timeout=timeout)
+
+
+# --- frontend side ----------------------------------------------------------
+
+
+class PlaneError(RuntimeError):
+    """Engine answered a frame with an error (carries the HTTP status)."""
+
+    def __init__(self, status: int, body: bytes):
+        super().__init__(body.decode(errors="replace"))
+        self.status = status
+        self.body = body
+
+
+class _PlaneRequest:
+    __slots__ = ("body", "out", "error", "event", "cancelled")
+
+    def __init__(self, body: bytes):
+        self.body = body          # raw little-endian int32 values
+        self.out: bytes | None = None
+        self.error: PlaneError | None = None
+        self.event = threading.Event()
+        self.cancelled = False    # waiter gave up; never ship it
+
+
+class PlaneClient:
+    """Frontend-local coalescer over persistent compute-plane connections.
+
+    Handler threads enqueue raw int32 bodies; one dispatcher thread per
+    connection packs EVERYTHING waiting into a single frame (FIFO, byte
+    offsets recorded), ships it, and scatters the response back by
+    offset.  The mirror of the engine's ServeBatcher, one level out.
+    """
+
+    def __init__(self, path: str, conns: int = 2, timeout: float = 60.0):
+        self._path = path
+        self._timeout = timeout
+        self._cond = threading.Condition()
+        self._pending: deque[_PlaneRequest] = deque()
+        self._closed = False
+        self._inflight = 0
+        # Adaptive coalesce window, the engine scheduler's policy applied
+        # one level out: a frame dispatches immediately when no frame is
+        # in flight; while one IS, waiting a few hundred microseconds
+        # gathers more concurrent requests into the next frame — fewer,
+        # bigger frames is exactly what keeps the engine's per-frame GIL
+        # cost amortized.
+        self._window_s = float(
+            os.environ.get("MISAKA_PLANE_WINDOW_US", "") or 300
+        ) / 1e6
+        for i in range(max(1, conns)):
+            threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name=f"misaka-plane-client-{i}",
+            ).start()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def compute_raw(self, body: bytes, timeout: float = 30.0) -> bytes:
+        """One request's raw int32 body in, raw int32 outputs out."""
+        req = _PlaneRequest(body)
+        with self._cond:
+            self._pending.append(req)
+            self._cond.notify()
+        if not req.event.wait(timeout):
+            with self._cond:
+                # never ship a request whose caller already got a 500:
+                # under overload the timed-out backlog would otherwise
+                # keep burning engine capacity for nobody
+                req.cancelled = True
+            raise PlaneError(500, b"compute plane timed out")
+        if req.error is not None:
+            raise req.error
+        return req.out
+
+    def _connect(self) -> socket.socket:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self._timeout)
+        sock.connect(self._path)
+        return sock
+
+    def _dispatch_loop(self) -> None:
+        sock: socket.socket | None = None
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait(0.5)
+                if self._closed:
+                    return
+                if self._inflight and self._window_s > 0:
+                    # coalesce only while another frame is in flight (an
+                    # idle plane dispatches immediately — no latency tax)
+                    self._cond.wait(self._window_s)
+                    if self._closed:
+                        return
+                batch: list[_PlaneRequest] = []
+                total = 0
+                while self._pending and total < MAX_FRAME_VALUES * 4:
+                    req = self._pending[0]
+                    if req.cancelled:
+                        self._pending.popleft()
+                        continue
+                    if total and total + len(req.body) > MAX_FRAME_VALUES * 4:
+                        break
+                    self._pending.popleft()
+                    batch.append(req)
+                    total += len(req.body)
+                if not batch:
+                    continue
+                self._inflight += 1
+            try:
+                if sock is None:
+                    sock = self._connect()
+                sock.sendall(
+                    _REQ_HDR.pack(total // 4) + b"".join(r.body for r in batch)
+                )
+                status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
+                if status == 200:
+                    payload = _recv_exact(sock, length * 4)
+                    off = 0
+                    for r in batch:
+                        r.out = payload[off:off + len(r.body)]
+                        off += len(r.body)
+                else:
+                    err = PlaneError(status, _recv_exact(sock, length))
+                    for r in batch:
+                        r.error = err
+            except (ConnectionError, OSError, struct.error) as e:
+                try:
+                    if sock is not None:
+                        sock.close()
+                except OSError:
+                    pass
+                sock = None  # reconnect on the next frame
+                err = PlaneError(502, f"compute plane error: {e}".encode())
+                for r in batch:
+                    r.error = err
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify()  # a window-waiting dispatcher can go
+            for r in batch:
+                r.event.set()
+
+
+class _ReusePortHTTPServer(ThreadingHTTPServer):
+    """SO_REUSEPORT bind: every frontend process (and only they) binds the
+    same public port; the kernel balances incoming connections."""
+
+    daemon_threads = True
+
+    def server_bind(self):
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
+def make_frontend_server(
+    public_port: int,
+    engine_url: str,
+    plane_path: str,
+    plane_conns: int = 2,
+    max_body: int | None = None,
+) -> ThreadingHTTPServer:
+    """Build one frontend worker's HTTP server (call serve_forever on it).
+
+    Hot routes answer from the compute plane; everything else proxies to
+    the engine's own HTTP server at `engine_url`.
+    """
+    import http.client
+    from urllib.parse import urlsplit
+
+    if max_body is None:
+        max_body = int(
+            os.environ.get("MISAKA_MAX_BODY", "") or 64 * 1024 * 1024
+        )
+    plane = PlaneClient(plane_path, conns=plane_conns)
+    engine = urlsplit(engine_url)
+    engine_host = engine.hostname or "127.0.0.1"
+    engine_port = engine.port or 8000
+    local = threading.local()
+    # Bodies above this ride the PROXY path instead of the compute plane:
+    # the plane exists to fuse many SMALL requests, its frame cap is
+    # MAX_FRAME_VALUES, and a single-client bulk body (the big-batch
+    # lane) is better off striping inside the engine directly.  Half the
+    # frame cap leaves room to coalesce a big body with neighbors.
+    plane_body_limit = MAX_FRAME_VALUES * 2  # bytes = frame cap / 2
+
+    class FrontendHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug(fmt, *args)
+
+        def handle_one_request(self):
+            # the same fast request loop the engine's server runs
+            try:
+                self.raw_requestline = self.rfile.readline(65537)
+                if len(self.raw_requestline) > 65536:
+                    self.requestline = ""
+                    self.request_version = ""
+                    self.command = ""
+                    self.send_error(414, "Request-URI Too Long")
+                    return
+                if not self.raw_requestline:
+                    self.close_connection = True
+                    return
+                parsed = fast_parse_request(self)
+                if parsed is None:
+                    return
+                if not parsed and not self.parse_request():
+                    return
+                mname = "do_" + self.command
+                if not hasattr(self, mname):
+                    self.send_error(
+                        501, f"Unsupported method ({self.command!r})"
+                    )
+                    return
+                getattr(self, mname)()
+                self.wfile.flush()
+            except TimeoutError as e:
+                self.log_error("Request timed out: %r", e)
+                self.close_connection = True
+
+        def _reply(self, code: int, data: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _text(self, code: int, body: str) -> None:
+            self._reply(code, body.encode(), "text/plain; charset=utf-8")
+
+        def _read_body(self, required: bool = True):
+            """Body bytes, or None after answering 411/400/413.
+
+            `required=False` treats a missing Content-Length as an empty
+            body — the engine's own form routes are lenient (`curl -X
+            POST /pause` sends no length), so the proxy and form paths
+            must be too; only the raw bulk lane demands a length.
+            """
+            length_hdr = self.headers.get("Content-Length")
+            if length_hdr is None:
+                if not required:
+                    return b""
+                self.close_connection = True
+                self._text(411, "Content-Length required")
+                return None
+            try:
+                length = int(length_hdr)
+            except ValueError:
+                self.close_connection = True
+                self._text(400, "cannot parse Content-Length")
+                return None
+            if length > max_body:
+                self.close_connection = True
+                self._text(
+                    413,
+                    f"body of {length} bytes exceeds the "
+                    f"{max_body}-byte cap (MISAKA_MAX_BODY)",
+                )
+                return None
+            return self.rfile.read(length)
+
+        def do_POST(self):
+            route = self.path.split("?", 1)[0]
+            if route == "/compute_raw" and "spread=0" not in self.path:
+                length_hdr = self.headers.get("Content-Length", "")
+                if length_hdr.isdigit() and int(length_hdr) > plane_body_limit:
+                    # bulk body: the engine stripes it directly (the
+                    # plane's frame cap must not shrink MISAKA_MAX_BODY)
+                    self._proxy("POST")
+                    return
+                body = self._read_body()
+                if body is None:
+                    return
+                if len(body) % 4:
+                    self._text(400, "body must be raw int32 values")
+                    return
+                try:
+                    out = plane.compute_raw(body)
+                except PlaneError as e:
+                    self._text(e.status, e.body.decode(errors="replace"))
+                    return
+                self._reply(200, out, "application/octet-stream")
+                return
+            if route == "/compute":
+                body = self._read_body(required=False)
+                if body is None:
+                    return
+                # minimal form parse for the one field the route takes
+                from urllib.parse import parse_qs
+
+                form = {
+                    k: v[0]
+                    for k, v in parse_qs(
+                        body.decode(errors="replace"),
+                        keep_blank_values=True,
+                    ).items()
+                }
+                try:
+                    value = int(form.get("value", ""))
+                except ValueError:
+                    self._text(400, "cannot parse value")
+                    return
+                raw = struct.pack("<i", value)
+                try:
+                    out = plane.compute_raw(raw)
+                except PlaneError as e:
+                    self._text(e.status, e.body.decode(errors="replace"))
+                    return
+                result = struct.unpack("<i", out)[0]
+                self._reply(
+                    200, b'{"value": %d}\n' % result, "application/json"
+                )
+                return
+            self._proxy("POST")
+
+        def do_GET(self):
+            self._proxy("GET")
+
+        def _proxy(self, method: str) -> None:
+            """Relay anything this worker does not accelerate to the
+            engine's HTTP server over a per-thread keep-alive connection."""
+            body = b""
+            if method == "POST":
+                body = self._read_body(required=False)
+                if body is None:
+                    return
+            headers = {}
+            ctype = self.headers.get("Content-Type")
+            if ctype:
+                headers["Content-Type"] = ctype
+            for attempt in (0, 1):
+                conn = getattr(local, "engine_conn", None)
+                fresh = conn is None
+                if fresh:
+                    conn = http.client.HTTPConnection(
+                        engine_host, engine_port, timeout=60
+                    )
+                    local.engine_conn = conn
+                try:
+                    conn.request(method, self.path, body or None, headers)
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                except (http.client.HTTPException, OSError) as e:
+                    conn.close()
+                    local.engine_conn = None
+                    if fresh or attempt:
+                        self._text(502, f"engine unreachable: {e}")
+                        return
+                    continue  # stale pooled socket: retry once, fresh
+                self._reply(
+                    resp.status, payload,
+                    resp.getheader("Content-Type") or "text/plain",
+                )
+                return
+
+    return _ReusePortHTTPServer(("0.0.0.0", public_port), FrontendHandler)
+
+
+def frontend_main(argv=None) -> int:
+    """`python -m misaka_tpu.runtime.frontends` — one worker process."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="misaka HTTP frontend worker (SO_REUSEPORT)"
+    )
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--engine", required=True,
+                        help="engine HTTP base url (proxy target)")
+    parser.add_argument("--plane", required=True,
+                        help="compute-plane unix socket path")
+    parser.add_argument("--plane-conns", type=int, default=2)
+    parser.add_argument(
+        "--parent-pid", type=int, default=0,
+        help="exit when this process disappears (spawn_frontends sets it: "
+        "an orphaned worker must NOT keep the SO_REUSEPORT public port — "
+        "the kernel would keep balancing real traffic onto a frontend "
+        "whose engine is gone)",
+    )
+    args = parser.parse_args(argv)
+    # Many small handler threads sharing this worker's GIL: the default
+    # 5ms switch interval turns response waves into convoys.
+    sys.setswitchinterval(0.001)
+    if args.parent_pid:
+        def _watch_parent(pid=args.parent_pid):
+            while True:
+                # reparenting check first: a dead engine left as a zombie
+                # (nothing reaped it) still answers os.kill(pid, 0), but
+                # this worker is the engine's direct child, so its ppid
+                # flips to the reaper the moment the engine dies
+                if os.getppid() != pid:
+                    log.warning("engine pid %d gone; frontend exiting", pid)
+                    os._exit(0)
+                try:
+                    os.kill(pid, 0)
+                except OSError:
+                    log.warning("engine pid %d gone; frontend exiting", pid)
+                    os._exit(0)
+                time.sleep(2.0)
+
+        threading.Thread(target=_watch_parent, daemon=True).start()
+    httpd = make_frontend_server(
+        args.port, args.engine, args.plane, plane_conns=args.plane_conns
+    )
+    log.info("frontend worker on :%d (engine %s)", args.port, args.engine)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def spawn_frontends(
+    n: int,
+    public_port: int,
+    engine_url: str,
+    plane_path: str,
+    plane_conns: int = 2,
+) -> list[subprocess.Popen]:
+    """Start n frontend worker processes sharing `public_port`.
+
+    Workers import stdlib only (no jax), so they boot in well under a
+    second.  The caller owns the Popen handles (terminate() to stop);
+    wait_ready() below confirms the port actually answers.
+    """
+    procs = []
+    for _ in range(n):
+        procs.append(subprocess.Popen([
+            sys.executable, "-m", "misaka_tpu.runtime.frontends",
+            "--port", str(public_port),
+            "--engine", engine_url,
+            "--plane", plane_path,
+            "--plane-conns", str(plane_conns),
+            "--parent-pid", str(os.getpid()),
+        ]))
+    return procs
+
+
+def wait_ready(port: int, timeout: float = 10.0,
+               host: str = "127.0.0.1") -> bool:
+    """Poll until a TCP connect to the public port succeeds."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=1):
+                return True
+        except OSError:
+            time.sleep(0.05)
+    return False
+
+
+def pick_free_port() -> int:
+    """A free TCP port for the shared SO_REUSEPORT public bind (racy by
+    nature, fine for benches and tests)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+if __name__ == "__main__":
+    sys.exit(frontend_main())

@@ -20,12 +20,14 @@ Deliberate divergences (SURVEY.md quirks, each strictly better and test-pinned):
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import os
 import queue
 import threading
 import time
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -35,6 +37,7 @@ from misaka_tpu.runtime.topology import Topology, TopologyError
 from misaka_tpu.tis.parser import TISParseError
 from misaka_tpu.tis.lower import TISLowerError
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils.httpfast import fast_parse_request as _fast_parse_request
 from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
 
 log = logging.getLogger("misaka_tpu.master")
@@ -114,6 +117,29 @@ M_COMPUTE_VALUES = metrics.counter(
 M_COMPUTE_TIMEOUTS = metrics.counter(
     "misaka_compute_timeouts_total", "Compute calls that raised ComputeTimeout"
 )
+M_SERVE_COALESCED_VALUES = metrics.histogram(
+    "misaka_serve_coalesced_values",
+    "Values fused into one serve-scheduler pass (cross-request batching)",
+    buckets=metrics.pow2_buckets(1, 1 << 20),
+)
+M_SERVE_COALESCED_REQS = metrics.histogram(
+    "misaka_serve_coalesced_requests",
+    "Requests fused into one serve-scheduler pass",
+    buckets=metrics.pow2_buckets(1, 4096),
+)
+M_SERVE_QUEUE_DELAY = metrics.histogram(
+    "misaka_serve_queue_delay_seconds",
+    "Time a request waited in the serve-scheduler queue before its first "
+    "dispatch (the coalescing latency tax — near zero when the engine is "
+    "idle, bounded by pass time under load)",
+)
+M_SERVE_WAITING = metrics.gauge(
+    "misaka_serve_waiting_requests",
+    "Requests queued in the serve scheduler, not yet dispatched (live master)",
+)
+M_SERVE_PASSES = metrics.counter(
+    "misaka_serve_passes_total", "Fused serve-scheduler passes dispatched"
+)
 M_HTTP_REQS = metrics.counter(
     "misaka_http_requests_total", "HTTP requests by route and method",
     ("route", "method"),
@@ -155,6 +181,373 @@ class BroadcastError(RuntimeError):
     surface can catch it without importing the grpc-dependent distributed
     module — the fused master must work with jax+numpy alone.
     """
+
+
+# Queue-drain sentinel: _drain_queues pushes one into every output queue
+# after bumping the epoch, so a collector blocked in out_qs.get() learns of
+# the wipe IMMEDIATELY instead of burning its full request timeout (a reset
+# racing an in-flight request used to park that request — and its slot —
+# for up to 30s).  A zero-length array so status()'s depth math reads it as
+# 0 values; matched by IDENTITY, never by shape.
+_WIPED = np.empty((0,), np.int32)
+
+
+class _BatchEntry:
+    """One request in the serve scheduler: values in, a future's worth of
+    outputs back.  Counters (`taken`/`filled`) are guarded by the batcher's
+    shared condition lock; `out` segments are written by exactly one pass
+    each (disjoint slices), so the array itself needs no lock."""
+
+    __slots__ = ("arr", "out", "taken", "filled", "deadline", "event",
+                 "error", "enqueued", "dispatched", "cancelled")
+
+    def __init__(self, arr: np.ndarray, deadline: float):
+        self.arr = arr
+        self.out = np.empty((arr.size,), np.int32)
+        self.taken = 0       # values cut into passes so far
+        self.filled = 0      # values scattered back so far
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.error: BaseException | None = None
+        self.enqueued = time.monotonic()
+        self.dispatched = False  # first-dispatch latch (queue-delay metric)
+        self.cancelled = False   # waiter gave up; skip undispatched remainder
+
+
+class _BatcherShared:
+    """The scheduler queue state a parked worker thread may hold: it
+    deliberately references NO master.  Workers hold a weakref to the
+    batcher and this object strongly — so an idle worker never keeps a
+    dead master (and its engine) alive, and exits within one poll interval
+    of the master being collected."""
+
+    __slots__ = ("cond", "pending", "inflight", "closed")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.pending: collections.deque[_BatchEntry] = collections.deque()
+        self.inflight = 0   # passes currently executing
+        self.closed = False
+
+
+def _batcher_worker(shared: _BatcherShared, ref) -> None:
+    """Dispatcher/collector loop (see ServeBatcher).  Takes a strong
+    batcher reference only while there is work; parks on the shared
+    condition otherwise."""
+    while True:
+        with shared.cond:
+            if shared.closed:
+                return
+            if not shared.pending:
+                shared.cond.wait(0.5)
+            if shared.closed:
+                return
+            empty = not shared.pending
+        if empty:
+            if ref() is None:  # master collected: wind the pool down
+                with shared.cond:
+                    shared.closed = True
+                    shared.cond.notify_all()
+                return
+            continue
+        batcher = ref()
+        if batcher is None:
+            with shared.cond:
+                shared.closed = True
+                shared.cond.notify_all()
+            return
+        try:
+            batcher._pass_once()
+        except Exception:  # pragma: no cover — a crashed pass must not
+            log.exception("serve-scheduler pass crashed")  # kill the pool
+        del batcher
+
+
+class ServeBatcher:
+    """Cross-request micro-batching between the HTTP handlers and the engine.
+
+    The multi-tenant serving problem (ROADMAP: heavy traffic from millions
+    of users): many concurrent clients each posting a handful of values.
+    Before this scheduler, every such request exclusively claimed one of B
+    instance slots and one submit/out queue round trip, so a 64-value
+    request paid the same slot-and-queue toll as a 16k-value one and the
+    engine ran nearly empty (6% ring fill measured at 64 clients).  This
+    is the dynamic-batching layer every inference-serving stack grows:
+    coalesce what's waiting, never wait when idle.
+
+    Mechanics: callers enqueue (values, future) entries (`compute`); a
+    small pool of dispatcher workers each repeatedly packs EVERYTHING
+    currently waiting (FIFO, large entries split) into contiguous stripes
+    across free instance slots — one input-ring refill per slot — submits
+    the whole pass as ONE submission-queue entry, collects each stripe's
+    outputs in order, and scatters contiguous output segments back to
+    their entries' futures.  Per-slot FIFO plus contiguous striping makes
+    the flat input order equal the flat output order, so pairing is exact
+    by construction.
+
+    Adaptive policy, no latency tax: an idle engine dispatches the first
+    arrival immediately (a parked worker wakes on enqueue); coalescing
+    happens only while passes are in flight, because that is when entries
+    accumulate.  Knobs: MISAKA_BATCH_WINDOW_US adds an explicit coalesce
+    window while a pass is in flight (default 0 — purely adaptive),
+    MISAKA_BATCH_MAX caps values per fused pass (default: the machine,
+    B x in_cap), MISAKA_BATCH_PASSES sets the worker count (default
+    min(4, B) — enough overlap to pipeline collect against pack).
+
+    Timeouts, stale-output accounting, and epoch invalidation all ride the
+    master's existing per-slot machinery (_collect_slot): a timed-out or
+    reset-wiped pass marks its uncollected stripes stale exactly like
+    compute_spread, so a wiped request never pollutes a neighbor's pairing.
+    """
+
+    def __init__(self, master: "MasterNode", n_slots: int, in_cap: int):
+        self._master = master
+        self._n_slots = int(n_slots)
+        self._in_cap = max(1, int(in_cap))
+        self._max_values = int(
+            os.environ.get("MISAKA_BATCH_MAX", "") or 0
+        ) or self._n_slots * self._in_cap
+        self._window_s = float(
+            os.environ.get("MISAKA_BATCH_WINDOW_US", "") or 0
+        ) / 1e6
+        self._n_workers = int(
+            os.environ.get("MISAKA_BATCH_PASSES", "") or 0
+        ) or min(4, self._n_slots)
+        self._shared = _BatcherShared()
+        self._started = False
+        ref = weakref.ref(self)
+        M_SERVE_WAITING.set_function(
+            lambda: len(b._shared.pending) if (b := ref()) is not None else 0
+        )
+
+    # --- the caller side ---------------------------------------------------
+
+    def waiting_values(self) -> int:
+        """Values enqueued but not yet cut into a pass (status gauge)."""
+        with self._shared.cond:
+            return sum(e.arr.size - e.taken for e in self._shared.pending)
+
+    def compute(self, arr: np.ndarray, timeout: float) -> np.ndarray:
+        """Enqueue one request's value stream and wait for its outputs
+        (len(arr) in, len(arr) out, order preserved)."""
+        self._ensure_workers()
+        entry = _BatchEntry(arr, time.monotonic() + timeout)
+        shared = self._shared
+        master = self._master
+        with shared.cond:
+            shared.pending.append(entry)
+            shared.cond.notify()
+        with master._waiters_lock:
+            master._requests_total += 1
+        M_COMPUTE_REQS.inc()
+        M_COMPUTE_VALUES.inc(arr.size)
+        if not entry.event.wait(timeout):
+            with shared.cond:
+                entry.cancelled = True  # skip the undispatched remainder
+                missing = entry.arr.size - entry.filled
+            M_COMPUTE_TIMEOUTS.inc()
+            raise ComputeTimeout(
+                f"no output for {missing}/{entry.arr.size} value(s) "
+                f"after {timeout}s"
+            )
+        if entry.error is not None:
+            if isinstance(entry.error, ComputeTimeout):
+                M_COMPUTE_TIMEOUTS.inc()
+            raise entry.error
+        return entry.out
+
+    def _ensure_workers(self) -> None:
+        """Start the dispatcher pool on first use: tests build masters by
+        the hundred, and a master that never serves coalesced traffic must
+        not own threads."""
+        if self._started:
+            return
+        with self._shared.cond:
+            if self._started:
+                return
+            ref = weakref.ref(self)
+            for i in range(self._n_workers):
+                threading.Thread(
+                    target=_batcher_worker, args=(self._shared, ref),
+                    daemon=True, name=f"misaka-batcher-{i}",
+                ).start()
+            self._started = True
+
+    # --- the dispatcher side (worker threads) ------------------------------
+
+    def _acquire_slots(self, want: int) -> list[int]:
+        """Try-acquire up to `want` free instance slots, scanning from the
+        master's rotating start (no blocking: a pass never deadlocks
+        against direct compute_many/compute_spread callers)."""
+        master = self._master
+        n = self._n_slots
+        with master._rr_lock:
+            start = master._rr
+            master._rr = (master._rr + 1) % n
+        slots: list[int] = []
+        for i in range(n):
+            s = (start + i) % n
+            if master._compute_locks[s].acquire(blocking=False):
+                slots.append(s)
+                if len(slots) >= want:
+                    break
+        return slots
+
+    def _pass_once(self) -> None:
+        """Pack everything currently waiting into one fused pass, run it,
+        scatter the outputs.  Called from a worker thread."""
+        master = self._master
+        shared = self._shared
+        # Optional explicit coalesce window: only while another pass is in
+        # flight (an idle engine must dispatch immediately — no latency tax).
+        if self._window_s > 0:
+            with shared.cond:
+                if shared.inflight and shared.pending:
+                    deadline = time.monotonic() + self._window_s
+                    while (
+                        sum(e.arr.size - e.taken for e in shared.pending)
+                        < self._max_values
+                    ):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        shared.cond.wait(remaining)
+        with shared.cond:
+            waiting = sum(e.arr.size - e.taken for e in shared.pending)
+        if waiting <= 0:
+            return
+        want = min(
+            self._n_slots, -(-min(waiting, self._max_values) // self._in_cap)
+        )
+        slots = self._acquire_slots(want)
+        if not slots:
+            # every instance is busy (other passes or direct compute
+            # callers): wait for a release instead of spinning — pass
+            # completion notifies this condition.
+            with shared.cond:
+                if shared.pending and not shared.closed:
+                    shared.cond.wait(0.05)
+            return
+        # --- cut: FIFO segments off the waiting entries, splitting a large
+        # tail entry so the pass fills exactly what its slots can refill ---
+        budget = min(len(slots) * self._in_cap, self._max_values)
+        segs: list[tuple[_BatchEntry, int, int]] = []
+        now = time.monotonic()
+        with shared.cond:
+            while shared.pending and budget > 0:
+                e = shared.pending[0]
+                if e.cancelled:
+                    shared.pending.popleft()
+                    continue
+                take = min(budget, e.arr.size - e.taken)
+                if not e.dispatched:
+                    e.dispatched = True
+                    M_SERVE_QUEUE_DELAY.observe(now - e.enqueued)
+                segs.append((e, e.taken, take))
+                e.taken += take
+                budget -= take
+                if e.taken >= e.arr.size:
+                    shared.pending.popleft()
+            if segs:
+                shared.inflight += 1
+        if not segs:  # another worker drained the queue first
+            for s in slots:
+                master._compute_locks[s].release()
+            return
+        try:
+            self._run_pass(slots, segs)
+        finally:
+            with shared.cond:
+                shared.inflight -= 1
+                shared.cond.notify_all()  # slots freed; window waiters wake
+
+    def _run_pass(
+        self,
+        slots: list[int],
+        segs: list[tuple[_BatchEntry, int, int]],
+    ) -> None:
+        """One fused engine pass: stripe, submit, collect, scatter.
+        Releases every slot in `slots`."""
+        master = self._master
+        shared = self._shared
+        if len(segs) == 1:
+            e0, s0, ln = segs[0]
+            flat = e0.arr[s0:s0 + ln]  # zero-copy: the big-batch fast path
+        else:
+            flat = np.concatenate([e.arr[s0:s0 + ln] for e, s0, ln in segs])
+        total = int(flat.size)
+        n_used = min(len(slots), -(-total // self._in_cap))
+        used, unused = slots[:n_used], slots[n_used:]
+        for s in unused:
+            master._compute_locks[s].release()
+        stripes = np.array_split(flat, n_used)
+        M_SERVE_COALESCED_VALUES.observe(total)
+        M_SERVE_COALESCED_REQS.observe(len(segs))
+        M_SERVE_PASSES.inc()
+        deadline = max(e.deadline for e, _, _ in segs)
+        timeout_s = max(0.0, deadline - time.monotonic())
+        with master._waiters_lock:
+            master._waiters += 1
+        try:
+            with master._epoch_lock:
+                epoch = master._epoch
+                master._submit_q.put(list(zip(used, stripes)))
+            master._work_event.set()
+            parts: list[np.ndarray] = []
+            try:
+                for i, (s, stripe) in enumerate(zip(used, stripes)):
+                    parts.extend(
+                        master._collect_slot(
+                            s, stripe.size, deadline, epoch, timeout_s
+                        )
+                    )
+            except ComputeTimeout:
+                # the stripes never collected will surface outputs too —
+                # mark those slots stale so their pairing survives (the
+                # compute_spread discipline)
+                with master._epoch_lock:
+                    if master._epoch == epoch:
+                        for s2, st2 in list(zip(used, stripes))[i + 1:]:
+                            master._stale[s2] += st2.size
+                raise
+            flat_out = np.concatenate(parts)
+            # scatter-gather: per-slot FIFO + contiguous striping means the
+            # flat output order IS the flat input order — segment j's
+            # outputs are flat_out[pos_j : pos_j + len_j], exactly.
+            pos = 0
+            done: list[_BatchEntry] = []
+            with shared.cond:
+                for e, s0, ln in segs:
+                    e.out[s0:s0 + ln] = flat_out[pos:pos + ln]
+                    pos += ln
+                    e.filled += ln
+                    if e.filled >= e.arr.size:
+                        done.append(e)
+            for e in done:
+                e.event.set()
+        except Exception as exc:
+            msg = f"{exc} (coalesced pass: {len(segs)} request(s), " \
+                  f"{total} values)"
+            failed: list[_BatchEntry] = []
+            with shared.cond:
+                for e, _, _ in segs:
+                    if e.error is None:
+                        e.error = (
+                            ComputeTimeout(msg)
+                            if isinstance(exc, ComputeTimeout) else exc
+                        )
+                    # a failed entry's undispatched remainder must not keep
+                    # claiming slots and engine passes (its caller already
+                    # raised) — cancel it like a waiter timeout does
+                    e.cancelled = True
+                    failed.append(e)
+            for e in failed:
+                e.event.set()
+        finally:
+            with master._waiters_lock:
+                master._waiters -= 1
+            for s in used:
+                master._compute_locks[s].release()
 
 
 class MasterNode:
@@ -362,8 +755,39 @@ class MasterNode:
         # zero device-loop cost, and a collected master reads as 0.
         self._created_mono = time.monotonic()
         self._requests_total = 0
-        import weakref
-
+        # Loop-private per-slot in-flight value counts (fed minus drained):
+        # the native tier's partial-fill fast path ticks only slots that
+        # are fed now or still owe outputs.  Maintained solely by the
+        # device loop (and _drain_queues, which runs with the loop joined).
+        self._inflight = np.zeros((n_slots,), np.int64)
+        # Partial-fill hot set (loop-private): a replica that retired any
+        # instruction last chunk may still hold in-flight values INSIDE the
+        # network (ports/registers) even when fed-minus-drained reads 0
+        # (non-1:1 programs), so it keeps ticking until a whole chunk
+        # retires nothing.  _retired_prev=None forces one full-batch pass
+        # (boot and every lifecycle state swap).
+        self._native_hot = np.zeros((n_slots,), bool)
+        self._retired_prev: np.ndarray | None = None
+        # _build_feed's reusable buffers (loop thread only)
+        self._feed_vals: np.ndarray | None = None
+        self._feed_counts: np.ndarray | None = None
+        # Restore-flush: a checkpoint/snapshot can carry values that were
+        # in flight when it was taken; reinstalling it resurrects them,
+        # and their outputs belong to requests that no longer exist.  The
+        # device loop runs the restored network to quiescence DISCARDING
+        # outputs before it ingests new work, so an orphan can never
+        # mispair a post-restore request (see _device_loop_inner).
+        self._restore_flush = False
+        self._flush_iters = 0
+        self._flush_quiet = 0
+        # The serve scheduler (cross-request micro-batching): concurrent
+        # compute/compute_raw/compute_batch callers coalesce into fused
+        # engine passes instead of each claiming an instance slot.
+        # MISAKA_SERVE_BATCH=0 restores the direct slot-per-request
+        # behavior (MISAKA_BATCH is the instance count, app.py).
+        self._batcher = None
+        if os.environ.get("MISAKA_SERVE_BATCH", "1") != "0":
+            self._batcher = ServeBatcher(self, n_slots, self._net.in_cap)
         ref = weakref.ref(self)
         M_SUBMIT_DEPTH.set_function(
             lambda: m._submit_q.qsize() if (m := ref()) is not None else 0
@@ -749,6 +1173,16 @@ class MasterNode:
                     if remaining <= 0:
                         raise queue.Empty
                     chunk = self._out_qs[slot].get(timeout=remaining)
+                    if chunk is _WIPED:
+                        # a reset/load/restore drained the queues: nothing
+                        # further is coming for a pre-wipe request — fail
+                        # NOW instead of burning the remaining timeout.  A
+                        # sentinel from an epoch this request postdates is
+                        # stale noise; discard it.
+                        with self._epoch_lock:
+                            if self._epoch != epoch:
+                                raise queue.Empty
+                        continue
                 with self._epoch_lock:
                     if self._epoch != epoch:
                         # a reset/load wiped this request mid-collect: the
@@ -783,6 +1217,35 @@ class MasterNode:
                 f"after {timeout}s"
             )
         return parts
+
+    def compute_coalesced(
+        self, values, timeout: float = 30.0, return_array: bool = False
+    ):
+        """A value stream through the serve scheduler: len(values) in,
+        len(values) out, order preserved — and concurrent callers fuse
+        into shared engine passes (ServeBatcher).
+
+        This is the multi-tenant serving lane the HTTP surface routes
+        through: under concurrent load, many small requests pack into
+        full input-ring stripes across few instances (instead of each
+        claiming a nearly-empty slot), and the native tier's partial-fill
+        fast path then ticks only the slots actually working.  A lone
+        caller dispatches immediately (no coalesce window when the engine
+        is idle) and large streams stripe across free instances exactly
+        like compute_spread.  Falls back to compute_spread when the
+        scheduler is disabled (MISAKA_SERVE_BATCH=0).
+        """
+        arr = np.asarray(values, dtype=np.int32)
+        if arr.ndim != 1:
+            raise ValueError(f"values must be a flat sequence, got shape {arr.shape}")
+        if arr.size == 0:
+            return np.empty((0,), np.int32) if return_array else []
+        if self._batcher is None:
+            return self.compute_spread(
+                arr, timeout=timeout, return_array=return_array
+            )
+        out = self._batcher.compute(arr, timeout)
+        return out if return_array else out.tolist()
 
     def compute_spread(
         self, values, timeout: float = 30.0, return_array: bool = False
@@ -888,6 +1351,10 @@ class MasterNode:
         host_in = sum(
             len(c) for pairs in q_depth(self._submit_q) for _, c in pairs
         ) + sum(sum(len(c) for c in pend) for pend in self._in_pending)
+        if self._batcher is not None:
+            # values enqueued in the serve scheduler but not yet cut into
+            # a pass — part of the same "waiting to enter the engine" story
+            host_in += self._batcher.waiting_values()
         host_out = sum(
             sum(len(c) for c in q_depth(q)) for q in self._out_qs
         )
@@ -1038,6 +1505,10 @@ class MasterNode:
                 self._batched_serve = self._make_serve_fns(new_net, new_runner)
             self._close_runner(old_runner)
             self._drain_queues()
+            # a checkpoint can carry in-flight values; flush their orphan
+            # outputs before serving new requests (see _device_loop_inner)
+            self._flush_iters = self._flush_quiet = 0
+            self._restore_flush = True
         M_ENGINE_SWAPS.labels(reason="restore").inc()
         M_CKPT_RESTORE_SECONDS.observe(time.perf_counter() - t0)
         log.info("checkpoint restored from %s", path)
@@ -1064,7 +1535,22 @@ class MasterNode:
         than the live engine compiles for — pad it (zero slots above the
         restored tops are exactly the grown state's invariant).  Any other
         shape mismatch is rejected here instead of crashing the device loop
-        on its next chunk."""
+        on its next chunk.
+
+        A RUNNING master is paused for the swap and resumed after: the
+        drain/epoch/orphan-flush protections (a wiped request must fail,
+        a resurrected in-flight value must never mispair a later request)
+        require the device loop joined, and silently skipping them for
+        live restores would reopen exactly that pollution."""
+        with self._lifecycle_lock:
+            resume = self._running
+            if resume:
+                self.pause()
+            self._restore_locked(state)
+            if resume:
+                self.run()
+
+    def _restore_locked(self, state) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -1100,6 +1586,19 @@ class MasterNode:
                 # (the XLA engines clamp OOB indices and keep serving)
                 validate(state)
             self._state = self._shard(state)
+            # restored retired counters invalidate the partial-fill hot
+            # baseline; the next native serve pass runs full-batch
+            self._retired_prev = None
+        # Epoch-invalidate in-flight requests (the caller paused the loop):
+        # a request submitted against pre-restore state must fail as
+        # ComputeTimeout, never receive outputs derived from the snapshot's
+        # rings (cross-request pollution).  reset/load/load_checkpoint
+        # already drain; restore was the gap.
+        self._drain_queues()
+        # and flush the snapshot's resurrected in-flight values before
+        # serving anything new (see _device_loop_inner)
+        self._flush_iters = self._flush_quiet = 0
+        self._restore_flush = True
 
     # --- the device loop ----------------------------------------------------
 
@@ -1125,8 +1624,15 @@ class MasterNode:
             # lands before the drain (wiped; its waiter sees a new epoch) or
             # after (it survives into the fresh queues under the new epoch).
             self._stale = [0] * len(self._stale)
+            self._inflight[:] = 0
+            self._retired_prev = None  # next native pass runs full-batch
             self._grow_blocked = False
             self._epoch += 1
+            # Wake collectors parked on the (now empty) output queues so
+            # their requests fail immediately instead of timing out — see
+            # _collect_slot's sentinel handling.
+            for q in self._out_qs:
+                q.put(_WIPED)
 
     def _maybe_grow_stacks(self) -> None:
         """Double stack capacity when a full stack has wedged the network.
@@ -1331,16 +1837,63 @@ class MasterNode:
 
     def _build_feed(self, ctrs):
         """Cut pending submissions into a [B, in_cap] feed matrix + counts
-        (loop thread only); shared by the one-dispatch and piecewise paths."""
-        vals = np.zeros((self._batch, self._net.in_cap), np.int32)
-        counts = np.zeros((self._batch,), np.int32)
+        (loop thread only); shared by the one-dispatch and piecewise paths.
+
+        Buffers are REUSED across iterations (the engines read only the
+        counts[b] leading entries of each row, so stale bytes beyond them
+        are dead): allocating a fresh [B, in_cap] matrix per serve
+        iteration was measurable loop-thread time under load.  Only the
+        previously-used rows are re-zeroed."""
+        shape = (self._batch, self._net.in_cap)
+        if self._feed_vals is None or self._feed_vals.shape != shape:
+            self._feed_vals = np.zeros(shape, np.int32)
+            self._feed_counts = np.zeros((self._batch,), np.int32)
+        vals, counts = self._feed_vals, self._feed_counts
+        counts[:] = 0
         free = self._net.in_cap - (ctrs[1] - ctrs[0])
         for b in list(self._active):
             got = self._cut_pending(b, int(free[b]))
             if got is not None:
                 vals[b, : len(got)] = got
                 counts[b] = len(got)
+        # fed-minus-drained accounting for the native partial-fill path: a
+        # slot owes outputs until the drain loop zeroes it back out
+        self._inflight += counts
         return vals, counts
+
+    def _native_active(self, ctrs, counts=None):
+        """The native partial-fill active set for this iteration (loop
+        thread only): replica indices that are fed now, hold input-ring
+        content, owe outputs (fed minus drained), or retired instructions
+        last chunk (internal in-flight work — non-1:1 programs can owe
+        nothing by count while values still sit in ports/registers).
+        None means run the full batch: the first pass after boot or a
+        lifecycle state swap (no retired baseline yet), or an active set
+        that covers everything anyway."""
+        if self._retired_prev is None:
+            return None
+        mask = (self._inflight > 0) | (ctrs[1] > ctrs[0]) | self._native_hot
+        if counts is not None:
+            mask |= counts > 0
+        active = np.flatnonzero(mask)
+        return None if active.size >= self._n_slots else active
+
+    def _native_note_progress(self, state, active) -> None:
+        """Refresh the hot set from per-replica retired deltas after a
+        native chunk: a replica that retired nothing across a whole chunk
+        is blocked awaiting input and safe to skip until fed again."""
+        ret = np.asarray(state.retired).sum(axis=1)
+        prev = self._retired_prev
+        if prev is None or active is None:
+            # no baseline: keep everyone hot one pass so real deltas form
+            self._native_hot = (
+                ret > prev if prev is not None
+                else np.ones((self._n_slots,), bool)
+            )
+        else:
+            self._native_hot[:] = False
+            self._native_hot[active] = ret[active] > prev[active]
+        self._retired_prev = ret
 
     def _device_loop_inner(self) -> None:
         # One device counter read per iteration (post-run), reused for the
@@ -1351,9 +1904,16 @@ class MasterNode:
         while self._running:
             busy = False
             t_iter = time.perf_counter()
+            # Orphan flush after restore/load_checkpoint: run WITHOUT
+            # ingesting new work and discard everything the network emits
+            # until it goes quiet — resurrected in-flight values must
+            # never pair with a post-restore request.  New submissions
+            # wait in the queue; the flush costs a few idle chunks.
+            flushing = self._restore_flush
             with self._state_lock:
                 state = self._state
-                self._ingest_submissions()
+                if not flushing:
+                    self._ingest_submissions()
                 if self._batch is None and self._trace is None:
                     # ONE device dispatch + ONE read for the whole iteration
                     # (feed+run+counters+drain fused, engine.serve_chunk):
@@ -1394,9 +1954,24 @@ class MasterNode:
                     if self._active:
                         vals, counts = self._build_feed(ctrs)
                         fed = bool(counts.any())
+                    native = getattr(self._runner, "is_native", False)
                     if fed:
                         M_SLOT_OCCUPANCY.observe(int((counts > 0).sum()))
-                        state, packed = serve_fn(state, vals, counts)
+                        if native:
+                            # Partial-fill fast path: the host pool ticks
+                            # only slots that are fed now, hold ring
+                            # content, owe outputs, or made progress last
+                            # chunk — an underfilled pass must not pay
+                            # full-batch cost (the 64-client workload fed
+                            # ~6% of slots and paid for 100%).  First pass
+                            # after boot/lifecycle swap runs everyone.
+                            active = self._native_active(ctrs, counts)
+                            state, packed = serve_fn(
+                                state, vals, counts, active=active
+                            )
+                            self._native_note_progress(state, active)
+                        else:
+                            state, packed = serve_fn(state, vals, counts)
                         self._mark_ticks()
                         p = np.asarray(packed)  # the single device read
                         ctrs = p[:, :4].T  # the counters() orientation
@@ -1405,16 +1980,29 @@ class MasterNode:
                         )
                         busy = True
                     else:
-                        state, packed = idle_fn(state)
-                        self._mark_ticks()
-                        p = np.asarray(packed)  # [B, 4]: counters only
-                        ctrs = p.T
-                        if (p[:, 3] > p[:, 2]).any():
-                            state, per_slot = self._net.drain_batched(
-                                state, rd=p[:, 2], wr=p[:, 3]
-                            )
-                        else:
+                        active = self._native_active(ctrs) if native else None
+                        if native and active is not None and active.size == 0:
+                            # fully quiescent: no ring content, no owed
+                            # outputs, no replica that moved last chunk —
+                            # ticking cannot change anything, so skip the
+                            # engine call (an idle full-batch chunk was
+                            # ~10ms the 64-client lane paid per request)
                             per_slot = []
+                        else:
+                            if native:
+                                state, packed = idle_fn(state, active=active)
+                                self._native_note_progress(state, active)
+                            else:
+                                state, packed = idle_fn(state)
+                            self._mark_ticks()
+                            p = np.asarray(packed)  # [B, 4]: counters only
+                            ctrs = p.T
+                            if (p[:, 3] > p[:, 2]).any():
+                                state, per_slot = self._net.drain_batched(
+                                    state, rd=p[:, 2], wr=p[:, 3]
+                                )
+                            else:
+                                per_slot = []
                     self._state = state
                 else:
                     # piecewise path: tracing and mesh serving
@@ -1456,13 +2044,38 @@ class MasterNode:
                         )
                     self._state = state
             for slot, outs in per_slot:
+                if flushing:
+                    busy = True  # orphan outputs: discard, keep flushing
+                    continue
                 self._out_qs[slot].put(outs)
+                if self._inflight[slot] > 0:  # clamp: non-1:1 networks can
+                    self._inflight[slot] = max(  # over- or under-produce
+                        0, self._inflight[slot] - len(outs)
+                    )
                 busy = True
             # One observe + one labeled inc per chunk: the instrumentation
             # cost is a lock and a bisect against a chunk that advances
             # thousands of ticks — measured <<5% on the native serve path.
             M_CHUNK_SECONDS.observe(time.perf_counter() - t_iter)
             (M_ITER_SERVE if busy else M_ITER_IDLE).inc()
+            if flushing:
+                # Quiescence = several consecutive chunks with no output,
+                # an empty input ring, and (native) no replica retiring
+                # instructions.  Hard-capped so a generator network (or a
+                # wedged restore) cannot flush forever.  Residual limit:
+                # a NON-native engine whose internal value latency exceeds
+                # 8 full chunks can still leak an orphan — internal
+                # progress is invisible to the XLA engines' counters.
+                self._flush_iters += 1
+                quiet = (
+                    not busy
+                    and not bool(np.any(ctrs[1] > ctrs[0]))
+                    and not self._native_hot.any()
+                )
+                self._flush_quiet = self._flush_quiet + 1 if quiet else 0
+                if self._flush_quiet >= 8 or self._flush_iters >= 64:
+                    self._restore_flush = False
+                continue
             if busy:
                 self._stall_iters = 0
                 self._grow_blocked = False
@@ -1524,6 +2137,13 @@ def make_http_server(
     textcodec.native_available()
 
     _name_re = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+    # Request-body ceiling for the bulk lanes (default 64 MiB): an
+    # unauthenticated client must not be able to make the server buffer an
+    # arbitrarily large body (answers 413; missing Content-Length is 411).
+    max_body = int(os.environ.get("MISAKA_MAX_BODY", "") or 64 * 1024 * 1024)
+    # Serving-plane fast request parsing (see _fast_parse_request);
+    # MISAKA_FAST_HTTP=0 restores the stock stdlib parser end to end.
+    fast_http = os.environ.get("MISAKA_FAST_HTTP", "1") != "0"
     profiler = Profiler()
     boot_mono = time.monotonic()  # /healthz uptime anchor (server, not master)
 
@@ -1549,6 +2169,42 @@ def make_http_server(
         def send_response(self, code, message=None):
             self._metrics_code = code  # read by the _observed wrapper
             super().send_response(code, message)
+
+        def handle_one_request(self):
+            """The stock request loop with the serving-plane fast parser
+            (_fast_parse_request) swapped in; the stock parser remains
+            the fallback for request shapes the fast path declines.
+            MISAKA_FAST_HTTP=0 restores the stock loop outright."""
+            if not fast_http:
+                return super().handle_one_request()
+            try:
+                self.raw_requestline = self.rfile.readline(65537)
+                if len(self.raw_requestline) > 65536:
+                    self.requestline = ""
+                    self.request_version = ""
+                    self.command = ""
+                    self.send_error(414, "Request-URI Too Long")
+                    return
+                if not self.raw_requestline:
+                    self.close_connection = True
+                    return
+                parsed = _fast_parse_request(self)
+                if parsed is None:  # answered an error during parsing
+                    return
+                if not parsed and not self.parse_request():
+                    return
+                mname = "do_" + self.command
+                if not hasattr(self, mname):
+                    self.send_error(
+                        501, f"Unsupported method ({self.command!r})"
+                    )
+                    return
+                getattr(self, mname)()
+                self.wfile.flush()  # send the response, if not already done
+            except TimeoutError as e:
+                # a read or write timed out: discard this connection
+                self.log_error("Request timed out: %r", e)
+                self.close_connection = True
 
         def _observed(self, method: str, inner) -> None:
             """Per-route request counter + error counter by status code +
@@ -1674,6 +2330,7 @@ def make_http_server(
         def _handle_post(self):
             try:
                 if self.path == "/run":
+                    self._form()  # drain any body (keep-alive sync)
                     try:
                         master.run()
                     except BroadcastError as e:
@@ -1681,6 +2338,7 @@ def make_http_server(
                         return
                     self._text(200, "Success")
                 elif self.path == "/pause":
+                    self._form()  # drain any body (keep-alive sync)
                     try:
                         master.pause()
                     except BroadcastError as e:
@@ -1688,6 +2346,7 @@ def make_http_server(
                         return
                     self._text(200, "Success")
                 elif self.path == "/reset":
+                    self._form()  # drain any body (keep-alive sync)
                     try:
                         master.reset()
                     except BroadcastError as e:
@@ -1711,17 +2370,29 @@ def make_http_server(
                         return
                     self._text(200, "Success")
                 elif self.path == "/compute":
+                    # body FIRST, even on the error paths: an early return
+                    # that leaves the body unread desynchronizes a
+                    # keep-alive connection (the next request line would be
+                    # parsed out of this request's body)
+                    form = self._form()
                     if not master.is_running:
                         self._text(400, "network is not running")
                         return
-                    form = self._form()
                     try:
                         value = int(form.get("value", ""))
                     except ValueError:
                         self._text(400, "cannot parse value")
                         return
                     try:
-                        result = master.compute(value)
+                        # through the serve scheduler: concurrent /compute
+                        # callers coalesce into fused passes (MasterNode
+                        # only — the distributed control plane keeps its
+                        # per-value path)
+                        coalesced = getattr(master, "compute_coalesced", None)
+                        if coalesced is not None:
+                            result = int(coalesced([value])[0])
+                        else:
+                            result = master.compute(value)
                     except ComputeTimeout as e:
                         self._text(500, str(e))
                         return
@@ -1733,13 +2404,13 @@ def make_http_server(
                     # Body field `values`: comma/whitespace-separated ints.
                     # `spread=1` stripes the stream over free instances
                     # (order preserved) so one request can load the batch.
+                    form = self._form()  # body first (keep-alive: see /compute)
                     if not hasattr(master, "compute_many"):
                         self._text(404, "not found")  # distributed control plane
                         return
                     if not master.is_running:
                         self._text(400, "network is not running")
                         return
-                    form = self._form()
                     try:
                         # vectorized decimal parse — the per-value Python of
                         # round 2 capped this route at 859k/s (textcodec.py)
@@ -1751,7 +2422,12 @@ def make_http_server(
                         if form.get("spread") == "1" and hasattr(
                             master, "compute_spread"
                         ):
-                            result = master.compute_spread(
+                            # spread requests ride the serve scheduler
+                            # (compute_coalesced falls back to
+                            # compute_spread when MISAKA_SERVE_BATCH=0); the
+                            # unspread default keeps its documented
+                            # single-instance FIFO pinning
+                            result = master.compute_coalesced(
                                 values, return_array=True
                             )
                         else:
@@ -1773,14 +2449,40 @@ def make_http_server(
                     # Striped over free instances by default (?spread=0 to
                     # pin one instance).  This is the fleet-client surface:
                     # at engine rates the text route's encode/parse dominates.
+                    # Robust body handling for the fleet wire format: a
+                    # missing Content-Length is 411 (this surface does not
+                    # speak chunked bodies) and an oversized one is 413
+                    # against the MISAKA_MAX_BODY cap — never an unbounded
+                    # rfile.read.  Both close the connection: the unread
+                    # body would desynchronize the next keep-alive request.
+                    length_hdr = self.headers.get("Content-Length")
+                    if length_hdr is None:
+                        self.close_connection = True
+                        self._text(411, "Content-Length required")
+                        return
+                    try:
+                        length = int(length_hdr)
+                    except ValueError:
+                        self.close_connection = True
+                        self._text(400, "cannot parse Content-Length")
+                        return
+                    if length > max_body:
+                        self.close_connection = True
+                        self._text(
+                            413,
+                            f"body of {length} bytes exceeds the "
+                            f"{max_body}-byte cap (MISAKA_MAX_BODY)",
+                        )
+                        return
+                    raw = self.rfile.read(length)
+                    # post-body checks (body consumed: keep-alive stays
+                    # synchronized through these early returns)
                     if not hasattr(master, "compute_spread"):
                         self._text(404, "not found")  # distributed control plane
                         return
                     if not master.is_running:
                         self._text(400, "network is not running")
                         return
-                    length = int(self.headers.get("Content-Length") or 0)
-                    raw = self.rfile.read(length)
                     if len(raw) % 4:
                         self._text(400, "body must be raw int32 values")
                         return
@@ -1791,7 +2493,9 @@ def make_http_server(
                     }
                     try:
                         if q.get("spread", "1") == "1":
-                            result = master.compute_spread(
+                            # the serve scheduler lane (falls back to
+                            # compute_spread when MISAKA_SERVE_BATCH=0)
+                            result = master.compute_coalesced(
                                 values, return_array=True
                             )
                         else:
@@ -1804,10 +2508,10 @@ def make_http_server(
                     self._bytes(result.astype("<i4").tobytes())
                 elif self.path == "/checkpoint":
                     # additive routes: the reference cannot checkpoint
+                    name = self._form().get("name", "")  # body first
                     if not checkpoint_dir:
                         self._text(403, "checkpointing disabled (no checkpoint_dir configured)")
                         return
-                    name = self._form().get("name", "")
                     path = resolve_checkpoint(name)
                     if path is None:
                         self._text(400, "invalid checkpoint name")
@@ -1816,10 +2520,10 @@ def make_http_server(
                     master.save_checkpoint(path)
                     self._text(200, "Success")
                 elif self.path == "/restore":
+                    name = self._form().get("name", "")  # body first
                     if not checkpoint_dir:
                         self._text(403, "checkpointing disabled (no checkpoint_dir configured)")
                         return
-                    name = self._form().get("name", "")
                     path = resolve_checkpoint(name)
                     if path is None:
                         self._text(400, "invalid checkpoint name")
@@ -1833,10 +2537,10 @@ def make_http_server(
                 elif self.path == "/profile/start":
                     # additive: capture a jax.profiler trace of the live
                     # device loop (SURVEY.md §5 — the reference has nothing)
+                    name = self._form().get("name", "profile")  # body first
                     if not profile_dir:
                         self._text(403, "profiling disabled (no profile_dir configured)")
                         return
-                    name = self._form().get("name", "profile")
                     if not _name_re.match(name) or ".." in name:
                         self._text(400, "invalid profile name")
                         return
@@ -1858,10 +2562,14 @@ def make_http_server(
                         return
                     self._text(200, out)
                 else:
+                    # unknown POST: the body (arbitrary size) is unread —
+                    # close instead of desynchronizing the connection
+                    self.close_connection = True
                     self._text(404, "not found")
             except Exception as e:  # defensive: a handler crash must not kill the server
                 log.exception("handler error")
                 try:
+                    self.close_connection = True  # request state unknown
                     self._text(500, f"internal error: {e}")
                 except Exception:
                     pass

@@ -86,8 +86,15 @@ struct Interp {
     return &code[(size_t)(lane * max_len + pc[lane]) * NFIELDS];
   }
 
-  void tick() {
+  // Returns whether the tick made ANY progress (a port consume or an
+  // instruction commit).  The network is deterministic, so a zero-progress
+  // tick proves every later tick is an identity step too — interp_run uses
+  // that to stop early on a quiescent/blocked network (the serving chunk is
+  // sized for throughput, 2048 ticks, while a typical request drains in a
+  // few hundred; the tail used to be pure waste on the partial-fill path).
+  bool tick() {
     const int n = n_lanes;
+    bool progressed = false;
 
     // phase A: consume ready port sources into the hold latch
     for (int l = 0; l < n; ++l) {
@@ -98,6 +105,7 @@ struct Interp {
           hold_val[l] = port_val[l * kPorts + p];
           holding[l] = 1;
           port_full[l * kPorts + p] = 0;
+          progressed = true;
         }
       }
     }
@@ -206,6 +214,7 @@ struct Interp {
                          op == OP_IN || op == OP_OUT;
       bool commit = needs_grant ? granted[l] : src_ok[l];
       if (!commit) continue;
+      progressed = true;
       int32_t ln = prog_len[l];
       switch (op) {
         case OP_MOV_LOCAL:
@@ -270,6 +279,7 @@ struct Interp {
       out_wr += 1;
     }
     tick_count = i32((int64_t)tick_count + 1);  // wrap-safe, like retired
+    return progressed;
   }
 };
 
@@ -347,7 +357,16 @@ int interp_feed(Interp* it, const int32_t* values, int count) {
 }
 
 void interp_run(Interp* it, int ticks) {
-  for (int i = 0; i < ticks; ++i) it->tick();
+  for (int i = 0; i < ticks; ++i) {
+    if (!it->tick()) {
+      // Quiescent: the remaining ticks are identity steps except the tick
+      // counter — add them in one wrap-safe step so the exported state
+      // stays BIT-IDENTICAL to the fixed-length XLA chunk (the
+      // differential suites pin native vs jitted state equality).
+      it->tick_count = i32((int64_t)it->tick_count + (ticks - 1 - i));
+      break;
+    }
+  }
   // Rebase ring counters below the int32 wrap at the chunk boundary, exactly
   // like the device engines (core/state.py rebase_rings): a multiple of the
   // ring capacity preserves slot indices and occupancy.
@@ -473,6 +492,13 @@ struct Pool {
     int ticks = 0;
     bool feeding = false;
     int32_t* packed = nullptr;  // [B, 4+out_cap] serve / [B, 4] idle
+    // Partial-fill fast path: when non-null, ONLY these replica indices
+    // (strictly increasing, validated at the entry point) are imported,
+    // fed, run, and exported — an underfilled serve pass pays for the
+    // replicas actually working, not the whole batch.  The Python caller
+    // prefills skipped replicas' packed rows from their current counters.
+    const int32_t* active = nullptr;
+    int n_active = 0;
   };
 
   std::vector<Interp*> replicas;
@@ -509,8 +535,11 @@ struct Pool {
         if (shutdown) return;
         seen = job_id;
       }
-      const int n = (int)replicas.size();
-      for (int r; (r = next.fetch_add(1)) < n;) rep_rc[r] = serve_replica(r);
+      const int n = job.active ? job.n_active : (int)replicas.size();
+      for (int r; (r = next.fetch_add(1)) < n;) {
+        const int rep = job.active ? job.active[r] : r;
+        rep_rc[rep] = serve_replica(rep);
+      }
       {
         std::lock_guard<std::mutex> lk(mu);
         if (++done_threads == (int)workers.size()) cv_done.notify_all();
@@ -571,6 +600,21 @@ struct Pool {
   }
 
   int run_job() {
+    const int n = job.active ? job.n_active : (int)replicas.size();
+    // Serial fast path: a small pass (the partial-fill serving case — a
+    // few coalesced slots out of thousands) runs on the CALLING thread.
+    // The parallel path costs a notify_all + done-barrier round trip
+    // across every worker (~0.3-0.5ms of futex churn on a 24-thread
+    // pool), which dwarfs the work itself below a handful of replicas.
+    if (n <= 4) {
+      int rc = 0;
+      for (int i = 0; i < n; ++i) {
+        const int rep = job.active ? job.active[i] : i;
+        const int r = serve_replica(rep);
+        if (r != 0 && rc == 0) rc = r;  // lowest index first by iteration
+      }
+      return rc;
+    }
     {
       std::lock_guard<std::mutex> lk(mu);
       next.store(0);
@@ -719,18 +763,30 @@ int misaka_pool_threads(void* h) { return (int)((Pool*)h)->workers.size(); }
 // One batched serve (feed_counts non-null) or idle (both feed pointers null)
 // iteration across every replica.  State arrays are batch-major [B, ...];
 // counters is [B, 5]; packed is [B, 4+out_cap] when feeding, [B, 4] idle.
-// Returns 0, or -1 (some replica's state slice failed import validation) or
-// -2 (a feed exceeded the ring's free space); on error surviving replicas
-// still round-tripped their slices unchanged-or-served, so the caller must
-// treat the whole call as failed.
+// `active` (may be null = all) restricts the pass to a strictly-increasing
+// list of replica indices — the partial-fill fast path; skipped replicas'
+// state slices and packed rows are never touched (the caller prefills the
+// rows).  Returns 0, or -1 (some replica's state slice failed import
+// validation), -2 (a feed exceeded the ring's free space), or -3 (invalid
+// active list); on error surviving replicas still round-tripped their
+// slices unchanged-or-served, so the caller must treat the whole call as
+// failed.
 int misaka_pool_serve(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
                       int32_t* port_val, uint8_t* port_full, int32_t* hold_val,
                       uint8_t* holding, int32_t* stack_mem, int32_t* stack_top,
                       int32_t* in_buf, int32_t* out_buf, int32_t* counters,
                       int32_t* retired, int32_t* acc_hi, int32_t* bak_hi,
                       const int32_t* feed_vals, const int32_t* feed_counts,
-                      int ticks, int32_t* packed) {
+                      int ticks, const int32_t* active, int n_active,
+                      int32_t* packed) {
   auto* p = (Pool*)h;
+  if (active != nullptr) {
+    if (n_active < 0 || n_active > (int)p->replicas.size()) return -3;
+    for (int i = 0; i < n_active; ++i) {
+      if (active[i] < 0 || active[i] >= (int)p->replicas.size()) return -3;
+      if (i > 0 && active[i] <= active[i - 1]) return -3;  // dupes would race
+    }
+  }
   Pool::Job& j = p->job;
   j.acc = acc;
   j.bak = bak;
@@ -752,6 +808,8 @@ int misaka_pool_serve(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
   j.ticks = ticks;
   j.feeding = feed_counts != nullptr;
   j.packed = packed;
+  j.active = active;
+  j.n_active = n_active;
   return p->run_job();
 }
 

@@ -80,6 +80,99 @@ def test_lifecycle_fuzz(seed):
     lifecycle_fuzz(seed)
 
 
+@pytest.mark.parametrize("engine", ["scan", "native"])
+def test_concurrent_compute_races_lifecycle(engine, tmp_path):
+    """N threads of small mixed compute/compute_coalesced requests racing
+    reset/load/restore mid-flight (the r8 serve-scheduler concurrency
+    lane): every completed request must return EXACTLY its own outputs
+    (input/output pairing, zero cross-request leakage), and a request
+    wiped by a lifecycle op must fail as ComputeTimeout without
+    poisoning any later request's pairing."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from misaka_tpu.runtime.master import ComputeTimeout
+
+    if engine == "native":
+        from misaka_tpu.core import native_serve
+
+        if not native_serve.available():
+            pytest.skip("no C++ toolchain for the native engine")
+    m = MasterNode(networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+                   chunk_steps=16, batch=4, engine=engine)
+    m.run()
+    # delta is ONLY mutated with the compute threads quiescent?  No — the
+    # whole point is racing /load.  A request in flight across a /load may
+    # legally compute under either program, so workers accept BOTH deltas
+    # current at submit and at completion (the set of loaded ks is small).
+    deltas = {2}
+    deltas_lock = threading.Lock()
+    stop = threading.Event()
+    failures = []
+
+    def worker(i):
+        rng = np.random.default_rng(1000 + i)
+        while not stop.is_set():
+            n = int(rng.integers(1, 7))
+            vals = rng.integers(-1000, 1000, size=n).astype(np.int32)
+            with deltas_lock:
+                ok_deltas = set(deltas)
+            try:
+                if int(rng.integers(2)):
+                    out = m.compute_coalesced(vals, timeout=15,
+                                              return_array=True)
+                else:
+                    out = np.asarray(
+                        m.compute_many(vals, timeout=15), np.int32
+                    )
+            except ComputeTimeout:
+                continue  # wiped by a lifecycle op: isolation, not failure
+            with deltas_lock:
+                ok_deltas |= set(deltas)
+            if not any(
+                np.array_equal(out, vals + d) for d in ok_deltas
+            ):
+                failures.append((i, vals.tolist(), out.tolist(),
+                                 sorted(ok_deltas)))
+                stop.set()
+                return
+
+    workers = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in workers:
+        t.start()
+    rng = np.random.default_rng(99)
+    snap = None
+    try:
+        for _ in range(10):
+            time.sleep(0.15)
+            op = int(rng.integers(4))
+            if op == 0:
+                m.reset()
+                m.run()
+            elif op == 1:
+                k = int(rng.integers(1, 10))
+                m.load("misaka1", _m1_program(k))
+                with deltas_lock:
+                    deltas.add(k + 1)
+                m.run()
+            elif op == 2:
+                m.pause()
+                snap = m.snapshot()
+                m.run()
+            elif snap is not None:
+                m.pause()
+                m.restore(snap)
+                m.run()
+    finally:
+        stop.set()
+        for t in workers:
+            t.join(30)
+        m.pause()
+    assert not failures, failures[:3]
+
+
 def test_lifecycle_fuzz_checkpoint_roundtrip(tmp_path):
     # checkpoint mid-fuzz and resume on a FRESH master with the OTHER engine
     from misaka_tpu.core import native_serve

@@ -1,0 +1,434 @@
+"""The r8 serving plane: cross-request micro-batching + frontends.
+
+Covers the ServeBatcher (exact FIFO pairing under concurrency, entry
+splitting, epoch invalidation, the MISAKA_SERVE_BATCH=0 fallback), the
+native partial-fill fast path (active-subset parity against a full-batch
+pass), the HTTP robustness satellites (411/413, keep-alive
+desynchronization), the pooled client transport, and the multi-process
+frontend tier driven in-process (PlaneClient + ComputePlane + frontend
+HTTP server threads — no subprocesses, so the lane stays fast).
+"""
+
+import http.client
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.runtime.master import ComputeTimeout, MasterNode, make_http_server
+
+
+def _master(batch=4, engine="scan", **kw):
+    return MasterNode(
+        networks.add2(in_cap=16, out_cap=16, stack_cap=16),
+        chunk_steps=32, batch=batch, engine=engine, **kw,
+    )
+
+
+def _native_or_skip():
+    from misaka_tpu.core import native_serve
+
+    if not native_serve.available():
+        pytest.skip("no C++ toolchain for the native engine")
+
+
+# --- ServeBatcher correctness ----------------------------------------------
+
+
+@pytest.mark.parametrize("batch", [None, 4])
+def test_coalesced_exact_pairing_concurrent(batch):
+    m = _master(batch=batch)
+    m.run()
+    try:
+        results = {}
+
+        def worker(i):
+            rng = np.random.default_rng(i)
+            out = []
+            for _ in range(6):
+                vals = rng.integers(-1000, 1000, size=int(rng.integers(1, 9)))
+                got = m.compute_coalesced(vals.astype(np.int32))
+                out.append(got == [int(v) + 2 for v in vals])
+            results[i] = all(out)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(results.values()), results
+    finally:
+        m.pause()
+
+
+def test_coalesced_large_entry_splits_across_passes():
+    # bigger than the whole machine's one-refill capacity (4 slots x 16):
+    # the scheduler must split it over multiple passes, order preserved
+    m = _master(batch=4)
+    m.run()
+    try:
+        vals = np.arange(500, dtype=np.int32)
+        out = m.compute_coalesced(vals, timeout=60, return_array=True)
+        np.testing.assert_array_equal(out, vals + 2)
+    finally:
+        m.pause()
+
+
+def test_coalesced_empty_and_validation():
+    m = _master(batch=2)
+    try:
+        assert m.compute_coalesced([]) == []
+        with pytest.raises(ValueError):
+            m.compute_coalesced([[1, 2], [3, 4]])
+    finally:
+        m.pause()
+
+
+def test_serve_batch_disabled_falls_back(monkeypatch):
+    monkeypatch.setenv("MISAKA_SERVE_BATCH", "0")
+    m = _master(batch=2)
+    assert m._batcher is None
+    m.run()
+    try:
+        assert m.compute_coalesced([5, 6]) == [7, 8]  # compute_spread path
+    finally:
+        m.pause()
+
+
+def test_reset_fails_inflight_request_promptly():
+    # a paused network holds the request in flight; reset must fail it in
+    # well under the request timeout (the _WIPED sentinel), and the next
+    # request must compute cleanly (no stale pairing pollution)
+    import time
+
+    m = _master(batch=2)
+    m.run()
+    m.compute_coalesced([1])
+    m.pause()
+    errs = []
+
+    def waiter():
+        t0 = time.monotonic()
+        try:
+            m.compute_coalesced([1, 2, 3], timeout=20)
+        except ComputeTimeout:
+            errs.append(time.monotonic() - t0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    m.reset()
+    t.join(10)
+    assert errs and errs[0] < 5, errs
+    m.run()
+    try:
+        assert m.compute_coalesced([9]) == [11]
+    finally:
+        m.pause()
+
+
+def test_coalesced_on_native_engine():
+    _native_or_skip()
+    m = _master(batch=8, engine="native")
+    m.run()
+    try:
+        results = {}
+
+        def worker(i):
+            vals = np.arange(i * 10, i * 10 + 7, dtype=np.int32)
+            results[i] = m.compute_coalesced(vals, return_array=True)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i in range(12):
+            np.testing.assert_array_equal(
+                results[i], np.arange(i * 10, i * 10 + 7) + 2
+            )
+    finally:
+        m.pause()
+
+
+# --- native partial fill ----------------------------------------------------
+
+
+def test_native_pool_active_subset_parity():
+    """A partial-fill pass must serve the active rows EXACTLY like a
+    full-batch pass does, and leave the skipped rows' state untouched."""
+    _native_or_skip()
+    from misaka_tpu.core.native_serve import NativeServePool
+
+    net = networks.add2(in_cap=16, out_cap=16, stack_cap=8).compile(batch=8)
+    pool_a = NativeServePool(net, chunk_steps=64)
+    pool_b = NativeServePool(net, chunk_steps=64)
+    try:
+        vals = np.zeros((8, 16), np.int32)
+        counts = np.zeros((8,), np.int32)
+        vals[2, :5] = np.arange(5)
+        vals[5, :3] = np.arange(100, 103)
+        counts[2], counts[5] = 5, 3
+        active = np.array([2, 5], np.int32)
+        sa, pa = pool_a.serve(net.init_state(), vals, counts, active=active)
+        sb, pb = pool_b.serve(net.init_state(), vals, counts)
+        # packed rows identical on the served rows; counters identical on
+        # the skipped ones (freshly-initialized rings are all zeros)
+        np.testing.assert_array_equal(pa[[2, 5]], pb[[2, 5]])
+        np.testing.assert_array_equal(pa[:, :4], pb[:, :4])
+        for f in ("acc", "pc", "in_rd", "in_wr", "out_rd", "out_wr"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(sa, f))[[2, 5]],
+                np.asarray(getattr(sb, f))[[2, 5]],
+                err_msg=f,
+            )
+        # skipped rows did not tick
+        assert (np.asarray(sa.tick)[[0, 1, 3, 4, 6, 7]] == 0).all()
+    finally:
+        pool_a.close()
+        pool_b.close()
+
+
+def test_native_pool_active_must_cover_fed_rows():
+    _native_or_skip()
+    from misaka_tpu.core.native_serve import NativeServePool
+
+    net = networks.add2(in_cap=16, out_cap=16, stack_cap=8).compile(batch=4)
+    pool = NativeServePool(net, chunk_steps=32)
+    try:
+        vals = np.zeros((4, 16), np.int32)
+        counts = np.zeros((4,), np.int32)
+        counts[3] = 1
+        with pytest.raises(ValueError, match="active must cover"):
+            pool.serve(
+                net.init_state(), vals, counts,
+                active=np.array([0], np.int32),
+            )
+        with pytest.raises(ValueError, match="strictly increasing"):
+            pool.serve(
+                net.init_state(), vals, counts,
+                active=np.array([3, 3], np.int32),
+            )
+    finally:
+        pool.close()
+
+
+# --- HTTP surface: robustness + keep-alive ---------------------------------
+
+
+@pytest.fixture
+def served():
+    m = _master(batch=4)
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield m, httpd.server_address[1]
+    finally:
+        m.pause()
+        httpd.shutdown()
+
+
+def test_compute_raw_411_and_413(served, monkeypatch):
+    m, port = served
+    m.run()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    # missing Content-Length: 411 (and the server closes the connection)
+    conn.putrequest("POST", "/compute_raw?spread=1")
+    conn.endheaders()
+    resp = conn.getresponse()
+    assert resp.status == 411
+    resp.read()
+    conn.close()
+    # oversized declared body: 413 against the MISAKA_MAX_BODY cap
+    monkeypatch.setenv("MISAKA_MAX_BODY", "1024")
+    httpd2 = make_http_server(m, port=0)
+    threading.Thread(target=httpd2.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd2.server_address[1], timeout=10
+        )
+        conn.putrequest("POST", "/compute_raw?spread=1")
+        conn.putheader("Content-Length", "2048")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        assert b"MISAKA_MAX_BODY" in resp.read()
+        conn.close()
+    finally:
+        httpd2.shutdown()
+
+
+def test_keep_alive_survives_error_responses(served):
+    """Early-return error paths must consume the request body: on a
+    keep-alive connection an unread body desynchronizes every later
+    request (found by the r8 pooled client; urllib's Connection: close
+    had been masking it)."""
+    m, port = served  # network NOT running: /compute answers 400
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", "/compute", b"value=1")
+    r = conn.getresponse()
+    assert r.status == 400 and b"not running" in r.read()
+    # same connection must still speak clean HTTP
+    m.run()
+    conn.request("POST", "/compute", b"value=5")
+    r = conn.getresponse()
+    assert r.status == 200 and b'"value": 7' in r.read()
+    # raw lane over the same connection too
+    vals = np.arange(8, dtype=np.int32)
+    conn.request("POST", "/compute_raw?spread=1", vals.astype("<i4").tobytes())
+    r = conn.getresponse()
+    assert r.status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(r.read(), dtype="<i4"), vals + 2
+    )
+    conn.close()
+
+
+def test_fast_parser_matches_stock(served):
+    """The serving-plane parser and the stock parser must answer the
+    byte-compatible routes identically (urllib exercises close-mode,
+    http.client exercises keep-alive)."""
+    m, port = served
+    m.run()
+    base = f"http://127.0.0.1:{port}"
+    req = urllib.request.Request(
+        base + "/compute", data=b"value=3", method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.read() == b'{"value": 5}\n'
+    with urllib.request.urlopen(base + "/status", timeout=10) as resp:
+        assert b'"running": true' in resp.read()
+
+
+# --- pooled client ----------------------------------------------------------
+
+
+def test_client_pools_and_reconnects(served):
+    from misaka_tpu.client import MisakaClient
+
+    m, port = served
+    m.run()
+    client = MisakaClient(f"http://127.0.0.1:{port}", timeout=15)
+    assert client.compute(1) == 3
+    assert len(client._pool) == 1  # connection returned to the pool
+    pooled = client._pool[0]
+    assert client.compute(2) == 4
+    assert client._pool[0] is pooled  # and reused
+    # a dead pooled socket must reconnect cleanly (shutdown produces the
+    # EPIPE/RemoteDisconnected shape a server-side drop produces; a
+    # garbled mid-response failure must NOT retry — see client._request)
+    import socket as _socket
+
+    pooled.sock.shutdown(_socket.SHUT_RDWR)
+    assert client.compute(3) == 5
+    out = client.compute_raw(np.arange(16, dtype=np.int32))
+    np.testing.assert_array_equal(out, np.arange(16) + 2)
+    client.close()
+    assert client._pool == []
+
+
+# --- the frontend tier (in-process) ----------------------------------------
+
+
+@pytest.fixture
+def frontend(tmp_path):
+    from misaka_tpu.runtime import frontends
+
+    m = _master(batch=4)
+    engine_httpd = make_http_server(m, port=0)
+    threading.Thread(target=engine_httpd.serve_forever, daemon=True).start()
+    plane_path = str(tmp_path / "plane.sock")
+    plane = frontends.start_compute_plane(m, plane_path)
+    fe = frontends.make_frontend_server(
+        0, f"http://127.0.0.1:{engine_httpd.server_address[1]}",
+        plane_path, plane_conns=2,
+    )
+    threading.Thread(target=fe.serve_forever, daemon=True).start()
+    try:
+        yield m, fe.server_address[1]
+    finally:
+        m.pause()
+        fe.shutdown()
+        plane.close()
+        engine_httpd.shutdown()
+
+
+def test_frontend_compute_routes_and_proxy(frontend):
+    m, port = frontend
+    m.run()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    # hot raw lane via the compute plane
+    vals = np.arange(32, dtype=np.int32)
+    conn.request("POST", "/compute_raw?spread=1", vals.astype("<i4").tobytes())
+    r = conn.getresponse()
+    assert r.status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(r.read(), dtype="<i4"), vals + 2
+    )
+    # hot scalar lane, byte-compatible body
+    conn.request("POST", "/compute", b"value=5")
+    r = conn.getresponse()
+    assert r.status == 200 and r.read() == b'{"value": 7}\n'
+    # proxied routes reach the engine
+    conn.request("GET", "/status")
+    r = conn.getresponse()
+    assert r.status == 200 and b'"running": true' in r.read()
+    conn.request("GET", "/healthz")
+    r = conn.getresponse()
+    assert r.status == 200 and b'"ok": true' in r.read()
+    # proxied lifecycle: pause through the public port
+    conn.request("POST", "/pause", b"")
+    r = conn.getresponse()
+    assert r.status == 200 and r.read() == b"Success"
+    assert not m.is_running
+    # error shape for the raw lane when paused (exact route body)
+    conn.request("POST", "/compute_raw?spread=1", vals.astype("<i4").tobytes())
+    r = conn.getresponse()
+    assert r.status == 400 and b"network is not running" in r.read()
+    conn.close()
+
+
+def test_frontend_411_and_spread0_proxy(frontend):
+    m, port = frontend
+    m.run()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.putrequest("POST", "/compute_raw?spread=1")
+    conn.endheaders()
+    r = conn.getresponse()
+    assert r.status == 411
+    r.read()
+    conn.close()
+    # spread=0 (pinned single-instance FIFO) proxies to the engine
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    vals = np.arange(8, dtype=np.int32)
+    conn.request("POST", "/compute_raw?spread=0", vals.astype("<i4").tobytes())
+    r = conn.getresponse()
+    assert r.status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(r.read(), dtype="<i4"), vals + 2
+    )
+    conn.close()
+
+
+# --- metrics ----------------------------------------------------------------
+
+
+def test_serve_scheduler_metrics_move():
+    from misaka_tpu.utils import metrics
+
+    def snap():
+        return metrics.parse_text(metrics.render())
+
+    before = snap()
+    m = _master(batch=4)
+    m.run()
+    try:
+        m.compute_coalesced(list(range(10)))
+    finally:
+        m.pause()
+    delta = metrics.delta(before, snap())
+    assert delta.get("misaka_serve_passes_total", 0) >= 1
+    assert delta.get("misaka_serve_coalesced_values_sum", 0) >= 10
+    assert delta.get("misaka_serve_queue_delay_seconds_count", 0) >= 1
