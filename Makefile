@@ -81,6 +81,17 @@ bench-smoke:
 metrics-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python tools/metrics_smoke.py
 
+# Fault-tolerance tripwire (~10s): the fast chaos lane, driven through the
+# MISAKA_FAULTS harness (utils/faults.py) — durable-checkpoint rejection of
+# torn/corrupt files, crash-mid-save atomicity, auto-checkpoint rotation +
+# fallback restore, RPC backoff policy, frontend-supervisor respawn and
+# crash-loop circuit breaker.  The multi-second kill-9-under-load and
+# dead-peer recovery scenarios are marked slow (the test-all lane runs
+# them).  docs/ARCHITECTURE.md "Fault tolerance" describes the contracts.
+chaos-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python -m pytest tests/test_chaos.py -q -m "not slow" -p no:cacheprovider
+
 # Replay the committed parity corpus (tests/corpus/parity/) against the
 # ACTUAL Go reference binary via its own Dockerfile — the SURVEY.md §4
 # check.  Skips cleanly (exit 0) where Docker is unavailable (here); the
@@ -113,4 +124,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke chaos-smoke parity-go parity-local parity-corpus stop clean

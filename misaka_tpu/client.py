@@ -62,17 +62,26 @@ class MisakaClient:
 
     def __init__(self, base_url: str = "http://localhost:8000",
                  timeout: float = 30.0, pool_size: int = 4,
-                 retry_stale: bool = True):
+                 retry_stale: bool = True, connect_retries: int = 3):
         """`retry_stale` (default True) replays a request ONCE when a
         POOLED connection proves dead at send time or before any
         response byte arrives — the stale-keep-alive case.  This is
         at-least-once: in the rare window where the server executed the
         request and died before writing a byte, the replay executes it
         twice.  Pass False for strict at-most-once (stale pooled sockets
-        then surface as URLError and the caller decides)."""
+        then surface as URLError and the caller decides).
+
+        `connect_retries` (default 3) retries a request whose FRESH
+        connection was refused outright — the server-restarting window
+        (a supervisor respawning a frontend worker, a rolling deploy) —
+        with exponential backoff (0.1s doubling, jittered).  Distinct
+        from `retry_stale` and always safe: connection refused means the
+        kernel rejected the dial, so nothing was ever sent to execute.
+        Pass 0 to surface the first refusal as URLError immediately."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.retry_stale = bool(retry_stale)
+        self.connect_retries = max(0, int(connect_retries))
         split = urllib.parse.urlsplit(self.base_url)
         if split.scheme not in ("http", ""):
             raise ValueError(
@@ -126,6 +135,7 @@ class MisakaClient:
             # the server's bulk lanes answer 411 without a length;
             # http.client sets it for bytes bodies, but be explicit
             headers["Content-Length"] = str(len(data))
+        refused = 0
         while True:
             conn, reused = self._checkout()
             try:
@@ -144,6 +154,24 @@ class MisakaClient:
                     # other failure shape (e.g. a garbled partial status
                     # line) may mean a response was in flight — never
                     # replay those.
+                    continue
+                if (
+                    not reused
+                    and isinstance(e, ConnectionRefusedError)
+                    and refused < self.connect_retries
+                ):
+                    # fresh dial refused: the server-restarting window.
+                    # Nothing was sent, so retrying is exactly-once safe;
+                    # back off exponentially to ride out the respawn (see
+                    # __init__'s connect_retries).  Lazy import: the
+                    # shared policy module is stdlib-only, but the happy
+                    # path shouldn't even pay the import.
+                    import time
+
+                    from misaka_tpu.utils.backoff import Backoff
+
+                    time.sleep(Backoff(base=0.1, cap=2.0).delay_for(refused))
+                    refused += 1
                     continue
                 raise urllib.error.URLError(e) from e
             try:

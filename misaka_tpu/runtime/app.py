@@ -63,7 +63,21 @@ so the master's env surface is what survives:
   MISAKA_CHECKPOINT_DIR  enable HTTP /checkpoint & /restore, storing named
                    .npz snapshots in this directory (disabled when unset;
                    fused master only — per-process nodes hold their own
-                   state, which the distributed master cannot snapshot)
+                   state, which the distributed master cannot snapshot).
+                   Every save is durable: tmp + fsync + atomic replace,
+                   plus a size/sha256 manifest that load verifies — a
+                   torn or corrupt file is rejected, never installed
+  MISAKA_AUTOCKPT  N > 0: snapshot the live state into
+                   MISAKA_CHECKPOINT_DIR every N seconds as auto-*.npz,
+                   keeping the newest MISAKA_AUTOCKPT_KEEP (default 4);
+                   at boot the newest VALID auto snapshot is restored
+                   automatically (corrupt ones are skipped, falling back
+                   to older snapshots) — crash recovery without operator
+                   intervention
+  MISAKA_FAULTS    chaos harness (utils/faults.py): arm named fault
+                   points, e.g. "worker_exit=2,ckpt_torn_write=0.5,
+                   rpc_drop@0.01" — `make chaos-smoke` drives the
+                   recovery paths with it; leave unset in production
   MISAKA_TRACE_CAP enable the per-lane instruction trace ring (core/trace.py)
                    with this many ticks of history; decoded listings served
                    at GET /trace?last=N (disabled when unset; debug path —
@@ -191,13 +205,18 @@ def _serve_http(
             "MISAKA_PLANE_SOCKET", f"/tmp/misaka-plane-{os.getpid()}.sock"
         )
         plane = frontends.start_compute_plane(master, plane_path)
-        procs = frontends.spawn_frontends(
+        # Supervised worker pool (not bare spawn_frontends): a dead worker
+        # is respawned with backoff, a crash loop trips a circuit breaker,
+        # and the pool's health rides /healthz + /status (the server reads
+        # the misaka_supervisor attribute) — a shrunk pool is never silent.
+        supervisor = frontends.FrontendSupervisor(
             workers, port, f"http://127.0.0.1:{engine_port}", plane_path,
             plane_conns=int(environ.get("MISAKA_PLANE_CONNS", "2")),
         )
+        server.misaka_supervisor = supervisor
         log_.info(
-            "engine http on 127.0.0.1:%d; %d frontend workers on :%d "
-            "(plane %s)", engine_port, workers, port, plane_path,
+            "engine http on 127.0.0.1:%d; %d supervised frontend workers "
+            "on :%d (plane %s)", engine_port, workers, port, plane_path,
         )
         try:
             server.serve_forever()
@@ -205,8 +224,7 @@ def _serve_http(
             master.pause()
             sys.exit(0)
         finally:
-            for p in procs:
-                p.terminate()
+            supervisor.close()
             plane.close()
         return
     server = make_http_server(
@@ -313,14 +331,46 @@ def main() -> None:
             stack_autogrow=environ.get("MISAKA_STACK_AUTOGROW", "1") != "0",
         )
         install_guards(master.pause, environ, start_ppid=_PPID_AT_START)
+        log_ = logging.getLogger("misaka_tpu.app")
+        checkpoint_dir = environ.get("MISAKA_CHECKPOINT_DIR")
+        autockpt_s = float(environ.get("MISAKA_AUTOCKPT", "0") or 0)
+        autockpt = None
+        if autockpt_s > 0 and not checkpoint_dir:
+            raise SystemExit(
+                "MISAKA_AUTOCKPT requires MISAKA_CHECKPOINT_DIR (snapshots "
+                "need a directory to rotate in)"
+            )
+        if autockpt_s > 0:
+            # Crash recovery BEFORE any traffic or autorun: install the
+            # newest auto snapshot that passes the durability gate,
+            # falling back across torn/corrupt ones (runtime/master.py
+            # AutoCheckpointer) — then keep snapshotting on the interval.
+            from misaka_tpu.runtime.master import AutoCheckpointer
+
+            restored = AutoCheckpointer.restore_latest(master, checkpoint_dir)
+            if restored:
+                log_.info("auto-restored checkpoint %s", restored)
+            else:
+                log_.info(
+                    "no valid auto checkpoint under %s; fresh state",
+                    checkpoint_dir,
+                )
+            autockpt = AutoCheckpointer(
+                master, checkpoint_dir, autockpt_s,
+                keep=int(environ.get("MISAKA_AUTOCKPT_KEEP", "4")),
+            )
         if environ.get("MISAKA_AUTORUN") == "1":
             master.run()
-        _serve_http(
-            master,
-            environ,
-            checkpoint_dir=environ.get("MISAKA_CHECKPOINT_DIR"),
-            profile_dir=environ.get("MISAKA_PROFILE_DIR"),
-        )
+        try:
+            _serve_http(
+                master,
+                environ,
+                checkpoint_dir=checkpoint_dir,
+                profile_dir=environ.get("MISAKA_PROFILE_DIR"),
+            )
+        finally:
+            if autockpt is not None:
+                autockpt.close()
     else:
         raise SystemExit(f"'{node_type}' not a valid node type")
 

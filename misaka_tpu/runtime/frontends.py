@@ -48,9 +48,25 @@ import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from misaka_tpu.utils import faults
+from misaka_tpu.utils import metrics
+from misaka_tpu.utils.backoff import Backoff
 from misaka_tpu.utils.httpfast import fast_parse_request
 
 log = logging.getLogger("misaka_tpu.frontends")
+
+M_FE_RESTARTS = metrics.counter(
+    "misaka_frontend_restarts_total",
+    "Frontend worker processes respawned by the supervisor",
+)
+M_FE_ALIVE = metrics.gauge(
+    "misaka_frontend_workers_alive",
+    "Frontend worker processes currently alive (live supervisor)",
+)
+M_FE_CONFIGURED = metrics.gauge(
+    "misaka_frontend_workers_configured",
+    "Frontend worker processes the pool is configured for (live supervisor)",
+)
 
 # Compute-plane wire format (unix SOCK_STREAM, one frame in flight per
 # connection — pipelining comes from running several connections):
@@ -559,6 +575,17 @@ def frontend_main(argv=None) -> int:
     # Many small handler threads sharing this worker's GIL: the default
     # 5ms switch interval turns response waves into convoys.
     sys.setswitchinterval(0.001)
+    exit_after = faults.fire("worker_exit")
+    if exit_after is not None:
+        # chaos harness (utils/faults.py): hard-exit this worker after N
+        # seconds, exactly the failure the supervisor must absorb — the
+        # kill(9)-without-kill lever `make chaos-smoke` pulls
+        def _fault_exit(delay=max(0.0, exit_after)):
+            time.sleep(delay)
+            log.warning("worker_exit fault fired; frontend hard-exiting")
+            os._exit(1)
+
+        threading.Thread(target=_fault_exit, daemon=True).start()
     if args.parent_pid:
         def _watch_parent(pid=args.parent_pid):
             while True:
@@ -588,6 +615,19 @@ def frontend_main(argv=None) -> int:
     return 0
 
 
+def _worker_cmd(
+    public_port: int, engine_url: str, plane_path: str, plane_conns: int
+) -> list[str]:
+    return [
+        sys.executable, "-m", "misaka_tpu.runtime.frontends",
+        "--port", str(public_port),
+        "--engine", engine_url,
+        "--plane", plane_path,
+        "--plane-conns", str(plane_conns),
+        "--parent-pid", str(os.getpid()),
+    ]
+
+
 def spawn_frontends(
     n: int,
     public_port: int,
@@ -595,23 +635,255 @@ def spawn_frontends(
     plane_path: str,
     plane_conns: int = 2,
 ) -> list[subprocess.Popen]:
-    """Start n frontend worker processes sharing `public_port`.
+    """Start n UNSUPERVISED frontend worker processes sharing `public_port`
+    (benches and tests that own process lifetimes themselves; production
+    serving uses FrontendSupervisor, which respawns deaths).
 
     Workers import stdlib only (no jax), so they boot in well under a
     second.  The caller owns the Popen handles (terminate() to stop);
     wait_ready() below confirms the port actually answers.
     """
-    procs = []
-    for _ in range(n):
-        procs.append(subprocess.Popen([
-            sys.executable, "-m", "misaka_tpu.runtime.frontends",
-            "--port", str(public_port),
-            "--engine", engine_url,
-            "--plane", plane_path,
-            "--plane-conns", str(plane_conns),
-            "--parent-pid", str(os.getpid()),
-        ]))
-    return procs
+    return [
+        subprocess.Popen(_worker_cmd(public_port, engine_url, plane_path,
+                                     plane_conns))
+        for _ in range(n)
+    ]
+
+
+class FrontendSupervisor:
+    """Keeps the frontend worker pool at strength: spawn, watch, respawn.
+
+    A SO_REUSEPORT pool has a failure mode plain process trees don't: when
+    one worker dies, the kernel keeps balancing the SAME public port over
+    the survivors — capacity silently shrinks and nothing errors.  The
+    supervisor closes that hole:
+
+      * each of the n slots holds one worker process; a monitor thread
+        polls for deaths (reaping them) and respawns with exponential
+        backoff + jitter (`backoff_base` doubling to `backoff_cap`);
+      * a slot whose workers keep dying FAST (within `fast_crash_s` of
+        spawn, `breaker_threshold` times in a row) is crash-looping — its
+        circuit breaker opens and respawning pauses for `breaker_reset_s`
+        before one half-open retry, so a poisoned config can't fork-bomb
+        the host;
+      * `state()` is the no-silent-degradation surface: alive vs
+        configured, restart counts, open breakers, and an explicit
+        `degraded` flag — /healthz and /status render it (make_http_server
+        reads `server.misaka_supervisor`), and every respawn rides the
+        misaka_frontend_restarts_total counter.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        public_port: int,
+        engine_url: str,
+        plane_path: str,
+        plane_conns: int = 2,
+        backoff_base: float = 0.5,
+        backoff_cap: float = 15.0,
+        fast_crash_s: float = 5.0,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 60.0,
+        poll_s: float = 0.2,
+    ):
+        self._cmd = _worker_cmd(public_port, engine_url, plane_path,
+                                plane_conns)
+        # used statelessly (delay_for): the exponent is each slot's
+        # consecutive-fast-crash streak, not a global attempt counter
+        self._backoff = Backoff(base=backoff_base, cap=backoff_cap)
+        self._fast_crash_s = float(fast_crash_s)
+        self._breaker_threshold = max(1, int(breaker_threshold))
+        self._breaker_reset_s = float(breaker_reset_s)
+        self._poll_s = float(poll_s)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._restarts_total = 0
+        now = time.monotonic()
+        self._slots: list[dict] = [
+            {
+                "proc": None,          # Popen | None (None = down)
+                "spawned_at": now,
+                "restarts": 0,         # respawns performed on this slot
+                "fast_crashes": 0,     # consecutive deaths < fast_crash_s
+                "next_spawn": 0.0,     # monotonic respawn-not-before
+                "breaker_until": None,  # monotonic | None (open breaker)
+            }
+            for _ in range(max(1, int(n)))
+        ]
+        for slot in self._slots:
+            self._spawn(slot)
+        import weakref
+
+        ref = weakref.ref(self)
+        M_FE_CONFIGURED.set_function(
+            lambda: len(s._slots) if (s := ref()) is not None else 0
+        )
+        M_FE_ALIVE.set_function(
+            lambda: s.alive() if (s := ref()) is not None else 0
+        )
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="misaka-frontend-supervisor",
+        )
+        self._monitor.start()
+
+    # --- pool surface -------------------------------------------------------
+
+    def alive(self) -> int:
+        with self._lock:
+            return sum(
+                1 for s in self._slots
+                if s["proc"] is not None and s["proc"].poll() is None
+            )
+
+    def state(self) -> dict:
+        """The /healthz + /status payload: never let the pool shrink
+        silently — `degraded` is True whenever any slot is down or
+        crash-loop-broken."""
+        now = time.monotonic()
+        with self._lock:
+            alive = sum(
+                1 for s in self._slots
+                if s["proc"] is not None and s["proc"].poll() is None
+            )
+            broken = sum(
+                1 for s in self._slots
+                if s["breaker_until"] is not None and s["breaker_until"] > now
+            )
+            configured = len(self._slots)
+            restarts = self._restarts_total
+        return {
+            "configured": configured,
+            "alive": alive,
+            "restarts_total": restarts,
+            "breaker_open": broken,
+            "degraded": alive < configured or broken > 0,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            procs = [s["proc"] for s in self._slots if s["proc"] is not None]
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in procs:
+            # reap: the monitor (the usual reaper via poll()) is exiting
+            # on the same flag, and an unreaped child is a zombie for the
+            # host process's whole remaining lifetime
+            try:
+                p.wait(timeout=2)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        self._monitor.join(timeout=2)
+
+    # also quacks like the spawn_frontends return for existing teardown code
+    def terminate(self) -> None:
+        self.close()
+
+    # --- the monitor --------------------------------------------------------
+
+    def _spawn(self, slot: dict) -> None:
+        slot["proc"] = subprocess.Popen(self._cmd)
+        slot["spawned_at"] = time.monotonic()
+
+    def _monitor_loop(self) -> None:
+        while True:
+            time.sleep(self._poll_s)
+            # Decide under the lock, fork OUTSIDE it: state() serves the
+            # /healthz probe and the metric gauges off the same lock, and
+            # a probe must never stall behind a batch of fork/execs.
+            due: list[dict] = []
+            with self._lock:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                for slot in self._slots:
+                    proc = slot["proc"]
+                    if proc is not None and proc.poll() is not None:
+                        # death observed (poll() reaps the zombie)
+                        lifetime = now - slot["spawned_at"]
+                        slot["proc"] = None
+                        fast = lifetime < self._fast_crash_s
+                        slot["fast_crashes"] = (
+                            slot["fast_crashes"] + 1 if fast else 0
+                        )
+                        if slot["fast_crashes"] >= self._breaker_threshold:
+                            slot["breaker_until"] = now + self._breaker_reset_s
+                            log.error(
+                                "frontend worker crash loop (%d fast deaths, "
+                                "last exit %s): circuit breaker open for "
+                                "%.0fs", slot["fast_crashes"],
+                                proc.returncode, self._breaker_reset_s,
+                            )
+                        else:
+                            delay = self._backoff.delay_for(
+                                slot["fast_crashes"] - 1
+                            )
+                            slot["next_spawn"] = now + delay
+                            log.warning(
+                                "frontend worker died (exit %s after %.1fs); "
+                                "respawn in %.2fs",
+                                proc.returncode, lifetime, delay,
+                            )
+                    if slot["proc"] is None:
+                        if slot["breaker_until"] is not None:
+                            if now < slot["breaker_until"]:
+                                continue
+                            # half-open: one retry; a fast death re-trips
+                            slot["breaker_until"] = None
+                            log.warning(
+                                "frontend circuit breaker half-open: "
+                                "retrying one respawn"
+                            )
+                        elif now < slot["next_spawn"]:
+                            continue
+                        due.append(slot)
+            spawned: list[dict] = []
+            for slot in due:
+                # only this thread mutates slots, so the unlocked spawn
+                # cannot race another writer — just the close() check below
+                try:
+                    self._spawn(slot)
+                except OSError as e:
+                    # fork/exec failed (fd or memory exhaustion — exactly
+                    # the weather workers die in): the monitor must
+                    # survive it, or the one failure mode it exists to
+                    # absorb would disable the supervisor itself.  Retry
+                    # on the backoff curve as if this were another fast
+                    # crash.
+                    log.error("frontend worker spawn failed (%s); "
+                              "retrying with backoff", e)
+                    with self._lock:
+                        slot["fast_crashes"] += 1
+                        slot["next_spawn"] = time.monotonic() + \
+                            self._backoff.delay_for(slot["fast_crashes"] - 1)
+                    continue
+                spawned.append(slot)
+            if not spawned:
+                continue
+            with self._lock:
+                if self._closed:
+                    # close() ran between the spawns and here; its
+                    # terminate pass missed these brand-new procs
+                    for slot in spawned:
+                        try:
+                            slot["proc"].terminate()
+                            slot["proc"].wait(timeout=2)
+                        except (OSError, subprocess.TimeoutExpired):
+                            pass
+                    return
+                for slot in spawned:
+                    slot["restarts"] += 1
+                    self._restarts_total += 1
+                    M_FE_RESTARTS.inc()
+                    log.info(
+                        "frontend worker respawned (pid %d, slot "
+                        "restarts %d)", slot["proc"].pid, slot["restarts"],
+                    )
 
 
 def wait_ready(port: int, timeout: float = 10.0,

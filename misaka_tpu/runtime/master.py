@@ -25,6 +25,7 @@ import json
 import logging
 import os
 import queue
+import re
 import threading
 import time
 import weakref
@@ -36,6 +37,7 @@ import numpy as np
 from misaka_tpu.runtime.topology import Topology, TopologyError
 from misaka_tpu.tis.parser import TISParseError
 from misaka_tpu.tis.lower import TISLowerError
+from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
 from misaka_tpu.utils.httpfast import fast_parse_request as _fast_parse_request
 from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
@@ -107,6 +109,17 @@ M_CKPT_SAVE_SECONDS = metrics.histogram(
 )
 M_CKPT_RESTORE_SECONDS = metrics.histogram(
     "misaka_checkpoint_restore_seconds", "load_checkpoint duration (recompile + swap)"
+)
+M_CKPT_AGE = metrics.gauge(
+    "misaka_checkpoint_age_seconds",
+    "Seconds since the live master's last successful checkpoint save "
+    "(-1 until one lands; alert when this exceeds the MISAKA_AUTOCKPT "
+    "interval by a safety factor)",
+)
+M_CKPT_REJECTED = metrics.counter(
+    "misaka_checkpoint_rejected_total",
+    "Checkpoints that failed durability verification (truncated, checksum "
+    "mismatch, CRC-corrupt) and were rejected before any state swap",
 )
 M_COMPUTE_REQS = metrics.counter(
     "misaka_compute_requests_total", "compute/compute_many/compute_spread calls"
@@ -180,6 +193,30 @@ class BroadcastError(RuntimeError):
     Defined here (not in runtime.nodes, which raises it) so the shared HTTP
     surface can catch it without importing the grpc-dependent distributed
     module — the fused master must work with jax+numpy alone.
+    """
+
+
+class PeerUnavailable(RuntimeError):
+    """A distributed compute refused fast because a peer the control plane
+    tracks as DOWN cannot move values (runtime/nodes.py peer health).
+
+    The alternative — letting the request park in the input queue until
+    its full timeout — wedges every caller for 30s per request while the
+    outcome is already known.  Raised only by the distributed control
+    plane; the HTTP surface answers it as 503 (retryable: the request was
+    refused before entering the pipeline, and service resumes without a
+    master restart once the peer returns).  Defined here for the same
+    grpc-free reason as BroadcastError.
+    """
+
+
+class CheckpointError(ValueError):
+    """A checkpoint failed durability verification (truncated, checksum
+    mismatch, or CRC-corrupt) and was rejected before any state swap.
+
+    A ValueError subclass so the HTTP /restore route's existing error
+    translation (400 with the reason) and every caller that treats bad
+    checkpoint content as a value problem keep working unchanged.
     """
 
 
@@ -550,6 +587,197 @@ class ServeBatcher:
                 master._compute_locks[s].release()
 
 
+def _fsync_dir(directory: str) -> None:
+    """Make a rename in `directory` durable (best-effort: some filesystems
+    refuse O_RDONLY fsync on directories; the rename is still atomic)."""
+    try:
+        dfd = os.open(directory or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+def manifest_path(path: str) -> str:
+    """The durability sidecar for a checkpoint file (size + sha256)."""
+    return path + ".manifest"
+
+
+def _zip_intact(path: str) -> str | None:
+    """None when `path` is a structurally valid zip whose every member
+    passes CRC; otherwise the reason it is not.  Truncation at any offset
+    fails here (a zip's central directory lives at its END), and member
+    corruption fails CRC."""
+    import zipfile
+
+    try:
+        with zipfile.ZipFile(path) as z:
+            bad = z.testzip()
+        return f"CRC mismatch in member {bad!r}" if bad is not None else None
+    except zipfile.BadZipFile as e:
+        return f"not a readable npz ({e})"
+    except OSError as e:
+        return f"unreadable ({e})"
+
+
+def verify_checkpoint(path: str) -> None:
+    """The durability gate: raise CheckpointError unless `path` matches its
+    manifest (exact size + sha256), so a file truncated at ANY byte offset
+    or bit-flipped anywhere is rejected BEFORE np.load touches it (and long
+    before any engine/state swap).
+
+    Two fallbacks ride the zip CRC walk (_zip_intact), which also rejects
+    truncation and member corruption: (a) checkpoints written before the
+    manifest era have no sidecar at all; (b) a STALE manifest — the save
+    path commits the npz before its manifest, so a crash between the two
+    renames leaves a fully valid new file described by the previous
+    manifest.  A mismatched-but-intact file is therefore accepted (the
+    committed data survives the crash); a mismatched file that also fails
+    the CRC walk is rejected as corrupt.
+    """
+    import hashlib
+
+    def reject(reason: str) -> CheckpointError:
+        M_CKPT_REJECTED.inc()
+        return CheckpointError(f"checkpoint {path} rejected: {reason}")
+
+    def mismatch(reason: str) -> None:
+        broken = _zip_intact(path)
+        if broken is not None:
+            raise reject(f"{reason}; {broken}")
+        log.warning(
+            "checkpoint %s: %s, but the file is an intact npz — accepting "
+            "(a crash between the data and manifest renames leaves exactly "
+            "this: committed data, stale sidecar)", path, reason,
+        )
+
+    mpath = manifest_path(path)
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            want_size = int(manifest["size"])
+            want_sha = str(manifest["sha256"])
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            raise reject(f"unreadable manifest {mpath} ({e})") from e
+        try:
+            size = os.path.getsize(path)
+        except OSError as e:
+            raise reject(f"unreadable ({e})") from e
+        if size != want_size:
+            mismatch(
+                f"{size} bytes on disk vs {want_size} in the manifest "
+                f"(torn write or stale manifest)"
+            )
+            return
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != want_sha:
+            mismatch("sha256 mismatch against the manifest")
+        return
+    broken = _zip_intact(path)
+    if broken is not None:
+        raise reject(broken)
+
+
+class AutoCheckpointer:
+    """Periodic durable snapshots with rotation, plus boot-time restore.
+
+    MISAKA_AUTOCKPT=N seconds arms this on the serving master (app.py):
+    every interval the LIVE state is checkpointed into the checkpoint
+    directory as `auto-<seq>.npz` under save_checkpoint's full durability
+    contract (tmp + fsync + atomic replace + manifest), and rotation keeps
+    only the newest `keep` snapshots (MISAKA_AUTOCKPT_KEEP, default 4).
+    `restore_latest` is the boot half: walk the auto snapshots newest-
+    first and install the first that passes verify_checkpoint — one torn
+    or corrupt snapshot costs one interval of history, never a boot.
+    """
+
+    PREFIX = "auto-"
+    _NAME_RE = re.compile(r"^auto-(\d+)\.npz$")
+
+    def __init__(self, master, directory: str, interval_s: float,
+                 keep: int = 4):
+        if interval_s <= 0:
+            raise ValueError(f"interval must be > 0, got {interval_s}")
+        self._master = master
+        self._dir = directory
+        self._interval = float(interval_s)
+        self._keep = max(1, int(keep))
+        existing = self.snapshots(directory)
+        self._seq = (
+            int(self._NAME_RE.match(os.path.basename(existing[0])).group(1))
+            if existing else 0
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="misaka-autockpt"
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    @classmethod
+    def snapshots(cls, directory: str) -> list[str]:
+        """auto-*.npz paths in `directory`, newest (highest seq) first."""
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            return []
+        seqs = []
+        for n in names:
+            m = cls._NAME_RE.match(n)
+            if m:
+                seqs.append((int(m.group(1)), n))
+        return [os.path.join(directory, n) for _, n in sorted(seqs, reverse=True)]
+
+    def save_once(self) -> str:
+        """One durable snapshot + rotation (also the thread's body)."""
+        self._seq += 1
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(self._dir, f"{self.PREFIX}{self._seq:08d}.npz")
+        self._master.save_checkpoint(path)
+        for old in self.snapshots(self._dir)[self._keep:]:
+            for stale in (old, manifest_path(old)):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
+        return path
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.save_once()
+            except Exception:  # keep snapshotting: one failure is one
+                log.exception(  # interval of lost history, not a dead plane
+                    "auto-checkpoint failed (retrying next interval)"
+                )
+
+    @classmethod
+    def restore_latest(cls, master, directory: str) -> str | None:
+        """Install the newest VALID auto snapshot; returns its path, or
+        None when none exists/verifies (a fresh boot)."""
+        for path in cls.snapshots(directory):
+            try:
+                master.load_checkpoint(path)
+                return path
+            except Exception as e:
+                log.warning(
+                    "auto-restore: skipping snapshot %s (%s); falling back",
+                    path, e,
+                )
+        return None
+
+
 class MasterNode:
     """Control plane + I/O gateway for one fused network."""
 
@@ -755,6 +983,9 @@ class MasterNode:
         # zero device-loop cost, and a collected master reads as 0.
         self._created_mono = time.monotonic()
         self._requests_total = 0
+        # checkpoint freshness anchor (misaka_checkpoint_age_seconds):
+        # stamped by every successful save_checkpoint on this master
+        self._last_ckpt_mono: float | None = None
         # Loop-private per-slot in-flight value counts (fed minus drained):
         # the native tier's partial-fill fast path ticks only slots that
         # are fed now or still owe outputs.  Maintained solely by the
@@ -795,6 +1026,11 @@ class MasterNode:
         M_OUT_DEPTH.set_function(
             lambda: sum(q.qsize() for q in m._out_qs)
             if (m := ref()) is not None else 0
+        )
+        M_CKPT_AGE.set_function(
+            lambda: time.monotonic() - m._last_ckpt_mono
+            if (m := ref()) is not None and m._last_ckpt_mono is not None
+            else -1.0
         )
 
     def _shard(self, state):
@@ -1418,10 +1654,30 @@ class MasterNode:
 
     def save_checkpoint(self, path: str) -> None:
         """Whole-network state + topology to one .npz (SURVEY.md §5: the
-        reference cannot checkpoint at all; here state is one pytree).
+        reference cannot checkpoint at all; here state is one pytree) —
+        DURABLY:
+
+          1. np.savez into a same-directory tmp file, flushed + fsync'd: a
+             crash mid-write leaves only a tmp, never a truncated file at
+             the target path that a later load would trust;
+          2. `path`.manifest sidecar (atomic too) carrying the exact byte
+             size + sha256 — verify_checkpoint's rejection evidence;
+          3. os.replace(tmp, path) THEN os.replace of the manifest: the
+             data file is the commit point, so a crash between the two
+             renames leaves a fully valid checkpoint under a stale
+             sidecar — which verify_checkpoint heals via its CRC-walk
+             fallback instead of rejecting committed data (+ a directory
+             fsync so the renames survive power loss).
 
         Arrays are materialized under the state lock (see status()).
+        Fault points (utils/faults.py): `ckpt_crash` raises between the
+        tmp writes and the replaces (the crash the discipline exists
+        for — the target must stay intact); `ckpt_torn_write` truncates
+        the final file after the swap (the legacy failure shape, which
+        the manifest must then reject at load).
         """
+        import hashlib
+
         t0 = time.perf_counter()
         with self._state_lock:
             state = self._state
@@ -1440,7 +1696,50 @@ class MasterNode:
             ).encode(),
             dtype=np.uint8,
         )
-        np.savez(path, **arrays)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        mtmp = f"{manifest_path(path)}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            h = hashlib.sha256()
+            with open(tmp, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            size = os.path.getsize(tmp)
+            with open(mtmp, "w") as f:
+                json.dump(
+                    {
+                        "format": 1,
+                        "sha256": h.hexdigest(),
+                        "size": size,
+                        "saved_unix": round(time.time(), 3),
+                        "batch": self._batch,
+                    },
+                    f,
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            if faults.fire("ckpt_crash") is not None:
+                raise OSError(
+                    "injected ckpt_crash fault (crash before atomic replace)"
+                )
+            os.replace(tmp, path)
+            os.replace(mtmp, manifest_path(path))
+        except BaseException:
+            for leftover in (tmp, mtmp):
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass
+            raise
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+        torn = faults.fire("ckpt_torn_write")
+        if torn is not None:
+            with open(path, "r+b") as f:
+                f.truncate(int(size * max(0.0, min(1.0, torn))))
+        self._last_ckpt_mono = time.monotonic()
         M_CKPT_SAVE_SECONDS.observe(time.perf_counter() - t0)
 
     def load_checkpoint(self, path: str) -> None:
@@ -1449,12 +1748,18 @@ class MasterNode:
         Capacities travel in the checkpoint: a snapshot taken under different
         ring/stack caps restores those caps, keeping the state arrays and the
         compiled network consistent.
+
+        Durability gate first: verify_checkpoint rejects a torn or corrupt
+        file (CheckpointError) before np.load runs — a partial write must
+        never reach the engine swap, and the live network keeps serving its
+        current state when one does arrive.
         """
         import jax.numpy as jnp
 
         from misaka_tpu.core.state import NetworkState
 
         t0 = time.perf_counter()
+        verify_checkpoint(path)
         with np.load(path) as data:
             meta = json.loads(bytes(data["__topology__"]).decode())
             fields = {
@@ -2280,7 +2585,7 @@ def make_http_server(
                     # device-array materialization (probing /status
                     # materializes device arrays under the state lock on
                     # every call — exactly wrong for a 1s-interval probe).
-                    self._json({
+                    payload = {
                         "ok": True,
                         "engine": getattr(
                             master, "engine_name", "distributed-grpc"
@@ -2289,10 +2594,25 @@ def make_http_server(
                         "uptime_seconds": round(
                             time.monotonic() - boot_mono, 3
                         ),
-                    })
+                    }
+                    # The frontend supervisor (runtime/frontends.py, armed
+                    # by app.py via server.misaka_supervisor): a shrunk or
+                    # crash-looping worker pool must NEVER be silent — the
+                    # probe carries an explicit degraded flag and the pool
+                    # counts, while ok stays a pure liveness bit.
+                    sup = getattr(self.server, "misaka_supervisor", None)
+                    if sup is not None:
+                        fs = sup.state()
+                        payload["frontends"] = fs
+                        payload["degraded"] = fs["degraded"]
+                    self._json(payload)
                     return
                 if parsed.path == "/status":
-                    self._json(master.status())
+                    payload = master.status()
+                    sup = getattr(self.server, "misaka_supervisor", None)
+                    if sup is not None:
+                        payload["frontends"] = sup.state()
+                    self._json(payload)
                     return
                 if parsed.path == "/trace":
                     if not hasattr(master, "trace"):
@@ -2396,6 +2716,11 @@ def make_http_server(
                     except ComputeTimeout as e:
                         self._text(500, str(e))
                         return
+                    except PeerUnavailable as e:
+                        # typed fast-fail (distributed peer down): 503 =
+                        # retryable, nothing entered the pipeline
+                        self._text(503, str(e))
+                        return
                     self._json({"value": result})
                 elif self.path == "/compute_batch":
                     # additive: a FIFO stream of values through one instance
@@ -2426,16 +2751,24 @@ def make_http_server(
                             # (compute_coalesced falls back to
                             # compute_spread when MISAKA_SERVE_BATCH=0); the
                             # unspread default keeps its documented
-                            # single-instance FIFO pinning
-                            result = master.compute_coalesced(
-                                values, return_array=True
+                            # single-instance FIFO pinning.  The distributed
+                            # control plane has no scheduler at all — its
+                            # compute_spread is the whole-pipeline stream
+                            # lane (an r8 regression 500'd here)
+                            coalesced = getattr(
+                                master, "compute_coalesced",
+                                master.compute_spread,
                             )
+                            result = coalesced(values, return_array=True)
                         else:
                             result = master.compute_many(
                                 values, return_array=True
                             )
                     except ComputeTimeout as e:
                         self._text(500, str(e))
+                        return
+                    except PeerUnavailable as e:
+                        self._text(503, str(e))
                         return
                     # one vectorized pass; pad spaces are legal JSON
                     # whitespace, so json.loads clients decode unchanged
@@ -2494,16 +2827,25 @@ def make_http_server(
                     try:
                         if q.get("spread", "1") == "1":
                             # the serve scheduler lane (falls back to
-                            # compute_spread when MISAKA_SERVE_BATCH=0)
-                            result = master.compute_coalesced(
-                                values, return_array=True
+                            # compute_spread when MISAKA_SERVE_BATCH=0, and
+                            # to the distributed control plane's stream
+                            # lane, which has no scheduler — an r8
+                            # regression 500'd every distributed
+                            # /compute_raw until r9)
+                            coalesced = getattr(
+                                master, "compute_coalesced",
+                                master.compute_spread,
                             )
+                            result = coalesced(values, return_array=True)
                         else:
                             result = np.asarray(
                                 master.compute_many(values), np.int32
                             )
                     except ComputeTimeout as e:
                         self._text(500, str(e))
+                        return
+                    except PeerUnavailable as e:
+                        self._text(503, str(e))
                         return
                     self._bytes(result.astype("<i4").tobytes())
                 elif self.path == "/checkpoint":

@@ -32,15 +32,21 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import threading
+import time
 from collections import deque
 
 import grpc
 import numpy as np
 from google.protobuf import empty_pb2
 
-from misaka_tpu.runtime.master import BroadcastError, ComputeTimeout
+from misaka_tpu.runtime.master import (
+    BroadcastError,
+    ComputeTimeout,
+    PeerUnavailable,
+)
 from misaka_tpu.tis.parser import TISParseError, parse
 from misaka_tpu.transport import rpc
 from misaka_tpu.transport import messenger_pb2 as pb
@@ -81,6 +87,20 @@ _C_STACK_POP = metrics.counter(
 _C_PROG_INSTRS = metrics.counter(
     "misaka_program_instructions_total",
     "Instructions committed by program nodes in this process",
+)
+_C_RPC_RETRIES = metrics.counter(
+    "misaka_rpc_retries_total",
+    "RPC failures retried with backoff (node execute loops, this process)",
+)
+_C_DIST_PEER_UNAVAIL = metrics.counter(
+    "misaka_dist_peer_unavailable_total",
+    "Distributed computes refused fast (PeerUnavailable / HTTP 503) because "
+    "a peer was down — distinct from genuine compute timeouts",
+)
+_G_PEER_STATE = metrics.gauge(
+    "misaka_peer_state",
+    "Control-plane peer health by name (0=down, 1=degraded, 2=up)",
+    ("peer",),
 )
 
 _M64 = 1 << 64
@@ -321,6 +341,10 @@ class ProgramNodeProcess:
     def _run_loop(self) -> None:
         """Free-running execute loop (program.go:78-92): on error, log and
         retry the same instruction (ptr not advanced)."""
+        backoff = rpc.Backoff(
+            base=0.05,
+            cap=float(os.environ.get("MISAKA_RPC_BACKOFF_MAX", "") or 5.0),
+        )
         while not self._shutdown.is_set():
             gen = self._life.gen
             if not self._life.is_running:
@@ -337,13 +361,36 @@ class ProgramNodeProcess:
                     self._life.check(gen)  # stop raced the lock acquisition
                     self._update(gen)
             except NodeCancelled:
+                backoff.reset()  # lifecycle moved; retry cadence starts over
                 continue
             except TISParseError as e:  # unreachable post-load; defensive
                 log.warning("program error: %s", e)
             except rpc.RpcError as e:
-                # Reference log.Fatalf's here (quirk #8); retry instead.
-                log.warning("rpc error (will retry): %s", e)
-                self._shutdown.wait(_POLL)
+                # Reference log.Fatalf's here (quirk #8); retry the SAME
+                # instruction instead — with bounded exponential backoff
+                # (rpc.Backoff): the retry never gives up, but a dead peer
+                # is no longer hammered at poll rate, and no single sleep
+                # exceeds MISAKA_RPC_BACKOFF_MAX (default 5s), so recovery
+                # after the peer returns stays prompt.
+                _C_RPC_RETRIES.inc()
+                delay = backoff.next_delay()
+                log.warning("rpc error (retry in %.2fs): %s", delay, e)
+                self._backoff_wait(delay, gen)
+            else:
+                backoff.reset()
+
+    def _backoff_wait(self, delay: float, gen: int) -> None:
+        """Sleep out a backoff delay, waking early on shutdown or any
+        lifecycle transition — a pause/reset/load landing mid-backoff must
+        take effect now, not after a multi-second sleep."""
+        deadline = time.monotonic() + delay
+        while not self._shutdown.is_set():
+            if self._life.cancelled(gen) or not self._life.is_running:
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            self._shutdown.wait(min(_POLL, remaining))
 
     def _update(self, gen: int) -> None:
         """One instruction (update(), program.go:219-432).  Taken jumps set
@@ -611,6 +658,73 @@ class _StackServicer:
         return pb.ValueMessage(value=rpc._i32(self._node.pop_blocking(context)))
 
 
+PEER_UP, PEER_DEGRADED, PEER_DOWN = "up", "degraded", "down"
+_PEER_STATE_VALUE = {PEER_DOWN: 0.0, PEER_DEGRADED: 1.0, PEER_UP: 2.0}
+
+
+class _PeerHealth:
+    """Per-peer health states for the distributed control plane.
+
+      up        — the last probe (or broadcast RPC) succeeded
+      degraded  — 1..down_after-1 consecutive failures: transient blips,
+                  traffic still flows (the node retry loops absorb them)
+      down      — >= down_after consecutive failures: compute_many fails
+                  FAST with PeerUnavailable instead of parking its full
+                  timeout against a pipeline that cannot move
+
+    Fed by the master's background prober (transport-level ready()
+    checks, no RPC side effects) and by broadcast results; read by the
+    compute path and /status; exported as the misaka_peer_state labeled
+    gauge (0=down, 1=degraded, 2=up).  One recovery observation flips a
+    peer straight back to up — the network heals without master restart.
+    """
+
+    def __init__(self, peers, down_after: int = 3):
+        self._lock = threading.Lock()
+        self._down_after = max(1, int(down_after))
+        self._peers: dict[str, dict] = {
+            name: {"state": PEER_UP, "failures": 0, "last_error": None}
+            for name in peers
+        }
+        for name in self._peers:
+            _G_PEER_STATE.labels(peer=name).set(_PEER_STATE_VALUE[PEER_UP])
+
+    def record_ok(self, name: str) -> None:
+        with self._lock:
+            p = self._peers.setdefault(
+                name, {"state": PEER_UP, "failures": 0, "last_error": None}
+            )
+            recovered = p["state"] == PEER_DOWN
+            p["state"], p["failures"], p["last_error"] = PEER_UP, 0, None
+        _G_PEER_STATE.labels(peer=name).set(_PEER_STATE_VALUE[PEER_UP])
+        if recovered:
+            log.info("peer %s is back up", name)
+
+    def record_failure(self, name: str, error: str) -> None:
+        with self._lock:
+            p = self._peers.setdefault(
+                name, {"state": PEER_UP, "failures": 0, "last_error": None}
+            )
+            p["failures"] += 1
+            p["last_error"] = error
+            was = p["state"]
+            p["state"] = (
+                PEER_DOWN if p["failures"] >= self._down_after else PEER_DEGRADED
+            )
+            state = p["state"]
+        _G_PEER_STATE.labels(peer=name).set(_PEER_STATE_VALUE[state])
+        if state == PEER_DOWN and was != PEER_DOWN:
+            log.warning("peer %s marked down: %s", name, error)
+
+    def down_peers(self) -> list[str]:
+        with self._lock:
+            return [n for n, p in self._peers.items() if p["state"] == PEER_DOWN]
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {n: dict(p) for n, p in self._peers.items()}
+
+
 class MasterNodeProcess:
     """Distributed control plane (MasterNode, master.go:29-351): HTTP routes
     served via runtime.master.make_http_server (duck-typed), command fan-out
@@ -652,10 +766,22 @@ class MasterNodeProcess:
         self._server: grpc.Server | None = None
         # /status additions (uptime_seconds / requests_total), mirroring the
         # fused MasterNode's observability surface
-        import time as _time
-
-        self._created_mono = _time.monotonic()
+        self._created_mono = time.monotonic()
         self._requests_total = 0
+        # Peer health (up/degraded/down): a background prober drives the
+        # transport-level ready() check per peer; compute fails fast with
+        # PeerUnavailable while any peer is down (MISAKA_PEER_DOWN_AFTER
+        # consecutive failures, default 3; probe cadence MISAKA_PEER_PROBE_S,
+        # default 1s — ~3s from peer death to fail-fast).
+        self._health = _PeerHealth(
+            self.node_info,
+            down_after=int(os.environ.get("MISAKA_PEER_DOWN_AFTER", "") or 3),
+        )
+        self._probe_interval = float(
+            os.environ.get("MISAKA_PEER_PROBE_S", "") or 1.0
+        )
+        self._probe_stop = threading.Event()
+        self._prober: threading.Thread | None = None
 
     def start(self) -> int:
         self._server, port = rpc.make_server(
@@ -666,15 +792,65 @@ class MasterNodeProcess:
             host=self._host,
         )
         self._server.start()
+        self._prober = threading.Thread(
+            target=self._probe_loop, daemon=True, name="misaka-peer-probe"
+        )
+        self._prober.start()
         log.info("master serving grpc on :%d", port)
         self._grpc_port = port
         return port
 
     def close(self) -> None:
+        self._probe_stop.set()
         self._life.stop()
         if self._server:
             self._server.stop(grace=0.2)
+        if self._prober is not None:
+            self._prober.join(timeout=2)
         self._pool.close()
+
+    def _probe_loop(self) -> None:
+        """Background peer-health prober: one transport-level reachability
+        check per peer per interval (rpc._Stub.ready — channel READY wait,
+        no RPC side effects).  This is what notices a peer that died
+        between broadcasts: the data plane is inbound-only (program nodes
+        dial the master), so without active probing a dead peer is
+        invisible until a request wedges against it.
+
+        Peers are probed CONCURRENTLY (one thread per peer per sweep,
+        like _broadcast): each dead peer blocks its ready() call for the
+        full probe timeout, so a serial sweep would make down-detection
+        latency scale with how many peers are dead — the cadence must
+        stay one interval regardless of cluster size."""
+        probe_timeout = min(1.0, self._probe_interval)
+
+        def probe(name: str, info: dict) -> None:
+            cls = (
+                rpc.StackClient
+                if info.get("type") == "stack"
+                else rpc.ProgramClient
+            )
+            try:
+                ok = self._pool.get(cls, name).ready(timeout=probe_timeout)
+            except Exception as e:  # a broken channel counts as down
+                self._health.record_failure(name, repr(e))
+                return
+            if ok:
+                self._health.record_ok(name)
+            else:
+                self._health.record_failure(
+                    name, "unreachable (connectivity probe timed out)"
+                )
+
+        while not self._probe_stop.wait(self._probe_interval):
+            threads = [
+                threading.Thread(target=probe, args=(name, info), daemon=True)
+                for name, info in self.node_info.items()
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
 
     # --- command broadcast (master.go:269-351) ------------------------------
 
@@ -689,7 +865,9 @@ class MasterNodeProcess:
                 cls = rpc.StackClient if info.get("type") == "stack" else rpc.ProgramClient
                 client = self._pool.get(cls, name)
                 getattr(client, command)(timeout=10)
+                self._health.record_ok(name)
             except Exception as e:  # noqa: BLE001 — collected, not swallowed
+                self._health.record_failure(name, str(e))
                 with lock:
                     errors.append(e)
 
@@ -753,9 +931,14 @@ class MasterNodeProcess:
         lane for the per-process control plane: the reference moves one
         value per HTTP round trip (master.go:197-224); here a whole stream
         costs one queue append and the pipeline stays full.
-        """
-        import time
 
+        Fails FAST with PeerUnavailable (never a silent full-timeout park)
+        when the health plane tracks any peer as down: a value stream
+        cannot cross a dead node, so refusing at the door keeps the error
+        typed, the latency bounded, and the input queue free of orphans.
+        Recovery needs no master restart — the prober flips the peer back
+        up and the next request flows.
+        """
         # ingress truncates to the sint32 wire exactly like the reference
         # (every value crosses gRPC as sint32 anyway, messenger.proto:34-41)
         arr = np.asarray(values, dtype=np.int64).astype(np.int32)
@@ -763,6 +946,13 @@ class MasterNodeProcess:
             raise ValueError(f"values must be a flat sequence, got shape {arr.shape}")
         if arr.size == 0:
             return np.empty((0,), np.int32) if return_array else []
+        down = self._health.down_peers()
+        if down:
+            _C_DIST_PEER_UNAVAIL.inc()
+            raise PeerUnavailable(
+                f"peer(s) down: {', '.join(sorted(down))} — compute refused "
+                f"(recovers automatically when the peer returns)"
+            )
         _C_DIST_REQS.inc()
         _C_DIST_VALUES.inc(arr.size)
         outs: list[int] = []
@@ -782,6 +972,23 @@ class MasterNodeProcess:
                             raise ComputeTimeout(
                                 "request wiped by reset/load mid-collect"
                             )
+                        down = self._health.down_peers()
+                        if down:
+                            # a peer died mid-request: fail NOW with the
+                            # typed error instead of burning the rest of
+                            # the timeout.  The outputs still owed will
+                            # surface when the peer returns — stale-mark
+                            # them so later pairing survives (the same
+                            # discipline as the timeout branch).  Counted
+                            # on its OWN series: an alert tuned on real
+                            # timeouts must not fire on peer outages.
+                            self._stale_outputs += arr.size - len(outs)
+                            _C_DIST_PEER_UNAVAIL.inc()
+                            raise PeerUnavailable(
+                                f"peer(s) down mid-compute: "
+                                f"{', '.join(sorted(down))} "
+                                f"({len(outs)}/{arr.size} value(s) collected)"
+                            )
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             # outputs still owed to this request surface later:
@@ -792,7 +999,9 @@ class MasterNodeProcess:
                                 f"no output for {arr.size - len(outs)}/"
                                 f"{arr.size} value(s) after {timeout}s"
                             )
-                        self._io_cond.wait(remaining)
+                        # slice the wait so a peer going down mid-collect is
+                        # noticed within a probe interval, not at timeout
+                        self._io_cond.wait(min(remaining, 0.25))
                     if self._epoch != epoch:
                         # outputs now in the queue belong to the NEW epoch:
                         # consuming them would fabricate results for wiped
@@ -820,17 +1029,18 @@ class MasterNodeProcess:
         return self._life.is_running
 
     def status(self) -> dict:
-        import time as _time
-
         with self._io_cond:
             in_depth, out_depth = len(self._in_q), len(self._out_q)
         return {
             "running": self._life.is_running,
             "mode": "distributed",
             "served_engine": "distributed-grpc",
-            "uptime_seconds": round(_time.monotonic() - self._created_mono, 3),
+            "uptime_seconds": round(time.monotonic() - self._created_mono, 3),
             "requests_total": self._requests_total,
             "nodes": dict(self.node_info),
+            # the health plane's view: {name: {state, failures, last_error}}
+            # — state "down" is what compute fails fast on (PeerUnavailable)
+            "peers": self._health.snapshot(),
             "in_queue": in_depth,
             "out_queue": out_depth,
         }

@@ -19,12 +19,61 @@ Transport policy vs the reference:
 
 from __future__ import annotations
 
+import time
+
 import grpc
 from google.protobuf import empty_pb2
 
 from misaka_tpu.transport import messenger_pb2 as pb
+from misaka_tpu.utils import faults
+
+# The shared retry-delay policy, re-exported for the node retry loops:
+# the pre-r9 loop slept a fixed 50ms forever — a dead peer got hammered
+# at 20 req/s per node per instruction, and every retrying node woke in
+# lockstep (bounded-exponential + jitter fixes both; utils/backoff.py).
+from misaka_tpu.utils.backoff import Backoff  # noqa: F401  (re-export)
 
 RpcError = grpc.RpcError
+
+
+class InjectedRpcError(grpc.RpcError):
+    """A transport failure injected by the fault harness (utils/faults.py
+    `rpc_drop`): shaped like any other grpc.RpcError so every retry and
+    health-accounting path treats it exactly like a real network fault."""
+
+    def __init__(self, method: str):
+        super().__init__(f"injected rpc_drop fault on {method}")
+        self.method = method
+
+
+
+
+class _FaultableCallable:
+    """A unary-unary callable wrapped with the rpc_delay/rpc_drop fault
+    points; passthrough-cheap (two dict lookups) when nothing is armed."""
+
+    __slots__ = ("_inner", "_method")
+
+    def __init__(self, inner, method: str):
+        self._inner = inner
+        self._method = method
+
+    def _check(self) -> None:
+        if not faults.armed():  # the production path: one dict truthiness
+            return
+        delay = faults.fire("rpc_delay")
+        if delay:
+            time.sleep(delay)
+        if faults.fire("rpc_drop") is not None:
+            raise InjectedRpcError(self._method)
+
+    def __call__(self, request, timeout=None):
+        self._check()
+        return self._inner(request, timeout=timeout)
+
+    def future(self, request):
+        self._check()
+        return self._inner.future(request)
 
 _EMPTY = empty_pb2.Empty
 _VALUE = pb.ValueMessage
@@ -94,15 +143,39 @@ class _Stub:
         self._owned = channel is None
         self._channel = channel or open_channel(target, cert_file)
         for method, (req_cls, resp_cls) in SERVICES[self._service].items():
+            path = f"/grpc.{self._service}/{method}"
             setattr(
                 self,
                 "_" + method,
-                self._channel.unary_unary(
-                    f"/grpc.{self._service}/{method}",
-                    request_serializer=req_cls.SerializeToString,
-                    response_deserializer=resp_cls.FromString,
+                _FaultableCallable(
+                    self._channel.unary_unary(
+                        path,
+                        request_serializer=req_cls.SerializeToString,
+                        response_deserializer=resp_cls.FromString,
+                    ),
+                    path,
                 ),
             )
+
+    def ready(self, timeout: float = 1.0) -> bool:
+        """Probe peer reachability: wait up to `timeout` for the channel
+        to reach READY (triggers a reconnect attempt on an idle or failed
+        channel).  Pure transport-level — no RPC is invoked, so probing
+        has no side effects on the peer.  This is the control plane's
+        peer-health primitive (runtime/nodes.py)."""
+        if faults.fire("rpc_drop") is not None:
+            return False
+        fut = grpc.channel_ready_future(self._channel)
+        try:
+            fut.result(timeout=timeout)
+            return True
+        except (grpc.FutureTimeoutError, grpc.RpcError, ValueError):
+            # cancel unsubscribes the connectivity watcher: leaving it
+            # armed makes grpc's poller thread crash when the channel
+            # closes later (ValueError covers exactly that closed-channel
+            # race when close() wins over a probe in flight)
+            fut.cancel()
+            return False
 
     def close(self) -> None:
         if self._owned:

@@ -1,0 +1,146 @@
+"""Fault injection: named failure points driven by one env spec.
+
+The robustness plane (frontend supervisor, durable checkpoints, RPC
+backoff) exists to survive process death, torn writes, and dead peers —
+failures that never occur in a clean test run.  This module makes them
+occur ON DEMAND, in-process and cheaply, so the chaos suite and
+`make chaos-smoke` exercise the recovery paths end to end instead of
+trusting them by inspection.
+
+Spec (env var `MISAKA_FAULTS`, or `configure()` for tests): a comma-
+separated list of armed fault points,
+
+    MISAKA_FAULTS="ckpt_torn_write=0.5,rpc_delay=0.2@0.1,worker_exit=1.5"
+
+each entry `name[=value][@probability]`:
+
+  * `name`        — one of the named points below (unknown names are an
+                    error at parse time: a typo'd fault spec silently
+                    injecting nothing would be worse than no harness).
+  * `value`       — a float parameter the point interprets (default 1.0).
+  * `probability` — chance in [0, 1] that an individual `fire()` call
+                    triggers (default 1.0 = always).
+
+Named points (the hook sites live next to the code they break):
+
+  worker_exit     — a frontend worker process hard-exits `value` seconds
+                    after boot (runtime/frontends.py frontend_main): the
+                    supervisor's respawn path, without kill(1).
+  rpc_drop        — a gRPC client call raises InjectedRpcError instead of
+                    sending (transport/rpc.py): the node retry/backoff
+                    path and the control plane's peer-health accounting.
+  rpc_delay       — a gRPC client call sleeps `value` seconds before
+                    sending: deadline and slow-peer behavior.
+  ckpt_torn_write — the checkpoint file is truncated to `value` fraction
+                    of its bytes AFTER the atomic replace
+                    (runtime/master.py save_checkpoint): simulates the
+                    torn write a crash mid-`np.savez` used to leave, so
+                    the manifest/checksum rejection path is exercised.
+  ckpt_crash      — save_checkpoint raises after writing the tmp file but
+                    BEFORE the atomic replace: the crash the atomic write
+                    discipline exists for (target must stay intact).
+
+Fault checks are zero-cost when nothing is armed (`fire` returns None
+after one dict lookup on an empty dict); the module imports stdlib only —
+it is imported by the jax-free frontend workers.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+POINTS = frozenset({
+    "worker_exit",
+    "rpc_drop",
+    "rpc_delay",
+    "ckpt_torn_write",
+    "ckpt_crash",
+})
+
+
+class FaultSpecError(ValueError):
+    """Malformed MISAKA_FAULTS spec (unknown point, bad value/probability)."""
+
+
+def parse_spec(text: str | None) -> dict[str, tuple[float, float]]:
+    """`name[=value][@prob],...` -> {name: (value, probability)}."""
+    spec: dict[str, tuple[float, float]] = {}
+    for raw in (text or "").split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        prob = 1.0
+        if "@" in entry:
+            entry, prob_s = entry.rsplit("@", 1)
+            try:
+                prob = float(prob_s)
+            except ValueError:
+                raise FaultSpecError(
+                    f"cannot parse probability {prob_s!r} in {raw!r}"
+                ) from None
+            if not 0.0 <= prob <= 1.0:
+                raise FaultSpecError(
+                    f"probability must be in [0, 1], got {prob} in {raw!r}"
+                )
+        value = 1.0
+        if "=" in entry:
+            entry, value_s = entry.split("=", 1)
+            try:
+                value = float(value_s)
+            except ValueError:
+                raise FaultSpecError(
+                    f"cannot parse value {value_s!r} in {raw!r}"
+                ) from None
+        name = entry.strip()
+        if name not in POINTS:
+            raise FaultSpecError(
+                f"unknown fault point {name!r} (known: {sorted(POINTS)})"
+            )
+        spec[name] = (value, prob)
+    return spec
+
+
+# The armed spec is an IMMUTABLE dict swapped whole by configure(): readers
+# (the hot RPC / device-loop hook sites) take no lock — a reference read is
+# atomic under the GIL, and a reader sees either the old or the new spec,
+# never a torn one.  The lock only serializes concurrent configure() calls.
+_lock = threading.Lock()
+_spec: dict[str, tuple[float, float]] = parse_spec(os.environ.get("MISAKA_FAULTS"))
+
+
+def configure(text: str | None) -> None:
+    """Re-arm from a spec string (tests); None/"" disarms everything."""
+    global _spec
+    parsed = parse_spec(text)
+    with _lock:
+        _spec = parsed
+
+
+def armed() -> bool:
+    """True when ANY fault point is armed — the one-dict-truthiness check
+    hot paths use to skip their per-point rolls entirely."""
+    return bool(_spec)
+
+
+def active() -> frozenset[str]:
+    """The currently armed point names (empty when faults are off)."""
+    return frozenset(_spec)
+
+
+def fire(point: str) -> float | None:
+    """Roll the dice for one armed fault point.
+
+    Returns the point's configured value when it triggers, None when the
+    point is unarmed or its probability roll misses.  Callers interpret
+    the value (seconds, fraction, ...) at the hook site.  Lock-free: one
+    dict lookup on the current (immutable) spec.
+    """
+    armed_point = _spec.get(point)
+    if armed_point is None:
+        return None
+    value, prob = armed_point
+    if prob < 1.0 and random.random() >= prob:
+        return None
+    return value

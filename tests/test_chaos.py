@@ -1,0 +1,540 @@
+"""Chaos suite: the r9 fault-tolerance plane under injected failure.
+
+Covers the durable-checkpoint contract (atomic writes, manifest
+verification rejecting truncation at any byte offset, torn-write and
+crash-mid-save fault points, auto-checkpoint rotation + fallback
+restore), the fault harness itself (MISAKA_FAULTS spec), the RPC backoff
+policy, and the frontend supervisor (kill -9 respawn, crash-loop circuit
+breaker, degraded-state surfacing, recovery under concurrent client load
+with zero client-visible errors).
+
+`make chaos-smoke` runs the fast lane of this file; the multi-second
+process-pool scenarios are marked slow (the `make test-all` lane).
+"""
+
+import json
+import os
+import shutil
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.runtime.master import (
+    AutoCheckpointer,
+    CheckpointError,
+    MasterNode,
+    make_http_server,
+    manifest_path,
+    verify_checkpoint,
+)
+from misaka_tpu.utils import faults, metrics
+
+
+def _master(batch=None, **kw):
+    return MasterNode(
+        networks.add2(in_cap=16, out_cap=16, stack_cap=16),
+        chunk_steps=32, engine="scan", batch=batch, **kw,
+    )
+
+
+def _snap():
+    return metrics.parse_text(metrics.render())
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No test may leak an armed fault into the rest of the suite."""
+    yield
+    faults.configure(None)
+
+
+# --- the fault harness ------------------------------------------------------
+
+
+def test_fault_spec_parsing():
+    spec = faults.parse_spec("ckpt_torn_write=0.5, rpc_delay=0.2@0.1,worker_exit")
+    assert spec == {
+        "ckpt_torn_write": (0.5, 1.0),
+        "rpc_delay": (0.2, 0.1),
+        "worker_exit": (1.0, 1.0),
+    }
+    assert faults.parse_spec("") == {}
+    assert faults.parse_spec(None) == {}
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec("not_a_point=1")
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec("rpc_drop@2")  # probability out of range
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec("rpc_delay=abc")
+
+
+def test_fault_fire_and_disarm():
+    faults.configure("ckpt_torn_write=0.25")
+    assert faults.active() == {"ckpt_torn_write"}
+    assert faults.fire("ckpt_torn_write") == 0.25
+    assert faults.fire("rpc_drop") is None
+    faults.configure(None)
+    assert faults.fire("ckpt_torn_write") is None
+    # probability 0 never fires
+    faults.configure("rpc_drop@0")
+    assert all(faults.fire("rpc_drop") is None for _ in range(50))
+
+
+def test_backoff_bounded_and_jittered():
+    # the ONE shared policy (utils/backoff.py): node RPC retries
+    # (transport/rpc.py re-exports it), supervisor respawns, client
+    # connect-retry all ride this curve
+    from misaka_tpu.utils.backoff import Backoff
+
+    b = Backoff(base=0.1, cap=1.0)
+    raw = [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]  # doubles, then pinned at the cap
+    for expect in raw:
+        d = b.next_delay()
+        assert expect * 0.5 <= d <= expect  # jitter in [delay/2, delay]
+    b.reset()
+    assert b.next_delay() <= 0.1  # fast first retry again
+    assert b.delay_for(10) <= 1.0  # the stateless form honors the cap too
+    with pytest.raises(ValueError):
+        Backoff(base=2.0, cap=1.0)
+
+
+# --- durable checkpoints ----------------------------------------------------
+
+
+def test_save_checkpoint_atomic_with_manifest(tmp_path):
+    m = _master()
+    path = str(tmp_path / "ck.npz")
+    m.save_checkpoint(path)
+    # manifest sidecar describes the exact bytes on disk
+    with open(manifest_path(path)) as f:
+        manifest = json.load(f)
+    assert manifest["size"] == os.path.getsize(path)
+    assert len(manifest["sha256"]) == 64
+    verify_checkpoint(path)  # passes
+    # no tmp litter: the write path either commits or cleans up
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+    m2 = _master()
+    m2.load_checkpoint(path)
+
+
+def test_truncated_checkpoint_rejected_at_any_offset(tmp_path):
+    m = _master()
+    path = str(tmp_path / "ck.npz")
+    m.save_checkpoint(path)
+    blob = open(path, "rb").read()
+    before = _snap()
+    cut = str(tmp_path / "cut.npz")
+    offsets = [0, 1, len(blob) // 4, len(blob) // 2, len(blob) - 1]
+    for offset in offsets:
+        with open(cut, "wb") as f:
+            f.write(blob[:offset])
+        shutil.copy(manifest_path(path), manifest_path(cut))
+        with pytest.raises(CheckpointError):
+            verify_checkpoint(cut)
+        with pytest.raises(CheckpointError):
+            _master().load_checkpoint(cut)
+        # legacy shape too: no manifest, the zip CRC/central-dir walk rejects
+        os.unlink(manifest_path(cut))
+        with pytest.raises(CheckpointError):
+            verify_checkpoint(cut)
+    delta = metrics.delta(before, _snap())
+    assert delta.get("misaka_checkpoint_rejected_total", 0) >= 3 * len(offsets)
+
+
+def test_stale_manifest_with_intact_file_heals(tmp_path):
+    """The overwrite crash window: the data rename commits but the process
+    dies before the manifest rename, leaving a fully valid NEW checkpoint
+    under the OLD sidecar.  verify_checkpoint must accept it via the CRC
+    fallback — rejecting committed data (whose predecessor is already
+    gone) would turn one crash into permanent loss."""
+    m = _master()
+    path = str(tmp_path / "ck.npz")
+    m.save_checkpoint(path)
+    stale_manifest = open(manifest_path(path), "rb").read()
+    m.run()
+    try:
+        assert m.compute(1) == 3  # state moves, so the second save differs
+    finally:
+        m.pause()
+    m.save_checkpoint(path)
+    with open(manifest_path(path), "wb") as f:
+        f.write(stale_manifest)  # simulate the crash between the renames
+    verify_checkpoint(path)  # accepted: intact npz, stale sidecar
+    _master().load_checkpoint(path)
+    # but a file that ALSO fails the CRC walk stays rejected
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CheckpointError):
+        verify_checkpoint(path)
+
+
+def test_corrupt_byte_rejected_by_checksum(tmp_path):
+    m = _master()
+    path = str(tmp_path / "ck.npz")
+    m.save_checkpoint(path)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # same size, different content
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(CheckpointError):
+        verify_checkpoint(path)
+
+
+def test_ckpt_crash_fault_leaves_target_intact(tmp_path):
+    m = _master()
+    path = str(tmp_path / "ck.npz")
+    m.save_checkpoint(path)
+    good = open(path, "rb").read()
+    faults.configure("ckpt_crash")
+    with pytest.raises(OSError):
+        m.save_checkpoint(path)
+    faults.configure(None)
+    # the crash landed between the tmp write and the atomic replace: the
+    # previous checkpoint is byte-identical, still verified, still loadable,
+    # and no tmp litter survives
+    assert open(path, "rb").read() == good
+    verify_checkpoint(path)
+    _master().load_checkpoint(path)
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+
+
+def test_ckpt_torn_write_fault_rejected_then_recovers(tmp_path):
+    m = _master()
+    m.run()
+    try:
+        assert m.compute(1) == 3
+        path = str(tmp_path / "ck.npz")
+        faults.configure("ckpt_torn_write=0.5")
+        m.save_checkpoint(path)  # the file on disk is torn at 50%
+        faults.configure(None)
+        with pytest.raises(CheckpointError):
+            _master().load_checkpoint(path)
+        # the serving master was never touched by the failed durability
+        # round trip — and a clean retry fully recovers
+        assert m.compute(2) == 4
+        m.save_checkpoint(path)
+        _master().load_checkpoint(path)
+    finally:
+        m.pause()
+
+
+def test_autockpt_rotation_and_fallback_restore(tmp_path):
+    m = _master()
+    m.run()
+    try:
+        assert m.compute(5) == 7
+    finally:
+        m.pause()
+    ckdir = str(tmp_path / "auto")
+    ac = AutoCheckpointer(m, ckdir, interval_s=3600, keep=3)
+    try:
+        for _ in range(5):
+            ac.save_once()
+    finally:
+        ac.close()
+    snaps = AutoCheckpointer.snapshots(ckdir)
+    assert len(snaps) == 3  # rotation kept the newest `keep`
+    assert os.path.basename(snaps[0]) == "auto-00000005.npz"
+    # tear the newest: boot restore must fall back to the next valid one
+    with open(snaps[0], "r+b") as f:
+        f.truncate(os.path.getsize(snaps[0]) // 2)
+    m2 = _master()
+    restored = AutoCheckpointer.restore_latest(m2, ckdir)
+    assert restored == snaps[1]
+    m2.run()
+    try:
+        assert m2.compute(1) == 3  # serving resumes from the restored state
+    finally:
+        m2.pause()
+    # a fresh directory is a fresh boot, not an error
+    assert AutoCheckpointer.restore_latest(_master(), str(tmp_path / "empty")) is None
+
+
+def test_autockpt_periodic_thread_snapshots(tmp_path):
+    m = _master()
+    ckdir = str(tmp_path / "auto")
+    ac = AutoCheckpointer(m, ckdir, interval_s=0.05, keep=2)
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if len(AutoCheckpointer.snapshots(ckdir)) == 2:
+                break
+            time.sleep(0.02)
+        snaps = AutoCheckpointer.snapshots(ckdir)
+        assert len(snaps) == 2
+        for s in snaps:
+            verify_checkpoint(s)
+    finally:
+        ac.close()
+
+
+def test_checkpoint_age_metric_tracks_saves(tmp_path):
+    m = _master()
+    age = metrics.REGISTRY.get("misaka_checkpoint_age_seconds")
+    assert age.value == -1.0  # no save yet on the live master
+    m.save_checkpoint(str(tmp_path / "ck.npz"))
+    assert 0.0 <= age.value < 60.0
+
+
+# --- frontend supervisor ----------------------------------------------------
+
+
+def _supervisor(n, tmp_path, **kw):
+    from misaka_tpu.runtime import frontends
+
+    port = frontends.pick_free_port()
+    sup = frontends.FrontendSupervisor(
+        n, port, "http://127.0.0.1:9", str(tmp_path / "plane.sock"), **kw
+    )
+    return sup, port
+
+
+def _wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return predicate()
+
+
+def test_supervisor_respawns_kill9_and_surfaces_degraded(tmp_path):
+    from misaka_tpu.runtime import frontends
+
+    sup, port = _supervisor(2, tmp_path, backoff_base=0.4, poll_s=0.05)
+    try:
+        assert frontends.wait_ready(port)
+        assert _wait_for(lambda: sup.state()["alive"] == 2)
+        victim = sup._slots[0]["proc"].pid
+        os.kill(victim, signal.SIGKILL)
+        # the shrunk pool is never silent: degraded shows while down
+        assert _wait_for(lambda: sup.state()["degraded"], timeout=5)
+        st = sup.state()
+        assert st["alive"] == 1 and st["configured"] == 2
+        # ... and the supervisor restores strength on its own
+        assert _wait_for(lambda: sup.state()["alive"] == 2, timeout=5)
+        st = sup.state()
+        assert st["restarts_total"] == 1 and not st["degraded"]
+        assert frontends.wait_ready(port)
+    finally:
+        sup.close()
+
+
+def test_supervisor_circuit_breaker_stops_crash_loop(tmp_path, monkeypatch):
+    # every spawned worker hard-exits right after boot (the worker_exit
+    # fault point, inherited via the environment): the breaker must open
+    # instead of fork-bombing the host
+    monkeypatch.setenv("MISAKA_FAULTS", "worker_exit=0")
+    sup, _ = _supervisor(
+        1, tmp_path, backoff_base=0.02, fast_crash_s=5.0,
+        breaker_threshold=2, breaker_reset_s=60.0, poll_s=0.05,
+    )
+    try:
+        assert _wait_for(lambda: sup.state()["breaker_open"] == 1, timeout=20)
+        st = sup.state()
+        assert st["degraded"] and st["alive"] == 0
+        settled = sup.state()["restarts_total"]
+        time.sleep(0.5)
+        assert sup.state()["restarts_total"] == settled  # breaker holds
+    finally:
+        sup.close()
+
+
+@pytest.mark.slow
+def test_kill9_under_concurrent_load_zero_client_errors(tmp_path):
+    """The acceptance scenario: kill -9 one frontend worker under sustained
+    concurrent load — capacity restored automatically, no client-visible
+    errors beyond the pooled client's single stale-socket retry, restart
+    visible in /metrics."""
+    from misaka_tpu.client import MisakaClient
+    from misaka_tpu.runtime import frontends
+
+    m = _master(batch=8)
+    engine_httpd = make_http_server(m, port=0)
+    threading.Thread(target=engine_httpd.serve_forever, daemon=True).start()
+    plane_path = str(tmp_path / "plane.sock")
+    plane = frontends.start_compute_plane(m, plane_path)
+    port = frontends.pick_free_port()
+    before = _snap()
+    sup = frontends.FrontendSupervisor(
+        2, port, f"http://127.0.0.1:{engine_httpd.server_address[1]}",
+        plane_path, backoff_base=0.05, fast_crash_s=0.5, poll_s=0.05,
+    )
+    engine_httpd.misaka_supervisor = sup
+    m.run()
+    errors: list[Exception] = []
+    stop = threading.Event()
+    warmed = threading.Semaphore(0)
+
+    def client_loop(i):
+        c = MisakaClient(f"http://127.0.0.1:{port}", timeout=20)
+        vals = (np.arange(16, dtype=np.int32) + i) % 1000
+        try:
+            # warm-up: the first request parks this client's socket in the
+            # pool, so everything in flight at kill time rides a REUSED
+            # connection — the shape retry_stale's single replay covers
+            # (a fresh first dial is deliberately not replayed)
+            out = c.compute_raw(vals)
+            warmed.release()
+            if not np.array_equal(out, vals + 2):
+                raise AssertionError(f"client {i}: wrong warm-up outputs")
+            while not stop.is_set():
+                out = c.compute_raw(vals)
+                if not np.array_equal(out, vals + 2):
+                    raise AssertionError(f"client {i}: wrong outputs")
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            warmed.release()
+            errors.append(e)
+        finally:
+            c.close()
+
+    try:
+        assert frontends.wait_ready(port)
+        threads = [
+            threading.Thread(target=client_loop, args=(i,), daemon=True)
+            for i in range(32)
+        ]
+        for t in threads:
+            t.start()
+        for _ in range(32):  # every client warmed (socket pooled)
+            assert warmed.acquire(timeout=30)
+        assert errors == []
+        time.sleep(0.5)  # sustained load on pooled keep-alive sockets
+        victim = sup._slots[0]["proc"].pid
+        os.kill(victim, signal.SIGKILL)
+        assert _wait_for(lambda: sup.state()["alive"] == 2, timeout=5)
+        time.sleep(1.0)  # keep serving through and after the recovery
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == []
+        st = sup.state()
+        assert st["restarts_total"] >= 1 and not st["degraded"]
+        delta = metrics.delta(before, _snap())
+        assert delta.get("misaka_frontend_restarts_total", 0) >= 1
+        # /healthz carries the supervisor surface end to end
+        import urllib.request
+
+        engine_port = engine_httpd.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{engine_port}/healthz", timeout=10
+        ) as resp:
+            payload = json.loads(resp.read())
+        assert payload["frontends"]["configured"] == 2
+        assert payload["degraded"] is False
+    finally:
+        stop.set()
+        m.pause()
+        sup.close()
+        plane.close()
+        engine_httpd.shutdown()
+
+
+# --- client connect-retry ---------------------------------------------------
+
+
+def test_client_connect_retry_rides_out_restart_window():
+    """A refused FRESH dial (server restarting) is retried with backoff —
+    the satellite to the supervisor's respawn; retries are exactly-once
+    safe because a refused connect never sent anything."""
+    import socket
+    import urllib.error
+
+    from misaka_tpu.client import MisakaClient
+    from misaka_tpu.runtime import frontends
+
+    port = frontends.pick_free_port()
+    # nothing listens: opt-out surfaces the refusal immediately
+    c0 = MisakaClient(f"http://127.0.0.1:{port}", timeout=5, connect_retries=0)
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.URLError):
+        c0.healthz()
+    assert time.monotonic() - t0 < 0.5
+    # with retries armed: the server boots DURING the backoff window and
+    # the same request lands on it (the rolling-restart shape)
+    m = _master()
+    holder: list = []
+
+    def serve_late():
+        time.sleep(0.3)
+        server = make_http_server(m, port=port)
+        holder.append(server)
+        server.serve_forever()
+
+    threading.Thread(target=serve_late, daemon=True).start()
+    c = MisakaClient(f"http://127.0.0.1:{port}", timeout=5, connect_retries=6)
+    try:
+        assert c.healthz()["ok"] is True
+    finally:
+        c.close()
+        if holder:
+            holder[0].shutdown()
+
+
+# --- distributed peer health ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_dead_peer_fails_fast_typed_and_recovers(monkeypatch):
+    """A downed distributed peer yields PeerUnavailable well inside the
+    request deadline (not a 30s park), /status shows the peer down, and
+    the cluster recovers with NO master restart once the peer returns."""
+    pytest.importorskip("grpc")
+    from misaka_tpu.runtime.master import PeerUnavailable
+    from misaka_tpu.runtime.nodes import (
+        MasterNodeProcess,
+        ProgramNodeProcess,
+        Resolver,
+    )
+
+    monkeypatch.setenv("MISAKA_PEER_PROBE_S", "0.2")
+    monkeypatch.setenv("MISAKA_PEER_DOWN_AFTER", "2")
+    program = "IN ACC\nADD 2\nOUT ACC"
+    resolver = Resolver()
+    node = ProgramNodeProcess(
+        master_uri="last_order", resolver=resolver,
+        grpc_port=0, host="127.0.0.1",
+    )
+    node.load_program(program)
+    port = node.start()
+    resolver.set_addr("n", f"127.0.0.1:{port}")
+    master = MasterNodeProcess(
+        node_info={"n": {"type": "program"}},
+        resolver=resolver, grpc_port=0, host="127.0.0.1",
+    )
+    resolver.set_addr("last_order", f"127.0.0.1:{master.start()}")
+    replacement = None
+    try:
+        master.run()
+        assert master.compute(1, timeout=30) == 3
+        node.close()  # the peer dies outright
+        assert _wait_for(
+            lambda: master.status()["peers"]["n"]["state"] == "down",
+            timeout=10,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(PeerUnavailable):
+            master.compute(2, timeout=30)
+        assert time.monotonic() - t0 < 5  # typed fast-fail, not a 30s park
+        # peer returns on the SAME address: health flips up, service resumes
+        replacement = ProgramNodeProcess(
+            master_uri="last_order", resolver=resolver,
+            grpc_port=port, host="127.0.0.1",
+        )
+        replacement.load_program(program)
+        replacement.start()
+        replacement.run_cmd()
+        assert _wait_for(
+            lambda: master.status()["peers"]["n"]["state"] == "up",
+            timeout=10,
+        )
+        assert master.compute(10, timeout=30) == 12
+    finally:
+        master.close()
+        node.close()
+        if replacement is not None:
+            replacement.close()

@@ -6,6 +6,7 @@ add-2 compose network.
 """
 
 import json
+import os
 import threading
 import urllib.error
 import urllib.parse
@@ -326,10 +327,13 @@ def test_checkpoint_pre_regs64_compat(tmp_path):
         )
     path = str(tmp_path / "old.npz")
     m1.save_checkpoint(path)
-    # rewrite the npz without the hi planes (the pre-upgrade format)
+    # rewrite the npz without the hi planes (the pre-upgrade format) — and
+    # without the durability manifest, which that era didn't write either
+    # (verify_checkpoint then takes its legacy zip-CRC path)
     with np.load(path) as data:
         arrays = {k: data[k] for k in data.files if k not in ("acc_hi", "bak_hi")}
     np.savez(path, **arrays)
+    os.unlink(path + ".manifest")
 
     m2 = MasterNode(top, chunk_steps=16)
     m2.load_checkpoint(path)
@@ -361,6 +365,50 @@ def test_checkpoint_caps_roundtrip(tmp_path):
     assert m2.compute(9, timeout=30) == 10
     m2.pause()
     assert m2._net.in_cap == 16  # restored caps, not the host's
+
+
+def test_spread_lanes_without_serve_scheduler():
+    """A master exposing compute_spread but NOT compute_coalesced — the
+    distributed control plane's shape — must still serve the spread lanes
+    of /compute_raw and /compute_batch through compute_spread.  Pins the
+    r8 regression where both routes called compute_coalesced
+    unconditionally and 500'd on every distributed spread request."""
+    import numpy as np
+
+    class SchedulerlessMaster:
+        is_running = True
+        engine_name = "stub"
+
+        def compute_spread(self, values, timeout=30.0, return_array=False):
+            out = np.asarray(values, np.int32) + 2
+            return out if return_array else out.tolist()
+
+        def compute_many(self, values, timeout=30.0, return_array=False):
+            return self.compute_spread(values, return_array=return_array)
+
+    server = make_http_server(SchedulerlessMaster(), 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/compute_raw",  # spread=1 is the default
+            data=np.asarray([10, 11], "<i4").tobytes(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            out = np.frombuffer(resp.read(), "<i4")
+        np.testing.assert_array_equal(out, [12, 13])
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/compute_batch",
+            data=b"values=1+2&spread=1",
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read()) == {"values": [3, 4]}
+    finally:
+        server.shutdown()
 
 
 def test_topology_validation():
