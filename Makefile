@@ -81,6 +81,15 @@ bench-smoke:
 metrics-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python tools/metrics_smoke.py
 
+# Request-tracing tripwire (~10s): boot a server with SO_REUSEPORT
+# frontend workers, fire concurrent traffic carrying X-Misaka-Trace IDs,
+# fetch GET /debug/perfetto from the engine, and assert spans from >= 3
+# tiers (frontend/plane/serve/...) appear under one trace ID — the whole
+# propagation chain in one shot.  The same assertions run inside tier-1
+# (tests/test_request_trace.py); docs/OBSERVABILITY.md "Request tracing".
+trace-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python tools/trace_smoke.py
+
 # Fault-tolerance tripwire (~10s): the fast chaos lane, driven through the
 # MISAKA_FAULTS harness (utils/faults.py) — durable-checkpoint rejection of
 # torn/corrupt files, crash-mid-save atomicity, auto-checkpoint rotation +
@@ -124,4 +133,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke chaos-smoke parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke chaos-smoke parity-go parity-local parity-corpus stop clean

@@ -39,12 +39,62 @@ import urllib.parse
 
 
 class MisakaClientError(RuntimeError):
-    """Non-2xx response from the master (carries status + body text)."""
+    """Non-2xx response from the master (carries status + body text, and
+    the server's trace ID when the response had one — a 503/timeout then
+    names the exact request to grep for in `/debug/requests/<id>` and
+    the server's JSON logs)."""
 
-    def __init__(self, status: int, body: str):
-        super().__init__(f"HTTP {status}: {body}")
+    def __init__(self, status: int, body: str, trace_id: str | None = None):
+        msg = f"HTTP {status}: {body}"
+        if trace_id:
+            msg += f" [trace {trace_id}]"
+        super().__init__(msg)
         self.status = status
         self.body = body
+        self.trace_id = trace_id
+
+
+class TracedInt(int):
+    """An int carrying the response's tracing context: ``timings`` (the
+    parsed ``Server-Timing`` phases, ms) and ``trace_id``."""
+
+    timings: dict | None = None
+    trace_id: str | None = None
+
+
+def _parse_server_timing(value: str) -> dict:
+    """"queue;dur=1.2, total;dur=3.4" -> {"queue": 1.2, "total": 3.4}.
+    One parser for both halves of the wire (lazy import: tracespan is
+    stdlib-only like this client, but the scalar/lifecycle surface
+    shouldn't pay any misaka import until a response carries timings)."""
+    from misaka_tpu.utils.tracespan import parse_server_timing
+
+    return parse_server_timing(value)
+
+
+_traced_array_cls = None
+
+
+def _traced_array(arr, headers):
+    """`arr` as a numpy view carrying ``.timings`` + ``.trace_id`` (the
+    subclass is built lazily so the scalar/lifecycle client surface stays
+    numpy-free)."""
+    global _traced_array_cls
+    import numpy as np
+
+    if _traced_array_cls is None:
+        class TracedArray(np.ndarray):
+            """An int32 result array + the response's tracing context."""
+
+            timings = None
+            trace_id = None
+
+        _traced_array_cls = TracedArray
+    out = arr.view(_traced_array_cls)
+    st = headers.get("Server-Timing")
+    out.timings = _parse_server_timing(st) if st else {}
+    out.trace_id = headers.get("X-Misaka-Trace")
+    return out
 
 
 class MisakaClient:
@@ -130,6 +180,13 @@ class MisakaClient:
         conn.close()
 
     def _request(self, path: str, data: bytes | None, method: str) -> bytes:
+        return self._request_full(path, data, method)[0]
+
+    def _request_full(
+        self, path: str, data: bytes | None, method: str
+    ) -> tuple[bytes, dict[str, str]]:
+        """Like _request, but also returns the response headers the
+        tracing surface rides (X-Misaka-Trace, Server-Timing)."""
         headers = {}
         if data is not None:
             # the server's bulk lanes answer 411 without a length;
@@ -185,11 +242,16 @@ class MisakaClient:
                 conn.close()
             else:
                 self._checkin(conn)
+            resp_headers = {
+                "X-Misaka-Trace": resp.getheader("X-Misaka-Trace"),
+                "Server-Timing": resp.getheader("Server-Timing"),
+            }
             if resp.status >= 400:
                 raise MisakaClientError(
-                    resp.status, body.decode(errors="replace").strip()
+                    resp.status, body.decode(errors="replace").strip(),
+                    trace_id=resp_headers["X-Misaka-Trace"],
                 )
-            return body
+            return body, resp_headers
 
     def _post_form(self, path: str, **fields) -> bytes:
         return self._request(
@@ -212,8 +274,19 @@ class MisakaClient:
         self._post_form("/load", targetURI=target, program=program)
 
     def compute(self, value: int) -> int:
-        raw = self._post_form("/compute", value=str(int(value)))
-        return int(json.loads(raw)["value"])
+        """One value through POST /compute.  The returned int carries the
+        response's tracing context: ``result.timings`` (parsed
+        Server-Timing phases, ms) and ``result.trace_id``."""
+        raw, headers = self._request_full(
+            "/compute",
+            urllib.parse.urlencode({"value": str(int(value))}).encode(),
+            "POST",
+        )
+        out = TracedInt(json.loads(raw)["value"])
+        st = headers.get("Server-Timing")
+        out.timings = _parse_server_timing(st) if st else {}
+        out.trace_id = headers.get("X-Misaka-Trace")
+        return out
 
     # --- bulk compute lanes -------------------------------------------------
 
@@ -227,8 +300,10 @@ class MisakaClient:
         body = b"values=" + b"+".join(b"%d" % v for v in vals.tolist())
         if spread:
             body += b"&spread=1"
-        raw = self._request("/compute_batch", body, "POST")
-        return np.asarray(json.loads(raw)["values"], dtype=np.int32)
+        raw, headers = self._request_full("/compute_batch", body, "POST")
+        return _traced_array(
+            np.asarray(json.loads(raw)["values"], dtype=np.int32), headers
+        )
 
     def compute_raw(self, values, spread: bool = True):
         """The wire-efficient lane: raw little-endian int32 both ways.
@@ -237,8 +312,8 @@ class MisakaClient:
 
         vals = np.ascontiguousarray(values, dtype="<i4")
         path = "/compute_raw?spread=" + ("1" if spread else "0")
-        raw = self._request(path, vals.tobytes(), "POST")
-        return np.frombuffer(raw, dtype="<i4").copy()
+        raw, headers = self._request_full(path, vals.tobytes(), "POST")
+        return _traced_array(np.frombuffer(raw, dtype="<i4").copy(), headers)
 
     # --- observability ------------------------------------------------------
 
@@ -256,8 +331,28 @@ class MisakaClient:
         return self._request("/metrics", None, "GET").decode()
 
     def trace(self, last: int | None = None) -> list[dict]:
-        path = "/trace" if last is None else f"/trace?last={int(last)}"
+        """Decoded INSTRUCTION history (GET /debug/isa_trace — renamed
+        from /trace, which the server keeps as a deprecated alias)."""
+        path = "/debug/isa_trace" if last is None \
+            else f"/debug/isa_trace?last={int(last)}"
         return json.loads(self._request(path, None, "GET"))["entries"]
+
+    def debug_requests(self, slowest: bool = False) -> dict:
+        """The request-trace flight recorder (GET /debug/requests):
+        recent + slowest completed traces, summaries only."""
+        path = "/debug/requests" + ("?slowest=1" if slowest else "")
+        return json.loads(self._request(path, None, "GET"))
+
+    def debug_request(self, trace_id: str) -> dict:
+        """One completed trace's full span tree."""
+        return json.loads(
+            self._request(f"/debug/requests/{trace_id}", None, "GET")
+        )
+
+    def perfetto(self) -> dict:
+        """The flight recorder as Chrome trace-event JSON — dump it to a
+        file and load in https://ui.perfetto.dev."""
+        return json.loads(self._request("/debug/perfetto", None, "GET"))
 
     # --- checkpoint / profiling (additive; server must have dirs enabled) --
 

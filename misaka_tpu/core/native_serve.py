@@ -40,6 +40,7 @@ import numpy as np
 from misaka_tpu.core import cinterp
 from misaka_tpu.core.state import NetworkState
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils import tracespan
 
 # Native-tier instrumentation (served at GET /metrics): one histogram for
 # every host-interpreter call kind, plus pool-shape gauges.  The label
@@ -228,7 +229,16 @@ class NativeServePool:
         self._last_state, self._last_dict = new_state, d
         out = new_state, packed
         _C_CALLS_POOL.inc()
-        _H_SERVE_POOL.observe(time.perf_counter() - t0)
+        dur = time.perf_counter() - t0
+        _H_SERVE_POOL.observe(dur)
+        # native-tier flight-recorder event (one deque append): the pool
+        # call underlying a fused pass, visible in GET /debug/perfetto
+        tracespan.note_tier(
+            "native.tick",
+            dur,
+            attrs={"replicas": self._replicas if active is None
+                   else int(len(active))},
+        )
         self._last_fill = (
             float((np.asarray(counts) > 0).sum()) / max(1, self._replicas)
         )

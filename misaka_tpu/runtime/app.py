@@ -80,10 +80,21 @@ so the master's env surface is what survives:
                    recovery paths with it; leave unset in production
   MISAKA_TRACE_CAP enable the per-lane instruction trace ring (core/trace.py)
                    with this many ticks of history; decoded listings served
-                   at GET /trace?last=N (disabled when unset; debug path —
+                   at GET /debug/isa_trace?last=N (GET /trace is a
+                   deprecated alias; disabled when unset; debug path —
                    recording costs one extra store per tick and forces the
                    scan engine).  With MISAKA_BATCH, traces the instance
                    selected by MISAKA_TRACE_INSTANCE (default 0)
+  MISAKA_TRACE_REQUESTS  "0" kills per-REQUEST distributed tracing
+                   (utils/tracespan.py; default on — every request gets a
+                   trace ID honoring an inbound X-Misaka-Trace header, a
+                   span tree across frontend/plane/scheduler/rpc hops, a
+                   Server-Timing response header, and a slot in the
+                   flight recorder served at GET /debug/requests +
+                   GET /debug/perfetto).  MISAKA_TRACE_SAMPLE thins root
+                   traces (default 1.0), MISAKA_TRACE_RING /
+                   MISAKA_TRACE_SLOWEST bound the recorder (256 / 32);
+                   docs/OBSERVABILITY.md "Request tracing"
   MISAKA_NATIVE_CODEC  /compute_batch decimal codec backend: unset = auto
                    (native C++ when a toolchain exists), "0" = numpy,
                    "1" = require native (utils/textcodec.py)

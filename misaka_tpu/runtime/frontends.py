@@ -50,6 +50,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils import tracespan
 from misaka_tpu.utils.backoff import Backoff
 from misaka_tpu.utils.httpfast import fast_parse_request
 
@@ -70,12 +71,24 @@ M_FE_CONFIGURED = metrics.gauge(
 
 # Compute-plane wire format (unix SOCK_STREAM, one frame in flight per
 # connection — pipelining comes from running several connections):
-#   request:  <I n_values> <n_values * 4 bytes little-endian int32>
+#   request:  <I n_values> <I n_meta_bytes>
+#             <n_values * 4 bytes little-endian int32>
+#             <n_meta_bytes of UTF-8 JSON trace metadata — [] when no
+#              request in the frame is traced>
 #   response: <i status> <I length> <payload>
 #     status == 200 -> payload is length*4 bytes of int32 outputs
 #     otherwise     -> payload is `length` bytes of utf-8 error body,
 #                      status is the HTTP code the frontend should answer
-_REQ_HDR = struct.Struct("<I")
+#
+# The trace metadata is a JSON list with one entry per TRACED request in
+# the frame: {"id": trace_id, "off": value offset, "len": value count,
+# "spans": [[name, start_monotonic_s, dur_s], ...]} — the spans the
+# frontend has already completed (http.parse, frontend.coalesce) ride
+# along so the engine-side trace tells the whole cross-process story.
+# CLOCK_MONOTONIC is host-wide, and the plane is a unix socket, so the
+# timestamps need no translation.  Both sides of the plane ship in one
+# build; there is no cross-version frame compatibility to keep.
+_REQ_HDR = struct.Struct("<II")
 _RESP_HDR = struct.Struct("<iI")
 
 # One frame's value budget.  Big enough that a frontend's whole in-hand
@@ -147,33 +160,82 @@ class ComputePlane:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         master = self._master
+
+        def parse_meta(blob: bytes) -> list:
+            """Engine-side traces for the frame's traced requests: honor
+            each frontend-minted ID, replay the forwarded frontend spans,
+            and hand the traces to the serve scheduler so serve.queue /
+            serve.pass land on them.  Malformed metadata is dropped, not
+            fatal — tracing must never break serving."""
+            if not blob or not tracespan.enabled():
+                # the engine-side kill switch skips even the metadata
+                # decode: MISAKA_TRACE_REQUESTS=0 must cost nothing here
+                return []
+            import json as _json
+
+            traces = []
+            try:
+                for seg in _json.loads(blob.decode()):
+                    tr = tracespan.begin(
+                        seg.get("id"), route="/compute_raw", activate=False
+                    )
+                    if tr is None:
+                        continue
+                    for name, start, dur in seg.get("spans", ()):
+                        tracespan.add_span(
+                            tr, str(name), float(start), float(dur)
+                        )
+                    traces.append(tr)
+            except (ValueError, TypeError, KeyError):
+                log.debug("dropping malformed plane trace metadata")
+            return traces
+
         try:
             while not self._closed:
-                n = _REQ_HDR.unpack(_recv_exact(conn, 4))[0]
+                n, n_meta = _REQ_HDR.unpack(_recv_exact(conn, 8))
                 if n > MAX_FRAME_VALUES:
                     body = b"frame exceeds MAX_FRAME_VALUES"
                     conn.sendall(_RESP_HDR.pack(413, len(body)) + body)
                     return  # protocol state is unrecoverable past this
                 raw = _recv_exact(conn, n * 4)
+                meta = _recv_exact(conn, n_meta) if n_meta else b""
+                traces = parse_meta(meta)
+                t_recv = time.monotonic()
                 if not master.is_running:
                     body = b"network is not running"  # the route's 400 body
                     conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
+                    for tr in traces:
+                        tracespan.end(tr, status=400)
                     continue
                 import numpy as np
 
                 values = np.frombuffer(raw, dtype="<i4")
                 try:
                     out = master.compute_coalesced(
-                        values, timeout=self._timeout, return_array=True
+                        values, timeout=self._timeout, return_array=True,
+                        traces=tuple(traces),
                     )
                 except Exception as e:
                     body = str(e).encode()
                     conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
+                    for tr in traces:
+                        tracespan.add_span(
+                            tr, "plane.recv", t_recv,
+                            time.monotonic() - t_recv,
+                        )
+                        tracespan.end(tr, status=500)
                     continue
                 payload = out.astype("<i4").tobytes()
                 conn.sendall(
                     _RESP_HDR.pack(200, len(payload) // 4) + payload
                 )
+                dur = time.monotonic() - t_recv
+                for tr in traces:
+                    tracespan.add_span(
+                        tr, "plane.recv", t_recv, dur,
+                        {"frame_values": int(n)},
+                    )
+                    tracespan.end(tr, status=200)
         except (ConnectionError, OSError) as e:
             # frontend went away; its requests fail on their side
             log.debug("compute-plane connection closed: %r", e)
@@ -203,14 +265,17 @@ class PlaneError(RuntimeError):
 
 
 class _PlaneRequest:
-    __slots__ = ("body", "out", "error", "event", "cancelled")
+    __slots__ = ("body", "out", "error", "event", "cancelled", "trace",
+                 "enqueued")
 
-    def __init__(self, body: bytes):
+    def __init__(self, body: bytes, trace=None):
         self.body = body          # raw little-endian int32 values
         self.out: bytes | None = None
         self.error: PlaneError | None = None
         self.event = threading.Event()
         self.cancelled = False    # waiter gave up; never ship it
+        self.trace = trace        # request trace (utils/tracespan.py) | None
+        self.enqueued = time.monotonic()  # frontend.coalesce span start
 
 
 class PlaneClient:
@@ -251,7 +316,7 @@ class PlaneClient:
 
     def compute_raw(self, body: bytes, timeout: float = 30.0) -> bytes:
         """One request's raw int32 body in, raw int32 outputs out."""
-        req = _PlaneRequest(body)
+        req = _PlaneRequest(body, trace=tracespan.current())
         with self._cond:
             self._pending.append(req)
             self._cond.notify()
@@ -301,11 +366,44 @@ class PlaneClient:
                 if not batch:
                     continue
                 self._inflight += 1
+            # Trace metadata for the frame: each traced request ships its
+            # ID + value offset + the spans already complete at frame
+            # build (http.parse, frontend.coalesce) so the engine-side
+            # trace carries the frontend half of the story.  Untraced
+            # frames pay 0 extra bytes.
+            meta = b""
+            now = time.monotonic()
+            traced = [r for r in batch if r.trace is not None]
+            if traced:
+                import json as _json
+
+                entries = []
+                off = 0
+                for r in batch:
+                    if r.trace is not None:
+                        tracespan.add_span(
+                            r.trace, "frontend.coalesce", r.enqueued,
+                            now - r.enqueued,
+                            {"frame_requests": len(batch)},
+                        )
+                        entries.append({
+                            "id": r.trace.trace_id,
+                            "off": off,
+                            "len": len(r.body) // 4,
+                            "spans": [
+                                [s.name, s.start, s.dur]
+                                for s in r.trace.spans
+                            ],
+                        })
+                    off += len(r.body) // 4
+                meta = _json.dumps(entries).encode()
+            t_ship = now
             try:
                 if sock is None:
                     sock = self._connect()
                 sock.sendall(
-                    _REQ_HDR.pack(total // 4) + b"".join(r.body for r in batch)
+                    _REQ_HDR.pack(total // 4, len(meta))
+                    + b"".join(r.body for r in batch) + meta
                 )
                 status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
                 if status == 200:
@@ -318,6 +416,9 @@ class PlaneClient:
                     err = PlaneError(status, _recv_exact(sock, length))
                     for r in batch:
                         r.error = err
+                dur = time.monotonic() - t_ship
+                for r in traced:
+                    tracespan.add_span(r.trace, "plane.ship", t_ship, dur)
             except (ConnectionError, OSError, struct.error) as e:
                 try:
                     if sock is not None:
@@ -396,11 +497,15 @@ def make_frontend_server(
                 if not self.raw_requestline:
                     self.close_connection = True
                     return
+                # parse-span clock starts after the request line arrives
+                # (the readline blocks across keep-alive idle time)
+                t_parse = time.monotonic()
                 parsed = fast_parse_request(self)
                 if parsed is None:
                     return
                 if not parsed and not self.parse_request():
                     return
+                self._parse_mark = (t_parse, time.monotonic() - t_parse)
                 mname = "do_" + self.command
                 if not hasattr(self, mname):
                     self.send_error(
@@ -413,15 +518,56 @@ def make_frontend_server(
                 self.log_error("Request timed out: %r", e)
                 self.close_connection = True
 
+        def send_response(self, code, message=None):
+            self._trace_code = code  # response status for the trace record
+            super().send_response(code, message)
+
         def _reply(self, code: int, data: bytes, ctype: str) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            # proxied responses carry the ENGINE's trace headers (they
+            # have the queue/pass phases) via _extra_headers; otherwise
+            # this worker answers with its own trace ID + total timing
+            extras = getattr(self, "_extra_headers", ()) or ()
+            have_trace = False
+            for k, v in extras:
+                if k.lower() == "x-misaka-trace":
+                    have_trace = True
+                self.send_header(k, v)
+            tr = getattr(self, "_misaka_trace", None)
+            if tr is not None and not have_trace:
+                self.send_header(tracespan.TRACE_HEADER, tr.trace_id)
+                st = tracespan.server_timing(tr)
+                if st:
+                    self.send_header("Server-Timing", st)
             self.end_headers()
             self.wfile.write(data)
 
         def _text(self, code: int, body: str) -> None:
             self._reply(code, body.encode(), "text/plain; charset=utf-8")
+
+        def _with_trace(self, inner) -> None:
+            """Begin/end the request trace around one handler dispatch —
+            the frontend-worker twin of make_http_server's _observed
+            (metrics live on the engine; the trace is what must start
+            HERE, where the request first enters the serving plane)."""
+            self._extra_headers = []
+            self._trace_code = None
+            trace = tracespan.begin(
+                self.headers.get(tracespan.TRACE_HEADER),
+                route=self.path.split("?", 1)[0],
+            )
+            self._misaka_trace = trace
+            mark = getattr(self, "_parse_mark", None)
+            self._parse_mark = None
+            if trace is not None and mark is not None:
+                tracespan.add_span(trace, "http.parse", mark[0], mark[1])
+            try:
+                inner()
+            finally:
+                self._misaka_trace = None
+                tracespan.end(trace, status=self._trace_code)
 
         def _read_body(self, required: bool = True):
             """Body bytes, or None after answering 411/400/413.
@@ -455,6 +601,12 @@ def make_frontend_server(
             return self.rfile.read(length)
 
         def do_POST(self):
+            self._with_trace(self._do_post)
+
+        def do_GET(self):
+            self._with_trace(lambda: self._proxy("GET"))
+
+        def _do_post(self):
             route = self.path.split("?", 1)[0]
             if route == "/compute_raw" and "spread=0" not in self.path:
                 length_hdr = self.headers.get("Content-Length", "")
@@ -508,9 +660,6 @@ def make_frontend_server(
                 return
             self._proxy("POST")
 
-        def do_GET(self):
-            self._proxy("GET")
-
         def _proxy(self, method: str) -> None:
             """Relay anything this worker does not accelerate to the
             engine's HTTP server over a per-thread keep-alive connection."""
@@ -523,6 +672,12 @@ def make_frontend_server(
             ctype = self.headers.get("Content-Type")
             if ctype:
                 headers["Content-Type"] = ctype
+            tr = getattr(self, "_misaka_trace", None)
+            if tr is not None:
+                # the trace follows the request to the engine, whose
+                # response headers (queue/pass phases, deprecations) come
+                # back verbatim below
+                headers[tracespan.TRACE_HEADER] = tr.trace_id
             for attempt in (0, 1):
                 conn = getattr(local, "engine_conn", None)
                 fresh = conn is None
@@ -542,6 +697,11 @@ def make_frontend_server(
                         self._text(502, f"engine unreachable: {e}")
                         return
                     continue  # stale pooled socket: retry once, fresh
+                for h in (tracespan.TRACE_HEADER, "Server-Timing",
+                          "Deprecation", "Link"):
+                    v = resp.getheader(h)
+                    if v:
+                        self._extra_headers.append((h, v))
                 self._reply(
                     resp.status, payload,
                     resp.getheader("Content-Type") or "text/plain",

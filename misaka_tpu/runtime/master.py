@@ -39,6 +39,7 @@ from misaka_tpu.tis.parser import TISParseError
 from misaka_tpu.tis.lower import TISLowerError
 from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils import tracespan
 from misaka_tpu.utils.httpfast import fast_parse_request as _fast_parse_request
 from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
 
@@ -175,11 +176,14 @@ _METRIC_ROUTES = frozenset({
     "/run", "/pause", "/reset", "/load", "/compute", "/compute_batch",
     "/compute_raw", "/checkpoint", "/restore", "/profile/start",
     "/profile/stop", "/status", "/trace", "/metrics", "/healthz",
+    "/debug/requests", "/debug/perfetto", "/debug/isa_trace",
 })
 
 
 def _route_label(path: str) -> str:
     route = path.split("?", 1)[0]
+    if route.startswith("/debug/requests/"):
+        return "/debug/requests"  # per-trace lookups share one label
     return route if route in _METRIC_ROUTES else "other"
 
 
@@ -236,9 +240,9 @@ class _BatchEntry:
     each (disjoint slices), so the array itself needs no lock."""
 
     __slots__ = ("arr", "out", "taken", "filled", "deadline", "event",
-                 "error", "enqueued", "dispatched", "cancelled")
+                 "error", "enqueued", "dispatched", "cancelled", "traces")
 
-    def __init__(self, arr: np.ndarray, deadline: float):
+    def __init__(self, arr: np.ndarray, deadline: float, traces=()):
         self.arr = arr
         self.out = np.empty((arr.size,), np.int32)
         self.taken = 0       # values cut into passes so far
@@ -249,6 +253,11 @@ class _BatchEntry:
         self.enqueued = time.monotonic()
         self.dispatched = False  # first-dispatch latch (queue-delay metric)
         self.cancelled = False   # waiter gave up; skip undispatched remainder
+        # request traces riding this entry (utils/tracespan.py): one for a
+        # direct HTTP request, several when a compute-plane frame carries
+        # many frontend requests in one entry.  serve.queue / serve.pass
+        # spans are recorded into each; empty tuple = untraced (no cost).
+        self.traces = traces
 
 
 class _BatcherShared:
@@ -364,11 +373,12 @@ class ServeBatcher:
         with self._shared.cond:
             return sum(e.arr.size - e.taken for e in self._shared.pending)
 
-    def compute(self, arr: np.ndarray, timeout: float) -> np.ndarray:
+    def compute(self, arr: np.ndarray, timeout: float,
+                traces=()) -> np.ndarray:
         """Enqueue one request's value stream and wait for its outputs
         (len(arr) in, len(arr) out, order preserved)."""
         self._ensure_workers()
-        entry = _BatchEntry(arr, time.monotonic() + timeout)
+        entry = _BatchEntry(arr, time.monotonic() + timeout, traces=traces)
         shared = self._shared
         master = self._master
         with shared.cond:
@@ -480,6 +490,10 @@ class ServeBatcher:
                 if not e.dispatched:
                     e.dispatched = True
                     M_SERVE_QUEUE_DELAY.observe(now - e.enqueued)
+                    for tr in e.traces:
+                        tracespan.add_span(
+                            tr, "serve.queue", e.enqueued, now - e.enqueued
+                        )
                 segs.append((e, e.taken, take))
                 e.taken += take
                 budget -= take
@@ -507,6 +521,7 @@ class ServeBatcher:
         Releases every slot in `slots`."""
         master = self._master
         shared = self._shared
+        t_pass = time.monotonic()
         if len(segs) == 1:
             e0, s0, ln = segs[0]
             flat = e0.arr[s0:s0 + ln]  # zero-copy: the big-batch fast path
@@ -525,6 +540,22 @@ class ServeBatcher:
         timeout_s = max(0.0, deadline - time.monotonic())
         with master._waiters_lock:
             master._waiters += 1
+
+        def record_pass_spans() -> None:
+            # one serve.pass span per traced request in the pass — the
+            # coalesced requests share identical pass timing, which is
+            # exactly what makes them stack on one pass in Perfetto.
+            # MUST run before any e.event.set(): a woken waiter builds
+            # its Server-Timing header from the spans recorded so far,
+            # and the pass phase has to be there by then.
+            dur = time.monotonic() - t_pass
+            attrs = {
+                "requests": len(segs), "values": total, "slots": n_used,
+            }
+            for e, _, _ in segs:
+                for tr in e.traces:
+                    tracespan.add_span(tr, "serve.pass", t_pass, dur, attrs)
+
         try:
             with master._epoch_lock:
                 epoch = master._epoch
@@ -548,6 +579,7 @@ class ServeBatcher:
                             master._stale[s2] += st2.size
                 raise
             flat_out = np.concatenate(parts)
+            record_pass_spans()  # before any waiter wakes (see above)
             # scatter-gather: per-slot FIFO + contiguous striping means the
             # flat output order IS the flat input order — segment j's
             # outputs are flat_out[pos_j : pos_j + len_j], exactly.
@@ -563,6 +595,7 @@ class ServeBatcher:
             for e in done:
                 e.event.set()
         except Exception as exc:
+            record_pass_spans()  # before the failed waiters wake
             msg = f"{exc} (coalesced pass: {len(segs)} request(s), " \
                   f"{total} values)"
             failed: list[_BatchEntry] = []
@@ -1357,6 +1390,8 @@ class MasterNode:
         if arr.size == 0:
             return np.empty((0,), np.int32) if return_array else []
         n = self._n_slots
+        tr = tracespan.current()
+        t_q = time.monotonic() if tr is not None else 0.0
         with self._rr_lock:
             start = self._rr
             self._rr = (self._rr + 1) % n
@@ -1375,12 +1410,20 @@ class MasterNode:
         M_COMPUTE_REQS.inc()
         M_COMPUTE_VALUES.inc(arr.size)
         try:
-            with self._epoch_lock:
-                epoch = self._epoch
-                self._submit_q.put([(slot, arr)])
-            self._work_event.set()
-            deadline = time.monotonic() + timeout
-            parts = self._collect_slot(slot, arr.size, deadline, epoch, timeout)
+            if tr is not None:
+                # the direct lane's queue phase is the slot-lock wait
+                tracespan.add_span(
+                    tr, "serve.queue", t_q, time.monotonic() - t_q
+                )
+            with tracespan.span("serve.pass", trace=tr, values=int(arr.size)):
+                with self._epoch_lock:
+                    epoch = self._epoch
+                    self._submit_q.put([(slot, arr)])
+                self._work_event.set()
+                deadline = time.monotonic() + timeout
+                parts = self._collect_slot(
+                    slot, arr.size, deadline, epoch, timeout
+                )
             out = np.concatenate(parts)
             return out if return_array else out.tolist()
         finally:
@@ -1455,7 +1498,8 @@ class MasterNode:
         return parts
 
     def compute_coalesced(
-        self, values, timeout: float = 30.0, return_array: bool = False
+        self, values, timeout: float = 30.0, return_array: bool = False,
+        traces=None,
     ):
         """A value stream through the serve scheduler: len(values) in,
         len(values) out, order preserved — and concurrent callers fuse
@@ -1476,11 +1520,17 @@ class MasterNode:
             raise ValueError(f"values must be a flat sequence, got shape {arr.shape}")
         if arr.size == 0:
             return np.empty((0,), np.int32) if return_array else []
+        if traces is None:
+            # the usual case: one HTTP request, its trace current on this
+            # handler thread; the compute plane passes its frame's traces
+            # explicitly (one entry can carry many)
+            tr = tracespan.current()
+            traces = (tr,) if tr is not None else ()
         if self._batcher is None:
             return self.compute_spread(
                 arr, timeout=timeout, return_array=return_array
             )
-        out = self._batcher.compute(arr, timeout)
+        out = self._batcher.compute(arr, timeout, traces=traces)
         return out if return_array else out.tolist()
 
     def compute_spread(
@@ -1521,27 +1571,35 @@ class MasterNode:
         M_COMPUTE_REQS.inc()
         M_COMPUTE_VALUES.inc(arr.size)
         try:
-            stripes = np.array_split(arr, len(owned))
-            with self._epoch_lock:
-                epoch = self._epoch
-                self._submit_q.put(list(zip(owned, stripes)))
-            self._work_event.set()
-            deadline = time.monotonic() + timeout
-            parts: list[np.ndarray] = []
-            for i, (s, part) in enumerate(zip(owned, stripes)):
-                try:
-                    parts.extend(
-                        self._collect_slot(s, part.size, deadline, epoch, timeout)
-                    )
-                except ComputeTimeout:
-                    # _collect_slot marked slot s; the stripes we never
-                    # collected will surface outputs too — mark those slots
-                    # stale as well so their pairing survives this failure.
-                    with self._epoch_lock:
-                        if self._epoch == epoch:
-                            for s2, part2 in list(zip(owned, stripes))[i + 1:]:
-                                self._stale[s2] += part2.size
-                    raise
+            with tracespan.span(
+                "serve.pass", values=int(arr.size), slots=len(owned)
+            ):
+                stripes = np.array_split(arr, len(owned))
+                with self._epoch_lock:
+                    epoch = self._epoch
+                    self._submit_q.put(list(zip(owned, stripes)))
+                self._work_event.set()
+                deadline = time.monotonic() + timeout
+                parts: list[np.ndarray] = []
+                for i, (s, part) in enumerate(zip(owned, stripes)):
+                    try:
+                        parts.extend(
+                            self._collect_slot(
+                                s, part.size, deadline, epoch, timeout
+                            )
+                        )
+                    except ComputeTimeout:
+                        # _collect_slot marked slot s; the stripes we never
+                        # collected will surface outputs too — mark those
+                        # slots stale as well so their pairing survives
+                        # this failure.
+                        with self._epoch_lock:
+                            if self._epoch == epoch:
+                                for s2, part2 in list(
+                                    zip(owned, stripes)
+                                )[i + 1:]:
+                                    self._stale[s2] += part2.size
+                        raise
             out = np.concatenate(parts)
             return out if return_array else out.tolist()
         finally:
@@ -2361,8 +2419,14 @@ class MasterNode:
             # One observe + one labeled inc per chunk: the instrumentation
             # cost is a lock and a bisect against a chunk that advances
             # thousands of ticks — measured <<5% on the native serve path.
-            M_CHUNK_SECONDS.observe(time.perf_counter() - t_iter)
+            iter_dur = time.perf_counter() - t_iter
+            M_CHUNK_SECONDS.observe(iter_dur)
             (M_ITER_SERVE if busy else M_ITER_IDLE).inc()
+            if busy:
+                # engine-tier flight-recorder event (one deque append):
+                # Perfetto shows serving chunks underneath the request
+                # spans they carried; idle chunks are noise and skipped
+                tracespan.note_tier("engine.chunk", iter_dur)
             if flushing:
                 # Quiescence = several consecutive chunks with no output,
                 # an empty input ring, and (native) no replica retiring
@@ -2493,11 +2557,17 @@ def make_http_server(
                 if not self.raw_requestline:
                     self.close_connection = True
                     return
+                # http.parse span timing starts AFTER the request line
+                # arrives: on a keep-alive connection the readline above
+                # blocks across idle time between requests, which is not
+                # parsing
+                t_parse = time.monotonic()
                 parsed = _fast_parse_request(self)
                 if parsed is None:  # answered an error during parsing
                     return
                 if not parsed and not self.parse_request():
                     return
+                self._parse_mark = (t_parse, time.monotonic() - t_parse)
                 mname = "do_" + self.command
                 if not hasattr(self, mname):
                     self.send_error(
@@ -2513,9 +2583,23 @@ def make_http_server(
 
         def _observed(self, method: str, inner) -> None:
             """Per-route request counter + error counter by status code +
-            in-flight gauge + latency histogram around every handler."""
+            in-flight gauge + latency histogram around every handler —
+            plus the request trace (utils/tracespan.py): begun here from
+            the inbound X-Misaka-Trace header (minted otherwise), current
+            on this handler thread for the whole request so the compute
+            lanes and jsonlog pick it up, ended into the flight recorder
+            with the response status."""
             route = _route_label(self.path)
             self._metrics_code = None  # reset: keep-alive reuses the handler
+            self._extra_headers = []   # per-request; keep-alive reuse
+            trace = tracespan.begin(
+                self.headers.get(tracespan.TRACE_HEADER), route=route
+            )
+            self._misaka_trace = trace
+            mark = getattr(self, "_parse_mark", None)
+            self._parse_mark = None
+            if trace is not None and mark is not None:
+                tracespan.add_span(trace, "http.parse", mark[0], mark[1])
             M_HTTP_INFLIGHT.inc()
             t0 = time.perf_counter()
             try:
@@ -2529,6 +2613,8 @@ def make_http_server(
                 if code >= 400:
                     M_HTTP_ERRORS.labels(route=route, code=str(code)).inc()
                 M_HTTP_INFLIGHT.dec()
+                self._misaka_trace = None
+                tracespan.end(trace, status=code)
 
         def do_GET(self):
             self._observed("GET", self._handle_get)
@@ -2536,11 +2622,27 @@ def make_http_server(
         def do_POST(self):
             self._observed("POST", self._handle_post)
 
+        def _trace_headers(self) -> None:
+            """Per-request extra headers, written between send_response
+            and end_headers on every response path: deprecation notices
+            queued by a route, then the trace ID + Server-Timing phases
+            (queue/pass from the serve spans recorded so far, total) —
+            the contract client.py parses into result.timings."""
+            for k, v in getattr(self, "_extra_headers", ()) or ():
+                self.send_header(k, v)
+            tr = getattr(self, "_misaka_trace", None)
+            if tr is not None:
+                self.send_header(tracespan.TRACE_HEADER, tr.trace_id)
+                st = tracespan.server_timing(tr)
+                if st:
+                    self.send_header("Server-Timing", st)
+
         def _text(self, code: int, body: str) -> None:
             data = body.encode()
             self.send_response(code)
             self.send_header("Content-Type", "text/plain; charset=utf-8")
             self.send_header("Content-Length", str(len(data)))
+            self._trace_headers()
             self.end_headers()
             self.wfile.write(data)
 
@@ -2556,6 +2658,7 @@ def make_http_server(
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            self._trace_headers()
             self.end_headers()
             self.wfile.write(data)
 
@@ -2614,7 +2717,42 @@ def make_http_server(
                         payload["frontends"] = sup.state()
                     self._json(payload)
                     return
-                if parsed.path == "/trace":
+                if parsed.path == "/debug/requests":
+                    # the request-trace flight recorder: recent ring +
+                    # slowest-K reservoir summaries (?slowest=1 for the
+                    # reservoir alone — the "histogram says p99 is bad,
+                    # which request was it" entry point)
+                    payload = tracespan.debug_payload()
+                    q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                    if q.get("slowest") == "1":
+                        payload.pop("recent", None)
+                    self._json(payload)
+                    return
+                if parsed.path.startswith("/debug/requests/"):
+                    tid = parsed.path[len("/debug/requests/"):]
+                    tr = tracespan.RECORDER.get(tid)
+                    if tr is None:
+                        self._text(404, f"no completed trace {tid!r} in "
+                                        f"the flight recorder")
+                        return
+                    self._json(tr.to_dict())
+                    return
+                if parsed.path == "/debug/perfetto":
+                    # Chrome trace-event JSON of the recorder contents —
+                    # load in https://ui.perfetto.dev or chrome://tracing
+                    self._json(tracespan.perfetto())
+                    return
+                if parsed.path in ("/trace", "/debug/isa_trace"):
+                    # the INSTRUCTION-history listing (core/trace.py),
+                    # renamed to /debug/isa_trace: "/trace" collided with
+                    # the request-tracing namespace above.  The old path
+                    # answers the same body plus a Deprecation header.
+                    if parsed.path == "/trace":
+                        self._extra_headers.append(("Deprecation", "true"))
+                        self._extra_headers.append(
+                            ("Link",
+                             '</debug/isa_trace>; rel="successor-version"')
+                        )
                     if not hasattr(master, "trace"):
                         # the distributed control plane (runtime/nodes.py)
                         # has no fused trace ring

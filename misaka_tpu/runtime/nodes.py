@@ -51,6 +51,7 @@ from misaka_tpu.tis.parser import TISParseError, parse
 from misaka_tpu.transport import rpc
 from misaka_tpu.transport import messenger_pb2 as pb
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils import tracespan
 
 # Distributed-mode metrics (the same registry the fused master renders at
 # GET /metrics; runtime.master.make_http_server serves this control plane
@@ -859,12 +860,17 @@ class MasterNodeProcess:
         broadcast (master.go:271-294)."""
         errors: list[Exception] = []
         lock = threading.Lock()
+        # the HTTP request's trace does not cross thread creation by
+        # itself (contextvars are per-thread): hand it to each fan-out
+        # thread so the rpc.<Method> spans + wire metadata ride along
+        trace = tracespan.current()
 
         def call(name: str, info: dict) -> None:
             try:
                 cls = rpc.StackClient if info.get("type") == "stack" else rpc.ProgramClient
                 client = self._pool.get(cls, name)
-                getattr(client, command)(timeout=10)
+                with tracespan.use(trace):
+                    getattr(client, command)(timeout=10)
                 self._health.record_ok(name)
             except Exception as e:  # noqa: BLE001 — collected, not swallowed
                 self._health.record_failure(name, str(e))

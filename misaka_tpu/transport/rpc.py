@@ -26,6 +26,7 @@ from google.protobuf import empty_pb2
 
 from misaka_tpu.transport import messenger_pb2 as pb
 from misaka_tpu.utils import faults
+from misaka_tpu.utils import tracespan
 
 # The shared retry-delay policy, re-exported for the node retry loops:
 # the pre-r9 loop slept a fixed 50ms forever — a dead peer got hammered
@@ -69,10 +70,35 @@ class _FaultableCallable:
 
     def __call__(self, request, timeout=None):
         self._check()
-        return self._inner(request, timeout=timeout)
+        trace = tracespan.current()
+        if trace is None:  # the production hot path: one contextvar read
+            return self._inner(request, timeout=timeout)
+        # A request trace is in scope (an HTTP broadcast fan-out, a /load):
+        # the ID crosses the wire as gRPC metadata — the peer's server
+        # interceptor records the receipt — and the call itself lands in
+        # the trace as an rpc.<Method> span.
+        t0 = time.monotonic()
+        try:
+            return self._inner(
+                request, timeout=timeout,
+                metadata=((tracespan.RPC_METADATA_KEY, trace.trace_id),),
+            )
+        finally:
+            tracespan.add_span(
+                trace, "rpc." + self._method.rsplit("/", 1)[-1],
+                t0, time.monotonic() - t0, {"path": self._method},
+            )
 
     def future(self, request):
         self._check()
+        trace = tracespan.current()
+        if trace is not None:
+            # propagate the ID; no span — the future's completion happens
+            # on a caller-owned schedule this wrapper cannot see
+            return self._inner.future(
+                request,
+                metadata=((tracespan.RPC_METADATA_KEY, trace.trace_id),),
+            )
         return self._inner.future(request)
 
 _EMPTY = empty_pb2.Empty
@@ -275,7 +301,10 @@ def make_server(
     """
     from concurrent import futures
 
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        interceptors=(_TraceMetadataInterceptor(),),
+    )
     for service_name, servicer in services.items():
         handlers = {}
         for method, (req_cls, resp_cls) in SERVICES[service_name].items():
@@ -296,6 +325,29 @@ def make_server(
     if bound == 0:
         raise RuntimeError(f"failed to bind gRPC server on {address}")
     return server, bound
+
+
+class _TraceMetadataInterceptor(grpc.ServerInterceptor):
+    """Record inbound trace IDs (x-misaka-trace metadata) as rpc.recv
+    tier events — the peer-side proof a request trace crossed the wire,
+    surfaced in this process's /debug/perfetto.  Passthrough-cheap: one
+    metadata scan per RPC, and only RPCs that carry the key record."""
+
+    def intercept_service(self, continuation, handler_call_details):
+        for key, value in handler_call_details.invocation_metadata or ():
+            if key == tracespan.RPC_METADATA_KEY:
+                tracespan.note_tier(
+                    "rpc.recv." + handler_call_details.method.rsplit(
+                        "/", 1
+                    )[-1],
+                    0.0,
+                    attrs={
+                        "trace_id": value,
+                        "path": handler_call_details.method,
+                    },
+                )
+                break
+        return continuation(handler_call_details)
 
 
 def _snake(name: str) -> str:

@@ -8,10 +8,14 @@ One JSON object per line on stderr — the shape container log pipelines
    "route": "/run"}
 
 `route` appears when the record carries one (the HTTP handler passes
-`extra={"route": ...}` in runtime/master.py log_message); exceptions land
-under "exc" as a single escaped string, so a traceback stays ONE log event
-instead of N unparseable lines.  Stdlib-only by design — same constraint
-as the metrics plane (utils/metrics.py): nothing to pip install.
+`extra={"route": ...}` in runtime/master.py log_message); `trace_id`
+appears on every line emitted while a request trace is in scope on the
+logging thread (utils/tracespan.py context var — the join key that lets
+a log line be matched to its `/debug/requests/<id>` entry); exceptions
+land under "exc" as a single escaped string, so a traceback stays ONE
+log event instead of N unparseable lines.  Stdlib-only by design — same
+constraint as the metrics plane (utils/metrics.py): nothing to pip
+install.
 """
 
 from __future__ import annotations
@@ -20,6 +24,8 @@ import json
 import logging
 import sys
 import time
+
+from misaka_tpu.utils import tracespan
 
 
 class JsonFormatter(logging.Formatter):
@@ -38,6 +44,11 @@ class JsonFormatter(logging.Formatter):
         route = getattr(record, "route", None)
         if route:
             obj["route"] = route
+        # an explicit extra={"trace_id": ...} wins; otherwise the trace
+        # current on the EMITTING thread (set by the HTTP handlers)
+        trace_id = getattr(record, "trace_id", None) or tracespan.current_id()
+        if trace_id:
+            obj["trace_id"] = trace_id
         if record.exc_info:
             obj["exc"] = self.formatException(record.exc_info)
         # default=str: a log call must never crash on an unserializable arg

@@ -1,0 +1,453 @@
+"""End-to-end request tracing (utils/tracespan.py): trace-ID propagation
+across every hop the serving plane has, flight-recorder bounds, Perfetto
+export validity, and the stays-cheap overhead guard.
+
+The three propagation hops the acceptance pins:
+  * fused HTTP: header in -> same ID in /debug/requests -> header out
+  * frontend plane: an ID minted at a frontend worker is observable in
+    the ENGINE's recorder, with the frontend's spans forwarded over the
+    unix-socket frame metadata
+  * distributed gRPC: the ID crosses as metadata and the peer records
+    the receipt (rpc.recv.<Method> tier event)
+"""
+
+import http.client
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.utils import tracespan
+
+
+def _master(batch=4, **kw):
+    top = networks.add2(in_cap=16, out_cap=16, stack_cap=8)
+    return MasterNode(top, chunk_steps=64, batch=batch, **kw)
+
+
+@pytest.fixture
+def server():
+    m = _master()
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield m, f"http://127.0.0.1:{httpd.server_address[1]}"
+    finally:
+        m.pause()
+        httpd.shutdown()
+
+
+def _get(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.status, resp.read(), dict(resp.headers)
+
+
+def _post(base, path, body, headers=None):
+    req = urllib.request.Request(
+        base + path, data=body, method="POST", headers=headers or {}
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, resp.read(), dict(resp.headers)
+
+
+# --- the recorder (unit) ----------------------------------------------------
+
+
+def test_ring_never_exceeds_n_and_slowest_k_survives():
+    rec = tracespan.FlightRecorder(ring=8, slowest=2)
+    slow = tracespan.Trace("slow0000")
+    slow.dur = 9.5  # the known-slow synthetic request
+    rec.record(slow)
+    for i in range(50):
+        t = tracespan.Trace(f"fast{i:04d}")
+        t.dur = 0.001
+        rec.record(t)
+    assert len(rec.recent()) == 8  # ring bound holds
+    assert all(t.trace_id.startswith("fast") for t in rec.recent())
+    # ...but the reservoir still has the slow one, ranked first
+    slowest = rec.slowest()
+    assert len(slowest) == 2
+    assert slowest[0].trace_id == "slow0000"
+    assert rec.get("slow0000") is slow  # reachable by ID after eviction
+
+
+def test_kill_switch_and_sampling():
+    try:
+        tracespan.configure({"MISAKA_TRACE_REQUESTS": "0"})
+        assert not tracespan.enabled()
+        assert tracespan.begin("aaaa1111") is None
+        tracespan.configure({"MISAKA_TRACE_SAMPLE": "0.0"})
+        # sampled out when minting...
+        assert all(tracespan.begin() is None for _ in range(20))
+        # ...but an inbound ID is always honored (the upstream hop chose)
+        tr = tracespan.begin("bbbb2222")
+        assert tr is not None and tr.trace_id == "bbbb2222"
+        tracespan.end(tr, status=200)
+    finally:
+        tracespan.configure({})  # defaults
+
+
+def test_inbound_id_sanitized():
+    try:
+        assert tracespan.sanitize_id("abc") is None  # too short
+        assert tracespan.sanitize_id("x" * 65) is None  # too long
+        assert tracespan.sanitize_id("has space") is None
+        assert tracespan.sanitize_id("ab\r\nInjected: 1") is None
+        assert tracespan.sanitize_id("dead-BEEF-0123") == "dead-BEEF-0123"
+        tr = tracespan.begin("ab\r\nInjected: 1")  # minted instead
+        assert tr is not None and "\r" not in tr.trace_id
+        tracespan.end(tr)
+    finally:
+        tracespan.configure({})
+
+
+def test_span_tree_and_merge():
+    tr = tracespan.begin("cccc3333", route="/x", activate=False)
+    with tracespan.span("serve.pass", trace=tr, values=4):
+        time.sleep(0.001)
+    tracespan.end(tr, status=200)
+    d = tr.to_dict()
+    assert d["spans"][0]["name"] == "serve.pass"
+    assert d["spans"][0]["tier"] == "serve"
+    assert d["spans"][0]["dur_ms"] >= 1.0
+    assert d["spans"][0]["attrs"] == {"values": 4}
+    # merging two completions of one ID unions the spans, dedup'd
+    other = tracespan.Trace("cccc3333")
+    other.add("http.parse", time.monotonic(), 0.001)
+    other.dur = 0.002
+    merged = tracespan.merge_traces([tr, other])
+    assert {s.name for s in merged.spans} == {"serve.pass", "http.parse"}
+    again = tracespan.merge_traces([merged, merged])
+    assert len(again.spans) == len(merged.spans)
+
+
+# --- hop 1: fused HTTP ------------------------------------------------------
+
+
+def test_fused_http_trace_roundtrip(server):
+    m, base = server
+    m.run()
+    tid = "feed0123beef4567"
+    vals = np.arange(32, dtype=np.int32)
+    status, body, headers = _post(
+        base, "/compute_raw?spread=1", vals.astype("<i4").tobytes(),
+        {"X-Misaka-Trace": tid},
+    )
+    assert status == 200
+    np.testing.assert_array_equal(np.frombuffer(body, "<i4"), vals + 2)
+    # hop out: the response header carries the same ID + phase timings
+    assert headers["X-Misaka-Trace"] == tid
+    timings = tracespan.parse_server_timing(headers["Server-Timing"])
+    assert {"queue", "pass", "total"} <= set(timings)
+    assert timings["total"] >= timings["pass"] > 0
+    # observable in the recorder by ID, with the serve spans attached
+    _, body, _ = _get(base, "/debug/requests")
+    assert tid in {t["trace_id"] for t in json.loads(body)["recent"]}
+    _, body, _ = _get(base, f"/debug/requests/{tid}")
+    names = [s["name"] for s in json.loads(body)["spans"]]
+    assert "http.parse" in names
+    assert "serve.queue" in names and "serve.pass" in names
+    # a request WITHOUT an inbound ID gets one minted
+    status, _, headers = _post(
+        base, "/compute", b"value=5",
+        {"Content-Type": "application/x-www-form-urlencoded"},
+    )
+    assert status == 200 and tracespan.sanitize_id(headers["X-Misaka-Trace"])
+    # unknown trace IDs answer 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(base, "/debug/requests/nosuchtrace00000")
+    assert e.value.code == 404
+
+
+def test_perfetto_export_valid_with_coalesced_spans(server):
+    m, base = server
+    m.run()
+    # concurrent small requests force the serve scheduler to coalesce
+    ids = [f"cafe{i:04d}cafe{i:04d}" for i in range(8)]
+    errors = []
+
+    def one(tid):
+        try:
+            vals = np.arange(16, dtype=np.int32)
+            status, body, _ = _post(
+                base, "/compute_raw?spread=1", vals.astype("<i4").tobytes(),
+                {"X-Misaka-Trace": tid},
+            )
+            assert status == 200
+            np.testing.assert_array_equal(np.frombuffer(body, "<i4"), vals + 2)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(tid,)) for tid in ids]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    # a trace is recorded in the handler's finally AFTER the response
+    # bytes flush, so the last completions can land a beat after the
+    # client sees its response — poll until every ID is in the export
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        _, body, headers = _get(base, "/debug/perfetto")
+        doc = json.loads(body)  # MUST parse as trace-event JSON
+        got = {
+            ev.get("args", {}).get("trace_id")
+            for ev in doc["traceEvents"] if ev.get("ph") == "X"
+        }
+        if set(ids) <= got:
+            break
+        time.sleep(0.02)
+    assert headers["Content-Type"].startswith("application/json")
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert ev["ph"] in ("X", "M")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float))
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+    by_name = {}
+    for ev in events:
+        if ev["ph"] == "X" and ev.get("args", {}).get("trace_id") in ids:
+            by_name.setdefault(ev["name"], set()).add(ev["args"]["trace_id"])
+    # the coalesced concurrent requests all carry queue + pass spans
+    assert len(by_name.get("serve.queue", ())) == len(ids)
+    assert len(by_name.get("serve.pass", ())) == len(ids)
+    # one "process" per tier: serve spans ride the serve tier's pid
+    serve_pids = {
+        ev["pid"] for ev in events
+        if ev["ph"] == "X" and ev["name"].startswith("serve.")
+    }
+    assert serve_pids == {tracespan.TIER_PIDS["serve"]}
+
+
+# --- hop 2: the frontend plane ----------------------------------------------
+
+
+@pytest.fixture
+def frontend(tmp_path):
+    from misaka_tpu.runtime import frontends
+
+    m = _master()
+    engine_httpd = make_http_server(m, port=0)
+    threading.Thread(target=engine_httpd.serve_forever, daemon=True).start()
+    plane_path = str(tmp_path / "plane.sock")
+    plane = frontends.start_compute_plane(m, plane_path)
+    fe = frontends.make_frontend_server(
+        0, f"http://127.0.0.1:{engine_httpd.server_address[1]}",
+        plane_path, plane_conns=2,
+    )
+    threading.Thread(target=fe.serve_forever, daemon=True).start()
+    try:
+        yield m, fe.server_address[1], engine_httpd.server_address[1]
+    finally:
+        m.pause()
+        fe.shutdown()
+        plane.close()
+        engine_httpd.shutdown()
+
+
+def test_frontend_plane_trace_propagation(frontend):
+    m, fe_port, engine_port = frontend
+    m.run()
+    tid = "fe000111fe000111"
+    conn = http.client.HTTPConnection("127.0.0.1", fe_port, timeout=15)
+    vals = np.arange(32, dtype=np.int32)
+    conn.request(
+        "POST", "/compute_raw?spread=1", vals.astype("<i4").tobytes(),
+        {"X-Misaka-Trace": tid},
+    )
+    r = conn.getresponse()
+    assert r.status == 200
+    assert r.getheader("X-Misaka-Trace") == tid  # back to the client
+    np.testing.assert_array_equal(np.frombuffer(r.read(), "<i4"), vals + 2)
+    conn.close()
+    # the worker-minted... here worker-RECEIVED ID reached the ENGINE's
+    # recorder over the plane frame, with the frontend spans forwarded
+    deadline = time.monotonic() + 5
+    tr = None
+    while time.monotonic() < deadline:
+        tr = tracespan.RECORDER.get(tid)
+        if tr is not None and any(
+            s.name == "serve.pass" for s in tr.spans
+        ):
+            break
+        time.sleep(0.02)
+    assert tr is not None
+    names = {s.name for s in tr.spans}
+    assert {"frontend.coalesce", "plane.recv",
+            "serve.queue", "serve.pass"} <= names
+    tiers = {tracespan.tier_of(s.name) for s in tr.spans}
+    assert {"frontend", "plane", "serve"} <= tiers
+    # and a frontend request with NO inbound header still gets an ID,
+    # minted at the worker, observable on the engine's HTTP surface
+    conn = http.client.HTTPConnection("127.0.0.1", fe_port, timeout=15)
+    conn.request("POST", "/compute", b"value=3")
+    r = conn.getresponse()
+    assert r.status == 200
+    minted = r.getheader("X-Misaka-Trace")
+    r.read()
+    conn.close()
+    assert tracespan.sanitize_id(minted)
+    engine = http.client.HTTPConnection("127.0.0.1", engine_port, timeout=15)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        engine.request("GET", f"/debug/requests/{minted}")
+        r = engine.getresponse()
+        body = r.read()
+        if r.status == 200:
+            break
+        time.sleep(0.02)
+    assert r.status == 200, (minted, body)
+    assert json.loads(body)["trace_id"] == minted
+    engine.close()
+
+
+# --- hop 3: loopback gRPC ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_grpc_metadata_propagation_loopback():
+    from misaka_tpu.runtime.nodes import build_loopback_cluster
+
+    master, close = build_loopback_cluster(
+        {"misaka1": "program"}, {"misaka1": "IN ACC\nOUT ACC"}
+    )
+    httpd = make_http_server(master, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    tid = "d157d157d157d157"
+    try:
+        # /run broadcasts Program.Run to the peer inside the request scope
+        status, _, headers = _post(
+            base, "/run", b"", {"X-Misaka-Trace": tid}
+        )
+        assert status == 200 and headers["X-Misaka-Trace"] == tid
+        # client side: the rpc.<Method> span landed in the recorded trace
+        _, body, _ = _get(base, f"/debug/requests/{tid}")
+        assert "rpc.Run" in {s["name"] for s in json.loads(body)["spans"]}
+        # peer side: the metadata crossed the wire (server interceptor)
+        received = [
+            s for s in tracespan.tier_events()
+            if s.name == "rpc.recv.Run"
+            and (s.attrs or {}).get("trace_id") == tid
+        ]
+        assert received, "peer never saw the trace metadata"
+        master.pause()
+    finally:
+        httpd.shutdown()
+        close()
+
+
+def test_grpc_metadata_on_direct_broadcast():
+    """The fast twin of the loopback-HTTP test: a broadcast inside an
+    explicitly begun trace carries metadata to an in-process peer."""
+    from misaka_tpu.runtime.nodes import build_loopback_cluster
+
+    master, close = build_loopback_cluster(
+        {"misaka1": "program"}, {"misaka1": "IN ACC\nOUT ACC"}
+    )
+    tid = "ab12ab12ab12ab12"
+    try:
+        tr = tracespan.begin(tid, route="/run")
+        master.run()
+        tracespan.end(tr, status=200)
+        assert "rpc.Run" in {s.name for s in tr.spans}
+        assert any(
+            s.name == "rpc.recv.Run"
+            and (s.attrs or {}).get("trace_id") == tid
+            for s in tracespan.tier_events()
+        )
+        master.pause()
+    finally:
+        close()
+
+
+# --- satellites -------------------------------------------------------------
+
+
+def test_jsonlog_carries_trace_id():
+    from misaka_tpu.utils.jsonlog import JsonFormatter
+
+    fmt = JsonFormatter()
+    rec = logging.LogRecord("t", logging.INFO, __file__, 1, "hello", (), None)
+    assert "trace_id" not in json.loads(fmt.format(rec))
+    tr = tracespan.begin("0123456789abcdef")
+    try:
+        line = json.loads(fmt.format(rec))
+        assert line["trace_id"] == "0123456789abcdef"
+    finally:
+        tracespan.end(tr)
+    # out of scope again: no stale id
+    assert "trace_id" not in json.loads(fmt.format(rec))
+
+
+def test_client_parses_timings_and_error_trace_id(server):
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+
+    m, base = server
+    client = MisakaClient(base)
+    # error BEFORE running: the raised message is grep-able server-side
+    with pytest.raises(MisakaClientError) as e:
+        client.compute(1)
+    assert e.value.trace_id and f"[trace {e.value.trace_id}]" in str(e.value)
+    m.run()
+    result = client.compute(7)
+    assert result == 9
+    assert result.trace_id and "total" in result.timings
+    out = client.compute_raw(np.arange(8, dtype=np.int32))
+    np.testing.assert_array_equal(np.asarray(out), np.arange(8) + 2)
+    assert out.trace_id
+    assert {"queue", "pass", "total"} <= set(out.timings)
+    out2 = client.compute_batch([1, 2, 3])
+    assert out2.trace_id and out2.timings["total"] > 0
+    client.close()
+
+
+def test_overhead_guard_tracing_on_vs_off():
+    """Tracing must be cheap enough to leave on: the full per-request
+    begin/span/end path against the kill switch, generous bound (the
+    bench A/B pins the real <=5% budget; this is the tripwire for an
+    accidental O(expensive) on the hot path)."""
+    m = _master()
+    m.run()
+    vals = np.arange(64, dtype=np.int32)
+
+    def lap(n=150):
+        t0 = time.perf_counter()
+        for i in range(n):
+            tr = tracespan.begin(route="/compute_raw")
+            try:
+                with tracespan.use(tr):
+                    m.compute_coalesced(vals, return_array=True)
+            finally:
+                tracespan.end(tr, status=200)
+        return time.perf_counter() - t0
+
+    try:
+        lap(20)  # warm both paths
+        tracespan.configure({"MISAKA_TRACE_REQUESTS": "0"})
+        off = lap()
+        tracespan.configure({})
+        on = lap()
+        assert on <= off * 2.0 + 0.5, (on, off)
+    finally:
+        tracespan.configure({})
+        m.pause()
+
+
+def test_debug_requests_slowest_param(server):
+    m, base = server
+    m.run()
+    _post(base, "/compute", b"value=1")
+    _, body, _ = _get(base, "/debug/requests?slowest=1")
+    doc = json.loads(body)
+    assert "recent" not in doc and "slowest" in doc and doc["enabled"]

@@ -181,7 +181,10 @@ def test_single_step_api():
 
 
 def test_master_trace_live():
-    """MasterNode with trace_cap: live trace over HTTP GET /trace."""
+    """MasterNode with trace_cap: live instruction history over HTTP
+    GET /debug/isa_trace, with GET /trace kept as a deprecated alias
+    answering the same body plus a Deprecation header (the old name
+    collided with the request-tracing namespace, /debug/requests)."""
     import threading
     import urllib.request
 
@@ -202,11 +205,22 @@ def test_master_trace_live():
 
         import json
 
-        with urllib.request.urlopen(base + "/trace?last=5", timeout=10) as resp:
+        with urllib.request.urlopen(
+            base + "/debug/isa_trace?last=5", timeout=10
+        ) as resp:
             payload = resp.read().decode()
+            assert resp.headers.get("Deprecation") is None
         decoded = json.loads(payload)["entries"]
         assert decoded and {"tick", "lane", "name", "pc", "op", "committed", "acc", "text"} <= set(decoded[0])
         assert len({e["tick"] for e in decoded}) <= 5
+
+        # the deprecated alias answers the same body + Deprecation header
+        with urllib.request.urlopen(base + "/trace?last=5", timeout=10) as resp:
+            alias = resp.read().decode()
+            assert resp.headers.get("Deprecation") == "true"
+            assert "/debug/isa_trace" in (resp.headers.get("Link") or "")
+        assert {e["tick"] for e in json.loads(alias)["entries"]} \
+            <= {e["tick"] for e in master.trace()}
 
         # reset reinitializes the ring
         master.reset()

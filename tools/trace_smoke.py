@@ -1,0 +1,158 @@
+"""`make trace-smoke`: boot a server WITH frontend workers, fire
+concurrent traced traffic, fetch GET /debug/perfetto from the engine,
+and assert spans from >= 3 tiers appear under one trace ID (~10s,
+CPU-forced).
+
+This is the out-of-pytest tripwire for the whole propagation chain:
+client header -> SO_REUSEPORT frontend worker process (http.parse,
+frontend.coalesce) -> unix-socket plane frame metadata -> engine
+(plane.recv, serve.queue, serve.pass) -> flight recorder -> Perfetto
+export.  The same assertions run inside tier-1
+(tests/test_request_trace.py); this target drives the real subprocess
+worker boot path.
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime import frontends
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    master = MasterNode(networks.add2(), chunk_steps=64, batch=8)
+    engine_httpd = make_http_server(master, port=0)
+    threading.Thread(target=engine_httpd.serve_forever, daemon=True).start()
+    engine_port = engine_httpd.server_address[1]
+    plane_path = f"/tmp/misaka-trace-smoke-{os.getpid()}.sock"
+    plane = frontends.start_compute_plane(master, plane_path)
+    public_port = frontends.pick_free_port()
+    workers = frontends.spawn_frontends(
+        2, public_port, f"http://127.0.0.1:{engine_port}", plane_path
+    )
+    try:
+        if not frontends.wait_ready(public_port):
+            raise AssertionError("frontend workers did not come up")
+        master.run()
+
+        ids = [f"5110ce{i:02d}5110ce{i:02d}" for i in range(8)]
+        errors = []
+
+        def client(tid, seed):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", public_port, timeout=30
+                )
+                rng = np.random.default_rng(seed)
+                for _ in range(4):
+                    vals = rng.integers(-99, 99, size=64).astype(np.int32)
+                    conn.request(
+                        "POST", "/compute_raw?spread=1",
+                        vals.astype("<i4").tobytes(),
+                        {"X-Misaka-Trace": tid},
+                    )
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    assert resp.status == 200, (resp.status, body)
+                    assert resp.getheader("X-Misaka-Trace") == tid
+                    out = np.frombuffer(body, dtype="<i4")
+                    assert (out == vals + 2).all()
+                conn.close()
+            except Exception as e:  # pragma: no cover — surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(tid, i))
+            for i, tid in enumerate(ids)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+        # the engine's recorder needs a beat: plane traces complete after
+        # the response bytes are already on their way back
+        def fetch_perfetto():
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", engine_port, timeout=15
+            )
+            conn.request("GET", "/debug/perfetto")
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            assert resp.status == 200, resp.status
+            return json.loads(body)  # must parse as trace-event JSON
+
+        from misaka_tpu.utils import tracespan
+
+        tiers_by_id = {}
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            doc = fetch_perfetto()
+            events = doc["traceEvents"]
+            assert isinstance(events, list) and events
+            tiers_by_id = {}
+            for ev in events:
+                if ev.get("ph") != "X":
+                    continue
+                tid = ev.get("args", {}).get("trace_id")
+                if tid in ids:
+                    tiers_by_id.setdefault(tid, set()).add(
+                        tracespan.tier_of(ev["name"])
+                    )
+            if tiers_by_id and max(len(v) for v in tiers_by_id.values()) >= 3:
+                break
+            time.sleep(0.2)
+
+        best_id, best = max(
+            tiers_by_id.items(), key=lambda kv: len(kv[1]),
+            default=(None, set()),
+        )
+        assert len(best) >= 3, (
+            f"expected spans from >= 3 tiers under one trace ID, best was "
+            f"{best_id}: {sorted(best)}"
+        )
+        span_names = {
+            ev["name"] for ev in events
+            if ev.get("ph") == "X"
+            and ev.get("args", {}).get("trace_id") == best_id
+        }
+        assert {"serve.queue", "serve.pass"} <= span_names, span_names
+
+        print(json.dumps({
+            "trace_smoke": "ok",
+            "trace_id": best_id,
+            "tiers": sorted(best),
+            "spans": sorted(span_names),
+            "events_total": len(events),
+        }))
+        return 0
+    except AssertionError as e:
+        print(f"# trace-smoke FAILED: {e}", file=sys.stderr)
+        return 1
+    finally:
+        for p in workers:
+            p.terminate()
+        master.pause()
+        plane.close()
+        engine_httpd.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
