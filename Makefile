@@ -90,6 +90,17 @@ metrics-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python tools/trace_smoke.py
 
+# Program-registry tripwire (~15s): a REAL subprocess server with
+# MISAKA_PROGRAMS_DIR armed — upload two programs, serve both concurrently
+# from per-program engines (parity-checked), hot-swap one by publishing a
+# new version under live traffic with zero client-visible errors, and
+# assert /metrics carries program-labeled registry series and
+# /debug/requests traces carry the program attr on serve.pass.  The same
+# assertions run inside tier-1 (tests/test_registry.py).
+registry-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/registry_smoke.py
+
 # Fault-tolerance tripwire (~10s): the fast chaos lane, driven through the
 # MISAKA_FAULTS harness (utils/faults.py) — durable-checkpoint rejection of
 # torn/corrupt files, crash-mid-save atomicity, auto-checkpoint rotation +
@@ -133,4 +144,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke chaos-smoke parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke chaos-smoke parity-go parity-local parity-corpus stop clean

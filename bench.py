@@ -975,6 +975,194 @@ def bench_concurrency_sweep(
     return out
 
 
+def bench_multi_tenant(
+    clients=64,
+    payload_values=64,
+    batch=None,
+    in_cap=128,
+    chunk_steps=2048,
+    seconds=3.0,
+    warmup_s=0.5,
+    engine="auto",
+    timeout=60.0,
+):
+    """Multi-PROGRAM serving through the registry (r11): C keep-alive
+    clients split across three concurrently served tenants — dense (the
+    add2 compose network, 2 lanes + stack), compact (acc_loop, one lane),
+    and chained (an 8-stage pipeline) — each on its OWN per-program
+    engine behind one HTTP server, addressed via POST
+    /programs/<name>/compute_raw.
+
+    This is the many-scenarios axis the single-program lanes say nothing
+    about: per-program ServeBatchers coalescing independently, the
+    registry lease on every request, and three engines sharing the host.
+    Every response is parity-checked against its tenant's program delta.
+    Returns per-program AND aggregate requests, p50/p99 latency, and
+    values/s.
+    """
+    import http.client
+    import threading as _threading
+
+    import jax
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+    from misaka_tpu.runtime.registry import ProgramRegistry
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if batch is None:
+        batch = 32768 if on_tpu else 1024  # bench_served's defaults
+    caps = dict(in_cap=in_cap, out_cap=in_cap, stack_cap=16)
+    reg = ProgramRegistry(
+        None, batch=batch, engine=engine, chunk_steps=chunk_steps, caps=caps
+    )
+    top = networks.add2(**caps)
+    master = MasterNode(top, chunk_steps=chunk_steps, batch=batch, engine=engine)
+    reg.seed("dense", master, top)
+    # the other two tenants upload through the registry like a client would
+    tenants = [("dense", 2)]
+    for name, topo, delta in (
+        ("compact", networks.acc_loop(**caps), 3),
+        ("chained", networks.pipeline(8, **caps), 8),
+    ):
+        reg.publish(name, topology_json=json.dumps(
+            {"nodes": topo.node_info, "programs": topo.programs, **caps}
+        ))
+        tenants.append((name, delta))
+    httpd = make_http_server(master, port=0, registry=reg)
+    server_thread = _threading.Thread(target=httpd.serve_forever, daemon=True)
+    server_thread.start()
+    host, port = "127.0.0.1", httpd.server_address[1]
+    master.run()
+
+    rng = np.random.default_rng(7)
+    bodies = []
+    for _ in range(8):
+        vals = rng.integers(-1000, 1000, size=payload_values).astype(np.int32)
+        bodies.append((vals, np.ascontiguousarray(vals, "<i4").tobytes()))
+    lat_per_client = [[] for _ in range(clients)]
+    counts = [0] * clients
+    errors = []
+    stop = _threading.Event()
+    start_bar = _threading.Barrier(clients + 1)
+
+    def one_client(i):
+        name, delta = tenants[i % len(tenants)]
+        path = f"/programs/{name}/compute_raw?spread=1"
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+            lats = lat_per_client[i]
+            t_end = time.monotonic() + warmup_s
+            while time.monotonic() < t_end:  # warmup (activates engines)
+                vals, body = bodies[counts[i] % 8]
+                conn.request("POST", path, body)
+                raw = conn.getresponse().read()
+                if not np.array_equal(
+                    np.frombuffer(raw, dtype="<i4"), vals + delta
+                ):
+                    raise RuntimeError(
+                        f"multi-tenant parity FAILED (warmup, {name})"
+                    )
+                counts[i] += 1
+            counts[i] = 0
+            start_bar.wait()
+            while not stop.is_set():
+                vals, body = bodies[counts[i] % 8]
+                t0 = time.perf_counter()
+                conn.request("POST", path, body)
+                raw = conn.getresponse().read()
+                dt = time.perf_counter() - t0
+                if not np.array_equal(
+                    np.frombuffer(raw, dtype="<i4"), vals + delta
+                ):
+                    raise RuntimeError(f"multi-tenant parity FAILED ({name})")
+                lats.append(dt)
+                counts[i] += 1
+            conn.close()
+        except Exception as e:  # pragma: no cover — failure path
+            errors.append(e)
+            stop.set()
+            try:
+                start_bar.abort()
+            except Exception:
+                pass
+
+    ts = [
+        _threading.Thread(target=one_client, args=(i,)) for i in range(clients)
+    ]
+    try:
+        for t in ts:
+            t.start()
+        start_bar.wait()
+        t0 = time.perf_counter()
+        time.sleep(seconds)
+        stop.set()
+        for t in ts:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+    finally:
+        stop.set()
+        master.pause()
+        reg.close()
+        httpd.shutdown()
+
+    per_program = []
+    agg_lats = []
+    agg_reqs = 0
+    for j, (name, _) in enumerate(tenants):
+        lats = [
+            x for i in range(clients) if i % len(tenants) == j
+            for x in lat_per_client[i]
+        ]
+        n_reqs = sum(
+            counts[i] for i in range(clients) if i % len(tenants) == j
+        )
+        arr = np.asarray(lats) * 1e3 if lats else np.asarray([0.0])
+        per_program.append({
+            "program": name,
+            "clients": sum(
+                1 for i in range(clients) if i % len(tenants) == j
+            ),
+            "requests": n_reqs,
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "throughput": round(n_reqs * payload_values / elapsed, 1),
+        })
+        agg_lats.extend(lats)
+        agg_reqs += n_reqs
+    agg_arr = np.asarray(agg_lats) * 1e3 if agg_lats else np.asarray([0.0])
+    out = {
+        "engine": engine,
+        "batch": batch,
+        "clients": clients,
+        "payload_values": payload_values,
+        "programs": per_program,
+        "aggregate": {
+            "requests": agg_reqs,
+            "p50_ms": round(float(np.percentile(agg_arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(agg_arr, 99)), 3),
+            "throughput": round(agg_reqs * payload_values / elapsed, 1),
+        },
+    }
+    for p in per_program:
+        print(
+            f"# multi-tenant: {p['program']} C={p['clients']} "
+            f"reqs={p['requests']} p50={p['p50_ms']:.2f}ms "
+            f"p99={p['p99_ms']:.2f}ms throughput={p['throughput']:.0f}/s",
+            file=sys.stderr,
+        )
+    print(
+        f"# multi-tenant aggregate: C={clients} reqs={agg_reqs} "
+        f"p50={out['aggregate']['p50_ms']:.2f}ms "
+        f"p99={out['aggregate']['p99_ms']:.2f}ms "
+        f"throughput={out['aggregate']['throughput']:.0f}/s",
+        file=sys.stderr,
+    )
+    return out
+
+
 def bench_tracing_ab(pairs=6):
     """Request-tracing overhead A/B (ISSUE r10 budget: mean served-
     throughput ratio >= 0.95 on both lanes, tracing on vs the
@@ -1258,6 +1446,15 @@ def bench_native_scaling(max_threads=None):
 # architecture actually achieves instead of an unreachable ratio.)
 R08_COALESCED_64 = 220_000.0
 
+# The committed r11 multi-tenant capture on this host (64 clients split
+# across dense/compact/chained registry tenants, engine=native, aggregate
+# values/s through /programs/<name>/compute_raw).  bench_smoke gates at
+# half: a regression in per-program routing, the registry lease path, or
+# cross-engine contention trips it.  (The lane measures ~0.6x of the
+# single-program 64-client in-harness rate — three engines coalesce
+# independently, so each sees a third of the traffic.)
+R11_MULTI_TENANT_64 = 49_000.0
+
 
 def bench_smoke(target=NORTH_STAR):
     """`make bench-smoke`: a ~5s bench_served through the multi-threaded
@@ -1299,6 +1496,24 @@ def bench_smoke(target=NORTH_STAR):
     except Exception as e:  # infra failure IS a smoke failure
         line["ok"] = False
         line["coalesced_error"] = str(e)[:200]
+    try:
+        # the registry lane: 64 clients across three per-program engines
+        mt = bench_multi_tenant(clients=64, seconds=1.5, engine="native")
+        agg = mt["aggregate"]["throughput"]
+        line["multi_tenant_throughput"] = round(agg, 1)
+        line["multi_tenant_p50_ms"] = mt["aggregate"]["p50_ms"]
+        line["multi_tenant_target"] = round(0.5 * R11_MULTI_TENANT_64, 1)
+        if agg < 0.5 * R11_MULTI_TENANT_64:
+            line["ok"] = False
+            print(
+                f"# bench-smoke: multi-tenant lane {agg:.0f}/s < "
+                f"{0.5 * R11_MULTI_TENANT_64:.0f}/s "
+                f"(50% of the committed r11 capture)",
+                file=sys.stderr,
+            )
+    except Exception as e:  # infra failure IS a smoke failure
+        line["ok"] = False
+        line["multi_tenant_error"] = str(e)[:200]
     print(json.dumps(line))
     if not line["ok"]:
         print(
@@ -1898,6 +2113,12 @@ def main():
                 )
             except Exception as e:  # pragma: no cover
                 print(f"# concurrency sweep lane failed: {e}", file=sys.stderr)
+            # the multi-PROGRAM lane (r11): the same 64 clients split
+            # across three registry tenants on per-program engines
+            try:
+                payload["multi_tenant"] = bench_multi_tenant(seconds=2.0)
+            except Exception as e:  # pragma: no cover
+                print(f"# multi-tenant lane failed: {e}", file=sys.stderr)
 
     if fallback:
         print(json.dumps(payload))
@@ -2032,6 +2253,14 @@ if __name__ == "__main__":
         _sharded_worker(*map(int, sys.argv[i + 1 : i + 4]))
     elif "--smoke" in sys.argv:
         bench_smoke()
+    elif "--multi-tenant" in sys.argv:
+        # standalone registry-lane capture (the r11 multi-program lane)
+        import jax  # noqa: F401 — device selection before the lane
+
+        print(json.dumps({
+            "metric": "multi_tenant_throughput",
+            **bench_multi_tenant(),
+        }))
     elif "--sweep-fleet" in sys.argv:
         # client-fleet worker subprocess (no jax import on this path)
         i = sys.argv.index("--sweep-fleet")

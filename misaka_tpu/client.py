@@ -15,6 +15,9 @@ the two bulk lanes a throughput client actually wants:
   healthz()/metrics() GET  /healthz /metrics  (liveness + Prometheus text)
   checkpoint/restore  POST /checkpoint /restore  (server-side .npz)
   profile_start/stop  POST /profile/start /profile/stop
+  upload_program/list_programs/program_info  POST/GET /programs*
+                      (the registry surface; Client(program=...) pins a
+                      session to one registry program)
 
 The module imports stdlib only (numpy lazily, inside the two bulk
 methods) and none of the jax-backed misaka_tpu packages — the scalar and
@@ -112,7 +115,8 @@ class MisakaClient:
 
     def __init__(self, base_url: str = "http://localhost:8000",
                  timeout: float = 30.0, pool_size: int = 4,
-                 retry_stale: bool = True, connect_retries: int = 3):
+                 retry_stale: bool = True, connect_retries: int = 3,
+                 program: str | None = None):
         """`retry_stale` (default True) replays a request ONCE when a
         POOLED connection proves dead at send time or before any
         response byte arrives — the stale-keep-alive case.  This is
@@ -127,7 +131,15 @@ class MisakaClient:
         with exponential backoff (0.1s doubling, jittered).  Distinct
         from `retry_stale` and always safe: connection refused means the
         kernel rejected the dial, so nothing was ever sent to execute.
-        Pass 0 to surface the first refusal as URLError immediately."""
+        Pass 0 to surface the first refusal as URLError immediately.
+
+        `program` pins this session to one registry program: compute /
+        compute_batch / compute_raw then ride the program-addressed
+        routes (POST /programs/<name>/compute*).  Accepts "name",
+        "name@latest", or "name@<version>"; requires the server to run
+        with MISAKA_PROGRAMS_DIR (unknown programs answer 404).  None
+        (default) keeps the legacy routes, which serve the seeded
+        default program."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.retry_stale = bool(retry_stale)
@@ -144,6 +156,15 @@ class MisakaClient:
         self._pool: list[http.client.HTTPConnection] = []
         self._pool_lock = threading.Lock()
         self._pool_size = max(0, int(pool_size))
+        self.program = program
+
+    def _compute_path(self, suffix: str) -> str:
+        """`/compute*` or the program-addressed `/programs/<name>/compute*`
+        twin when this session is pinned to a registry program."""
+        if not self.program:
+            return suffix
+        return f"/programs/{urllib.parse.quote(self.program, safe='@')}" \
+               f"{suffix}"
 
     def close(self) -> None:
         """Drop every pooled connection (sessions are reusable after)."""
@@ -278,7 +299,7 @@ class MisakaClient:
         response's tracing context: ``result.timings`` (parsed
         Server-Timing phases, ms) and ``result.trace_id``."""
         raw, headers = self._request_full(
-            "/compute",
+            self._compute_path("/compute"),
             urllib.parse.urlencode({"value": str(int(value))}).encode(),
             "POST",
         )
@@ -300,7 +321,9 @@ class MisakaClient:
         body = b"values=" + b"+".join(b"%d" % v for v in vals.tolist())
         if spread:
             body += b"&spread=1"
-        raw, headers = self._request_full("/compute_batch", body, "POST")
+        raw, headers = self._request_full(
+            self._compute_path("/compute_batch"), body, "POST"
+        )
         return _traced_array(
             np.asarray(json.loads(raw)["values"], dtype=np.int32), headers
         )
@@ -311,7 +334,8 @@ class MisakaClient:
         import numpy as np
 
         vals = np.ascontiguousarray(values, dtype="<i4")
-        path = "/compute_raw?spread=" + ("1" if spread else "0")
+        path = self._compute_path("/compute_raw") \
+            + "?spread=" + ("1" if spread else "0")
         raw, headers = self._request_full(path, vals.tobytes(), "POST")
         return _traced_array(np.frombuffer(raw, dtype="<i4").copy(), headers)
 
@@ -353,6 +377,44 @@ class MisakaClient:
         """The flight recorder as Chrome trace-event JSON — dump it to a
         file and load in https://ui.perfetto.dev."""
         return json.loads(self._request("/debug/perfetto", None, "GET"))
+
+    # --- the program registry (server must run with MISAKA_PROGRAMS_DIR) ---
+
+    def upload_program(self, name: str, program: str | None = None,
+                       topology: "dict | str | None" = None,
+                       compose: str | None = None) -> dict:
+        """Publish one program version (POST /programs) and return the
+        server's {"name", "version", "created", "latest", "swapped"}.
+
+        Exactly one source form: `program` is bare TIS text (served as a
+        single-node network), `topology` a {"nodes": ..., "programs": ...}
+        dict or JSON string, `compose` a reference docker-compose YAML
+        text.  Identical sources dedup to one content-addressed version;
+        publishing a new version over a live engine hot-swaps it with
+        zero client-visible errors."""
+        fields: dict[str, str] = {"name": name}
+        if program is not None:
+            fields["program"] = program
+        if topology is not None:
+            fields["topology"] = (
+                topology if isinstance(topology, str) else json.dumps(topology)
+            )
+        if compose is not None:
+            fields["compose"] = compose
+        return json.loads(self._post_form("/programs", **fields))
+
+    def list_programs(self) -> dict:
+        """The registry catalog (GET /programs): every name's versions,
+        aliases, and which engines are active."""
+        return json.loads(self._request("/programs", None, "GET"))
+
+    def program_info(self, name: str) -> dict:
+        """One program's detail (GET /programs/<name>)."""
+        return json.loads(
+            self._request(
+                f"/programs/{urllib.parse.quote(name, safe='')}", None, "GET"
+            )
+        )
 
     # --- checkpoint / profiling (additive; server must have dirs enabled) --
 

@@ -74,10 +74,30 @@ so the master's env surface is what survives:
                    automatically (corrupt ones are skipped, falling back
                    to older snapshots) — crash recovery without operator
                    intervention
+  MISAKA_PROGRAMS_DIR  arm the program registry (runtime/registry.py):
+                   persistent store for uploaded/versioned TIS networks.
+                   POST /programs uploads (TIS source, topology JSON, or
+                   compose YAML), content-addressed + versioned
+                   (name@<sha12>, mutable name@latest alias); compute
+                   routes gain program addressing (POST
+                   /programs/<name>/compute[_batch|_raw] and the
+                   X-Misaka-Program header on the legacy routes, which
+                   default to the boot network, seeded as program
+                   MISAKA_DEFAULT_PROGRAM [default "default"]).  Each
+                   active program serves on its own engine;
+                   MISAKA_REGISTRY_MAX_ACTIVE (default 4) caps live
+                   engines with LRU eviction through the durable
+                   checkpoint path (state restores bit-identically on
+                   re-activation).  Publishing a new version under live
+                   traffic hot-swaps with zero client-visible errors
+                   (MISAKA_SWAP_DRAIN_S bounds the old engine's drain,
+                   default 30).  Unset = the single-program surface,
+                   exactly as before
   MISAKA_FAULTS    chaos harness (utils/faults.py): arm named fault
                    points, e.g. "worker_exit=2,ckpt_torn_write=0.5,
-                   rpc_drop@0.01" — `make chaos-smoke` drives the
-                   recovery paths with it; leave unset in production
+                   rpc_drop@0.01,swap_during_load=0.3" — `make
+                   chaos-smoke` drives the recovery paths with it; leave
+                   unset in production
   MISAKA_TRACE_CAP enable the per-lane instruction trace ring (core/trace.py)
                    with this many ticks of history; decoded listings served
                    at GET /debug/isa_trace?last=N (GET /trace is a
@@ -193,6 +213,7 @@ def _serve_http(
     environ=os.environ,
     checkpoint_dir: str | None = None,
     profile_dir: str | None = None,
+    registry=None,
 ) -> None:
     port = int(environ.get("MISAKA_PORT", "8000"))
     log_ = logging.getLogger("misaka_tpu.app")
@@ -209,13 +230,16 @@ def _serve_http(
         from misaka_tpu.runtime import frontends
 
         server = make_http_server(
-            master, 0, checkpoint_dir=checkpoint_dir, profile_dir=profile_dir
+            master, 0, checkpoint_dir=checkpoint_dir,
+            profile_dir=profile_dir, registry=registry,
         )
         engine_port = server.server_address[1]
         plane_path = environ.get(
             "MISAKA_PLANE_SOCKET", f"/tmp/misaka-plane-{os.getpid()}.sock"
         )
-        plane = frontends.start_compute_plane(master, plane_path)
+        plane = frontends.start_compute_plane(
+            master, plane_path, registry=registry
+        )
         # Supervised worker pool (not bare spawn_frontends): a dead worker
         # is respawned with backoff, a crash loop trips a circuit breaker,
         # and the pool's health rides /healthz + /status (the server reads
@@ -239,7 +263,8 @@ def _serve_http(
             plane.close()
         return
     server = make_http_server(
-        master, port, checkpoint_dir=checkpoint_dir, profile_dir=profile_dir
+        master, port, checkpoint_dir=checkpoint_dir, profile_dir=profile_dir,
+        registry=registry,
     )
     log_.info("starting http server on :%d", port)
     try:
@@ -370,6 +395,37 @@ def main() -> None:
                 master, checkpoint_dir, autockpt_s,
                 keep=int(environ.get("MISAKA_AUTOCKPT_KEEP", "4")),
             )
+        registry = None
+        programs_dir = environ.get("MISAKA_PROGRAMS_DIR")
+        if programs_dir:
+            # The program registry (runtime/registry.py): the boot network
+            # seeds the pinned default program; uploads, per-program
+            # engines, LRU eviction, and hot-swap layer on top.
+            from misaka_tpu.runtime.registry import ProgramRegistry
+
+            caps = {}
+            for env_name, field in (
+                ("MISAKA_STACK_CAP", "stack_cap"),
+                ("MISAKA_IN_CAP", "in_cap"),
+                ("MISAKA_OUT_CAP", "out_cap"),
+            ):
+                if environ.get(env_name):
+                    caps[field] = int(environ[env_name])
+            registry = ProgramRegistry(
+                programs_dir,
+                batch=batch,
+                engine=environ.get("MISAKA_ENGINE", "auto"),
+                caps=caps,
+            )
+            default_name = environ.get("MISAKA_DEFAULT_PROGRAM", "default")
+            # seed from the master's LIVE topology (an auto-restored
+            # checkpoint may carry different programs than the boot env)
+            registry.seed(default_name, master)
+            log_.info(
+                "program registry armed (dir %s, default program %r, "
+                "max_active %d)", programs_dir, default_name,
+                registry._max_active,
+            )
         if environ.get("MISAKA_AUTORUN") == "1":
             master.run()
         try:
@@ -378,10 +434,13 @@ def main() -> None:
                 environ,
                 checkpoint_dir=checkpoint_dir,
                 profile_dir=environ.get("MISAKA_PROFILE_DIR"),
+                registry=registry,
             )
         finally:
             if autockpt is not None:
                 autockpt.close()
+            if registry is not None:
+                registry.close()
     else:
         raise SystemExit(f"'{node_type}' not a valid node type")
 

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import socket
 import struct
 import subprocess
@@ -46,6 +47,7 @@ import sys
 import threading
 import time
 from collections import deque
+from urllib.parse import unquote
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from misaka_tpu.utils import faults
@@ -73,15 +75,20 @@ M_FE_CONFIGURED = metrics.gauge(
 # connection — pipelining comes from running several connections):
 #   request:  <I n_values> <I n_meta_bytes>
 #             <n_values * 4 bytes little-endian int32>
-#             <n_meta_bytes of UTF-8 JSON trace metadata — [] when no
-#              request in the frame is traced>
+#             <n_meta_bytes of UTF-8 JSON metadata — absent (0) when the
+#              frame is untraced AND addressed to the default program>
 #   response: <i status> <I length> <payload>
 #     status == 200 -> payload is length*4 bytes of int32 outputs
 #     otherwise     -> payload is `length` bytes of utf-8 error body,
 #                      status is the HTTP code the frontend should answer
 #
-# The trace metadata is a JSON list with one entry per TRACED request in
-# the frame: {"id": trace_id, "off": value offset, "len": value count,
+# The metadata is a JSON object {"program": name-or-null, "traces": [...]}
+# (a bare JSON list is accepted as traces-only, the pre-registry form).
+# "program" is the registry address every request in the frame shares —
+# the frontend coalescer packs frames PER PROGRAM, so engine-side
+# coalescing (one ServeBatcher per program engine) stays per-program by
+# construction.  Each traces entry covers one TRACED request in the
+# frame: {"id": trace_id, "off": value offset, "len": value count,
 # "spans": [[name, start_monotonic_s, dur_s], ...]} — the spans the
 # frontend has already completed (http.parse, frontend.coalesce) ride
 # along so the engine-side trace tells the whole cross-process story.
@@ -94,6 +101,13 @@ _RESP_HDR = struct.Struct("<iI")
 # One frame's value budget.  Big enough that a frontend's whole in-hand
 # backlog ships at once; small enough to bound engine-side buffering.
 MAX_FRAME_VALUES = 1 << 20
+
+# Program-addressed compute (the registry surface, runtime/registry.py):
+# /programs/<name>/<op> — the frontend accelerates the same ops it does on
+# the legacy paths, with the program threaded through the plane frames.
+_PROGRAM_COMPUTE_RE = re.compile(
+    r"^/programs/([^/]+)/(compute|compute_batch|compute_raw)$"
+)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -111,6 +125,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 # --- engine side ------------------------------------------------------------
 
 
+class _NotRunning(Exception):
+    """Internal control flow: the resolved engine is paused (the frame
+    answers the compute route's legacy 400 body)."""
+
+
+class _BadMeta(Exception):
+    """A plane frame's metadata blob failed to decode.  Must fail the
+    frame: the blob carries the PROGRAM address, and serving an
+    undecodable frame on the default tenant would return the wrong
+    network's outputs with a 200."""
+
+
 class ComputePlane:
     """The engine-side unix-socket listener serving fused compute frames.
 
@@ -121,8 +147,16 @@ class ComputePlane:
     frontends hold several connections for overlap.
     """
 
-    def __init__(self, master, path: str, timeout: float = 30.0):
+    def __init__(self, master, path: str, timeout: float = 30.0,
+                 registry=None):
         self._master = master
+        # the program registry (runtime/registry.py) when multi-program
+        # serving is armed: frames then resolve their engine through a
+        # registry lease (activating cold programs, parking through
+        # hot-swaps); None keeps the single-engine plane exactly.  This
+        # module never imports the registry — an unknown program surfaces
+        # as the lease's KeyError (ProgramNotFound), answered as 404.
+        self._registry = registry
         self._timeout = timeout
         self.path = path
         if os.path.exists(path):
@@ -160,35 +194,56 @@ class ComputePlane:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         master = self._master
+        registry = self._registry
 
-        def parse_meta(blob: bytes) -> list:
-            """Engine-side traces for the frame's traced requests: honor
-            each frontend-minted ID, replay the forwarded frontend spans,
-            and hand the traces to the serve scheduler so serve.queue /
-            serve.pass land on them.  Malformed metadata is dropped, not
-            fatal — tracing must never break serving."""
-            if not blob or not tracespan.enabled():
-                # the engine-side kill switch skips even the metadata
-                # decode: MISAKA_TRACE_REQUESTS=0 must cost nothing here
-                return []
+        def parse_meta(blob: bytes) -> tuple[str | None, list]:
+            """(program, traces) from the frame's JSON metadata.
+
+            The program address must decode even with tracing killed; an
+            UNDECODABLE blob raises _BadMeta and fails the frame (it may
+            name a program, and guessing "default" would silently serve
+            the wrong tenant's network).  Trace rebuilding (honor each
+            frontend-minted ID, replay the forwarded frontend spans, hand
+            the traces to the serve scheduler so serve.queue / serve.pass
+            land on them) only runs when tracing is enabled —
+            MISAKA_TRACE_REQUESTS=0 skips it — and stays lenient:
+            malformed trace SEGMENTS are dropped, never fatal."""
+            if not blob:
+                return None, []
             import json as _json
 
-            traces = []
             try:
-                for seg in _json.loads(blob.decode()):
-                    tr = tracespan.begin(
-                        seg.get("id"), route="/compute_raw", activate=False
-                    )
-                    if tr is None:
-                        continue
-                    for name, start, dur in seg.get("spans", ()):
-                        tracespan.add_span(
-                            tr, str(name), float(start), float(dur)
+                obj = _json.loads(blob.decode())
+                if isinstance(obj, dict):
+                    program = obj.get("program") or None
+                    segs = obj.get("traces", ())
+                elif isinstance(obj, list):
+                    # the pre-registry traces-only list form
+                    program, segs = None, obj
+                else:
+                    raise ValueError("metadata must be an object or list")
+                if program is not None and not isinstance(program, str):
+                    raise ValueError("program must be a string")
+            except (ValueError, TypeError, UnicodeDecodeError) as e:
+                raise _BadMeta(str(e)) from e
+            traces = []
+            if tracespan.enabled():
+                try:
+                    for seg in segs:
+                        tr = tracespan.begin(
+                            seg.get("id"), route="/compute_raw",
+                            activate=False,
                         )
-                    traces.append(tr)
-            except (ValueError, TypeError, KeyError):
-                log.debug("dropping malformed plane trace metadata")
-            return traces
+                        if tr is None:
+                            continue
+                        for name, start, dur in seg.get("spans", ()):
+                            tracespan.add_span(
+                                tr, str(name), float(start), float(dur)
+                            )
+                        traces.append(tr)
+                except (ValueError, TypeError, KeyError, AttributeError):
+                    log.debug("dropping malformed plane trace metadata")
+            return program, traces
 
         try:
             while not self._closed:
@@ -199,22 +254,70 @@ class ComputePlane:
                     return  # protocol state is unrecoverable past this
                 raw = _recv_exact(conn, n * 4)
                 meta = _recv_exact(conn, n_meta) if n_meta else b""
-                traces = parse_meta(meta)
+                try:
+                    program, traces = parse_meta(meta)
+                except _BadMeta as e:
+                    body = f"malformed plane metadata: {e}".encode()
+                    conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
+                    continue
                 t_recv = time.monotonic()
-                if not master.is_running:
+                import numpy as np
+
+                values = np.frombuffer(raw, dtype="<i4")
+                # Lease resolution FIRST, in its own try: only this step
+                # may answer 404 (ProgramNotFound is a KeyError subclass —
+                # this module stays registry-import-free).  A KeyError
+                # escaping the compute itself must stay a 500: classifying
+                # an engine bug as "program not found" would hide it from
+                # 5xx alerting.
+                lease_ctx = None
+                try:
+                    if registry is not None:
+                        # the registry lease: resolves the program (the
+                        # seeded default for None), activates cold
+                        # engines, parks through hot-swaps, and counts
+                        # the per-program metric series
+                        lease_ctx = registry.lease(
+                            program, values=int(values.size)
+                        )
+                        m = lease_ctx.__enter__()
+                    elif program:
+                        raise KeyError(
+                            f"program registry disabled; cannot "
+                            f"route to program {program!r}"
+                        )
+                    else:
+                        m = master
+                except KeyError as e:
+                    # args[0] dodges KeyError's repr-quoting of its message
+                    msg = e.args[0] if e.args and isinstance(
+                        e.args[0], str
+                    ) else str(e)
+                    body = msg.encode()
+                    conn.sendall(_RESP_HDR.pack(404, len(body)) + body)
+                    for tr in traces:
+                        tracespan.end(tr, status=404)
+                    continue
+                except Exception as e:
+                    # activation failure (RegistryError, compile error...)
+                    body = str(e).encode()
+                    conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
+                    for tr in traces:
+                        tracespan.end(tr, status=500)
+                    continue
+                try:
+                    if not m.is_running:
+                        raise _NotRunning()
+                    out = m.compute_coalesced(
+                        values, timeout=self._timeout,
+                        return_array=True, traces=tuple(traces),
+                    )
+                except _NotRunning:
                     body = b"network is not running"  # the route's 400 body
                     conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
                     for tr in traces:
                         tracespan.end(tr, status=400)
                     continue
-                import numpy as np
-
-                values = np.frombuffer(raw, dtype="<i4")
-                try:
-                    out = master.compute_coalesced(
-                        values, timeout=self._timeout, return_array=True,
-                        traces=tuple(traces),
-                    )
                 except Exception as e:
                     body = str(e).encode()
                     conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
@@ -225,6 +328,9 @@ class ComputePlane:
                         )
                         tracespan.end(tr, status=500)
                     continue
+                finally:
+                    if lease_ctx is not None:
+                        lease_ctx.__exit__(None, None, None)
                 payload = out.astype("<i4").tobytes()
                 conn.sendall(
                     _RESP_HDR.pack(200, len(payload) // 4) + payload
@@ -248,8 +354,9 @@ class ComputePlane:
                 pass
 
 
-def start_compute_plane(master, path: str, timeout: float = 30.0) -> ComputePlane:
-    return ComputePlane(master, path, timeout=timeout)
+def start_compute_plane(master, path: str, timeout: float = 30.0,
+                        registry=None) -> ComputePlane:
+    return ComputePlane(master, path, timeout=timeout, registry=registry)
 
 
 # --- frontend side ----------------------------------------------------------
@@ -266,9 +373,9 @@ class PlaneError(RuntimeError):
 
 class _PlaneRequest:
     __slots__ = ("body", "out", "error", "event", "cancelled", "trace",
-                 "enqueued")
+                 "enqueued", "program")
 
-    def __init__(self, body: bytes, trace=None):
+    def __init__(self, body: bytes, trace=None, program=None):
         self.body = body          # raw little-endian int32 values
         self.out: bytes | None = None
         self.error: PlaneError | None = None
@@ -276,6 +383,7 @@ class _PlaneRequest:
         self.cancelled = False    # waiter gave up; never ship it
         self.trace = trace        # request trace (utils/tracespan.py) | None
         self.enqueued = time.monotonic()  # frontend.coalesce span start
+        self.program = program    # registry address (None = default program)
 
 
 class PlaneClient:
@@ -314,9 +422,12 @@ class PlaneClient:
             self._closed = True
             self._cond.notify_all()
 
-    def compute_raw(self, body: bytes, timeout: float = 30.0) -> bytes:
-        """One request's raw int32 body in, raw int32 outputs out."""
-        req = _PlaneRequest(body, trace=tracespan.current())
+    def compute_raw(self, body: bytes, timeout: float = 30.0,
+                    program: str | None = None) -> bytes:
+        """One request's raw int32 body in, raw int32 outputs out.
+        `program` addresses a registry program (None = the seeded
+        default); frames coalesce strictly per program."""
+        req = _PlaneRequest(body, trace=tracespan.current(), program=program)
         with self._cond:
             self._pending.append(req)
             self._cond.notify()
@@ -351,18 +462,33 @@ class PlaneClient:
                     self._cond.wait(self._window_s)
                     if self._closed:
                         return
+                # One frame = one PROGRAM: the engine side runs a frame
+                # through a single program's ServeBatcher, so coalescing
+                # stays per-program by construction.  The head request
+                # picks the frame's program; later requests for other
+                # programs keep their FIFO position for the next frame
+                # (other dispatcher connections pick them up in parallel).
                 batch: list[_PlaneRequest] = []
+                skipped: deque[_PlaneRequest] = deque()
+                program: str | None = None
                 total = 0
                 while self._pending and total < MAX_FRAME_VALUES * 4:
                     req = self._pending[0]
                     if req.cancelled:
                         self._pending.popleft()
                         continue
+                    if batch and req.program != program:
+                        skipped.append(self._pending.popleft())
+                        continue
                     if total and total + len(req.body) > MAX_FRAME_VALUES * 4:
                         break
                     self._pending.popleft()
+                    if not batch:
+                        program = req.program
                     batch.append(req)
                     total += len(req.body)
+                while skipped:  # restore FIFO order for other programs
+                    self._pending.appendleft(skipped.pop())
                 if not batch:
                     continue
                 self._inflight += 1
@@ -374,7 +500,7 @@ class PlaneClient:
             meta = b""
             now = time.monotonic()
             traced = [r for r in batch if r.trace is not None]
-            if traced:
+            if traced or program is not None:
                 import json as _json
 
                 entries = []
@@ -396,7 +522,9 @@ class PlaneClient:
                             ],
                         })
                     off += len(r.body) // 4
-                meta = _json.dumps(entries).encode()
+                meta = _json.dumps(
+                    {"program": program, "traces": entries}
+                ).encode()
             t_ship = now
             try:
                 if sock is None:
@@ -608,6 +736,14 @@ def make_frontend_server(
 
         def _do_post(self):
             route = self.path.split("?", 1)[0]
+            pm = _PROGRAM_COMPUTE_RE.match(route)
+            if pm:
+                # program-addressed op: run the same accelerated body
+                # against the named program (the plane frame carries it)
+                program = unquote(pm.group(1))
+                route = "/" + pm.group(2)
+            else:
+                program = self.headers.get("X-Misaka-Program") or None
             if route == "/compute_raw" and "spread=0" not in self.path:
                 length_hdr = self.headers.get("Content-Length", "")
                 if length_hdr.isdigit() and int(length_hdr) > plane_body_limit:
@@ -622,7 +758,7 @@ def make_frontend_server(
                     self._text(400, "body must be raw int32 values")
                     return
                 try:
-                    out = plane.compute_raw(body)
+                    out = plane.compute_raw(body, program=program)
                 except PlaneError as e:
                     self._text(e.status, e.body.decode(errors="replace"))
                     return
@@ -649,7 +785,7 @@ def make_frontend_server(
                     return
                 raw = struct.pack("<i", value)
                 try:
-                    out = plane.compute_raw(raw)
+                    out = plane.compute_raw(raw, program=program)
                 except PlaneError as e:
                     self._text(e.status, e.body.decode(errors="replace"))
                     return
@@ -672,6 +808,11 @@ def make_frontend_server(
             ctype = self.headers.get("Content-Type")
             if ctype:
                 headers["Content-Type"] = ctype
+            prog = self.headers.get("X-Misaka-Program")
+            if prog:
+                # program addressing follows proxied requests (e.g. the
+                # legacy /compute_batch text lane) to the engine
+                headers["X-Misaka-Program"] = prog
             tr = getattr(self, "_misaka_trace", None)
             if tr is not None:
                 # the trace follows the request to the engine, whose

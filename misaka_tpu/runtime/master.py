@@ -21,6 +21,7 @@ Deliberate divergences (SURVEY.md quirks, each strictly better and test-pinned):
 from __future__ import annotations
 
 import collections
+import contextlib
 import json
 import logging
 import os
@@ -179,11 +180,25 @@ _METRIC_ROUTES = frozenset({
     "/debug/requests", "/debug/perfetto", "/debug/isa_trace",
 })
 
+# Program-addressed compute (the registry surface): the <name> segment
+# collapses out of the route label — program names are client-chosen, so
+# they live in the registry's own `program`-labeled series (with their own
+# cardinality guard), never in the route label.
+_PROGRAM_OPS = ("compute", "compute_batch", "compute_raw")
+_PROGRAM_COMPUTE_RE = re.compile(
+    r"^/programs/([^/]+)/(compute|compute_batch|compute_raw)$"
+)
+
 
 def _route_label(path: str) -> str:
     route = path.split("?", 1)[0]
     if route.startswith("/debug/requests/"):
         return "/debug/requests"  # per-trace lookups share one label
+    if route.startswith("/programs"):
+        parts = route.split("/")
+        if len(parts) >= 4 and parts[3] in _PROGRAM_OPS:
+            return "/programs/" + parts[3]
+        return "/programs"
     return route if route in _METRIC_ROUTES else "other"
 
 
@@ -479,8 +494,12 @@ class ServeBatcher:
         # tail entry so the pass fills exactly what its slots can refill ---
         budget = min(len(slots) * self._in_cap, self._max_values)
         segs: list[tuple[_BatchEntry, int, int]] = []
-        now = time.monotonic()
         with shared.cond:
+            # the queue-delay clock reads INSIDE the lock: an entry
+            # enqueued between an outside read and the acquisition would
+            # observe a negative delay (seen as a negative serve.queue
+            # span in the Perfetto export)
+            now = time.monotonic()
             while shared.pending and budget > 0:
                 e = shared.pending[0]
                 if e.cancelled:
@@ -552,6 +571,10 @@ class ServeBatcher:
             attrs = {
                 "requests": len(segs), "values": total, "slots": n_used,
             }
+            if master.program_label is not None:
+                # which registry tenant this pass served (the trace-side
+                # twin of the metrics plane's `program` label)
+                attrs["program"] = master.program_label
             for e, _, _ in segs:
                 for tr in e.traces:
                     tracespan.add_span(tr, "serve.pass", t_pass, dur, attrs)
@@ -1016,6 +1039,10 @@ class MasterNode:
         # zero device-loop cost, and a collected master reads as 0.
         self._created_mono = time.monotonic()
         self._requests_total = 0
+        # Which registry program this engine serves (runtime/registry.py
+        # sets it; None outside the registry).  Rides serve.pass trace
+        # spans and /status so multi-tenant traffic stays attributable.
+        self.program_label: str | None = None
         # checkpoint freshness anchor (misaka_checkpoint_age_seconds):
         # stamped by every successful save_checkpoint on this master
         self._last_ckpt_mono: float | None = None
@@ -1314,6 +1341,21 @@ class MasterNode:
             self._rate = None
             log.info("network was paused")
 
+    def close(self) -> None:
+        """Stop serving and release native resources promptly (the program
+        registry's eviction/retire path; harmless elsewhere).  The master
+        stays constructible-state consistent — run() after close() would
+        recompile nothing but serve on a closed native handle, so treat a
+        closed master as done."""
+        with self._lifecycle_lock:
+            self.pause()
+            self._drain_queues()
+            if self._batcher is not None:
+                with self._batcher._shared.cond:
+                    self._batcher._shared.closed = True
+                    self._batcher._shared.cond.notify_all()
+            self._close_runner(self._runner)
+
     def reset(self) -> None:
         """Stop + zero all state and queues (stopNode/resetNode, master.go:252-266)."""
         with self._lifecycle_lock:
@@ -1328,25 +1370,25 @@ class MasterNode:
     def load(self, target: str, program: str) -> None:
         """Reprogram one node; resets the whole network (master.go:145-195).
 
-        Ordering parity: target validation happens BEFORE anything stops
-        (master.go:158-163 — a bad target leaves the network running), while a
-        program that fails to compile is discovered after the reset, leaving
-        the network stopped with its old programs (LoadProgram errors before
-        overwriting p.asm, program.go:178-193).
+        COMPILE-FIRST (the registry discipline, runtime/registry.py): the
+        new program is validated, lowered, and its engine built BEFORE
+        anything stops — a parse/lower/runner error leaves the running
+        network completely untouched, old programs and in-flight state
+        intact.  This is a deliberate divergence from the reference, which
+        discovers a bad program only after resetting (program.go:178-193,
+        leaving the network stopped) — and strictly better: the pre-r10
+        port of that ordering wiped the live state on every typo'd /load.
+        Target validation still precedes everything (master.go:158-163).
         """
         with self._lifecycle_lock:
             new_topology = self._topology.with_program(target, program)  # validates target
+            # Compile + build the runner against the still-running network:
+            # both are pure w.r.t. the live net/state/runner triple, so a
+            # failure here (parse, lower, fused VMEM budget) propagates
+            # with the old network still serving.
+            new_net = new_topology.compile(batch=self._batch)
+            new_runner = self._make_runner(new_net)
             self.pause()
-            try:
-                new_net = new_topology.compile(batch=self._batch)  # may raise parse/lower errors
-                new_runner = self._make_runner(new_net)  # before any swap: a
-                # runner failure (e.g. fused VMEM budget) must leave the old
-                # net/state/runner triple intact and consistent
-            except Exception:
-                with self._state_lock:
-                    self._state = self._shard(self._net.init_state())
-                self._drain_queues()
-                raise
             with self._state_lock:
                 old_runner = self._runner
                 self._topology = new_topology
@@ -1415,7 +1457,10 @@ class MasterNode:
                 tracespan.add_span(
                     tr, "serve.queue", t_q, time.monotonic() - t_q
                 )
-            with tracespan.span("serve.pass", trace=tr, values=int(arr.size)):
+            pass_attrs = {"values": int(arr.size)}
+            if self.program_label is not None:
+                pass_attrs["program"] = self.program_label
+            with tracespan.span("serve.pass", trace=tr, **pass_attrs):
                 with self._epoch_lock:
                     epoch = self._epoch
                     self._submit_q.put([(slot, arr)])
@@ -1571,9 +1616,10 @@ class MasterNode:
         M_COMPUTE_REQS.inc()
         M_COMPUTE_VALUES.inc(arr.size)
         try:
-            with tracespan.span(
-                "serve.pass", values=int(arr.size), slots=len(owned)
-            ):
+            pass_attrs = {"values": int(arr.size), "slots": len(owned)}
+            if self.program_label is not None:
+                pass_attrs["program"] = self.program_label
+            with tracespan.span("serve.pass", **pass_attrs):
                 stripes = np.array_split(arr, len(owned))
                 with self._epoch_lock:
                     epoch = self._epoch
@@ -2479,12 +2525,22 @@ def make_http_server(
     port: int = 8000,
     checkpoint_dir: str | None = None,
     profile_dir: str | None = None,
+    registry=None,
 ) -> ThreadingHTTPServer:
     """The five client routes (master.go:90-224), byte-compatible, plus the
     additive /status, /trace, /checkpoint, /restore, /profile/* routes.
     (Byte compatibility covers the five reference routes; the additive
     /compute_batch emits JSON-equivalent fixed-width-padded int arrays —
     legal JSON whitespace, not byte-identical to json.dumps output.)
+
+    `registry` (runtime/registry.ProgramRegistry) arms the multi-program
+    surface: POST/GET /programs for upload/listing, program-addressed
+    compute at POST /programs/<name>/compute[_batch|_raw], and the
+    X-Misaka-Program header on the legacy compute routes.  Without a
+    header or program path the legacy routes serve the seeded default
+    program — full backward compatibility.  Unknown programs answer a
+    typed 404.  registry=None (the default) keeps the pre-registry
+    single-program surface exactly.
 
     HTTP checkpointing is DISABLED unless `checkpoint_dir` is configured;
     when enabled, clients pass a bare checkpoint NAME (no path separators)
@@ -2495,7 +2551,9 @@ def make_http_server(
     """
     import re
     import zipfile
+    from urllib.parse import unquote
 
+    from misaka_tpu.runtime.registry import ProgramNotFound, RegistryError
     from misaka_tpu.utils import textcodec
     from misaka_tpu.utils.profiling import Profiler, ProfilerError
 
@@ -2520,6 +2578,24 @@ def make_http_server(
         if not checkpoint_dir or not _name_re.match(name) or ".." in name:
             return None
         return os.path.join(checkpoint_dir, name if name.endswith(".npz") else name + ".npz")
+
+    @contextlib.contextmanager
+    def resolved_master(ref, values=0):
+        """The engine a compute request serves on: the registry lease for
+        a program-addressed request (activating cold programs, parking
+        through hot-swaps, counting per-program metrics), the seeded
+        default through the same lease when a registry is armed, or the
+        bare master on a pre-registry server."""
+        if registry is not None:
+            with registry.lease(ref, values=values) as m:
+                yield m
+            return
+        if ref:
+            raise ProgramNotFound(
+                f"program registry disabled (set MISAKA_PROGRAMS_DIR); "
+                f"cannot route to program {ref!r}"
+            )
+        yield master
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -2715,7 +2791,36 @@ def make_http_server(
                     sup = getattr(self.server, "misaka_supervisor", None)
                     if sup is not None:
                         payload["frontends"] = sup.state()
+                    if registry is not None:
+                        payload["programs"] = registry.summary()
                     self._json(payload)
+                    return
+                if parsed.path == "/programs":
+                    if registry is None:
+                        self._text(
+                            404,
+                            "program registry disabled "
+                            "(set MISAKA_PROGRAMS_DIR)",
+                        )
+                        return
+                    self._json(registry.list_programs())
+                    return
+                if parsed.path.startswith("/programs/"):
+                    if _PROGRAM_COMPUTE_RE.match(parsed.path):
+                        self._text(405, "method GET not allowed")
+                        return
+                    if registry is None:
+                        self._text(
+                            404,
+                            "program registry disabled "
+                            "(set MISAKA_PROGRAMS_DIR)",
+                        )
+                        return
+                    name = unquote(parsed.path[len("/programs/"):])
+                    try:
+                        self._json(registry.info(name))
+                    except ProgramNotFound as e:
+                        self._text(404, str(e))
                     return
                 if parsed.path == "/debug/requests":
                     # the request-trace flight recorder: recent ring +
@@ -2787,7 +2892,20 @@ def make_http_server(
 
         def _handle_post(self):
             try:
-                if self.path == "/run":
+                # Program addressing (the registry surface): a
+                # /programs/<name>/<op> path or an X-Misaka-Program header
+                # names the serving program; the op then runs the SAME
+                # route body as its legacy twin against the leased engine.
+                # Neither given -> the seeded default program (legacy
+                # behavior, byte-compatible).
+                path = self.path.split("?", 1)[0]
+                pm = _PROGRAM_COMPUTE_RE.match(path)
+                if pm:
+                    prog_ref = unquote(pm.group(1))
+                    path = "/" + pm.group(2)
+                else:
+                    prog_ref = self.headers.get("X-Misaka-Program") or None
+                if path == "/run":
                     self._form()  # drain any body (keep-alive sync)
                     try:
                         master.run()
@@ -2795,7 +2913,7 @@ def make_http_server(
                         self._text(400, f"error running network: {e}")
                         return
                     self._text(200, "Success")
-                elif self.path == "/pause":
+                elif path == "/pause":
                     self._form()  # drain any body (keep-alive sync)
                     try:
                         master.pause()
@@ -2803,7 +2921,7 @@ def make_http_server(
                         self._text(400, f"error pausing network: {e}")
                         return
                     self._text(200, "Success")
-                elif self.path == "/reset":
+                elif path == "/reset":
                     self._form()  # drain any body (keep-alive sync)
                     try:
                         master.reset()
@@ -2811,7 +2929,7 @@ def make_http_server(
                         self._text(400, f"error resetting network: {e}")
                         return
                     self._text(200, "Success")
-                elif self.path == "/load":
+                elif path == "/load":
                     form = self._form()
                     target = form.get("targetURI", "")
                     try:
@@ -2827,30 +2945,34 @@ def make_http_server(
                         )
                         return
                     self._text(200, "Success")
-                elif self.path == "/compute":
+                elif path == "/compute":
                     # body FIRST, even on the error paths: an early return
                     # that leaves the body unread desynchronizes a
                     # keep-alive connection (the next request line would be
                     # parsed out of this request's body)
                     form = self._form()
-                    if not master.is_running:
-                        self._text(400, "network is not running")
-                        return
                     try:
-                        value = int(form.get("value", ""))
-                    except ValueError:
-                        self._text(400, "cannot parse value")
+                        with resolved_master(prog_ref, values=1) as m:
+                            if not m.is_running:
+                                self._text(400, "network is not running")
+                                return
+                            try:
+                                value = int(form.get("value", ""))
+                            except ValueError:
+                                self._text(400, "cannot parse value")
+                                return
+                            # through the serve scheduler: concurrent
+                            # /compute callers coalesce into fused passes
+                            # (MasterNode only — the distributed control
+                            # plane keeps its per-value path)
+                            coalesced = getattr(m, "compute_coalesced", None)
+                            if coalesced is not None:
+                                result = int(coalesced([value])[0])
+                            else:
+                                result = m.compute(value)
+                    except ProgramNotFound as e:
+                        self._text(404, str(e))
                         return
-                    try:
-                        # through the serve scheduler: concurrent /compute
-                        # callers coalesce into fused passes (MasterNode
-                        # only — the distributed control plane keeps its
-                        # per-value path)
-                        coalesced = getattr(master, "compute_coalesced", None)
-                        if coalesced is not None:
-                            result = int(coalesced([value])[0])
-                        else:
-                            result = master.compute(value)
                     except ComputeTimeout as e:
                         self._text(500, str(e))
                         return
@@ -2860,7 +2982,7 @@ def make_http_server(
                         self._text(503, str(e))
                         return
                     self._json({"value": result})
-                elif self.path == "/compute_batch":
+                elif path == "/compute_batch":
                     # additive: a FIFO stream of values through one instance
                     # in a single HTTP round trip — the throughput shape of
                     # /compute (the reference moves one value per request).
@@ -2868,12 +2990,6 @@ def make_http_server(
                     # `spread=1` stripes the stream over free instances
                     # (order preserved) so one request can load the batch.
                     form = self._form()  # body first (keep-alive: see /compute)
-                    if not hasattr(master, "compute_many"):
-                        self._text(404, "not found")  # distributed control plane
-                        return
-                    if not master.is_running:
-                        self._text(400, "network is not running")
-                        return
                     try:
                         # vectorized decimal parse — the per-value Python of
                         # round 2 capped this route at 859k/s (textcodec.py)
@@ -2882,26 +2998,39 @@ def make_http_server(
                         self._text(400, "cannot parse values")
                         return
                     try:
-                        if form.get("spread") == "1" and hasattr(
-                            master, "compute_spread"
-                        ):
-                            # spread requests ride the serve scheduler
-                            # (compute_coalesced falls back to
-                            # compute_spread when MISAKA_SERVE_BATCH=0); the
-                            # unspread default keeps its documented
-                            # single-instance FIFO pinning.  The distributed
-                            # control plane has no scheduler at all — its
-                            # compute_spread is the whole-pipeline stream
-                            # lane (an r8 regression 500'd here)
-                            coalesced = getattr(
-                                master, "compute_coalesced",
-                                master.compute_spread,
-                            )
-                            result = coalesced(values, return_array=True)
-                        else:
-                            result = master.compute_many(
-                                values, return_array=True
-                            )
+                        with resolved_master(
+                            prog_ref, values=len(values)
+                        ) as m:
+                            if not hasattr(m, "compute_many"):
+                                self._text(404, "not found")  # distributed control plane
+                                return
+                            if not m.is_running:
+                                self._text(400, "network is not running")
+                                return
+                            if form.get("spread") == "1" and hasattr(
+                                m, "compute_spread"
+                            ):
+                                # spread requests ride the serve scheduler
+                                # (compute_coalesced falls back to
+                                # compute_spread when MISAKA_SERVE_BATCH=0);
+                                # the unspread default keeps its documented
+                                # single-instance FIFO pinning.  The
+                                # distributed control plane has no scheduler
+                                # at all — its compute_spread is the
+                                # whole-pipeline stream lane (an r8
+                                # regression 500'd here)
+                                coalesced = getattr(
+                                    m, "compute_coalesced",
+                                    m.compute_spread,
+                                )
+                                result = coalesced(values, return_array=True)
+                            else:
+                                result = m.compute_many(
+                                    values, return_array=True
+                                )
+                    except ProgramNotFound as e:
+                        self._text(404, str(e))
+                        return
                     except ComputeTimeout as e:
                         self._text(500, str(e))
                         return
@@ -2913,7 +3042,7 @@ def make_http_server(
                     self._bytes_json(
                         b'{"values": [' + ints_to_dec(result, b",") + b"]}\n"
                     )
-                elif self.path.split("?", 1)[0] == "/compute_raw":
+                elif path == "/compute_raw":
                     # additive: the wire-efficient twin of /compute_batch —
                     # request body is raw little-endian int32 values, the
                     # response body is raw int32 outputs, order preserved.
@@ -2948,12 +3077,6 @@ def make_http_server(
                     raw = self.rfile.read(length)
                     # post-body checks (body consumed: keep-alive stays
                     # synchronized through these early returns)
-                    if not hasattr(master, "compute_spread"):
-                        self._text(404, "not found")  # distributed control plane
-                        return
-                    if not master.is_running:
-                        self._text(400, "network is not running")
-                        return
                     if len(raw) % 4:
                         self._text(400, "body must be raw int32 values")
                         return
@@ -2963,22 +3086,34 @@ def make_http_server(
                         for k, v in parse_qs(urlparse(self.path).query).items()
                     }
                     try:
-                        if q.get("spread", "1") == "1":
-                            # the serve scheduler lane (falls back to
-                            # compute_spread when MISAKA_SERVE_BATCH=0, and
-                            # to the distributed control plane's stream
-                            # lane, which has no scheduler — an r8
-                            # regression 500'd every distributed
-                            # /compute_raw until r9)
-                            coalesced = getattr(
-                                master, "compute_coalesced",
-                                master.compute_spread,
-                            )
-                            result = coalesced(values, return_array=True)
-                        else:
-                            result = np.asarray(
-                                master.compute_many(values), np.int32
-                            )
+                        with resolved_master(
+                            prog_ref, values=int(values.size)
+                        ) as m:
+                            if not hasattr(m, "compute_spread"):
+                                self._text(404, "not found")  # distributed control plane
+                                return
+                            if not m.is_running:
+                                self._text(400, "network is not running")
+                                return
+                            if q.get("spread", "1") == "1":
+                                # the serve scheduler lane (falls back to
+                                # compute_spread when MISAKA_SERVE_BATCH=0,
+                                # and to the distributed control plane's
+                                # stream lane, which has no scheduler — an
+                                # r8 regression 500'd every distributed
+                                # /compute_raw until r9)
+                                coalesced = getattr(
+                                    m, "compute_coalesced",
+                                    m.compute_spread,
+                                )
+                                result = coalesced(values, return_array=True)
+                            else:
+                                result = np.asarray(
+                                    m.compute_many(values), np.int32
+                                )
+                    except ProgramNotFound as e:
+                        self._text(404, str(e))
+                        return
                     except ComputeTimeout as e:
                         self._text(500, str(e))
                         return
@@ -2986,7 +3121,37 @@ def make_http_server(
                         self._text(503, str(e))
                         return
                     self._bytes(result.astype("<i4").tobytes())
-                elif self.path == "/checkpoint":
+                elif path == "/programs":
+                    # the registry upload surface: publish one program
+                    # version (TIS source, topology JSON, or compose YAML)
+                    # under a name; publishing a NEW version over a live
+                    # engine hot-swaps it with zero client-visible errors
+                    # (runtime/registry.py)
+                    form = self._form()  # body first (keep-alive)
+                    if registry is None:
+                        self._text(
+                            404,
+                            "program registry disabled "
+                            "(set MISAKA_PROGRAMS_DIR)",
+                        )
+                        return
+                    try:
+                        result = registry.publish(
+                            form.get("name", ""),
+                            tis=form.get("program"),
+                            topology_json=form.get("topology"),
+                            compose=form.get("compose"),
+                        )
+                    except (
+                        RegistryError,
+                        TopologyError,
+                        TISParseError,
+                        TISLowerError,
+                    ) as e:
+                        self._text(400, f"error publishing program: {e}")
+                        return
+                    self._json(result)
+                elif path == "/checkpoint":
                     # additive routes: the reference cannot checkpoint
                     name = self._form().get("name", "")  # body first
                     if not checkpoint_dir:
@@ -2999,7 +3164,7 @@ def make_http_server(
                     os.makedirs(checkpoint_dir, exist_ok=True)
                     master.save_checkpoint(path)
                     self._text(200, "Success")
-                elif self.path == "/restore":
+                elif path == "/restore":
                     name = self._form().get("name", "")  # body first
                     if not checkpoint_dir:
                         self._text(403, "checkpointing disabled (no checkpoint_dir configured)")
@@ -3014,7 +3179,7 @@ def make_http_server(
                         self._text(400, f"error restoring checkpoint: {e}")
                         return
                     self._text(200, "Success")
-                elif self.path == "/profile/start":
+                elif path == "/profile/start":
                     # additive: capture a jax.profiler trace of the live
                     # device loop (SURVEY.md §5 — the reference has nothing)
                     name = self._form().get("name", "profile")  # body first
@@ -3031,7 +3196,7 @@ def make_http_server(
                         self._text(409, str(e))
                         return
                     self._text(200, "Success")
-                elif self.path == "/profile/stop":
+                elif path == "/profile/stop":
                     if not profile_dir:
                         self._text(403, "profiling disabled (no profile_dir configured)")
                         return

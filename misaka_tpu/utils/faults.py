@@ -39,6 +39,13 @@ Named points (the hook sites live next to the code they break):
   ckpt_crash      — save_checkpoint raises after writing the tmp file but
                     BEFORE the atomic replace: the crash the atomic write
                     discipline exists for (target must stay intact).
+  swap_during_load — the program registry's hot-swap sleeps `value`
+                    seconds WITH THE PARK GATE CLOSED (between building
+                    the replacement engine and installing it,
+                    runtime/registry.py): every alias-addressed request
+                    arriving in that window parks — the widened race the
+                    zero-client-visible-errors swap contract is tested
+                    against.
 
 Fault checks are zero-cost when nothing is armed (`fire` returns None
 after one dict lookup on an empty dict); the module imports stdlib only —
@@ -57,6 +64,7 @@ POINTS = frozenset({
     "rpc_delay",
     "ckpt_torn_write",
     "ckpt_crash",
+    "swap_during_load",
 })
 
 

@@ -538,3 +538,114 @@ def test_dead_peer_fails_fast_typed_and_recovers(monkeypatch):
         node.close()
         if replacement is not None:
             replacement.close()
+
+
+# --- the program registry under chaos (runtime/registry.py) -----------------
+
+
+def test_swap_during_load_fault_point_parses():
+    spec = faults.parse_spec("swap_during_load=0.3")
+    assert spec == {"swap_during_load": (0.3, 1.0)}
+
+
+@pytest.mark.slow
+def test_hot_swap_under_pooled_load_zero_errors():
+    """The swap_during_load chaos scenario: publish a new program version
+    while 64 POOLED keep-alive clients hammer the program's compute
+    route, with the fault point holding the swap's park gate closed for
+    0.5s (the widened race window).  The contract: ZERO client-visible
+    errors — every response is either the old or the new program's
+    output, request-consistently — and the evicted old version's state
+    round-trips bit-identically through its manifest-verified checkpoint.
+    """
+    from misaka_tpu.client import MisakaClient
+    from misaka_tpu.runtime.registry import ProgramRegistry
+    from misaka_tpu.runtime.topology import Topology
+
+    small = dict(stack_cap=16, in_cap=16, out_cap=16)
+    reg = ProgramRegistry(None, batch=4, engine="scan", chunk_steps=32,
+                          caps=small)
+    top = networks.add2(**small)
+    master = MasterNode(top, chunk_steps=32, batch=4, engine="scan")
+    reg.seed("default", master, top)
+    httpd = make_http_server(master, port=0, registry=reg)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    master.run()
+    v_old = reg.publish(
+        "victim", tis="IN ACC\nADD 10\nOUT ACC\n"
+    )["version"]
+
+    n_clients = 64
+    stop = threading.Event()
+    start_bar = threading.Barrier(n_clients + 1)
+    failures: list = []
+    bad: list = []
+    counts = [0] * n_clients
+
+    def client_loop(i):
+        c = MisakaClient(base, program="victim", timeout=60)
+        try:
+            c.compute_raw([0])  # warm the pooled connection pre-barrier
+            start_bar.wait()
+            while not stop.is_set():
+                vals = [i, i + 1]
+                out = c.compute_raw(vals).tolist()
+                if out not in ([i + 10, i + 11], [i + 20, i + 21]):
+                    bad.append((i, out))
+                    return
+                counts[i] += 1
+        except Exception as e:  # pragma: no cover — the failure path
+            failures.append((i, repr(e)))
+            stop.set()
+        finally:
+            c.close()
+
+    threads = [
+        threading.Thread(target=client_loop, args=(i,))
+        for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        start_bar.wait(timeout=60)
+        time.sleep(0.3)  # sustained pre-swap load
+        faults.configure("swap_during_load=0.5")  # park gate held closed
+        out = reg.publish("victim", tis="IN ACC\nADD 20\nOUT ACC\n")
+        assert out["swapped"]
+        time.sleep(0.5)  # sustained post-swap load
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    assert not failures, failures[:3]
+    assert not bad, bad[:3]
+    assert sum(counts) > n_clients  # the fleet really ran through the swap
+    # post-swap traffic serves the new version...
+    with reg.lease("victim") as m:
+        assert m.compute_coalesced([1]) == [21]
+    # ...and the drained old version checkpointed durably: the manifest
+    # gate passes and a fresh engine restores EXACTLY the saved arrays
+    ckpt = reg._state_path("victim", v_old)
+    verify_checkpoint(ckpt)
+    fresh = MasterNode(
+        Topology(node_info={"main": "program"},
+                 programs={"main": "IN ACC\nADD 10\nOUT ACC\n"}, **small),
+        chunk_steps=32, batch=4, engine="scan",
+    )
+    fresh.load_checkpoint(ckpt)
+    snap = fresh.snapshot()
+    with np.load(ckpt) as data:
+        for field in snap._fields:
+            if field in data:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(snap, field)), data[field],
+                    err_msg=field,
+                )
+    fresh.close()
+    # the old version is still addressable and revives from its checkpoint
+    with reg.lease(f"victim@{v_old}") as m:
+        assert m.compute_coalesced([1]) == [11]
+    master.pause()
+    reg.close()
+    httpd.shutdown()

@@ -1,0 +1,545 @@
+"""The program registry (runtime/registry.py): multi-tenant serving of
+versioned TIS networks.
+
+Covers the registry core (content-address dedup, version/alias
+resolution, LRU eviction order, concurrent upload races, the typed
+unknown-program 404), the HTTP surface (POST/GET /programs,
+/programs/<name>/compute*, X-Misaka-Program on the legacy routes, full
+legacy single-program compat), hot-swap under concurrency, eviction/
+reactivation state round-trips through the manifest-verified checkpoint
+path, the per-program compute-plane frames, client helpers, and the
+persistent MISAKA_PROGRAMS_DIR store.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.client import MisakaClient, MisakaClientError
+from misaka_tpu.runtime.master import (
+    MasterNode,
+    make_http_server,
+    verify_checkpoint,
+)
+from misaka_tpu.runtime.registry import (
+    ProgramNotFound,
+    ProgramRegistry,
+    RegistryError,
+    canonical_topology,
+    version_of,
+)
+from misaka_tpu.runtime.topology import Topology
+
+SMALL = dict(stack_cap=16, in_cap=16, out_cap=16)
+
+ADD10 = "IN ACC\nADD 10\nOUT ACC\n"
+ADD20 = "IN ACC\nADD 20\nOUT ACC\n"
+ADD30 = "IN ACC\nADD 30\nOUT ACC\n"
+# A DELAY LINE: output_i = input_{i-1} (0 first) — the persistent state
+# (BAK holds the last value) is what eviction must round-trip.
+DELAY = "IN ACC\nSWP\nOUT ACC\nSWP\nSAV\n"
+
+
+def make_registry(**kw):
+    kw.setdefault("batch", 2)
+    kw.setdefault("engine", "scan")
+    kw.setdefault("chunk_steps", 32)
+    kw.setdefault("caps", SMALL)
+    return ProgramRegistry(None, **kw)
+
+
+def seeded_registry(**kw):
+    reg = make_registry(**kw)
+    top = networks.add2(**SMALL)
+    master = MasterNode(top, chunk_steps=32, batch=reg._batch, engine="scan")
+    reg.seed("default", master, top)
+    master.run()
+    return reg, master
+
+
+# --- registry core ----------------------------------------------------------
+
+
+def test_content_address_dedup():
+    reg, master = seeded_registry()
+    try:
+        r1 = reg.publish("p", tis=ADD10)
+        r2 = reg.publish("p", tis=ADD10)
+        assert r1["created"] and not r2["created"]
+        assert r1["version"] == r2["version"]
+        # the same network as explicit topology JSON (different key
+        # order) content-addresses identically
+        r3 = reg.publish(
+            "q",
+            topology_json=json.dumps({
+                "programs": {"main": ADD10},
+                "nodes": {"main": "program"},
+                "out_cap": 16, "in_cap": 16, "stack_cap": 16,
+            }),
+        )
+        assert r3["version"] == r1["version"]
+        # and a different program is a different version
+        assert reg.publish("p", tis=ADD20)["version"] != r1["version"]
+    finally:
+        master.pause()
+        reg.close()
+
+
+def test_canonicalization_is_key_order_invariant():
+    t = Topology(node_info={"main": "program"}, programs={"main": ADD10},
+                 **SMALL)
+    assert version_of(canonical_topology(t)) == version_of(
+        canonical_topology(
+            Topology(node_info={"main": "program"},
+                     programs={"main": ADD10}, **SMALL)
+        )
+    )
+
+
+def test_version_and_alias_resolution():
+    reg, master = seeded_registry()
+    try:
+        v1 = reg.publish("p", tis=ADD10)["version"]
+        v2 = reg.publish("p", tis=ADD20)["version"]
+        assert reg.resolve("p") == ("p", v2)
+        assert reg.resolve("p@latest") == ("p", v2)
+        assert reg.resolve(f"p@{v1}") == ("p", v1)
+        assert reg.resolve(None) == ("default", reg.resolve("default")[1])
+        with pytest.raises(ProgramNotFound):
+            reg.resolve("ghost")
+        with pytest.raises(ProgramNotFound):
+            reg.resolve("p@000000000000")
+        # exact-version addressing serves the OLD program after a publish
+        with reg.lease(f"p@{v1}") as m:
+            assert m.compute_coalesced([1]) == [11]
+        with reg.lease("p") as m:
+            assert m.compute_coalesced([1]) == [21]
+    finally:
+        master.pause()
+        reg.close()
+
+
+def test_lru_eviction_order(tmp_path):
+    reg, master = seeded_registry(max_active=3)
+    try:
+        for name, src in (("a", ADD10), ("b", ADD20), ("c", ADD30)):
+            reg.publish(name, tis=src)
+        with reg.lease("a") as m:
+            assert m.compute_coalesced([1]) == [11]
+        with reg.lease("b") as m:
+            assert m.compute_coalesced([1]) == [21]
+        # active: default(pinned), a, b — at the cap of 3.  Touch a so b
+        # is the LRU candidate, then activate c: b must be the eviction.
+        with reg.lease("a") as m:
+            pass
+        with reg.lease("c") as m:
+            assert m.compute_coalesced([1]) == [31]
+        active = {f"{n}@{v}"[: len(n)] or n for n, v in reg.active_versions()}
+        names = {n for n, _ in reg.active_versions()}
+        assert names == {"default", "a", "c"}, active
+        # the evicted program left a manifest-verified checkpoint behind
+        vb = reg.resolve("b")[1]
+        verify_checkpoint(reg._state_path("b", vb))
+        # ... and the pinned default was never a candidate
+        assert "default" in names
+        # reactivating b works (and now evicts the new LRU, a)
+        with reg.lease("b") as m:
+            assert m.compute_coalesced([2]) == [22]
+        assert {n for n, _ in reg.active_versions()} == {"default", "c", "b"}
+    finally:
+        master.pause()
+        reg.close()
+
+
+def test_eviction_restores_state_bit_identically():
+    # batch=None: ONE instance, so the delay line's persistent state and
+    # every value share it (a batched master round-robins instances,
+    # which would scatter the continuation check across fresh replicas)
+    reg, master = seeded_registry(max_active=4, batch=None)
+    try:
+        v = reg.publish("delay", tis=DELAY)["version"]
+        with reg.lease("delay") as m:
+            assert m.compute_coalesced([5]) == [0]
+            assert m.compute_coalesced([6]) == [5]
+        # evict: drain + durable checkpoint (manifest sidecar) + close
+        assert reg.deactivate("delay")
+        ckpt = reg._state_path("delay", v)
+        verify_checkpoint(ckpt)  # the durability gate passes
+        # bit-identical restore at the state level: a fresh master that
+        # loads the eviction checkpoint holds EXACTLY the saved arrays
+        fresh = MasterNode(
+            Topology(node_info={"main": "program"},
+                     programs={"main": DELAY}, **SMALL),
+            chunk_steps=32, batch=None, engine="scan",
+        )
+        fresh.load_checkpoint(ckpt)
+        snap = fresh.snapshot()
+        with np.load(ckpt) as data:
+            for field in snap._fields:
+                if field in data:
+                    np.testing.assert_array_equal(
+                        np.asarray(getattr(snap, field)), data[field],
+                        err_msg=field,
+                    )
+        fresh.close()
+        # functional continuation: the delay line remembers its last
+        # value across the eviction (fresh state would answer 0)
+        with reg.lease("delay") as m:
+            assert m.compute_coalesced([7]) == [6]
+    finally:
+        master.pause()
+        reg.close()
+
+
+def test_concurrent_upload_races():
+    reg, master = seeded_registry()
+    try:
+        sources = [f"IN ACC\nADD {i}\nOUT ACC\n" for i in range(1, 9)]
+        errors = []
+
+        def upload(src):
+            try:
+                reg.publish("raced", tis=src)
+            except Exception as e:  # pragma: no cover — the failure path
+                errors.append(e)
+
+        ts = [threading.Thread(target=upload, args=(s,)) for s in sources]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors
+        info = reg.list_programs()["programs"]["raced"]
+        assert len(info["versions"]) == len(sources)
+        assert info["latest"] in info["versions"]
+        # the alias landed on SOME upload; serving through it works and
+        # matches that version's program
+        with reg.lease("raced") as m:
+            out = m.compute_coalesced([0])[0]
+        assert 1 <= out <= 8
+    finally:
+        master.pause()
+        reg.close()
+
+
+def test_publish_over_seeded_program_rejected():
+    reg, master = seeded_registry()
+    try:
+        with pytest.raises(RegistryError, match="seeded boot program"):
+            reg.publish("default", tis=ADD10)
+    finally:
+        master.pause()
+        reg.close()
+
+
+def test_publish_compile_first_touches_nothing():
+    reg, master = seeded_registry()
+    try:
+        v1 = reg.publish("p", tis=ADD10)["version"]
+        with reg.lease("p") as m:
+            assert m.compute_coalesced([1]) == [11]
+        from misaka_tpu.tis.parser import TISParseError
+
+        with pytest.raises(TISParseError):
+            reg.publish("p", tis="FROB 1\n")
+        # the bad upload changed nothing: same latest, engine serving
+        assert reg.resolve("p")[1] == v1
+        with reg.lease("p") as m:
+            assert m.compute_coalesced([2]) == [12]
+    finally:
+        master.pause()
+        reg.close()
+
+
+def test_registry_persistence_across_restart(tmp_path):
+    d = str(tmp_path / "programs")
+    reg = ProgramRegistry(d, batch=2, engine="scan", chunk_steps=32,
+                          caps=SMALL)
+    v = reg.publish("keeper", tis=ADD10)["version"]
+    with reg.lease("keeper") as m:
+        assert m.compute_coalesced([1]) == [11]
+    reg.close()  # checkpoints + closes the active engine
+    reg2 = ProgramRegistry(d, batch=2, engine="scan", chunk_steps=32,
+                           caps=SMALL)
+    info = reg2.list_programs()["programs"]
+    assert info["keeper"]["latest"] == v
+    assert info["keeper"]["versions"][v]["checkpoint"]
+    with reg2.lease("keeper") as m:  # revives from the shutdown checkpoint
+        assert m.compute_coalesced([2]) == [12]
+    reg2.close()
+
+
+# --- the HTTP surface -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def reg_server():
+    reg = make_registry()
+    top = networks.add2(**SMALL)
+    master = MasterNode(top, chunk_steps=32, batch=2, engine="scan")
+    reg.seed("default", master, top)
+    httpd = make_http_server(master, port=0, registry=reg)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    master.run()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", master, reg
+    master.pause()
+    reg.close()
+    httpd.shutdown()
+
+
+def post(base, path, data=None, headers=None, raw=None):
+    body = raw if raw is not None else urllib.parse.urlencode(data or {}).encode()
+    req = urllib.request.Request(
+        base + path, data=body, method="POST", headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_http_upload_and_program_routes(reg_server):
+    base, _, _ = reg_server
+    status, body = post(base, "/programs", {"name": "web", "program": ADD10})
+    assert status == 200, body
+    out = json.loads(body)
+    assert out["created"] and out["name"] == "web"
+    # all three compute ops, program-addressed
+    status, body = post(base, "/programs/web/compute", {"value": "1"})
+    assert (status, json.loads(body)) == (200, {"value": 11})
+    status, body = post(
+        base, "/programs/web/compute_batch", {"values": "1 2", "spread": "1"}
+    )
+    assert json.loads(body) == {"values": [11, 12]}
+    status, body = post(
+        base, "/programs/web/compute_raw?spread=1",
+        raw=np.asarray([3], "<i4").tobytes(),
+    )
+    assert np.frombuffer(body, "<i4").tolist() == [13]
+    # version-pinned addressing
+    status, body = post(
+        base, f"/programs/web@{out['version']}/compute", {"value": "2"}
+    )
+    assert json.loads(body) == {"value": 12}
+
+
+def test_http_legacy_routes_serve_default(reg_server):
+    base, _, _ = reg_server
+    status, body = post(base, "/compute", {"value": "5"})
+    assert (status, json.loads(body)) == (200, {"value": 7})
+    status, body = post(base, "/compute_batch", {"values": "1 2", "spread": "1"})
+    assert json.loads(body) == {"values": [3, 4]}
+    status, body = post(
+        base, "/compute_raw?spread=1", raw=np.asarray([1, 2], "<i4").tobytes()
+    )
+    assert np.frombuffer(body, "<i4").tolist() == [3, 4]
+
+
+def test_http_header_addressing(reg_server):
+    base, _, _ = reg_server
+    post(base, "/programs", {"name": "hdr", "program": ADD20})
+    status, body = post(
+        base, "/compute", {"value": "1"}, headers={"X-Misaka-Program": "hdr"}
+    )
+    assert json.loads(body) == {"value": 21}
+    status, body = post(
+        base, "/compute_raw?spread=1",
+        raw=np.asarray([5], "<i4").tobytes(),
+        headers={"X-Misaka-Program": "hdr"},
+    )
+    assert np.frombuffer(body, "<i4").tolist() == [25]
+
+
+def test_http_unknown_program_typed_404(reg_server):
+    base, _, _ = reg_server
+    status, body = post(base, "/programs/ghost/compute", {"value": "1"})
+    assert status == 404 and b"unknown program" in body
+    status, body = post(
+        base, "/compute", {"value": "1"},
+        headers={"X-Misaka-Program": "ghost"},
+    )
+    assert status == 404 and b"unknown program" in body
+    status, body = get(base, "/programs/ghost")
+    assert status == 404
+    # an unknown VERSION of a known program is typed too
+    post(base, "/programs", {"name": "known", "program": ADD10})
+    status, body = post(
+        base, "/programs/known@ffffffffffff/compute", {"value": "1"}
+    )
+    assert status == 404 and b"no version" in body
+
+
+def test_http_listing_and_status(reg_server):
+    base, _, _ = reg_server
+    post(base, "/programs", {"name": "listed", "program": ADD10})
+    status, body = get(base, "/programs")
+    listing = json.loads(body)
+    assert "listed" in listing["programs"]
+    assert listing["programs"]["default"]["pinned"]
+    status, body = get(base, "/programs/listed")
+    assert json.loads(body)["latest"]
+    status, body = get(base, "/status")
+    assert "programs" in json.loads(body)
+    # GET on a compute route is the reference's method rejection
+    status, body = get(base, "/programs/listed/compute")
+    assert (status, body) == (405, b"method GET not allowed")
+
+
+def test_http_bad_upload_400(reg_server):
+    base, _, _ = reg_server
+    status, body = post(base, "/programs", {"name": "bad", "program": "FROB"})
+    assert status == 400 and b"not a valid instruction" in body
+    status, body = post(base, "/programs", {"name": "bad/../evil",
+                                            "program": ADD10})
+    assert status == 400
+    status, body = post(base, "/programs", {"name": "noform"})
+    assert status == 400 and b"exactly one" in body
+    # publishing over the seeded default is rejected, not swapped
+    status, body = post(base, "/programs", {"name": "default",
+                                            "program": ADD10})
+    assert status == 400 and b"seeded boot program" in body
+
+
+def test_http_hot_swap_under_concurrency(reg_server):
+    base, _, _ = reg_server
+    post(base, "/programs", {"name": "swapper", "program": ADD10})
+    stop = threading.Event()
+    failures = []
+    odd = []
+
+    def hammer():
+        body = np.asarray([1, 2], "<i4").tobytes()
+        while not stop.is_set():
+            status, out = post(
+                base, "/programs/swapper/compute_raw?spread=1", raw=body
+            )
+            if status != 200:
+                failures.append((status, out))
+                return
+            got = np.frombuffer(out, "<i4").tolist()
+            if got not in ([11, 12], [21, 22]):
+                odd.append(got)
+                return
+
+    ts = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in ts:
+        t.start()
+    status, body = post(base, "/programs", {"name": "swapper",
+                                            "program": ADD20})
+    assert status == 200 and json.loads(body)["swapped"]
+    import time as _time
+
+    _time.sleep(0.3)
+    stop.set()
+    for t in ts:
+        t.join()
+    assert not failures and not odd
+    # post-swap traffic serves the new version
+    status, body = post(base, "/programs/swapper/compute", {"value": "1"})
+    assert json.loads(body) == {"value": 21}
+
+
+def test_metrics_carry_program_labels(reg_server):
+    base, _, _ = reg_server
+    post(base, "/programs", {"name": "metered", "program": ADD10})
+    post(base, "/programs/metered/compute", {"value": "1"})
+    post(base, "/compute", {"value": "1"})
+    status, body = get(base, "/metrics")
+    text = body.decode()
+    assert 'misaka_program_requests_total{program="metered"}' in text
+    assert 'misaka_program_requests_total{program="default"}' in text
+    assert 'misaka_program_values_total{program="metered"}' in text
+
+
+def test_client_helpers_and_pinned_session(reg_server):
+    base, _, _ = reg_server
+    c = MisakaClient(base)
+    out = c.upload_program("cli", program=ADD10)
+    assert out["name"] == "cli"
+    dup = c.upload_program(
+        "cli2",
+        topology={"nodes": {"main": "program"}, "programs": {"main": ADD10},
+                  "stack_cap": 16, "in_cap": 16, "out_cap": 16},
+    )
+    assert dup["version"] == out["version"]  # content-addressed dedup
+    assert "cli" in c.list_programs()["programs"]
+    assert c.program_info("cli")["latest"] == out["version"]
+    pinned = MisakaClient(base, program="cli")
+    assert int(pinned.compute(1)) == 11
+    assert pinned.compute_raw([1, 2]).tolist() == [11, 12]
+    assert pinned.compute_batch([3]).tolist() == [13]
+    with pytest.raises(MisakaClientError) as exc:
+        MisakaClient(base, program="ghost").compute(1)
+    assert exc.value.status == 404
+    c.close()
+    pinned.close()
+
+
+def test_serve_pass_span_carries_program_attr(reg_server):
+    base, _, _ = reg_server
+    post(base, "/programs", {"name": "traced", "program": ADD10})
+    status, body = post(
+        base, "/programs/traced/compute", {"value": "1"},
+        headers={"X-Misaka-Trace": "prog-attr-test-1"},
+    )
+    assert status == 200
+    status, body = get(base, "/debug/requests/prog-attr-test-1")
+    tree = json.loads(body)
+    spans = [s for s in tree["spans"] if s["name"] == "serve.pass"]
+    assert spans and spans[0]["attrs"]["program"] == "traced"
+
+
+# --- the compute plane ------------------------------------------------------
+
+
+def test_plane_frames_route_per_program(tmp_path):
+    from misaka_tpu.runtime import frontends
+
+    reg, master = seeded_registry()
+    plane_path = str(tmp_path / "plane.sock")
+    plane = frontends.start_compute_plane(master, plane_path, registry=reg)
+    client = frontends.PlaneClient(plane_path, conns=2)
+    try:
+        reg.publish("pl", tis=ADD10)
+        # default and program frames interleaved from many threads: the
+        # coalescer must keep frames per-program
+        results = {}
+
+        def worker(i):
+            vals = np.asarray([i, i + 1], "<i4")
+            prog = "pl" if i % 2 else None
+            out = client.compute_raw(vals.tobytes(), program=prog)
+            want = vals + (10 if i % 2 else 2)
+            results[i] = np.frombuffer(out, "<i4").tolist() == want.tolist()
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(results.values()) and len(results) == 12
+        # unknown program: the typed 404 crosses the plane
+        with pytest.raises(frontends.PlaneError) as exc:
+            client.compute_raw(np.asarray([1], "<i4").tobytes(),
+                               program="ghost")
+        assert exc.value.status == 404
+    finally:
+        client.close()
+        plane.close()
+        master.pause()
+        reg.close()

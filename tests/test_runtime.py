@@ -149,16 +149,20 @@ def test_load_unknown_node_leaves_network_running(server):
     assert json.loads(body) == {"value": 3}
 
 
-def test_load_bad_program_stops_network_keeps_old_program(server):
-    # A parse failure is discovered after the reset: network left stopped,
-    # old program intact (LoadProgram errors before overwriting, program.go:185-191).
+def test_load_bad_program_leaves_network_running_untouched(server):
+    # COMPILE-FIRST (r10, the registry discipline): a parse failure is
+    # discovered BEFORE anything stops — the running network keeps
+    # serving its old programs and its in-flight state.  (The reference
+    # discovers the error after resetting, program.go:185-191, leaving
+    # the network stopped; the pre-r10 port of that ordering wiped live
+    # state on every typo'd /load.)
     base, _ = server
     post(base, "/run")
+    status, body = post(base, "/compute", {"value": "7"})
+    assert json.loads(body) == {"value": 9}
     status, body = post(base, "/load", {"program": "FROB", "targetURI": "misaka1"})
     assert status == 400
-    status, body = post(base, "/compute", {"value": "1"})
-    assert (status, body) == (400, "network is not running")
-    post(base, "/run")
+    # still RUNNING, old program intact, no /run needed
     status, body = post(base, "/compute", {"value": "1"})
     assert json.loads(body) == {"value": 3}
 

@@ -1,0 +1,250 @@
+"""`make registry-smoke`: the program registry proven end-to-end (~15s).
+
+Boots the REAL server as a subprocess (python -m misaka_tpu.runtime.app)
+with MISAKA_PROGRAMS_DIR armed, then drives the whole multi-tenant story
+through the public HTTP surface:
+
+  1. upload two programs (POST /programs) and serve BOTH concurrently
+     from per-program engines, parity-checked;
+  2. hot-swap one of them by publishing a new version under concurrent
+     traffic — zero client-visible errors, responses flip old -> new;
+  3. assert GET /metrics carries `program`-labeled registry series for
+     both tenants, and GET /debug/requests/<id> shows the serve.pass
+     span carrying the program attr — the observability contract.
+
+Exit 0 on success, 1 with a reason on any failed assertion.  The same
+assertions run inside tier-1 (tests/test_registry.py); this is the
+standalone tripwire against the real process boundary.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+ADD5 = "IN ACC\nADD 5\nOUT ACC\n"
+ADD7 = "IN ACC\nADD 7\nOUT ACC\n"
+ADD9 = "IN ACC\nADD 9\nOUT ACC\n"
+
+
+def post(base, path, data=None, headers=None, raw=None, timeout=60):
+    body = raw if raw is not None else urllib.parse.urlencode(data or {}).encode()
+    req = urllib.request.Request(
+        base + path, data=body, method="POST", headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def get(base, path, timeout=30):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def wait_ready(base, deadline_s=120):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            status, _ = get(base, "/healthz", timeout=2)
+            if status == 200:
+                return True
+        except OSError:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+def fail(msg):
+    print(f"# registry-smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    tmp = tempfile.mkdtemp(prefix="misaka-registry-smoke-")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MISAKA_PORT": str(port),
+        "MISAKA_BATCH": "4",
+        "MISAKA_ENGINE": "scan",
+        "MISAKA_AUTORUN": "1",
+        "MISAKA_IN_CAP": "16",
+        "MISAKA_OUT_CAP": "16",
+        "MISAKA_STACK_CAP": "16",
+        "MISAKA_PROGRAMS_DIR": os.path.join(tmp, "programs"),
+        "NODE_INFO": json.dumps({
+            "misaka1": {"type": "program"}, "misaka2": {"type": "program"},
+            "misaka3": {"type": "stack"},
+        }),
+        "MISAKA_PROGRAMS": json.dumps({
+            "misaka1": "IN ACC\nADD 1\nMOV ACC, misaka2:R0\n"
+                       "MOV R0, ACC\nOUT ACC\n",
+            "misaka2": "MOV R0, ACC\nADD 1\nPUSH ACC, misaka3\n"
+                       "POP misaka3, ACC\nMOV ACC, misaka1:R0\n",
+        }),
+    }
+    proc = subprocess.Popen([sys.executable, "-m", "misaka_tpu.runtime.app"],
+                            env=env)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        if not wait_ready(base):
+            fail("server did not come up")
+
+        # --- 1. upload two programs, serve both concurrently ------------
+        status, body = post(base, "/programs", {"name": "alpha",
+                                                "program": ADD5})
+        if status != 200:
+            fail(f"upload alpha: {status} {body!r}")
+        status, body = post(base, "/programs", {"name": "beta",
+                                                "program": ADD7})
+        if status != 200:
+            fail(f"upload beta: {status} {body!r}")
+
+        errors = []
+
+        def hammer(name, delta, n=30, trace_prefix=None):
+            for k in range(n):
+                headers = {}
+                if trace_prefix:
+                    headers["X-Misaka-Trace"] = f"{trace_prefix}{k:04d}"
+                st, out = post(base, f"/programs/{name}/compute",
+                               {"value": str(k)}, headers=headers)
+                if st != 200 or json.loads(out)["value"] != k + delta:
+                    errors.append((name, k, st, out))
+                    return
+
+        ts = [
+            threading.Thread(target=hammer, args=("alpha", 5, 30, "regsmka")),
+            threading.Thread(target=hammer, args=("beta", 7, 30, "regsmkb")),
+            # legacy routes keep serving the seeded default (+2) alongside
+            threading.Thread(target=lambda: [
+                errors.append(("default", v, st, out))
+                for v in range(10)
+                for st, out in [post(base, "/compute", {"value": str(v)})]
+                if st != 200 or json.loads(out)["value"] != v + 2
+            ]),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errors:
+            fail(f"concurrent serving errors: {errors[:3]}")
+        print("# registry-smoke: two programs + the default served "
+              "concurrently, parity-checked", file=sys.stderr)
+
+        # --- 2. hot-swap beta under concurrent traffic ------------------
+        swap_errors = []
+        seen = {"old": 0, "new": 0}
+        stop = threading.Event()
+
+        def swap_traffic():
+            k = 0
+            while not stop.is_set():
+                st, out = post(base, "/programs/beta/compute",
+                               {"value": str(k)})
+                if st != 200:
+                    swap_errors.append((k, st, out))
+                    return
+                got = json.loads(out)["value"]
+                if got == k + 7:
+                    seen["old"] += 1
+                elif got == k + 9:
+                    seen["new"] += 1
+                else:
+                    swap_errors.append((k, st, out))
+                    return
+                k += 1
+
+        hammers = [threading.Thread(target=swap_traffic) for _ in range(4)]
+        for t in hammers:
+            t.start()
+        time.sleep(0.3)
+        status, body = post(base, "/programs", {"name": "beta",
+                                                "program": ADD9})
+        if status != 200 or not json.loads(body)["swapped"]:
+            stop.set()
+            fail(f"hot-swap publish: {status} {body!r}")
+        time.sleep(0.5)
+        stop.set()
+        for t in hammers:
+            t.join()
+        if swap_errors:
+            fail(f"hot-swap client-visible errors: {swap_errors[:3]}")
+        if not seen["new"]:
+            fail("no post-swap responses observed")
+        print(f"# registry-smoke: hot-swap under traffic, zero errors "
+              f"(old={seen['old']} new={seen['new']} responses)",
+              file=sys.stderr)
+
+        # --- 3. observability: program labels + trace attr --------------
+        status, body = get(base, "/metrics")
+        text = body.decode()
+        for want in (
+            'misaka_program_requests_total{program="alpha"}',
+            'misaka_program_requests_total{program="beta"}',
+            'misaka_program_values_total{program="alpha"}',
+            "misaka_program_swaps_total",
+            "misaka_program_active_engines",
+        ):
+            if want not in text:
+                fail(f"/metrics missing {want}")
+        # a FRESH traced request (the earlier hammer traces may have been
+        # evicted from the bounded flight-recorder ring by swap traffic)
+        status, body = post(base, "/programs/alpha/compute",
+                            {"value": "1"},
+                            headers={"X-Misaka-Trace": "regsmk-final-1"})
+        if status != 200:
+            fail(f"traced request: {status} {body!r}")
+        status, body = get(base, "/debug/requests/regsmk-final-1")
+        if status != 200:
+            fail(f"trace lookup: {status} {body!r}")
+        tree = json.loads(body)
+        passes = [s for s in tree["spans"] if s["name"] == "serve.pass"]
+        if not passes or passes[0].get("attrs", {}).get("program") != "alpha":
+            fail(f"serve.pass span lacks the program attr: {passes}")
+        status, body = get(base, "/programs")
+        listing = json.loads(body)
+        if not {"alpha", "beta", "default"} <= set(listing["programs"]):
+            fail(f"listing incomplete: {sorted(listing['programs'])}")
+        print("# registry-smoke: /metrics program labels + serve.pass "
+              "program attr + /programs listing all present",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": "registry_smoke", "ok": True,
+            "programs": sorted(listing["programs"]),
+            "swap_responses": seen,
+        }))
+        return 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
