@@ -101,6 +101,32 @@ registry-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/registry_smoke.py
 
+# Usage/SLO/profiler tripwire (~15s): a REAL subprocess server — two
+# registry tenants under mixed native+Python load, then assert GET
+# /debug/usage attributes nonzero CPU-seconds per program summing to the
+# pass wall total (20%), /debug/flamegraph carries both a CPython frame
+# aggregate and the native busy/idle split, and /debug/alerts serves the
+# SLO states.  The same assertions run inside tier-1 (tests/test_usage.py,
+# tests/test_slo.py); docs/OBSERVABILITY.md has the catalogs.
+usage-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/usage_smoke.py
+
+# The CI entry point: tier-1 fast lane + every smoke tripwire +
+# bench-smoke, in one target — what a CI runner invokes (there is no
+# hosted CI config; this is the single command one would call).  Order:
+# the cheap wide net first (pytest), then the subprocess smokes, then the
+# throughput gate last (it is the slowest and the most environment-
+# sensitive).  Fails on the first broken stage.
+ci:
+	$(MAKE) test
+	$(MAKE) metrics-smoke
+	$(MAKE) trace-smoke
+	$(MAKE) registry-smoke
+	$(MAKE) usage-smoke
+	$(MAKE) chaos-smoke
+	$(MAKE) bench-smoke
+
 # Fault-tolerance tripwire (~10s): the fast chaos lane, driven through the
 # MISAKA_FAULTS harness (utils/faults.py) — durable-checkpoint rejection of
 # torn/corrupt files, crash-mid-save atomicity, auto-checkpoint rotation +
@@ -144,4 +170,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke chaos-smoke parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke chaos-smoke ci parity-go parity-local parity-corpus stop clean

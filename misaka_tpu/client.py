@@ -13,6 +13,9 @@ the two bulk lanes a throughput client actually wants:
   load(target, prog)  POST /load
   status()/trace()    GET  /status /trace
   healthz()/metrics() GET  /healthz /metrics  (liveness + Prometheus text)
+  usage()/alerts()    GET  /debug/usage /debug/alerts  (per-program cost
+                      ledger + SLO burn-rate states); flamegraph() GET
+                      /debug/flamegraph (continuous profiler)
   checkpoint/restore  POST /checkpoint /restore  (server-side .npz)
   profile_start/stop  POST /profile/start /profile/stop
   upload_program/list_programs/program_info  POST/GET /programs*
@@ -377,6 +380,25 @@ class MisakaClient:
         """The flight recorder as Chrome trace-event JSON — dump it to a
         file and load in https://ui.perfetto.dev."""
         return json.loads(self._request("/debug/perfetto", None, "GET"))
+
+    def usage(self) -> dict:
+        """The per-program resource ledger (GET /debug/usage): requests,
+        values, CPU-seconds, measured native-pool seconds, and
+        queue-delay seconds per program (runtime/usage.py)."""
+        return json.loads(self._request("/debug/usage", None, "GET"))
+
+    def alerts(self) -> dict:
+        """The SLO burn-rate engine's state (GET /debug/alerts):
+        per-program ok/warning/page with per-window burn rates and
+        latency quantiles (utils/slo.py; objectives via MISAKA_SLO or
+        per-program upload metadata)."""
+        return json.loads(self._request("/debug/alerts", None, "GET"))
+
+    def flamegraph(self) -> dict:
+        """The continuous profiler's folded-stack aggregate + native
+        busy/idle split (GET /debug/flamegraph; append ?html=1 in a
+        browser for the self-contained viewer)."""
+        return json.loads(self._request("/debug/flamegraph", None, "GET"))
 
     # --- the program registry (server must run with MISAKA_PROGRAMS_DIR) ---
 

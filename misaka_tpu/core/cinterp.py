@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 
 import numpy as np
 
@@ -71,6 +72,13 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.misaka_pool_destroy.argtypes = [ctypes.c_void_p]
     lib.misaka_pool_threads.restype = ctypes.c_int
     lib.misaka_pool_threads.argtypes = [ctypes.c_void_p]
+    _I64P = ctypes.POINTER(ctypes.c_int64)
+    lib.misaka_pool_counters.restype = None
+    lib.misaka_pool_counters.argtypes = [ctypes.c_void_p, _I64P]
+    lib.misaka_pool_thread_counters.restype = ctypes.c_int
+    lib.misaka_pool_thread_counters.argtypes = [
+        ctypes.c_void_p, _I64P, _I64P, ctypes.c_int,
+    ]
     lib.misaka_pool_serve.restype = ctypes.c_int
     lib.misaka_pool_serve.argtypes = [ctypes.c_void_p] + [
         _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
@@ -385,13 +393,22 @@ class NativePool:
         if not self._h:
             raise ValueError("invalid network tables")
         self.threads = int(lib.misaka_pool_threads(self._h))
+        # Serializes counter READS against destroy: the r12 debug surfaces
+        # (/metrics, /debug/usage, /debug/flamegraph) read counters() from
+        # scrape threads while a registry eviction/hot-swap may close()
+        # this pool — the _h None-check alone is TOCTOU-racy (a reader
+        # past the check would dereference a freed C++ Pool).  serve/idle
+        # stay outside the lock: only the device loop calls them, and the
+        # engine quiesces before close by construction.
+        self._ctr_lock = threading.Lock()
         _C_CREATED.labels(kind="pool").inc()
 
     def close(self) -> None:
-        if self._h:
-            self._lib.misaka_pool_destroy(self._h)
-            self._h = None
-            _C_CLOSED.labels(kind="pool").inc()
+        with self._ctr_lock:
+            if self._h:
+                self._lib.misaka_pool_destroy(self._h)
+                self._h = None
+                _C_CLOSED.labels(kind="pool").inc()
 
     def __del__(self):
         try:
@@ -403,6 +420,39 @@ class NativePool:
         if not self._h:
             raise RuntimeError("pool is closed")
         return self._h
+
+    def counters(self) -> dict:
+        """Pool busy/idle nanosecond counters (the usage-accounting plane):
+        `busy_ns` is worker-thread time spent executing replica supersteps,
+        `idle_ns` time parked awaiting work, `serial_ns` the small-pass
+        fast path run on the calling thread.  Lock-free on the C++ side
+        (safe concurrently with serve/idle); _ctr_lock only fences the
+        read against a concurrent close() freeing the Pool."""
+        out = np.zeros((3,), np.int64)
+        with self._ctr_lock:
+            self._lib.misaka_pool_counters(
+                self._handle(),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            )
+        return {
+            "threads": self.threads,
+            "busy_ns": int(out[0]),
+            "idle_ns": int(out[1]),
+            "serial_ns": int(out[2]),
+        }
+
+    def thread_counters(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-thread (busy_ns, idle_ns) arrays — the skew diagnostic
+        behind the aggregate counters()."""
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        busy = np.zeros((self.threads,), np.int64)
+        idle = np.zeros((self.threads,), np.int64)
+        with self._ctr_lock:
+            self._lib.misaka_pool_thread_counters(
+                self._handle(), busy.ctypes.data_as(i64p),
+                idle.ctypes.data_as(i64p), self.threads,
+            )
+        return busy, idle
 
     def serve(self, d: dict, values, counts, ticks: int, active=None,
               trusted: bool = False):

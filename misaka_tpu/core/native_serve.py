@@ -33,12 +33,15 @@ when no TPU is attached.
 
 from __future__ import annotations
 
+import threading
 import time
+import weakref
 
 import numpy as np
 
 from misaka_tpu.core import cinterp
 from misaka_tpu.core.state import NetworkState
+from misaka_tpu.runtime import usage
 from misaka_tpu.utils import metrics
 from misaka_tpu.utils import tracespan
 
@@ -72,15 +75,104 @@ _G_POOL_FILL = metrics.gauge(
     "misaka_native_pool_fill_ratio",
     "Fraction of replicas fed on the last pool serve (replica-batch fill)",
 )
-# The pool gauges are weakref callbacks bound at pool construction (last
-# pool wins, like master.py's queue-depth gauges): a closed or collected
-# pool must read 0, not its last live values — an engine swap away from
-# the native tier would otherwise leave /metrics reporting a running pool
-# that no longer exists.
+# The pool gauges aggregate over EVERY live pool at scrape time (the
+# set_function bindings live below _live_pools) — the same multi-tenant
+# discipline as pool_counters(): a last-constructed-pool binding reported
+# the wrong tenant's pool after an activation or eviction, and a closed
+# or collected pool must read 0, not its last live values.
 
 
 def available() -> bool:
     return cinterp.available()
+
+
+# Every live pool, for the usage/flamegraph planes: a multi-tenant
+# registry server runs one pool per active program engine, so the debug
+# surfaces aggregate across ALL of them (a single last-constructed slot
+# reported the wrong tenant's pool after an activation or eviction).
+# Weakrefs only — this module must not keep a swapped-out engine alive;
+# dead/closed entries are pruned on read.
+_pool_refs: list = []
+_pool_refs_lock = threading.Lock()
+
+
+def _live_pools() -> list:
+    with _pool_refs_lock:
+        pools = []
+        keep = []
+        for r in _pool_refs:
+            p = r()
+            if p is not None and not p._closed:
+                pools.append(p)
+                keep.append(r)
+        _pool_refs[:] = keep
+    return pools
+
+
+def _fill_ratio() -> float:
+    # replica-weighted mean across pools: the per-pool value already is
+    # "fraction of replicas fed on the last serve"
+    pools = _live_pools()
+    total = sum(p._replicas for p in pools)
+    if not total:
+        return 0.0
+    return sum(p._last_fill * p._replicas for p in pools) / total
+
+
+_G_POOL_THREADS.set_function(
+    lambda: sum(p.threads for p in _live_pools())
+)
+_G_POOL_REPLICAS.set_function(
+    lambda: sum(p._replicas for p in _live_pools())
+)
+_G_POOL_FILL.set_function(_fill_ratio)
+
+
+def pool_counters() -> dict | None:
+    """Busy/idle nanosecond counters across every live native pool (None
+    when no pool is serving): process-wide aggregate + a per-pool block
+    per program, read lock-free from the C++ side
+    (native/interpreter.cpp misaka_pool_counters).  `busy` includes the
+    serial fast-path time (small passes run on the calling thread) — a
+    box saturated in the partial-fill regime is busy, not idle."""
+    pools = []
+    for p in _live_pools():
+        try:
+            c = p._pool.counters()
+            busy, idle = p._pool.thread_counters()
+        except Exception:  # a closing pool must not 500 the debug surface
+            continue
+        try:
+            label = p.usage_label()
+        except Exception:
+            label = usage.DEFAULT_LABEL
+        c["program"] = label
+        c["busy_ns_per_thread"] = [int(v) for v in busy]
+        c["idle_ns_per_thread"] = [int(v) for v in idle]
+        work = c["busy_ns"] + c["serial_ns"]
+        total = work + c["idle_ns"]
+        c["busy_fraction"] = round(work / total, 6) if total else 0.0
+        pools.append(c)
+    if not pools:
+        return None
+    out = {
+        "threads": sum(c["threads"] for c in pools),
+        "busy_ns": sum(c["busy_ns"] for c in pools),
+        "idle_ns": sum(c["idle_ns"] for c in pools),
+        "serial_ns": sum(c["serial_ns"] for c in pools),
+        "busy_ns_per_thread": [
+            v for c in pools for v in c["busy_ns_per_thread"]
+        ],
+        "idle_ns_per_thread": [
+            v for c in pools for v in c["idle_ns_per_thread"]
+        ],
+    }
+    work = out["busy_ns"] + out["serial_ns"]
+    total = work + out["idle_ns"]
+    out["busy_fraction"] = round(work / total, 6) if total else 0.0
+    if len(pools) > 1:
+        out["pools"] = pools  # the per-program split, one block per pool
+    return out
 
 
 class NativeServe:
@@ -96,6 +188,10 @@ class NativeServe:
             net.num_stacks, net.stack_cap, net.in_cap, net.out_cap,
         )
         self._out_cap = net.out_cap
+        # usage attribution: the unbatched interpreter runs synchronously
+        # on the calling thread, so the call wall IS its busy time (the
+        # pooled tier uses the C++ busy-ns counters instead)
+        self.usage_label = lambda: usage.DEFAULT_LABEL
 
     def close(self) -> None:
         self._interp.close()
@@ -131,7 +227,9 @@ class NativeServe:
         d["out_rd"] = d["out_wr"]  # the returned state's ring is drained
         out = NetworkState(**{f: d[f] for f in NetworkState._fields}), packed
         _C_CALLS_CHUNK.inc()
-        _H_SERVE_CHUNK.observe(time.perf_counter() - t0)
+        dur = time.perf_counter() - t0
+        usage.add_native(self.usage_label(), dur)
+        _H_SERVE_CHUNK.observe(dur)
         return out
 
 
@@ -175,22 +273,40 @@ class NativeServePool:
         # the cache and takes the validated path.
         self._last_state = None
         self._last_dict = None
-        import weakref
-
-        ref = weakref.ref(self)
-        _G_POOL_THREADS.set_function(
-            lambda: 0 if (p := ref()) is None or p._closed else p.threads
-        )
-        _G_POOL_REPLICAS.set_function(
-            lambda: 0 if (p := ref()) is None or p._closed else p._replicas
-        )
-        _G_POOL_FILL.set_function(
-            lambda: 0.0 if (p := ref()) is None or p._closed else p._last_fill
-        )
+        # Usage attribution (runtime/usage.py): which program this pool's
+        # busy time bills to.  MasterNode rebinds this to its live
+        # program_label (through a weakref — the registry names engines
+        # AFTER construction); direct constructions bill "default".
+        self.usage_label = lambda: usage.DEFAULT_LABEL
+        # busy-ns watermark for take_busy_ns deltas (device-loop thread
+        # only — one serializing caller per pool by construction)
+        self._busy_mark = 0
+        with _pool_refs_lock:
+            _pool_refs.append(weakref.ref(self))
 
     def close(self) -> None:
         self._closed = True
         self._pool.close()
+
+    def take_busy_ns(self) -> int:
+        """Busy-ns accumulated since the last take (worker + serial-path
+        time): the MEASURED native cost of the call(s) in between, which
+        the device loop attributes to its program.  Device-loop thread
+        only — one serializing caller per pool by construction."""
+        c = self._pool.counters()
+        busy = c["busy_ns"] + c["serial_ns"]
+        delta = busy - self._busy_mark
+        self._busy_mark = busy
+        return max(0, delta)
+
+    def _account_native(self) -> None:
+        # ALWAYS advance the watermark — billing gated after.  Skipping
+        # the take while the kill switch is off would leave the mark
+        # stale, and re-enabling would bill the entire disabled period's
+        # busy time to one call in a single bogus spike.
+        delta = self.take_busy_ns()
+        if usage.enabled():
+            usage.add_native(self.usage_label(), delta * 1e-9)
 
     def _to_dict(self, state: NetworkState) -> dict:
         return {f: np.asarray(getattr(state, f)) for f in NetworkState._fields}
@@ -228,6 +344,7 @@ class NativeServePool:
         new_state = self._to_state(d)
         self._last_state, self._last_dict = new_state, d
         out = new_state, packed
+        self._account_native()
         _C_CALLS_POOL.inc()
         dur = time.perf_counter() - t0
         _H_SERVE_POOL.observe(dur)
@@ -260,6 +377,7 @@ class NativeServePool:
         new_state = self._to_state(d)
         self._last_state, self._last_dict = new_state, d
         out = new_state, ctrs
+        self._account_native()
         _C_CALLS_IDLE.inc()
         _H_SERVE_IDLE.observe(time.perf_counter() - t0)
         return out

@@ -122,12 +122,43 @@ so the master's env surface is what survives:
                    POST /profile/start + /profile/stop, traces written under
                    this directory (disabled when unset)
   MISAKA_LOG_JSON  "1" for structured JSON logging (utils/jsonlog.py): one
-                   JSON object per line with time/level/logger/msg and the
-                   HTTP route where a request is in scope, so container log
-                   pipelines parse server logs without grok rules.  The
-                   metrics plane itself is always on: GET /metrics serves
-                   Prometheus text exposition, GET /healthz cheap liveness
+                   JSON object per line with time/level/logger/msg, the
+                   HTTP route, trace_id, and the registry program where a
+                   request is in scope, so container log pipelines parse
+                   server logs without grok rules.  MISAKA_SLOW_REQ_MS=N
+                   auto-emits a warning line (trace ID + program attached)
+                   for any request over N ms.  The metrics plane itself is
+                   always on: GET /metrics serves Prometheus text
+                   exposition, GET /healthz cheap liveness
                    (docs/OBSERVABILITY.md has the catalog)
+  MISAKA_SLO       declare service objectives, e.g. "p99<25ms,err<0.1%"
+                   (utils/slo.py): per-program sliding-window latency
+                   quantiles + error rates feed a multi-window burn-rate
+                   engine — ok/warning/page states at GET /debug/alerts,
+                   page => /healthz `degraded`, misaka_slo_* gauges on
+                   /metrics.  Per-program overrides ride the registry
+                   (`slo` field on POST /programs).  Knobs:
+                   MISAKA_SLO_WINDOWS (default "10,60,300,3600" seconds),
+                   MISAKA_SLO_MIN_EVENTS (default 10).  Unset + no
+                   overrides = the engine is disarmed, zero serving cost
+  MISAKA_USAGE     "0" disables per-program usage accounting
+                   (runtime/usage.py; default on): values/requests,
+                   CPU-seconds split across requests by slot share,
+                   MEASURED native-pool seconds, and queue-delay seconds
+                   per program — GET /debug/usage, `usage` blocks in
+                   GET /programs, misaka_usage_* counters
+                   (MISAKA_USAGE_LABEL_MAX caps label cardinality, 64)
+  MISAKA_SAMPLER   "0" disables the always-on continuous profiler
+                   (utils/sampler.py; default on): ~67 Hz all-thread
+                   stack sampling into a decayed folded-stack aggregate,
+                   served at GET /debug/flamegraph (?html=1 for the
+                   self-contained viewer) with the native pool's measured
+                   busy/idle split alongside.  Knobs: MISAKA_SAMPLER_HZ,
+                   MISAKA_SAMPLER_MAX_STACKS (4096),
+                   MISAKA_SAMPLER_DECAY_S (120), MISAKA_SAMPLER_BUDGET
+                   (0.02 — the duty-cycle cap: the sampler measures its
+                   own per-sample cost and stretches its period to stay
+                   under this fraction of one core)
   MISAKA_COORDINATOR  join a multi-host jax.distributed runtime before any
                    device touch ("host:port", or "auto" on Cloud TPU pods);
                    with MISAKA_NUM_PROCESSES + MISAKA_PROCESS_ID

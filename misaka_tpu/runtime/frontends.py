@@ -52,6 +52,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils import slo
 from misaka_tpu.utils import tracespan
 from misaka_tpu.utils.backoff import Backoff
 from misaka_tpu.utils.httpfast import fast_parse_request
@@ -82,8 +83,15 @@ M_FE_CONFIGURED = metrics.gauge(
 #     otherwise     -> payload is `length` bytes of utf-8 error body,
 #                      status is the HTTP code the frontend should answer
 #
-# The metadata is a JSON object {"program": name-or-null, "traces": [...]}
-# (a bare JSON list is accepted as traces-only, the pre-registry form).
+# The metadata is a JSON object {"program": name-or-null, "traces": [...],
+# "edge": [t0_mono, ...]} (a bare JSON list is accepted as
+# traces-only, the pre-registry form).  "edge" appears only while the SLO
+# engine is armed (utils/slo.py): one frontend-receive monotonic
+# timestamp per request, so the engine's
+# per-program SLO windows measure latency from the moment the request hit
+# the EDGE — frontend queueing ahead of the engine is part of the
+# objective, not invisible to it.  CLOCK_MONOTONIC is host-wide and the
+# plane is a unix socket, so the timestamps need no translation.
 # "program" is the registry address every request in the frame shares —
 # the frontend coalescer packs frames PER PROGRAM, so engine-side
 # coalescing (one ServeBatcher per program engine) stays per-program by
@@ -196,8 +204,8 @@ class ComputePlane:
         master = self._master
         registry = self._registry
 
-        def parse_meta(blob: bytes) -> tuple[str | None, list]:
-            """(program, traces) from the frame's JSON metadata.
+        def parse_meta(blob: bytes) -> tuple[str | None, list, list]:
+            """(program, traces, edge) from the frame's JSON metadata.
 
             The program address must decode even with tracing killed; an
             UNDECODABLE blob raises _BadMeta and fails the frame (it may
@@ -207,9 +215,13 @@ class ComputePlane:
             the traces to the serve scheduler so serve.queue / serve.pass
             land on them) only runs when tracing is enabled —
             MISAKA_TRACE_REQUESTS=0 skips it — and stays lenient:
-            malformed trace SEGMENTS are dropped, never fatal."""
+            malformed trace SEGMENTS are dropped, never fatal.  `edge`
+            entries (one receive timestamp per request) feed the SLO
+            windows —
+            also lenient: a malformed edge list costs the observation,
+            never the frame."""
             if not blob:
-                return None, []
+                return None, [], []
             import json as _json
 
             try:
@@ -217,9 +229,10 @@ class ComputePlane:
                 if isinstance(obj, dict):
                     program = obj.get("program") or None
                     segs = obj.get("traces", ())
+                    edge_raw = obj.get("edge", ())
                 elif isinstance(obj, list):
                     # the pre-registry traces-only list form
-                    program, segs = None, obj
+                    program, segs, edge_raw = None, obj, ()
                 else:
                     raise ValueError("metadata must be an object or list")
                 if program is not None and not isinstance(program, str):
@@ -243,7 +256,33 @@ class ComputePlane:
                         traces.append(tr)
                 except (ValueError, TypeError, KeyError, AttributeError):
                     log.debug("dropping malformed plane trace metadata")
-            return program, traces
+            edge = []
+            if slo.armed():
+                try:
+                    edge = [float(t0) for t0 in edge_raw]
+                except (ValueError, TypeError):
+                    log.debug("dropping malformed plane edge metadata")
+            return program, traces, edge
+
+        def slo_record(program, edge, t_recv, error: bool) -> None:
+            """Feed the frame's outcome into the per-program SLO windows:
+            per request when the frontend shipped edge timestamps (the
+            clock starts at the EDGE, so frontend queueing counts), one
+            frame-level observation otherwise.  4xx outcomes never reach
+            here — they are the client's, not the service's."""
+            if not slo.armed():
+                return
+            label = (
+                program.partition("@")[0] if program
+                else registry.default_name if registry is not None
+                else None
+            )
+            now = time.monotonic()
+            if edge:
+                for t0 in edge:
+                    slo.observe(label, max(0.0, now - t0), error=error)
+            else:
+                slo.observe(label, now - t_recv, error=error)
 
         try:
             while not self._closed:
@@ -255,7 +294,7 @@ class ComputePlane:
                 raw = _recv_exact(conn, n * 4)
                 meta = _recv_exact(conn, n_meta) if n_meta else b""
                 try:
-                    program, traces = parse_meta(meta)
+                    program, traces, edge = parse_meta(meta)
                 except _BadMeta as e:
                     body = f"malformed plane metadata: {e}".encode()
                     conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
@@ -302,6 +341,7 @@ class ComputePlane:
                     # activation failure (RegistryError, compile error...)
                     body = str(e).encode()
                     conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
+                    slo_record(program, edge, t_recv, error=True)
                     for tr in traces:
                         tracespan.end(tr, status=500)
                     continue
@@ -321,6 +361,7 @@ class ComputePlane:
                 except Exception as e:
                     body = str(e).encode()
                     conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
+                    slo_record(program, edge, t_recv, error=True)
                     for tr in traces:
                         tracespan.add_span(
                             tr, "plane.recv", t_recv,
@@ -335,6 +376,7 @@ class ComputePlane:
                 conn.sendall(
                     _RESP_HDR.pack(200, len(payload) // 4) + payload
                 )
+                slo_record(program, edge, t_recv, error=False)
                 dur = time.monotonic() - t_recv
                 for tr in traces:
                     tracespan.add_span(
@@ -500,10 +542,23 @@ class PlaneClient:
             meta = b""
             now = time.monotonic()
             traced = [r for r in batch if r.trace is not None]
-            if traced or program is not None:
+            # Ship edge timestamps when THIS process sees objectives OR a
+            # registry is configured: per-program overrides are installed
+            # engine-side (slo.set_objectives on upload) and a frontend
+            # worker is a fresh subprocess that cannot see them — its own
+            # armed() is False with MISAKA_SLO unset, which would starve
+            # the engine's windows down to one observation per frame and
+            # hide frontend queueing from the objective.  The engine-side
+            # armed() check in slo_record stays authoritative; the only
+            # cost of a false positive here is a few metadata bytes.
+            slo_armed = slo.armed() or bool(
+                os.environ.get("MISAKA_PROGRAMS_DIR")
+            )
+            if traced or program is not None or slo_armed:
                 import json as _json
 
                 entries = []
+                edge = []
                 off = 0
                 for r in batch:
                     if r.trace is not None:
@@ -521,10 +576,15 @@ class PlaneClient:
                                 for s in r.trace.spans
                             ],
                         })
+                    if slo_armed:
+                        # edge-observed SLO clock: this request's wait
+                        # started when the frontend enqueued it
+                        edge.append(round(r.enqueued, 6))
                     off += len(r.body) // 4
-                meta = _json.dumps(
-                    {"program": program, "traces": entries}
-                ).encode()
+                obj = {"program": program, "traces": entries}
+                if edge:
+                    obj["edge"] = edge
+                meta = _json.dumps(obj).encode()
             t_ship = now
             try:
                 if sock is None:

@@ -35,11 +35,13 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from misaka_tpu.runtime import usage
 from misaka_tpu.runtime.topology import Topology, TopologyError
 from misaka_tpu.tis.parser import TISParseError
 from misaka_tpu.tis.lower import TISLowerError
 from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils import slo
 from misaka_tpu.utils import tracespan
 from misaka_tpu.utils.httpfast import fast_parse_request as _fast_parse_request
 from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
@@ -178,6 +180,15 @@ _METRIC_ROUTES = frozenset({
     "/compute_raw", "/checkpoint", "/restore", "/profile/start",
     "/profile/stop", "/status", "/trace", "/metrics", "/healthz",
     "/debug/requests", "/debug/perfetto", "/debug/isa_trace",
+    "/debug/usage", "/debug/alerts", "/debug/flamegraph",
+})
+
+# The routes whose latency/error outcomes feed the per-program SLO windows
+# (utils/slo.py): compute traffic only — scrapes and debug reads are not
+# the service the objectives are declared over.
+_SLO_ROUTES = frozenset({
+    "/compute", "/compute_batch", "/compute_raw",
+    "/programs/compute", "/programs/compute_batch", "/programs/compute_raw",
 })
 
 # Program-addressed compute (the registry surface): the <name> segment
@@ -403,6 +414,7 @@ class ServeBatcher:
             master._requests_total += 1
         M_COMPUTE_REQS.inc()
         M_COMPUTE_VALUES.inc(arr.size)
+        usage.add_request(master.program_label, arr.size)
         if not entry.event.wait(timeout):
             with shared.cond:
                 entry.cancelled = True  # skip the undispatched remainder
@@ -509,6 +521,9 @@ class ServeBatcher:
                 if not e.dispatched:
                     e.dispatched = True
                     M_SERVE_QUEUE_DELAY.observe(now - e.enqueued)
+                    usage.add_queue(
+                        master.program_label, now - e.enqueued
+                    )
                     for tr in e.traces:
                         tracespan.add_span(
                             tr, "serve.queue", e.enqueued, now - e.enqueued
@@ -540,6 +555,19 @@ class ServeBatcher:
         Releases every slot in `slots`."""
         master = self._master
         shared = self._shared
+        if faults.armed():
+            # chaos point (utils/faults.py): inject latency into this
+            # program's serve path — `serve_delay` hits every pass,
+            # `serve_delay:<program>` only the named tenant's (the SLO
+            # chaos scenario: one tenant's alerts flip to page while its
+            # neighbors stay green, tests/test_slo.py)
+            delay = faults.fire("serve_delay")
+            if delay is None:
+                delay = faults.fire(
+                    f"serve_delay:{master.program_label or usage.DEFAULT_LABEL}"
+                )
+            if delay is not None:
+                time.sleep(max(0.0, delay))
         t_pass = time.monotonic()
         if len(segs) == 1:
             e0, s0, ln = segs[0]
@@ -560,7 +588,7 @@ class ServeBatcher:
         with master._waiters_lock:
             master._waiters += 1
 
-        def record_pass_spans() -> None:
+        def record_pass_spans(bill: bool = True) -> None:
             # one serve.pass span per traced request in the pass — the
             # coalesced requests share identical pass timing, which is
             # exactly what makes them stack on one pass in Perfetto.
@@ -568,6 +596,19 @@ class ServeBatcher:
             # its Server-Timing header from the spans recorded so far,
             # and the pass phase has to be there by then.
             dur = time.monotonic() - t_pass
+            # usage accounting (runtime/usage.py): this batcher serves
+            # exactly ONE program (per-program engines since r11), so
+            # the whole pass wall bills to it in one call — a
+            # per-segment slot-share loop would re-sum to the same
+            # number while paying a lock + labeled inc per request on
+            # the hot path (note_pass is the independently-accumulated
+            # anchor the conservation test compares against).
+            # Success-only, like the direct lane: a ComputeTimeout must
+            # not charge the tenant the whole timeout window as CPU, and
+            # skipping note_pass with it keeps conservation exact.
+            if bill:
+                usage.note_pass(dur)
+                usage.add_cpu(master.program_label, dur)
             attrs = {
                 "requests": len(segs), "values": total, "slots": n_used,
             }
@@ -618,7 +659,7 @@ class ServeBatcher:
             for e in done:
                 e.event.set()
         except Exception as exc:
-            record_pass_spans()  # before the failed waiters wake
+            record_pass_spans(bill=False)  # before the failed waiters wake
             msg = f"{exc} (coalesced pass: {len(segs)} request(s), " \
                   f"{total} values)"
             failed: list[_BatchEntry] = []
@@ -1166,9 +1207,21 @@ class MasterNode:
             # (unbatched) or the (serve, idle) twin pair (batched pool)
             from misaka_tpu.core.native_serve import NativeServe, NativeServePool
 
-            if self._batch is None:
-                return NativeServe(net)
-            return NativeServePool(net, chunk_steps=self._chunk)
+            runner = (
+                NativeServe(net) if self._batch is None
+                else NativeServePool(net, chunk_steps=self._chunk)
+            )
+            # usage attribution: the runner bills its measured native time
+            # to THIS master's program.  Read through a weakref at call
+            # time — the registry names engines (program_label) after
+            # construction, and the lambda must not keep a closed master
+            # alive through its runner.
+            mref = weakref.ref(self)
+            runner.usage_label = lambda: (
+                (m.program_label or usage.DEFAULT_LABEL)
+                if (m := mref()) is not None else usage.DEFAULT_LABEL
+            )
+            return runner
         if self._mp > 1:
             # Lane-sharded serving: the statically-routed two-collective
             # kernel (parallel/routed.py) is THE model-parallel path;
@@ -1433,7 +1486,7 @@ class MasterNode:
             return np.empty((0,), np.int32) if return_array else []
         n = self._n_slots
         tr = tracespan.current()
-        t_q = time.monotonic() if tr is not None else 0.0
+        t_q = time.monotonic()  # queue clock: slot-lock wait (usage + trace)
         with self._rr_lock:
             start = self._rr
             self._rr = (self._rr + 1) % n
@@ -1451,6 +1504,8 @@ class MasterNode:
             self._requests_total += 1
         M_COMPUTE_REQS.inc()
         M_COMPUTE_VALUES.inc(arr.size)
+        usage.add_request(self.program_label, arr.size)
+        usage.add_queue(self.program_label, time.monotonic() - t_q)
         try:
             if tr is not None:
                 # the direct lane's queue phase is the slot-lock wait
@@ -1460,6 +1515,7 @@ class MasterNode:
             pass_attrs = {"values": int(arr.size)}
             if self.program_label is not None:
                 pass_attrs["program"] = self.program_label
+            t_pass = time.monotonic()
             with tracespan.span("serve.pass", trace=tr, **pass_attrs):
                 with self._epoch_lock:
                     epoch = self._epoch
@@ -1469,6 +1525,15 @@ class MasterNode:
                 parts = self._collect_slot(
                     slot, arr.size, deadline, epoch, timeout
                 )
+            # the direct lane's completed submit+collect window IS its
+            # pass (one request, whole share) — same conservation-anchor
+            # discipline as the scheduler's fused passes.  Success-only:
+            # a ComputeTimeout must not charge the tenant the full
+            # timeout as CPU, and skipping note_pass with it keeps the
+            # conservation invariant exact.
+            dur = time.monotonic() - t_pass
+            usage.note_pass(dur)
+            usage.add_cpu(self.program_label, dur)
             out = np.concatenate(parts)
             return out if return_array else out.tolist()
         finally:
@@ -1615,6 +1680,8 @@ class MasterNode:
             self._requests_total += 1
         M_COMPUTE_REQS.inc()
         M_COMPUTE_VALUES.inc(arr.size)
+        usage.add_request(self.program_label, arr.size)
+        t_pass = time.monotonic()
         try:
             pass_attrs = {"values": int(arr.size), "slots": len(owned)}
             if self.program_label is not None:
@@ -1647,6 +1714,11 @@ class MasterNode:
                                     self._stale[s2] += part2.size
                         raise
             out = np.concatenate(parts)
+            # success-only billing — same discipline (and rationale) as
+            # the compute_many lane above
+            dur = time.monotonic() - t_pass
+            usage.note_pass(dur)
+            usage.add_cpu(self.program_label, dur)
             return out if return_array else out.tolist()
         finally:
             with self._waiters_lock:
@@ -2563,11 +2635,31 @@ def make_http_server(
     # of boot (ADVICE r5 #3).
     textcodec.native_available()
 
+    # Always-on continuous profiler (utils/sampler.py): every serving
+    # process samples its own stacks from boot, served at GET
+    # /debug/flamegraph.  Process-global (one thread no matter how many
+    # servers tests build); MISAKA_SAMPLER=0 is the kill switch.
+    from misaka_tpu.utils import sampler as _sampler
+
+    _sampler.ensure_started()
+
+    # Fleet-debugging stamp (utils/buildinfo.py): the misaka_build_info
+    # gauge (version / git sha / runtime versions / native provenance in
+    # labels, value 1) plus the /status `build` block below.
+    from misaka_tpu.utils import buildinfo
+
+    buildinfo.install_metric()
+
     _name_re = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
     # Request-body ceiling for the bulk lanes (default 64 MiB): an
     # unauthenticated client must not be able to make the server buffer an
     # arbitrarily large body (answers 413; missing Content-Length is 411).
     max_body = int(os.environ.get("MISAKA_MAX_BODY", "") or 64 * 1024 * 1024)
+    # Slow-request logging threshold (MISAKA_SLOW_REQ_MS): requests over it
+    # auto-emit a structured warning carrying trace ID + program, so the
+    # log <-> trace <-> tenant correlation is one grep.  Unset = off.
+    _slow_ms = os.environ.get("MISAKA_SLOW_REQ_MS")
+    slow_req_s = float(_slow_ms) / 1e3 if _slow_ms else None
     # Serving-plane fast request parsing (see _fast_parse_request);
     # MISAKA_FAST_HTTP=0 restores the stock stdlib parser end to end.
     fast_http = os.environ.get("MISAKA_FAST_HTTP", "1") != "0"
@@ -2668,6 +2760,7 @@ def make_http_server(
             route = _route_label(self.path)
             self._metrics_code = None  # reset: keep-alive reuses the handler
             self._extra_headers = []   # per-request; keep-alive reuse
+            self._misaka_program = None  # set by _handle_post's resolution
             trace = tracespan.begin(
                 self.headers.get(tracespan.TRACE_HEADER), route=route
             )
@@ -2681,14 +2774,39 @@ def make_http_server(
             try:
                 inner()
             finally:
-                M_HTTP_LATENCY.labels(route=route).observe(
-                    time.perf_counter() - t0
-                )
+                dur = time.perf_counter() - t0
+                M_HTTP_LATENCY.labels(route=route).observe(dur)
                 M_HTTP_REQS.labels(route=route, method=method).inc()
                 code = self._metrics_code or 500
                 if code >= 400:
                     M_HTTP_ERRORS.labels(route=route, code=str(code)).inc()
                 M_HTTP_INFLIGHT.dec()
+                if route in _SLO_ROUTES and slo.armed() and (
+                    code < 400 or code >= 500
+                ):
+                    # edge-observed latency/error into the per-program SLO
+                    # windows: the whole handler window, so queue time
+                    # ahead of the engine is part of the objective.  5xx
+                    # are service errors; 4xx are the client's own and
+                    # count neither way.
+                    slo.observe(
+                        self._misaka_program, dur, error=code >= 500
+                    )
+                if slow_req_s is not None and dur >= slow_req_s:
+                    # slow-request structured log line: trace_id rides the
+                    # contextvar, program the explicit extra — with
+                    # MISAKA_LOG_JSON the grep joins log <-> trace <->
+                    # tenant in one line (utils/jsonlog.py)
+                    log.warning(
+                        "slow request: %s %.1fms (threshold %.0fms)",
+                        route, dur * 1e3, slow_req_s * 1e3,
+                        extra={
+                            "route": route,
+                            "program": self._misaka_program,
+                            "trace_id": trace.trace_id
+                            if trace is not None else None,
+                        },
+                    )
                 self._misaka_trace = None
                 tracespan.end(trace, status=code)
 
@@ -2755,6 +2873,10 @@ def make_http_server(
                     # Prometheus text exposition v0.0.4 from the process
                     # registry: HTTP surface, device loop, native pool,
                     # distributed counters — whatever this process runs.
+                    # The misaka_slo_* gauges are evaluation RESULTS, not
+                    # callbacks — refresh them so a scrape always carries
+                    # current burn rates (cached, cheap; no-op disarmed).
+                    slo.refresh_metrics()
                     self._send(
                         metrics.render().encode(), metrics.CONTENT_TYPE
                     )
@@ -2779,15 +2901,27 @@ def make_http_server(
                     # crash-looping worker pool must NEVER be silent — the
                     # probe carries an explicit degraded flag and the pool
                     # counts, while ok stays a pure liveness bit.
+                    degraded = None
                     sup = getattr(self.server, "misaka_supervisor", None)
                     if sup is not None:
                         fs = sup.state()
                         payload["frontends"] = fs
-                        payload["degraded"] = fs["degraded"]
+                        degraded = fs["degraded"]
+                    # The SLO engine (utils/slo.py): a paging burn rate is
+                    # the service being unhealthy BY DECLARED OBJECTIVE —
+                    # it rides the same degraded flag the PR 9 supervisor
+                    # introduced, while ok stays pure liveness.
+                    slo_state = slo.overall_state()
+                    if slo_state is not None:
+                        payload["slo"] = slo_state
+                        degraded = bool(degraded) or slo_state == "page"
+                    if degraded is not None:
+                        payload["degraded"] = degraded
                     self._json(payload)
                     return
                 if parsed.path == "/status":
                     payload = master.status()
+                    payload["build"] = buildinfo.info()
                     sup = getattr(self.server, "misaka_supervisor", None)
                     if sup is not None:
                         payload["frontends"] = sup.state()
@@ -2821,6 +2955,34 @@ def make_http_server(
                         self._json(registry.info(name))
                     except ProgramNotFound as e:
                         self._text(404, str(e))
+                    return
+                if parsed.path == "/debug/usage":
+                    # the per-program resource ledger (runtime/usage.py):
+                    # values/requests served, CPU-seconds (fused-pass wall
+                    # split by slot share), measured native-pool seconds,
+                    # and queue-delay seconds, per program
+                    self._json(usage.debug_payload())
+                    return
+                if parsed.path == "/debug/alerts":
+                    # the SLO burn-rate engine (utils/slo.py): per-program
+                    # ok/warning/page states with per-window burn rates
+                    # and latency quantiles
+                    self._json(slo.debug_payload())
+                    return
+                if parsed.path == "/debug/flamegraph":
+                    # the continuous profiler (utils/sampler.py): folded
+                    # CPython stacks + the native busy/idle split;
+                    # ?html=1 answers the self-contained viewer
+                    from misaka_tpu.utils import sampler
+
+                    q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                    if q.get("html") == "1":
+                        self._send(
+                            sampler.render_html().encode(),
+                            "text/html; charset=utf-8",
+                        )
+                    else:
+                        self._json(sampler.debug_payload())
                     return
                 if parsed.path == "/debug/requests":
                     # the request-trace flight recorder: recent ring +
@@ -2905,6 +3067,15 @@ def make_http_server(
                     path = "/" + pm.group(2)
                 else:
                     prog_ref = self.headers.get("X-Misaka-Program") or None
+                # which program this request bills to (SLO windows, slow-
+                # request log lines): the addressed name, or the seeded
+                # default when a registry is armed (None collapses to the
+                # "default" ledger label on pre-registry servers)
+                self._misaka_program = (
+                    prog_ref.partition("@")[0] if prog_ref
+                    else registry.default_name if registry is not None
+                    else None
+                )
                 if path == "/run":
                     self._form()  # drain any body (keep-alive sync)
                     try:
@@ -3141,6 +3312,7 @@ def make_http_server(
                             tis=form.get("program"),
                             topology_json=form.get("topology"),
                             compose=form.get("compose"),
+                            slo_spec=form.get("slo"),
                         )
                     except (
                         RegistryError,

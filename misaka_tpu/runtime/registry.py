@@ -58,9 +58,11 @@ import re
 import threading
 import time
 
+from misaka_tpu.runtime import usage
 from misaka_tpu.runtime.topology import Topology
 from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils import slo
 
 log = logging.getLogger("misaka_tpu.registry")
 
@@ -327,14 +329,25 @@ class ProgramRegistry:
                     key=lambda v: entry.versions[v].get("created_unix", 0),
                 )
             self._entries[name] = entry
+            spec = entry.versions[entry.aliases["latest"]].get("slo")
+            if spec:
+                try:  # the latest version's objectives survive restarts
+                    slo.set_objectives(name, spec)
+                except slo.SLOSpecError:
+                    log.warning(
+                        "registry: ignoring corrupt slo spec on %s@%s",
+                        name, entry.aliases["latest"],
+                    )
             log.info(
                 "registry: loaded program %s (%d version(s), latest %s)",
                 name, len(entry.versions), entry.aliases["latest"],
             )
 
-    def _persist_version(self, name: str, version: str, meta: dict) -> None:
+    def _persist_version(
+        self, name: str, version: str, meta: dict, overwrite: bool = False
+    ) -> None:
         path = self._version_path(name, version)
-        if os.path.exists(path):
+        if os.path.exists(path) and not overwrite:
             return  # content-addressed: identical by construction
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -451,6 +464,7 @@ class ProgramRegistry:
         tis: str | None = None,
         topology_json: str | None = None,
         compose: str | None = None,
+        slo_spec: str | None = None,
     ) -> dict:
         """Upload one program version; hot-swap the live engine when the
         `latest` alias moves under it.
@@ -458,9 +472,22 @@ class ProgramRegistry:
         Compile-FIRST discipline: the source is parsed, lowered, and
         compiled at the registry's serving batch before any bookkeeping
         mutates — a bad upload is a 400 that touches nothing (the fix the
-        legacy /load route needed too, runtime/master.py)."""
+        legacy /load route needed too, runtime/master.py).
+
+        `slo_spec` (the upload form's `slo` field) declares per-program
+        service objectives in MISAKA_SLO grammar (e.g. "p99<25ms,
+        err<0.1%"): stored in the version metadata, installed into the
+        burn-rate engine (utils/slo.py) when the version becomes
+        `latest`, overriding the env-wide default objectives for this
+        program.  Validated HERE — a malformed spec is a 400 that
+        touches nothing, same as a bad source."""
         if not NAME_RE.match(name):
             raise RegistryError(f"invalid program name {name!r}")
+        if slo_spec is not None:
+            try:
+                slo.parse_spec(slo_spec)  # validate-first, like the source
+            except slo.SLOSpecError as e:
+                raise RegistryError(f"invalid slo spec: {e}") from e
         topo = self.parse_source(
             tis=tis, topology_json=topology_json, compose=compose
         )
@@ -468,6 +495,8 @@ class ProgramRegistry:
         canonical = canonical_topology(topo)
         version = version_of(canonical)
         meta = {"source": canonical, "created_unix": round(time.time(), 3)}
+        if slo_spec is not None:
+            meta["slo"] = slo_spec
         with self._cond:
             entry = self._entries.get(name)
             if entry is not None and entry.pinned:
@@ -482,8 +511,19 @@ class ProgramRegistry:
             with self._cond:
                 entry = self._entries.setdefault(name, _Entry())
                 created = version not in entry.versions
+                slo_changed = False
                 if created:
                     entry.versions[version] = meta
+                elif (
+                    slo_spec is not None
+                    and entry.versions[version].get("slo") != slo_spec
+                ):
+                    # content-addressed dedup keeps the stored meta; an
+                    # slo re-declaration on a known version still lands
+                    # (and is the ONLY dedup'd case worth a disk rewrite)
+                    entry.versions[version]["slo"] = slo_spec
+                    slo_changed = True
+                meta = entry.versions[version]
                 prev = entry.aliases.get("latest")
                 old_key = (name, prev) if prev is not None else None
                 need_swap = (
@@ -491,7 +531,7 @@ class ProgramRegistry:
                     and prev != version
                     and old_key in self._engines
                 )
-            self._persist_version(name, version, meta)
+            self._persist_version(name, version, meta, overwrite=slo_changed)
             M_PROG_UPLOADS.inc()
             swapped = False
             if need_swap:
@@ -501,6 +541,18 @@ class ProgramRegistry:
                 with self._cond:
                     entry.aliases["latest"] = version
                 self._persist_aliases(name, {"latest": version})
+            # the new `latest` owns this program's objectives: its spec
+            # overrides MISAKA_SLO for this program; a latest without one
+            # clears any previous override back to the env default.  A
+            # refused install (override budget exhausted — the shared
+            # MISAKA_USAGE_LABEL_MAX cap bounds slo gauge cardinality)
+            # must not fail the upload: the program serves under the env
+            # defaults and the refusal is loud in the log.
+            try:
+                slo.set_objectives(name, meta.get("slo"))
+            except slo.SLOSpecError as e:
+                log.warning("registry: slo override for %s not installed: %s",
+                            name, e)
             return {
                 "name": name,
                 "version": version,
@@ -915,14 +967,18 @@ class ProgramRegistry:
     def lease(self, ref: str | None = None, values: int = 0):
         """The request-side entry point: resolve `ref`, activate if
         needed, park through a swap, count per-program metrics, and yield
-        the engine for the request's lifetime."""
+        the engine for the request's lifetime.  The program name is made
+        current on this thread for the scope (runtime/usage.py), so
+        structured log lines emitted while serving carry a `program`
+        field next to `trace_id` (utils/jsonlog.py)."""
         key, eng = self._checkout(ref)
         label = _program_label(key[0])
         M_PROG_REQS.labels(program=label).inc()
         if values:
             M_PROG_VALUES.labels(program=label).inc(values)
         try:
-            yield eng.master
+            with usage.program_scope(key[0]):
+                yield eng.master
         finally:
             self._checkin(key, eng)
 
@@ -940,6 +996,9 @@ class ProgramRegistry:
                     "latest": entry.aliases.get("latest"),
                     "pinned": entry.pinned,
                     "default": name == self._default,
+                    # the usage ledger (runtime/usage.py): what this
+                    # program has cost the box — None until it serves
+                    "usage": usage.program_snapshot(name),
                     "versions": {
                         v: {
                             "created_unix": meta.get("created_unix"),

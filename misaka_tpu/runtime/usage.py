@@ -1,0 +1,293 @@
+"""Per-program resource accounting: who is spending this box's time.
+
+PR 6 made the box multi-tenant (per-program engines behind one endpoint)
+but left the observability plane tenant-blind where it matters: the
+metrics catalog says how busy the machine is, nothing says WHICH program
+made it busy.  Admission control and fleet health scoring (ROADMAP: per-
+tenant quotas, replicated fleet) both need per-tenant cost signals; this
+module is that ledger.
+
+Four accumulators per program (label = the registry program name; the
+pre-registry single-program surface accounts under "default"):
+
+  requests / values   what entered the compute lanes
+  cpu_seconds         fused-pass wall time, split across the requests a
+                      pass served by slot share (each request's share is
+                      pass_wall * its_values / pass_values) — so the sum
+                      over programs equals the total fused-pass wall time
+                      by construction, which the conservation test pins
+                      (tests/test_usage.py, within 5%)
+  native_seconds      MEASURED time in the C++ pool attributed to this
+                      program's passes, from the per-thread busy-ns
+                      counters native/interpreter.cpp maintains (NOT a
+                      Python-side wall-clock inference); conservation vs
+                      pool busy-ns pinned within 10%
+  queue_seconds       time requests waited ahead of their first dispatch
+                      (serve-scheduler queue delay + direct-lane slot
+                      waits) — the contention signal quotas act on
+
+Surfaces: ``GET /debug/usage`` (this module's debug_payload), a `usage`
+block per program in ``GET /programs`` listings (runtime/registry.py),
+and ``misaka_usage_*`` counters on GET /metrics — program-labeled, with
+the same cardinality guard discipline as the registry series (an
+unauthenticated upload flood collapses to program="other").
+
+The module also owns the per-request *program context* (a contextvar the
+registry lease sets): ``current_program()`` is how the structured logs
+(utils/jsonlog.py) stamp a `program` field next to `trace_id`, closing
+the log <-> trace <-> tenant correlation loop in one grep.
+
+Kill switch: ``MISAKA_USAGE=0`` turns every record call into a no-op
+(the ABBA overhead gate in bench.py --usage-ab runs with it on AND off).
+Stdlib-only, like metrics/tracespan/jsonlog — importable anywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+
+from misaka_tpu.utils import metrics
+
+DEFAULT_LABEL = "default"
+
+# One counter family per accumulator, program-labeled.  Children are
+# resolved once per program (cached on the _Account) — the serve hot path
+# must not pay a label-lookup dict walk per pass.
+M_USAGE_REQS = metrics.counter(
+    "misaka_usage_requests_total",
+    "Compute requests accounted to a program by the usage ledger",
+    ("program",),
+)
+M_USAGE_VALUES = metrics.counter(
+    "misaka_usage_values_total",
+    "Values accounted to a program by the usage ledger",
+    ("program",),
+)
+M_USAGE_CPU = metrics.counter(
+    "misaka_usage_cpu_seconds_total",
+    "Fused-pass wall seconds attributed to a program (slot-share split; "
+    "sums across programs to misaka_serve_pass_wall_seconds_total)",
+    ("program",),
+)
+M_USAGE_NATIVE = metrics.counter(
+    "misaka_usage_native_seconds_total",
+    "Measured C++-pool busy seconds attributed to a program (from the "
+    "native per-thread busy-ns counters)",
+    ("program",),
+)
+M_USAGE_QUEUE = metrics.counter(
+    "misaka_usage_queue_seconds_total",
+    "Seconds requests of a program waited ahead of first dispatch "
+    "(scheduler queue delay + direct-lane slot waits)",
+    ("program",),
+)
+# The conservation anchor: total fused-pass wall time, accumulated at the
+# pass sites themselves (NOT derived from the per-program splits — the
+# tests compare the two to catch attribution that leaks or double-counts).
+M_PASS_SECONDS = metrics.counter(
+    "misaka_serve_pass_wall_seconds_total",
+    "Total wall seconds of fused serve passes (all programs; the "
+    "conservation anchor for misaka_usage_cpu_seconds_total)",
+)
+
+
+class _Account:
+    """One program's accumulators + its resolved metric children."""
+
+    __slots__ = ("label", "requests", "values", "cpu_seconds",
+                 "native_seconds", "queue_seconds", "_lock",
+                 "_m_reqs", "_m_values", "_m_cpu", "_m_native", "_m_queue")
+
+    def __init__(self, label: str):
+        self.label = label
+        self.requests = 0
+        self.values = 0
+        self.cpu_seconds = 0.0
+        self.native_seconds = 0.0
+        self.queue_seconds = 0.0
+        self._lock = threading.Lock()
+        self._m_reqs = M_USAGE_REQS.labels(program=label)
+        self._m_values = M_USAGE_VALUES.labels(program=label)
+        self._m_cpu = M_USAGE_CPU.labels(program=label)
+        self._m_native = M_USAGE_NATIVE.labels(program=label)
+        self._m_queue = M_USAGE_QUEUE.labels(program=label)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "values": self.values,
+                "cpu_seconds": round(self.cpu_seconds, 6),
+                "native_seconds": round(self.native_seconds, 6),
+                "queue_seconds": round(self.queue_seconds, 6),
+            }
+
+
+_lock = threading.Lock()
+_accounts: dict[str, _Account] = {}
+_ENABLED = True
+
+
+def configure(environ=os.environ) -> None:
+    """(Re-)read MISAKA_USAGE (kill switch; default on).  Called at
+    import; the bench A/B toggles it live."""
+    global _ENABLED
+    _ENABLED = environ.get("MISAKA_USAGE", "1") != "0"
+
+
+configure()
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _label_budget() -> int:
+    return metrics.tenant_label_budget()
+
+
+def account(program: str | None) -> _Account:
+    """The ledger for `program` (None -> "default"), creating it within
+    the cardinality budget — past MISAKA_USAGE_LABEL_MAX distinct labels,
+    new programs collapse into "other" (metrics.capped_label, the guard
+    shared with the SLO windows and the registry's metric series)."""
+    label = program or DEFAULT_LABEL
+    acct = _accounts.get(label)
+    if acct is not None:
+        return acct
+    with _lock:
+        label = metrics.capped_label(_accounts, label, _label_budget())
+        acct = _accounts.get(label)
+        if acct is None:
+            acct = _accounts[label] = _Account(label)
+    return acct
+
+
+def add_request(program: str | None, values: int) -> None:
+    if not _ENABLED:
+        return
+    a = account(program)
+    with a._lock:
+        a.requests += 1
+        a.values += int(values)
+    a._m_reqs.inc()
+    a._m_values.inc(values)
+
+
+def add_cpu(program: str | None, seconds: float) -> None:
+    """One request's slot share of a fused pass's wall time."""
+    if not _ENABLED or seconds <= 0:
+        return
+    a = account(program)
+    with a._lock:
+        a.cpu_seconds += seconds
+    a._m_cpu.inc(seconds)
+
+
+def add_native(program: str | None, seconds: float) -> None:
+    """Measured C++-pool busy time (busy-ns counter delta) for one of
+    this program's engine calls."""
+    if not _ENABLED or seconds <= 0:
+        return
+    a = account(program)
+    with a._lock:
+        a.native_seconds += seconds
+    a._m_native.inc(seconds)
+
+
+def add_queue(program: str | None, seconds: float) -> None:
+    if not _ENABLED or seconds <= 0:
+        return
+    a = account(program)
+    with a._lock:
+        a.queue_seconds += seconds
+    a._m_queue.inc(seconds)
+
+
+def note_pass(seconds: float) -> None:
+    """Record one fused pass's total wall time into the conservation
+    anchor (called at the pass site, independent of the per-program
+    splits — so the conservation tests compare two real code paths)."""
+    if not _ENABLED or seconds <= 0:
+        return
+    M_PASS_SECONDS.inc(seconds)
+
+
+def pass_seconds_total() -> float:
+    return M_PASS_SECONDS.value
+
+
+def snapshot() -> dict[str, dict]:
+    """{program: accumulators} for every program the ledger has seen."""
+    with _lock:
+        accounts = list(_accounts.values())
+    return {a.label: a.snapshot() for a in accounts}
+
+
+def program_snapshot(program: str) -> dict | None:
+    """One program's accumulators, or None when it never served (the
+    /programs listing must not mint ledger entries for idle programs)."""
+    a = _accounts.get(program)
+    return a.snapshot() if a is not None else None
+
+
+def reset() -> None:
+    """Tests: wipe the ledger (metric counters keep their process-
+    cumulative Prometheus semantics and are delta'd by readers)."""
+    with _lock:
+        _accounts.clear()
+
+
+def debug_payload() -> dict:
+    """The GET /debug/usage body."""
+    programs = snapshot()
+    payload = {
+        "enabled": _ENABLED,
+        "programs": programs,
+        "pass_seconds_total": round(pass_seconds_total(), 6),
+        "cpu_seconds_total": round(
+            sum(p["cpu_seconds"] for p in programs.values()), 6
+        ),
+    }
+    try:
+        # the live native pool's measured busy/idle split (None when no
+        # pool is serving); lazy import — this module stays stdlib-only
+        # for every process that never runs a native engine
+        from misaka_tpu.core import native_serve
+
+        pool = native_serve.pool_counters()
+        if pool is not None:
+            payload["native_pool"] = pool
+    except Exception:  # pragma: no cover — the ledger must always answer
+        pass
+    return payload
+
+
+# --- the per-request program context (jsonlog's `program` field) ------------
+
+_current: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "misaka_program", default=None
+)
+
+
+def current_program() -> str | None:
+    """The program the EMITTING thread is serving (set by the registry
+    lease / HTTP handlers) — utils/jsonlog.py stamps it next to trace_id
+    so log <-> trace <-> tenant correlation is one grep."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def program_scope(program: str | None):
+    """Make `program` current for a request's lifetime (no-op on None)."""
+    if program is None:
+        yield
+        return
+    token = _current.set(program)
+    try:
+        yield
+    finally:
+        _current.reset(token)

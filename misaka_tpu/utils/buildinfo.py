@@ -1,0 +1,129 @@
+"""Build identity: the `misaka_build_info` gauge + the /status `build`
+block — the standard fleet-debugging stamp.
+
+When a fleet of replicas misbehaves, the first question is "which BUILD
+is each one running" — version, commit, runtime versions, and (here)
+which native components actually loaded.  The Prometheus convention is a
+constant `<thing>_build_info` gauge valued 1 whose labels carry the
+identity, so `count by (git_sha) (misaka_build_info)` instantly shows a
+mixed-version fleet mid-rollout.  The same dict rides /status as the
+`build` block for humans.
+
+Everything is computed ONCE and cached: git shells out a single
+rev-parse (absent in a deployed image — falls back to
+MISAKA_BUILD_SHA, then "unknown"), jax's version is read only if jax is
+already imported (this module must not force a multi-second backend
+boot on a process that never touched jax), and the native components
+report the source hash of the .so each loader would serve.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from misaka_tpu import __version__
+from misaka_tpu.utils import metrics
+
+_info_cache: dict | None = None
+
+
+def _git_sha() -> str:
+    env = os.environ.get("MISAKA_BUILD_SHA")
+    if env:
+        return env
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+        )
+        sha = out.stdout.strip()
+        if out.returncode == 0 and sha:
+            return sha
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def _native_labels() -> dict[str, str]:
+    """Source hash per native component when its .so is present and
+    current, "absent" otherwise — the provenance tag utils/nativelib.py
+    embeds at build time, read from the loader's own source hash."""
+    out: dict[str, str] = {}
+    try:
+        from misaka_tpu.core import cinterp
+        from misaka_tpu.utils import textcodec
+
+        for name, lib in (
+            ("interp", cinterp._NATIVE),
+            ("textcodec", getattr(textcodec, "_NATIVE", None)),
+        ):
+            if lib is None:
+                continue
+            try:
+                out[name] = (
+                    lib._src_hash() if lib._so_matches_src() else "absent"
+                )
+            except OSError:
+                out[name] = "absent"
+    except Exception:  # pragma: no cover — identity must never crash boot
+        pass
+    return out
+
+
+def info() -> dict:
+    """The cached build-identity dict (/status `build` block)."""
+    global _info_cache
+    if _info_cache is None:
+        jax_version = "unloaded"
+        mod = sys.modules.get("jax")
+        if mod is not None:
+            jax_version = getattr(mod, "__version__", "unknown")
+        _info_cache = {
+            "version": __version__,
+            "git_sha": _git_sha(),
+            "python": ".".join(str(v) for v in sys.version_info[:3]),
+            "jax": jax_version,
+            "native": _native_labels(),
+        }
+    elif _info_cache["jax"] == "unloaded" and "jax" in sys.modules:
+        # jax was imported after the first call: upgrade the stamp, and
+        # re-stamp the gauge so /metrics and /status keep agreeing
+        _info_cache["jax"] = getattr(
+            sys.modules["jax"], "__version__", "unknown"
+        )
+        if _metric_installed:
+            install_metric()
+    return _info_cache
+
+
+_metric_installed = False
+
+
+def install_metric() -> None:
+    """Register misaka_build_info (value 1, identity in labels) into the
+    default registry — called by make_http_server, so every serving
+    process stamps itself.  Re-entrant: a jax-version upgrade (info())
+    re-stamps, dropping the stale jax="unloaded" series so the gauge
+    never disagrees with the /status build block."""
+    global _metric_installed
+    i = info()
+    native = i["native"]
+    g = metrics.gauge(
+        "misaka_build_info",
+        "Build identity (constant 1; the identity lives in the labels)",
+        ("version", "git_sha", "python", "jax", "native_interp"),
+    )
+    g.prune(lambda kv: kv["jax"] != i["jax"])
+    g.labels(
+        version=i["version"],
+        git_sha=i["git_sha"],
+        python=i["python"],
+        jax=i["jax"],
+        native_interp=native.get("interp", "absent"),
+    ).set(1)
+    _metric_installed = True

@@ -46,6 +46,13 @@ Named points (the hook sites live next to the code they break):
                     arriving in that window parks — the widened race the
                     zero-client-visible-errors swap contract is tested
                     against.
+  serve_delay     — every serve-scheduler pass sleeps `value` seconds
+                    before dispatching (runtime/master.py ServeBatcher):
+                    the rpc_delay of the fused serving plane.  The scoped
+                    form `serve_delay:<program>` delays ONLY that
+                    registry program's passes — the per-tenant SLO chaos
+                    scenario (one tenant pages on /debug/alerts, its
+                    neighbors stay green; tests/test_slo.py).
 
 Fault checks are zero-cost when nothing is armed (`fire` returns None
 after one dict lookup on an empty dict); the module imports stdlib only —
@@ -65,7 +72,15 @@ POINTS = frozenset({
     "ckpt_torn_write",
     "ckpt_crash",
     "swap_during_load",
+    "serve_delay",
 })
+
+# Points that accept a ":<qualifier>" suffix scoping the fault to one
+# target: `serve_delay:tenant-b=0.05` injects latency into ONLY that
+# registry program's serve passes (runtime/master.py ServeBatcher) — the
+# per-tenant SLO chaos scenario, where one program must page while its
+# neighbors stay green.
+SCOPED_POINTS = frozenset({"serve_delay"})
 
 
 class FaultSpecError(ValueError):
@@ -102,9 +117,13 @@ def parse_spec(text: str | None) -> dict[str, tuple[float, float]]:
                     f"cannot parse value {value_s!r} in {raw!r}"
                 ) from None
         name = entry.strip()
-        if name not in POINTS:
+        base = name.split(":", 1)[0]
+        if name not in POINTS and not (
+            ":" in name and base in SCOPED_POINTS and name[len(base) + 1:]
+        ):
             raise FaultSpecError(
-                f"unknown fault point {name!r} (known: {sorted(POINTS)})"
+                f"unknown fault point {name!r} (known: {sorted(POINTS)}; "
+                f"scoped: {sorted(SCOPED_POINTS)} accept ':<target>')"
             )
         spec[name] = (value, prob)
     return spec

@@ -28,6 +28,17 @@ import time
 from misaka_tpu.utils import tracespan
 
 
+def _current_program() -> str | None:
+    """The lease-context program (lazy import: runtime.usage sits one
+    package over; a plain-format process must not pay for it at import)."""
+    try:
+        from misaka_tpu.runtime import usage
+
+        return usage.current_program()
+    except Exception:  # pragma: no cover — logging must never crash
+        return None
+
+
 class JsonFormatter(logging.Formatter):
     """Format every record as one JSON object per line."""
 
@@ -49,6 +60,12 @@ class JsonFormatter(logging.Formatter):
         trace_id = getattr(record, "trace_id", None) or tracespan.current_id()
         if trace_id:
             obj["trace_id"] = trace_id
+        # the program (tenant) in scope on the emitting thread — set by
+        # the registry lease (runtime/usage.py program_scope) — so
+        # log <-> trace <-> tenant correlation is one grep
+        program = getattr(record, "program", None) or _current_program()
+        if program:
+            obj["program"] = program
         if record.exc_info:
             obj["exc"] = self.formatException(record.exc_info)
         # default=str: a log call must never crash on an unserializable arg

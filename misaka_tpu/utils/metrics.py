@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
 import re
 import threading
 
@@ -86,6 +87,31 @@ def pow2_buckets(lo: int, hi: int) -> tuple[float, ...]:
 # span ~us (native pool chunk) to ~s (XLA autogrow compile), so one fixed
 # grid serves every duration histogram (fixed buckets = aggregatable).
 DURATION_BUCKETS = log_buckets(1e-5, 10.0)
+
+
+def tenant_label_budget() -> int:
+    """MISAKA_USAGE_LABEL_MAX (default 64): the ONE per-tenant cardinality
+    cap shared by the whole health plane — usage ledger accounts, SLO
+    windows and overrides, and every program-labeled metric series."""
+    return int(os.environ.get("MISAKA_USAGE_LABEL_MAX", "") or 64)
+
+
+def capped_label(existing, label: str, budget: int, exempt=()) -> str:
+    """Resolve `label` against a cardinality budget: once `existing`
+    (any container supporting `in`/`len`) already tracks `budget`
+    distinct labels, a NEW label collapses to "other" — existing labels,
+    "other" itself, and `exempt` members always resolve verbatim.
+
+    MUST be called under the lock guarding `existing`, and deliberately
+    never recurses or re-locks: the usage ledger and the SLO windows each
+    independently grew this logic with a recursive "other" resolution
+    that self-deadlocked their non-reentrant module locks — this helper
+    is the single shared copy."""
+    if label == "other" or label in existing or label in exempt:
+        return label
+    if len(existing) >= budget:
+        return "other"
+    return label
 
 
 def _fmt(v: float) -> str:
@@ -242,6 +268,19 @@ class _Metric:
         with self._lock:
             return sorted(self._children.items())
 
+    def prune(self, predicate) -> None:
+        """Drop labeled children the predicate (labels-dict -> bool)
+        matches: a series whose label set no longer exists must DISAPPEAR
+        from the scrape, not freeze at its last value (e.g. the burn-rate
+        series of a replaced per-program SLO objective)."""
+        with self._lock:
+            stale = [
+                k for k in self._children
+                if k and predicate(dict(zip(self.labelnames, k)))
+            ]
+            for k in stale:
+                del self._children[k]
+
     def render(self) -> list[str]:
         lines = [
             f"# HELP {self.name} {_escape_help(self.help)}",
@@ -397,6 +436,73 @@ def histogram(
 
 def render(registry=None) -> str:
     return (registry or REGISTRY).render()
+
+
+# --- histogram estimation math (shared with the SLO windows) ----------------
+
+
+def quantile_from_buckets(uppers, counts, q: float) -> float:
+    """Estimate the q-quantile (q in [0, 1]) from cumulative-style bucket
+    data: `uppers` are the bucket upper bounds (ascending, +Inf implicit),
+    `counts` the PER-BUCKET (non-cumulative) counts, len(uppers) + 1 long.
+
+    Linear interpolation inside the straddling bucket (the Prometheus
+    histogram_quantile convention): the first bucket interpolates from 0,
+    and a quantile landing in the +Inf bucket returns the last finite
+    bound (the estimate saturates — there is no upper edge to lerp to).
+    Returns 0.0 when there are no observations.  Reused by utils/slo.py's
+    sliding windows, so its accuracy is pinned by tests/test_metrics.py.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise MetricError(f"quantile must be in [0, 1], got {q}")
+    if len(counts) != len(uppers) + 1:
+        raise MetricError(
+            f"need len(uppers)+1 counts, got {len(counts)} for "
+            f"{len(uppers)} bounds"
+        )
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= rank:
+            if i >= len(uppers):  # the +Inf bucket: saturate
+                return float(uppers[-1]) if uppers else 0.0
+            lo = float(uppers[i - 1]) if i > 0 else 0.0
+            hi = float(uppers[i])
+            frac = (rank - cum) / c
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        cum += c
+    return float(uppers[-1]) if uppers else 0.0
+
+
+def fraction_over(uppers, counts, threshold: float) -> float:
+    """Estimated fraction of observations ABOVE `threshold`, from the same
+    per-bucket counts quantile_from_buckets takes.  The bucket straddling
+    the threshold contributes linearly (uniform-within-bucket assumption).
+    The +Inf bucket counts whole — its observations exceed every finite
+    bound, and over-counting an unbounded tail is the conservative error
+    for an SLO bad-event estimate.  0.0 with no observations."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    over = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if i >= len(uppers):  # the +Inf bucket
+            over += c
+            continue
+        lo = float(uppers[i - 1]) if i > 0 else 0.0
+        hi = float(uppers[i])
+        if lo >= threshold:
+            over += c
+        elif hi > threshold:
+            over += c * (hi - threshold) / (hi - lo)
+    return over / total
 
 
 # --- the read side: the same parser for tests and bench deltas -------------

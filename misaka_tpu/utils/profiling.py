@@ -45,21 +45,44 @@ class Profiler:
     def __init__(self):
         self._lock = threading.Lock()
         self._active_dir: str | None = None
+        self._started_unix: float | None = None
 
     @property
     def active_dir(self) -> str | None:
         return self._active_dir
 
+    def active(self) -> dict | None:
+        """The in-flight capture ({dir, started_unix, running_s}), or
+        None — the info the HTTP 409 carries so an operator can tell a
+        forgotten capture from a concurrent one."""
+        import time
+
+        with self._lock:
+            if self._active_dir is None:
+                return None
+            return {
+                "dir": self._active_dir,
+                "started_unix": round(self._started_unix, 3),
+                "running_s": round(time.time() - self._started_unix, 1),
+            }
+
     def start(self, log_dir: str) -> None:
+        import time
+
         import jax.profiler
 
         with self._lock:
             if self._active_dir is not None:
                 raise ProfilerError(
-                    f"profiler already capturing to {self._active_dir}"
+                    f"a jax profiler capture is already running: writing "
+                    f"to {self._active_dir} for "
+                    f"{time.time() - self._started_unix:.0f}s — POST "
+                    f"/profile/stop to finish it first (JAX's profiler "
+                    f"is process-global; one capture at a time)"
                 )
             jax.profiler.start_trace(log_dir)
             self._active_dir = log_dir
+            self._started_unix = time.time()
 
     def stop(self) -> str:
         """Stop the capture; returns the directory the trace was written to.
@@ -74,5 +97,6 @@ class Profiler:
             if self._active_dir is None:
                 raise ProfilerError("profiler is not capturing")
             out, self._active_dir = self._active_dir, None
+            self._started_unix = None
             jax.profiler.stop_trace()
             return out

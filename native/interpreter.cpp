@@ -20,6 +20,7 @@
 // ctypes (misaka_tpu/core/cinterp.py).  Build: make native.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -479,6 +480,12 @@ void read_state(Interp* it, int32_t* acc, int32_t* bak, int32_t* pc,
 // All state arrays are batch-major ([B, ...] contiguous), so a replica's
 // slice is a pointer offset — no per-replica marshalling on the Python side.
 
+inline int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
 struct Pool {
   struct Job {
     int32_t *acc, *bak, *pc, *port_val;
@@ -515,6 +522,16 @@ struct Pool {
   // worker's atomic store landed last.
   std::vector<int> rep_rc;
   Job job;
+  // Per-thread busy/idle nanosecond counters (the usage-accounting plane,
+  // misaka_tpu/runtime/usage.py): `busy` accumulates time a worker spends
+  // executing replica supersteps, `idle` the time it parks on cv_work —
+  // MEASURED native attribution, so "time in the C++ pool" is a counter
+  // read, not an inference from Python-side wall clocks.  serial_busy_ns
+  // covers the small-pass fast path, which runs on the CALLING thread
+  // (outside the worker set).  Atomics: readers (misaka_pool_counters)
+  // run concurrently with serving without taking the pool mutex.
+  std::vector<std::atomic<int64_t>> busy_ns, idle_ns;
+  std::atomic<int64_t> serial_busy_ns{0};
 
   ~Pool() {
     {
@@ -526,20 +543,25 @@ struct Pool {
     for (auto* it : replicas) delete it;
   }
 
-  void worker_main() {
+  void worker_main(int tid) {
     long seen = 0;
     for (;;) {
       {
+        const int64_t t_park = now_ns();
         std::unique_lock<std::mutex> lk(mu);
         cv_work.wait(lk, [&] { return shutdown || job_id != seen; });
+        idle_ns[tid].fetch_add(now_ns() - t_park,
+                               std::memory_order_relaxed);
         if (shutdown) return;
         seen = job_id;
       }
+      const int64_t t_work = now_ns();
       const int n = job.active ? job.n_active : (int)replicas.size();
       for (int r; (r = next.fetch_add(1)) < n;) {
         const int rep = job.active ? job.active[r] : r;
         rep_rc[rep] = serve_replica(rep);
       }
+      busy_ns[tid].fetch_add(now_ns() - t_work, std::memory_order_relaxed);
       {
         std::lock_guard<std::mutex> lk(mu);
         if (++done_threads == (int)workers.size()) cv_done.notify_all();
@@ -607,12 +629,14 @@ struct Pool {
     // across every worker (~0.3-0.5ms of futex churn on a 24-thread
     // pool), which dwarfs the work itself below a handful of replicas.
     if (n <= 4) {
+      const int64_t t_work = now_ns();
       int rc = 0;
       for (int i = 0; i < n; ++i) {
         const int rep = job.active ? job.active[i] : i;
         const int r = serve_replica(rep);
         if (r != 0 && rc == 0) rc = r;  // lowest index first by iteration
       }
+      serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
       return rc;
     }
     {
@@ -750,15 +774,47 @@ void* misaka_pool_create(const int32_t* code, const int32_t* prog_len,
   }
   if (n_threads < 1) n_threads = 1;
   if (n_threads > n_replicas) n_threads = n_replicas;
+  p->busy_ns = std::vector<std::atomic<int64_t>>(n_threads);
+  p->idle_ns = std::vector<std::atomic<int64_t>>(n_threads);
   p->workers.reserve(n_threads);
   for (int t = 0; t < n_threads; ++t)
-    p->workers.emplace_back([p] { p->worker_main(); });
+    p->workers.emplace_back([p, t] { p->worker_main(t); });
   return p;
 }
 
 void misaka_pool_destroy(void* h) { delete (Pool*)h; }
 
 int misaka_pool_threads(void* h) { return (int)((Pool*)h)->workers.size(); }
+
+// Pool-level busy/idle nanosecond counters (usage accounting): out[0] =
+// worker busy ns summed across threads, out[1] = worker idle ns (time
+// parked on the work condition; a thread currently parked contributes its
+// completed waits only), out[2] = serial-fast-path busy ns (small passes
+// run on the calling thread).  Lock-free relaxed reads — a scrape must
+// never stall a serving pass.
+void misaka_pool_counters(void* h, int64_t* out /*[3]*/) {
+  auto* p = (Pool*)h;
+  int64_t busy = 0, idle = 0;
+  for (auto& v : p->busy_ns) busy += v.load(std::memory_order_relaxed);
+  for (auto& v : p->idle_ns) idle += v.load(std::memory_order_relaxed);
+  out[0] = busy;
+  out[1] = idle;
+  out[2] = p->serial_busy_ns.load(std::memory_order_relaxed);
+}
+
+// Per-thread busy/idle ns (the flamegraph's native annotation keys on the
+// aggregate; the per-thread split is the skew diagnostic).  Fills up to
+// `cap` entries of each array; returns the thread count.
+int misaka_pool_thread_counters(void* h, int64_t* busy, int64_t* idle,
+                                int cap) {
+  auto* p = (Pool*)h;
+  const int n = (int)p->workers.size();
+  for (int t = 0; t < n && t < cap; ++t) {
+    busy[t] = p->busy_ns[t].load(std::memory_order_relaxed);
+    idle[t] = p->idle_ns[t].load(std::memory_order_relaxed);
+  }
+  return n;
+}
 
 // One batched serve (feed_counts non-null) or idle (both feed pointers null)
 // iteration across every replica.  State arrays are batch-major [B, ...];

@@ -258,7 +258,13 @@ def test_native_pool_series_present(server):
     post(base, "/run")
     post(base, "/compute", {"value": "1"})
     after = scrape(base)
-    assert after["misaka_native_pool_replicas"] == 4
+    # the gauges aggregate EVERY live pool in the process (r12): this
+    # server's 4 replicas are part of the sum, other suites' still-live
+    # pools may add to it
+    from misaka_tpu.core import native_serve
+
+    expected = sum(p._replicas for p in native_serve._live_pools())
+    assert after["misaka_native_pool_replicas"] == expected >= 4
     assert after["misaka_native_pool_threads"] >= 1
     assert after['misaka_native_serve_calls_total{kind="serve"}'] >= 1
     assert after['misaka_native_serve_seconds_count{kind="serve"}'] >= 1
@@ -266,23 +272,30 @@ def test_native_pool_series_present(server):
 
 
 def test_native_pool_gauges_zero_after_close():
-    """Pool gauges are weakref callbacks: a closed pool must read 0, not
-    its last live shape (an engine swap away from the native tier must not
-    leave /metrics reporting a running pool)."""
+    """Pool gauges aggregate every LIVE pool at scrape time (r12): a
+    closed pool must stop contributing — an engine swap away from the
+    native tier must not leave /metrics reporting a pool that no longer
+    exists."""
     from misaka_tpu.core import native_serve
 
     if not native_serve.available():
         pytest.skip("native tier unavailable (no toolchain)")
+    before = metrics.parse_text(metrics.render())
     net = add2(in_cap=16, out_cap=16, stack_cap=8).compile(batch=2)
     pool = native_serve.NativeServePool(net, chunk_steps=16)
     live = metrics.parse_text(metrics.render())
-    assert live["misaka_native_pool_replicas"] == 2
+    assert live["misaka_native_pool_replicas"] == (
+        before["misaka_native_pool_replicas"] + 2
+    )
     assert live["misaka_native_pool_threads"] >= 1
     pool.close()
     closed = metrics.parse_text(metrics.render())
-    assert closed["misaka_native_pool_replicas"] == 0
-    assert closed["misaka_native_pool_threads"] == 0
-    assert closed["misaka_native_pool_fill_ratio"] == 0
+    assert closed["misaka_native_pool_replicas"] == (
+        before["misaka_native_pool_replicas"]
+    )
+    assert closed["misaka_native_pool_threads"] == (
+        before["misaka_native_pool_threads"]
+    )
 
 
 def test_counter_monotonic_under_concurrent_compute(server):
@@ -441,3 +454,72 @@ def test_distributed_counters_move_with_traffic():
     finally:
         httpd.shutdown()
         close()
+
+
+# --- histogram estimation math (r12: reused by the SLO windows) -------------
+
+
+def test_quantile_from_buckets_interpolation():
+    uppers = (1.0, 2.0, 4.0)
+    # all mass in one bucket: linear interpolation inside (1, 2]
+    counts = [0, 100, 0, 0]
+    assert metrics.quantile_from_buckets(uppers, counts, 0.5) == pytest.approx(1.5)
+    assert metrics.quantile_from_buckets(uppers, counts, 0.25) == pytest.approx(1.25)
+    assert metrics.quantile_from_buckets(uppers, counts, 1.0) == pytest.approx(2.0)
+    # first bucket interpolates from 0
+    assert metrics.quantile_from_buckets(
+        uppers, [100, 0, 0, 0], 0.5
+    ) == pytest.approx(0.5)
+
+
+def test_quantile_from_buckets_boundaries():
+    uppers = (1.0, 2.0, 4.0)
+    # mass split across buckets: the bucket boundary is the exact
+    # quantile where the cumulative count crosses it
+    counts = [50, 50, 0, 0]
+    assert metrics.quantile_from_buckets(uppers, counts, 0.5) == pytest.approx(1.0)
+    assert metrics.quantile_from_buckets(uppers, counts, 0.75) == pytest.approx(1.5)
+    # +Inf bucket saturates at the last finite bound
+    assert metrics.quantile_from_buckets(
+        uppers, [0, 0, 0, 10], 0.5
+    ) == pytest.approx(4.0)
+    # empty histogram
+    assert metrics.quantile_from_buckets(uppers, [0, 0, 0, 0], 0.99) == 0.0
+    with pytest.raises(metrics.MetricError):
+        metrics.quantile_from_buckets(uppers, [0, 0, 0, 0], 1.5)
+    with pytest.raises(metrics.MetricError):
+        metrics.quantile_from_buckets(uppers, [0, 0], 0.5)
+
+
+def test_quantile_matches_exact_on_dense_grid():
+    # against numpy's exact quantile for samples ON the duration grid:
+    # the estimator must land within one bucket's width
+    uppers = metrics.DURATION_BUCKETS
+    rng = np.random.default_rng(3)
+    samples = rng.lognormal(mean=-6.0, sigma=1.0, size=4000)
+    counts = [0] * (len(uppers) + 1)
+    import bisect
+
+    for s in samples:
+        counts[bisect.bisect_left(uppers, s)] += 1
+    for q in (0.5, 0.9, 0.99):
+        est = metrics.quantile_from_buckets(uppers, counts, q)
+        exact = float(np.quantile(samples, q))
+        i = bisect.bisect_left(uppers, exact)
+        lo = uppers[i - 1] if i > 0 else 0.0
+        hi = uppers[i] if i < len(uppers) else uppers[-1]
+        assert lo <= est <= hi * 1.0001, (q, est, exact, lo, hi)
+
+
+def test_fraction_over():
+    uppers = (1.0, 2.0, 4.0)
+    counts = [10, 80, 10, 0]
+    # threshold mid-bucket: the straddling bucket contributes linearly
+    assert metrics.fraction_over(uppers, counts, 1.5) == pytest.approx(
+        (80 * 0.5 + 10) / 100
+    )
+    assert metrics.fraction_over(uppers, counts, 4.0) == 0.0
+    assert metrics.fraction_over(uppers, counts, 0.0) == pytest.approx(1.0)
+    # the +Inf bucket counts whole (conservative for an unbounded tail)
+    assert metrics.fraction_over(uppers, [0, 0, 0, 10], 100.0) == 1.0
+    assert metrics.fraction_over(uppers, [0, 0, 0, 0], 1.0) == 0.0

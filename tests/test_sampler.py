@@ -1,0 +1,196 @@
+"""The r12 continuous profiler (utils/sampler.py).
+
+Samples land with thread-rooted folded stacks, the aggregate decays and
+stays bounded, the /debug/flamegraph route serves JSON + the
+self-contained HTML viewer, and the jax-profiler 409 carries
+active-capture info (the satellite guard).
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from misaka_tpu.utils.sampler import StackSampler
+from misaka_tpu.utils import sampler
+
+
+def test_samples_capture_busy_thread():
+    s = StackSampler(hz=200)
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=busy, name="sampler-busy-probe")
+    t.start()
+    s.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            stacks, samples = s.snapshot()
+            if any(k.startswith("sampler-busy-probe;") for k in stacks):
+                break
+            time.sleep(0.05)
+        stacks, samples = s.snapshot()
+        assert samples > 0
+        hits = [k for k in stacks if k.startswith("sampler-busy-probe;")]
+        assert hits, sorted(stacks)[:5]
+        # frames read leaf-last with function (file) context — no line
+        # numbers: one function is one label-cache entry, which is what
+        # keeps the sample walk allocation-free per frame
+        assert "busy (" in hits[0]
+    finally:
+        stop.set()
+        s.stop()
+        t.join()
+    assert not s.running
+
+
+def test_decay_halves_and_prunes():
+    s = StackSampler(hz=1, decay_s=0.01)
+    with s._lock:
+        s._stacks = {"keep;me": 8.0, "prune;me": 1.0}
+        s._last_decay = time.monotonic() - 10  # decay is due NOW
+    s._sample_once(skip_ident=threading.get_ident())
+    stacks, _ = s.snapshot()
+    assert "prune;me" not in stacks  # 0.5 < 1 pruned
+    assert 3.9 <= stacks["keep;me"] <= 5.1  # halved (+ maybe a live hit)
+
+
+def test_bounded_stacks():
+    s = StackSampler(hz=1, max_stacks=16)
+    with s._lock:
+        for i in range(16):
+            s._stacks[f"prefill;{i}"] = 1.0
+    # several sampling passes with the cap exhausted: every NEW stack
+    # shape folds into "(other)" instead of growing the dict
+
+    def busy(n):
+        t_end = time.monotonic() + 0.1
+        while time.monotonic() < t_end:
+            pass
+
+    ts = [
+        threading.Thread(target=busy, args=(i,), name=f"cap-probe-{i}")
+        for i in range(4)
+    ]
+    for t in ts:
+        t.start()
+    for _ in range(5):
+        s._sample_once(skip_ident=0)
+    for t in ts:
+        t.join()
+    stacks, _ = s.snapshot()
+    assert len(stacks) <= 16 + 1  # the cap + the "(other)" bucket
+    assert stacks.get("(other)", 0) > 0
+
+
+def test_folded_format_and_payload():
+    s = StackSampler(hz=1)
+    with s._lock:
+        s._stacks = {"a;b;c": 5.0, "a;d": 2.0}
+        s._samples = 7
+    folded = s.folded()
+    assert folded.splitlines() == ["a;b;c 5", "a;d 2"]
+    p = s.payload()
+    assert p["samples"] == 7 and p["distinct_stacks"] == 2
+    assert p["stacks"]["a;b;c"] == 5.0
+
+
+def test_flamegraph_route_json_and_html():
+    import numpy as np
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    m = MasterNode(
+        networks.add2(in_cap=16, out_cap=16, stack_cap=16),
+        chunk_steps=32, batch=4,
+    )
+    httpd = make_http_server(m, port=0)  # starts the global sampler
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    m.run()
+    try:
+        assert sampler.get() is not None and sampler.get().running
+        m.compute_coalesced(np.arange(8, dtype=np.int32))
+        time.sleep(0.1)  # a few sampling periods
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd.server_address[1], timeout=15
+        )
+        conn.request("GET", "/debug/flamegraph")
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        assert r.status == 200
+        assert body["running"] is True and body["rate_hz"] > 0
+        assert isinstance(body["stacks"], dict)
+        conn.request("GET", "/debug/flamegraph?html=1")
+        r = conn.getresponse()
+        html = r.read().decode()
+        conn.close()
+        assert r.status == 200
+        assert r.getheader("Content-Type").startswith("text/html")
+        assert "<script>" in html and "misaka continuous profiler" in html
+    finally:
+        m.pause()
+        httpd.shutdown()
+
+
+def test_duty_cycle_governor():
+    """A sample whose measured cost would blow the budget stretches the
+    period — an always-on profiler must never become the workload."""
+    s = StackSampler(hz=67, budget=0.02)
+    assert s._current_period() == pytest.approx(1 / 67.0)
+    s._cost_ema = 0.005  # 5ms samples at 2% budget -> >=0.25s period
+    assert s._current_period() == pytest.approx(0.25)
+    p = s.payload()
+    assert p["effective_hz"] == pytest.approx(4.0)
+    assert p["sample_cost_us"] == pytest.approx(5000.0)
+
+
+def test_parked_thread_fold_cache():
+    """A thread parked at the same leaf instruction between samples is
+    served from the fold cache (no walk); the cache prunes dead idents."""
+    s = StackSampler(hz=1)
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="park-probe")
+    t.start()
+    try:
+        time.sleep(0.05)
+        s._sample_once(skip_ident=threading.get_ident())
+        hit = s._fold_cache.get(t.ident)
+        assert hit is not None and "park-probe" in hit[2]
+        s._sample_once(skip_ident=threading.get_ident())
+        assert s._fold_cache[t.ident][2] == hit[2]
+        stacks, _ = s.snapshot()
+        parked = [k for k in stacks if k.startswith("park-probe;")]
+        assert parked and stacks[parked[0]] >= 2  # both samples counted
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_kill_switch(monkeypatch):
+    assert not sampler.enabled({"MISAKA_SAMPLER": "0"})
+    assert sampler.ensure_started({"MISAKA_SAMPLER": "0"}) is None
+
+
+def test_profiler_409_carries_active_info():
+    import time as _time
+
+    from misaka_tpu.utils.profiling import Profiler, ProfilerError
+
+    p = Profiler()
+    assert p.active() is None
+    # simulate an in-flight capture without touching jax's global state
+    p._active_dir = "/tmp/some-capture"
+    p._started_unix = _time.time() - 42
+    info = p.active()
+    assert info["dir"] == "/tmp/some-capture" and info["running_s"] >= 42
+    with pytest.raises(ProfilerError) as e:
+        p.start("/tmp/another")
+    msg = str(e.value)
+    assert "/tmp/some-capture" in msg and "/profile/stop" in msg

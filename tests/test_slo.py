@@ -1,0 +1,441 @@
+"""The r12 SLO burn-rate engine (utils/slo.py).
+
+Spec grammar, ring-of-buckets window behavior (rotation, stale-slot
+reclaim, concurrent writers), multi-window burn-rate state transitions,
+the per-program override surface, the /debug/alerts + /healthz wiring —
+and the acceptance chaos scenario: an injected serve-path latency fault
+against ONE tenant flips only that program's state to page, /healthz
+reports degraded, and recovery clears it.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.runtime.registry import ProgramRegistry
+from misaka_tpu.utils import faults
+from misaka_tpu.utils import slo
+
+CAPS = dict(in_cap=32, out_cap=32, stack_cap=16)
+
+
+@pytest.fixture(autouse=True)
+def _restore_slo():
+    yield
+    faults.configure(None)
+    slo.configure()  # back to the (disarmed) env defaults
+
+
+def _arm(monkeypatch, spec="p99<50ms,err<5%", windows="0.5,1,2,4",
+         min_events=5):
+    monkeypatch.setenv("MISAKA_SLO", spec)
+    monkeypatch.setenv("MISAKA_SLO_WINDOWS", windows)
+    monkeypatch.setenv("MISAKA_SLO_MIN_EVENTS", str(min_events))
+    slo.configure()
+
+
+# --- spec parsing -----------------------------------------------------------
+
+
+def test_parse_spec():
+    objs = slo.parse_spec("p99<25ms,err<0.1%")
+    assert [o.kind for o in objs] == ["latency", "error"]
+    assert objs[0].threshold_s == pytest.approx(0.025)
+    assert objs[0].budget == pytest.approx(0.01)
+    assert objs[1].budget == pytest.approx(0.001)
+    assert slo.parse_spec("p50<2s")[0].threshold_s == 2.0
+    assert slo.parse_spec("p95<100us")[0].threshold_s == pytest.approx(1e-4)
+    assert slo.parse_spec("") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "p99<25", "p0<1ms", "p100<1ms", "err<0%", "err<200%", "latency<5ms",
+    "p99>25ms",
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(slo.SLOSpecError):
+        slo.parse_spec(bad)
+
+
+# --- window rings -----------------------------------------------------------
+
+
+def test_ring_rotation_and_stale_reclaim():
+    ring = slo._Ring(width=1.0, length=4)
+    ring.observe(100.0, 0.01, False)
+    ring.observe(100.5, 0.01, True)
+    reqs, errs, lat = ring.window_sum(100.9, 1.0)
+    assert (reqs, errs) == (2, 1)
+    # one bucket later the old bucket still covers a 2s window
+    ring.observe(101.2, 0.02, False)
+    reqs, errs, _ = ring.window_sum(101.3, 2.0)
+    assert (reqs, errs) == (3, 1)
+    # far in the future every slot is stale: nothing leaks into a fresh
+    # window even though the ring positions collide modulo length
+    reqs, errs, lat = ring.window_sum(100 + 4000.0, 4.0)
+    assert (reqs, errs) == (0, 0) and sum(lat) == 0
+
+
+def test_window_rotation_under_concurrent_writers(monkeypatch):
+    _arm(monkeypatch, windows="0.2,0.4,0.8,1.6")
+    errors = []
+
+    def writer(i):
+        try:
+            t_end = time.monotonic() + 0.6
+            while time.monotonic() < t_end:
+                slo.observe("concurrent", 0.001 * (i + 1), error=(i == 0))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+    payload = slo.evaluate("concurrent")
+    wins = payload["windows"]
+    counts = [w["requests"] for w in wins.values()]
+    # longer windows contain at least the shorter ones' events
+    assert counts == sorted(counts)
+    assert counts[-1] > 0
+    assert 0.0 < list(wins.values())[-1]["error_ratio"] < 1.0
+
+
+# --- burn-rate states -------------------------------------------------------
+
+
+def _flood(program, dur_s, n=60, error=False):
+    for _ in range(n):
+        slo.observe(program, dur_s, error=error)
+
+
+def test_latency_burn_pages_and_min_events_guard(monkeypatch):
+    _arm(monkeypatch)
+    _flood("hot", 0.5)  # every request blows the 50ms objective
+    assert slo.evaluate("hot")["state"] == "page"
+    # below the sample floor burn reads 0 — one unlucky request can't page
+    _flood("tiny", 0.5, n=2)
+    assert slo.evaluate("tiny")["state"] == "ok"
+
+
+def test_error_burn_pages(monkeypatch):
+    _arm(monkeypatch)
+    _flood("err-prog", 0.001, error=True)
+    assert slo.evaluate("err-prog")["state"] == "page"
+    _flood("fine-prog", 0.001, error=False)
+    assert slo.evaluate("fine-prog")["state"] == "ok"
+
+
+def test_per_program_override(monkeypatch):
+    _arm(monkeypatch, spec="p99<10s")  # env default: impossible to violate
+    slo.set_objectives("strict", "p99<1ms")
+    _flood("strict", 0.1)
+    _flood("lax", 0.1)
+    assert slo.evaluate("strict")["state"] == "page"
+    assert slo.evaluate("lax")["state"] == "ok"
+    assert slo.overall_state() == "page"
+    slo.set_objectives("strict", None)  # cleared: back to the env default
+    assert slo.evaluate("strict")["state"] == "ok"
+
+
+def test_replaced_objective_prunes_stale_burn_series(monkeypatch):
+    """A replaced override DROPS the old objective's burn-rate series:
+    a frozen misaka_slo_burn_rate child would hold a Prometheus alert
+    open forever after /debug/alerts recovered."""
+    _arm(monkeypatch, spec="p99<10s")
+    slo.set_objectives("swapper", "p99<1ms")
+    _flood("swapper", 0.1)
+    assert slo.evaluate("swapper")["state"] == "page"
+
+    def burn_objectives():
+        return {
+            dict(zip(slo.M_SLO_BURN.labelnames, key))["objective"]
+            for key, _ in slo.M_SLO_BURN._items()
+            if key and key[0] == "swapper"
+        }
+
+    assert "p99<1ms" in burn_objectives()
+    slo.set_objectives("swapper", "p99<10s")  # the relaxed replacement
+    slo._eval_cache.clear()  # bypass the 0.25s evaluation TTL
+    assert slo.evaluate("swapper")["state"] == "ok"
+    objs = burn_objectives()
+    assert "p99<1ms" not in objs
+    assert "p99<10s" in objs
+
+
+def test_override_budget_bounds_gauge_cardinality(monkeypatch):
+    """Past the shared cap a NEW override raises (the registry logs and
+    serves the program under env defaults) — overrides name programs
+    verbatim in misaka_slo_* labels, so an upload flood must not mint
+    unbounded series.  Replacing an installed override always works."""
+    _arm(monkeypatch, spec="")
+    monkeypatch.setenv("MISAKA_USAGE_LABEL_MAX", "3")
+    for i in range(3):
+        slo.set_objectives(f"ovr-{i}", "p99<50ms")
+    with pytest.raises(slo.SLOSpecError):
+        slo.set_objectives("ovr-overflow", "p99<50ms")
+    slo.set_objectives("ovr-1", "p95<10ms")  # replacement: allowed
+    assert slo.objectives_for("ovr-1")[0].name == "p95<10ms"
+    slo.set_objectives("ovr-0", None)  # clearing frees a slot
+    slo.set_objectives("ovr-new", "p99<50ms")
+
+
+def test_malformed_env_spec_is_loud(monkeypatch):
+    """A typo'd MISAKA_SLO disarms (never crashes) but must not hide:
+    /debug/alerts carries spec_error so 'pages that never fire' is
+    visible at a glance."""
+    monkeypatch.setenv("MISAKA_SLO", "p99<25")  # missing unit
+    slo.configure()
+    assert not slo.armed()
+    payload = slo.debug_payload()
+    assert "spec_error" in payload and "p99<25" in payload["spec_error"]
+    monkeypatch.setenv("MISAKA_SLO", "p99<25ms")
+    slo.configure()
+    assert slo.armed()
+    assert "spec_error" not in slo.debug_payload()
+
+
+def test_window_cardinality_guard_collapses(monkeypatch):
+    """Past MISAKA_USAGE_LABEL_MAX distinct programs, new windows fold
+    into "other" — inline, because recursing for "other" under the
+    non-reentrant module lock self-deadlocked (the r12 hang)."""
+    _arm(monkeypatch)
+    monkeypatch.setenv("MISAKA_USAGE_LABEL_MAX", "3")
+    for i in range(8):
+        slo.observe(f"cap-flood-{i}", 0.001)
+    assert "other" in slo._windows
+    assert len(slo._windows) <= 4  # 3 named + "other"
+    assert slo.evaluate("other")["windows"]
+
+
+def test_override_program_exempt_from_window_collapse(monkeypatch):
+    """A program with an EXPLICIT objective override keeps its own
+    windows past the cardinality cap — collapsed into "other", its
+    declared objectives would evaluate 0 requests forever (a page that
+    can never fire, the exact failure spec_error exists to prevent)."""
+    _arm(monkeypatch)
+    monkeypatch.setenv("MISAKA_USAGE_LABEL_MAX", "3")
+    for i in range(5):
+        slo.observe(f"cap-flood-{i}", 0.001)
+    assert "other" in slo._windows
+    slo.set_objectives("vip", "p99<1ms,err<1%")
+    # burn hard against the override: every request violates p99<1ms
+    for _ in range(50):
+        slo.observe("vip", 0.5)
+    assert "vip" in slo._windows  # own windows, not folded into "other"
+    assert slo.evaluate("vip")["state"] == "page"
+
+
+def test_disarmed_is_free(monkeypatch):
+    monkeypatch.delenv("MISAKA_SLO", raising=False)
+    slo.configure()
+    assert not slo.armed()
+    slo.observe("ghost", 99.0, error=True)  # no-op
+    assert slo.overall_state() is None
+    assert slo.debug_payload()["programs"] == {}
+
+
+# --- registry override via upload metadata ----------------------------------
+
+
+def test_registry_slo_upload(monkeypatch):
+    _arm(monkeypatch, spec="")  # no env default: override only
+    reg = ProgramRegistry(None, batch=None, engine="scan", caps=CAPS)
+    try:
+        topo = networks.acc_loop(**CAPS)
+        out = reg.publish("slo-ten", topology_json=json.dumps(
+            {"nodes": topo.node_info, "programs": topo.programs, **CAPS}
+        ), slo_spec="p99<1ms")
+        assert out["version"]
+        assert slo.armed()
+        assert [o.name for o in slo.objectives_for("slo-ten")] == ["p99<1ms"]
+        # a bad spec is a 400-shaped error that touches nothing
+        from misaka_tpu.runtime.registry import RegistryError
+
+        with pytest.raises(RegistryError):
+            reg.publish("slo-ten2", topology_json=json.dumps(
+                {"nodes": topo.node_info, "programs": topo.programs, **CAPS}
+            ), slo_spec="p99>nope")
+    finally:
+        reg.close()
+
+
+# --- the chaos scenario (acceptance) ----------------------------------------
+
+
+def _native_or_skip():
+    from misaka_tpu.core import native_serve
+
+    if not native_serve.available():
+        pytest.skip("no C++ toolchain for the native engine")
+
+
+def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
+    """Injected serve-path latency against ONE tenant flips only that
+    program's /debug/alerts state to page within a short window, /healthz
+    reports degraded, and recovery clears it."""
+    _native_or_skip()
+    _arm(monkeypatch, spec="p99<40ms", windows="0.5,1,2,4", min_events=3)
+    reg = ProgramRegistry(None, batch=8, engine="native", caps=CAPS)
+    top = networks.add2(**CAPS)
+    master = MasterNode(top, chunk_steps=64, batch=8, engine="native")
+    reg.seed("ten-a", master, top)
+    t2 = networks.acc_loop(**CAPS)
+    reg.publish("ten-b", topology_json=json.dumps(
+        {"nodes": t2.node_info, "programs": t2.programs, **CAPS}
+    ))
+    httpd = make_http_server(master, port=0, registry=reg)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    master.run()
+    stop = threading.Event()
+    errors = []
+
+    def client(name, delta):
+        vals = np.arange(8, dtype=np.int32)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            while not stop.is_set():
+                conn.request(
+                    "POST", f"/programs/{name}/compute_raw?spread=1",
+                    vals.tobytes(),
+                )
+                raw = conn.getresponse().read()
+                assert (np.frombuffer(raw, "<i4") == vals + delta).all()
+                time.sleep(0.005)
+            conn.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+            stop.set()
+
+    def get_json(path):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+        conn.request("GET", path)
+        r = conn.getresponse()
+        data = json.loads(r.read())
+        conn.close()
+        return data
+
+    def states():
+        progs = get_json("/debug/alerts")["programs"]
+        return (
+            progs.get("ten-a", {}).get("state"),
+            progs.get("ten-b", {}).get("state"),
+        )
+
+    ts = [
+        threading.Thread(target=client, args=("ten-a", 2)),
+        threading.Thread(target=client, args=("ten-b", 3)),
+    ]
+    try:
+        for t in ts:
+            t.start()
+        # warm both tenants healthy first (activates ten-b's engine)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not stop.is_set():
+            if states() == ("ok", "ok"):
+                break
+            time.sleep(0.1)
+        assert states() == ("ok", "ok"), states()
+        # inject 100ms into ONLY ten-b's serve passes
+        faults.configure("serve_delay:ten-b=0.1")
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not stop.is_set():
+            a, b = states()
+            if b == "page":
+                break
+            time.sleep(0.1)
+        a, b = states()
+        assert b == "page", (a, b)
+        assert a == "ok", (a, b)  # the neighbor stays green
+        health = get_json("/healthz")
+        assert health["slo"] == "page" and health["degraded"] is True
+        # recovery: disarm, keep healthy traffic flowing, page clears
+        faults.configure(None)
+        deadline = time.monotonic() + 25
+        while time.monotonic() < deadline and not stop.is_set():
+            if states()[1] == "ok":
+                break
+            time.sleep(0.2)
+        assert states()[1] == "ok", states()
+        health = get_json("/healthz")
+        assert health["degraded"] is False
+        assert not errors, errors[0]
+    finally:
+        stop.set()
+        for t in ts:
+            t.join(timeout=10)
+        master.pause()
+        reg.close()
+        httpd.shutdown()
+
+
+# --- edge observations through the compute plane ----------------------------
+
+
+def test_plane_edge_feeds_windows(monkeypatch, tmp_path):
+    """Requests served over the unix-socket compute plane land in the SLO
+    windows with the frontend-edge clock (frame metadata `edge`)."""
+    _arm(monkeypatch, windows="0.5,1,2,4")
+    from misaka_tpu.runtime import frontends
+
+    m = MasterNode(networks.add2(**CAPS), chunk_steps=32, batch=4)
+    plane_path = str(tmp_path / "plane.sock")
+    plane = frontends.start_compute_plane(m, plane_path)
+    client = frontends.PlaneClient(plane_path, conns=1)
+    m.run()
+    try:
+        vals = np.arange(16, dtype=np.int32)
+        for _ in range(8):
+            out = client.compute_raw(vals.astype("<i4").tobytes())
+            assert (np.frombuffer(out, "<i4") == vals + 2).all()
+        # the engine-side record for the last frame lands just after its
+        # response bytes go out; give it a beat before reading
+        time.sleep(0.2)
+        payload = slo.evaluate("default")
+        assert payload["windows"]["0.5s"]["requests"] >= 8
+        assert payload["windows"]["0.5s"]["p99_ms"] > 0
+    finally:
+        client.close()
+        m.pause()
+        plane.close()
+
+
+def test_alerts_route_and_gauges(monkeypatch):
+    _arm(monkeypatch)
+    _flood("gauge-prog", 0.001)
+    m = MasterNode(networks.add2(**CAPS), chunk_steps=32, batch=None,
+                   engine="scan")
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd.server_address[1], timeout=15
+        )
+        conn.request("GET", "/debug/alerts")
+        body = json.loads(conn.getresponse().read())
+        assert body["enabled"] is True
+        assert body["programs"]["gauge-prog"]["state"] == "ok"
+        assert body["burn_rules"][0]["state"] == "page"
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        from misaka_tpu.utils import metrics as umetrics
+
+        parsed = umetrics.parse_text(text)
+        assert any(
+            k.startswith("misaka_slo_state") and 'program="gauge-prog"' in k
+            for k in parsed
+        )
+        assert any(k.startswith("misaka_slo_burn_rate") for k in parsed)
+    finally:
+        m.pause()
+        httpd.shutdown()

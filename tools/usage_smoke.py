@@ -1,0 +1,234 @@
+"""`make usage-smoke`: the r12 observability plane proven end-to-end
+against a REAL subprocess server (~15s).
+
+Boots `python -m misaka_tpu.runtime.app` with the registry + SLO armed
+(engine=native so the C++ pool serves), drives two tenants with mixed
+native+Python load, then asserts the whole health plane through the
+public HTTP surface:
+
+  1. GET /debug/usage attributes nonzero CPU-seconds to BOTH tenants and
+     the per-program sums land within 20% of the fused-pass wall total
+     (the conservation contract; the tier-1 test pins 5%), with measured
+     native-pool seconds nonzero;
+  2. GET /debug/flamegraph shows a CPython frame aggregate (folded
+     stacks with samples) AND the native pool's busy/idle split — mixed
+     native+Python load in one view — and ?html=1 serves the viewer;
+  3. GET /debug/alerts serves per-program SLO states (ok under healthy
+     load) and GET /healthz carries the slo field; misaka_usage_* and
+     misaka_slo_* series parse on /metrics.
+
+Exit 0 on success, 1 with a reason on any failed assertion.  The same
+assertions run inside tier-1 (tests/test_usage.py, tests/test_slo.py);
+this is the standalone tripwire against the real process boundary.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ADD5 = "IN ACC\nADD 5\nOUT ACC\n"
+
+
+def post(base, path, data=None, raw=None, timeout=60):
+    body = raw if raw is not None else urllib.parse.urlencode(data or {}).encode()
+    req = urllib.request.Request(base + path, data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def get(base, path, timeout=30):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def wait_ready(base, deadline_s=120):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            status, _ = get(base, "/healthz", timeout=2)
+            if status == 200:
+                return True
+        except OSError:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+def fail(msg):
+    print(f"# usage-smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    import socket
+
+    import numpy as np
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    tmp = tempfile.mkdtemp(prefix="misaka-usage-smoke-")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MISAKA_PORT": str(port),
+        "MISAKA_BATCH": "16",
+        "MISAKA_ENGINE": "native",  # the C++ pool: mixed native+Python load
+        "MISAKA_AUTORUN": "1",
+        "MISAKA_IN_CAP": "32",
+        "MISAKA_OUT_CAP": "32",
+        "MISAKA_STACK_CAP": "16",
+        "MISAKA_PROGRAMS_DIR": os.path.join(tmp, "programs"),
+        "MISAKA_SLO": "p99<5s,err<5%",  # healthy under any CI weather
+        "NODE_INFO": json.dumps({"main": {"type": "program"}}),
+        "MISAKA_PROGRAMS": json.dumps({"main": "IN ACC\nADD 2\nOUT ACC\n"}),
+    }
+    proc = subprocess.Popen([sys.executable, "-m", "misaka_tpu.runtime.app"],
+                            env=env)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        if not wait_ready(base):
+            fail("server did not come up")
+
+        status, body = post(base, "/programs", {"name": "alpha",
+                                                "program": ADD5})
+        if status != 200:
+            fail(f"upload alpha: {status} {body!r}")
+
+        st, body = get(base, "/debug/usage")
+        if st != 200:
+            fail(f"/debug/usage before: {st}")
+        before = json.loads(body)
+
+        # --- mixed load: two tenants, raw lanes, concurrent threads ----
+        errors = []
+
+        def hammer(program, delta, n=25):
+            vals = np.arange(64, dtype=np.int32)
+            path = (f"/programs/{program}/compute_raw?spread=1" if program
+                    else "/compute_raw?spread=1")
+            for _ in range(n):
+                st, out = post(base, path, raw=vals.astype("<i4").tobytes())
+                if st != 200 or not np.array_equal(
+                    np.frombuffer(out, "<i4"), vals + delta
+                ):
+                    errors.append((program, st, out[:80]))
+                    return
+
+        ts = [
+            threading.Thread(target=hammer, args=("alpha", 5)),
+            threading.Thread(target=hammer, args=(None, 2)),
+            threading.Thread(target=hammer, args=("alpha", 5)),
+            threading.Thread(target=hammer, args=(None, 2)),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errors:
+            fail(f"traffic errors: {errors[0]}")
+
+        # --- 1. the usage ledger + conservation -------------------------
+        st, body = get(base, "/debug/usage")
+        if st != 200:
+            fail(f"/debug/usage after: {st}")
+        after = json.loads(body)
+        deltas = {}
+        for name, a in after["programs"].items():
+            b = before["programs"].get(name, {})
+            deltas[name] = {
+                k: a[k] - b.get(k, 0) for k in a
+            }
+        for name in ("alpha", "default"):
+            d = deltas.get(name)
+            if not d or d["cpu_seconds"] <= 0:
+                fail(f"no cpu attribution for {name!r}: {deltas}")
+            if d["native_seconds"] <= 0:
+                fail(f"no measured native attribution for {name!r}: {d}")
+        cpu_sum = sum(d["cpu_seconds"] for d in deltas.values())
+        pass_total = (after["pass_seconds_total"]
+                      - before["pass_seconds_total"])
+        if pass_total <= 0 or abs(cpu_sum - pass_total) > 0.2 * pass_total:
+            fail(f"conservation: cpu {cpu_sum:.4f}s vs pass wall "
+                 f"{pass_total:.4f}s (>20% apart)")
+        if "native_pool" not in after:
+            fail("no native_pool busy/idle split in /debug/usage")
+
+        # --- 2. the flamegraph: CPython frames + the native split -------
+        st, body = get(base, "/debug/flamegraph")
+        if st != 200:
+            fail(f"/debug/flamegraph: {st}")
+        flame = json.loads(body)
+        if flame.get("samples", 0) <= 0 or not flame.get("stacks"):
+            fail(f"no CPython samples in the flamegraph: "
+                 f"samples={flame.get('samples')}")
+        if "native_pool" not in flame or flame["native_pool"]["busy_ns"] <= 0:
+            fail("flamegraph lacks the measured native busy/idle split")
+        if not any(";" in k for k in flame["stacks"]):
+            fail("flamegraph folded stacks carry no frame chains")
+        st, body = get(base, "/debug/flamegraph?html=1")
+        if st != 200 or b"<script>" not in body:
+            fail(f"flamegraph html viewer: {st}")
+
+        # --- 3. SLO states + metric series -------------------------------
+        st, body = get(base, "/debug/alerts")
+        if st != 200:
+            fail(f"/debug/alerts: {st}")
+        alerts = json.loads(body)
+        if not alerts["enabled"] or alerts["state"] != "ok":
+            fail(f"alerts unhealthy under healthy load: {alerts['state']}")
+        progs = alerts["programs"]
+        if "alpha" not in progs:
+            fail(f"no per-program SLO evaluation for alpha: {sorted(progs)}")
+        st, body = get(base, "/healthz")
+        health = json.loads(body)
+        if health.get("slo") != "ok" or health.get("degraded"):
+            fail(f"/healthz slo integration: {health}")
+        st, body = get(base, "/metrics")
+        from misaka_tpu.utils import metrics as umetrics
+
+        parsed = umetrics.parse_text(body.decode())
+        for needle in ("misaka_usage_cpu_seconds_total",
+                       "misaka_usage_native_seconds_total",
+                       "misaka_slo_state", "misaka_build_info",
+                       "misaka_serve_pass_wall_seconds_total"):
+            if not any(k.startswith(needle) for k in parsed):
+                fail(f"missing metric family {needle}")
+
+        print(json.dumps({
+            "usage_smoke": "ok",
+            "programs": sorted(deltas),
+            "cpu_seconds_sum": round(cpu_sum, 4),
+            "pass_seconds_total": round(pass_total, 4),
+            "conservation": round(cpu_sum / pass_total, 4),
+            "native_busy_ns": flame["native_pool"]["busy_ns"],
+            "flamegraph_samples": flame["samples"],
+            "slo_state": alerts["state"],
+        }))
+        return 0
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
