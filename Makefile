@@ -125,18 +125,37 @@ ci:
 	$(MAKE) registry-smoke
 	$(MAKE) usage-smoke
 	$(MAKE) chaos-smoke
+	$(MAKE) fleet-smoke
 	$(MAKE) bench-smoke
 
-# Fault-tolerance tripwire (~10s): the fast chaos lane, driven through the
+# Fault-tolerance tripwire (~15s): the fast chaos lane, driven through the
 # MISAKA_FAULTS harness (utils/faults.py) — durable-checkpoint rejection of
 # torn/corrupt files, crash-mid-save atomicity, auto-checkpoint rotation +
 # fallback restore, RPC backoff policy, frontend-supervisor respawn and
-# crash-loop circuit breaker.  The multi-second kill-9-under-load and
-# dead-peer recovery scenarios are marked slow (the test-all lane runs
-# them).  docs/ARCHITECTURE.md "Fault tolerance" describes the contracts.
+# crash-loop circuit breaker — plus the fleet failover shapes from
+# tests/test_fleet.py (replica death under concurrent load, drain
+# reroute, scoped replica_blackhole hedging, readmission, typed
+# fleet-down 503).  The multi-second kill-9-under-load, dead-peer
+# recovery, and subprocess-fleet scenarios are marked slow (test-all and
+# fleet-smoke run them).  docs/ARCHITECTURE.md "Fault tolerance" + "The
+# engine fleet" describe the contracts.
 chaos-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python -m pytest tests/test_chaos.py -q -m "not slow" -p no:cacheprovider
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python -m pytest tests/test_fleet.py -q -m "not slow" -p no:cacheprovider \
+		-k "failover or blackhole or drain or fleet_down or readmits or fault or stale"
+
+# Fleet tripwire (~60s): the REAL thing — a subprocess fleet of 4 engine
+# replicas behind supervised SO_REUSEPORT frontends, 64 pooled concurrent
+# clients, one kill -9 (zero client-visible errors, supervisor respawn),
+# one POST /fleet/roll across all replicas under the same load (zero
+# loss, drain→manifest-verified checkpoint→replace→bit-identical
+# restore), plus the MISAKA_FAULTS=replica_kill boot scenario.  These
+# acceptance tests are slow-marked, so this target is their CI home.
+fleet-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 580 \
+		python -m pytest tests/test_fleet.py -q -m slow -p no:cacheprovider
 
 # Replay the committed parity corpus (tests/corpus/parity/) against the
 # ACTUAL Go reference binary via its own Dockerfile — the SURVEY.md §4
@@ -170,4 +189,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke chaos-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean

@@ -1018,6 +1018,158 @@ def bench_concurrency_sweep(
     return out
 
 
+def bench_fleet_scaling(
+    replicas=(1, 2, 4),
+    clients=64,
+    payload_values=64,
+    seconds=3.0,
+    timeout=120.0,
+    http_workers=None,
+    client_procs=4,
+):
+    """The horizontal scale-out lane (r13): a REAL subprocess fleet —
+    `MISAKA_FLEET=N` engine replicas (each its own process, native pool,
+    and ServeBatcher) behind the shared SO_REUSEPORT frontend tier
+    routing with the FleetPlaneRouter — under the 64-client keep-alive
+    small-payload workload, for each N in `replicas`.
+
+    This measures the ONE number the single-box lanes cannot: whether
+    adding engine replicas moves the 64-client aggregate past the
+    single-engine wall (docs/BENCH_HISTORY.md r8: one CPython engine
+    process saturates near ~3.5k req/s regardless of native-pool
+    speed).  The client fleet runs in `client_procs` subprocesses (their
+    own GILs, same harness as the committed r08 frontend sweep) and
+    every response is parity-checked.  Returns per-N lanes with
+    aggregate values/s, p50/p99, and speedup vs the 1-replica lane.
+    """
+    import subprocess
+    import urllib.request
+
+    from misaka_tpu.runtime import frontends
+
+    add2_env = {
+        "NODE_INFO": json.dumps({
+            "misaka1": {"type": "program"},
+            "misaka2": {"type": "program"},
+            "misaka3": {"type": "stack"},
+        }),
+        "MISAKA_PROGRAMS": json.dumps({
+            "misaka1": "IN ACC\nADD 1\nMOV ACC, misaka2:R0\nMOV R0, ACC\n"
+                       "OUT ACC\n",
+            "misaka2": "MOV R0, ACC\nADD 1\nPUSH ACC, misaka3\n"
+                       "POP misaka3, ACC\nMOV ACC, misaka1:R0\n",
+        }),
+    }
+
+    def run_lane(n):
+        port = frontends.pick_free_port()
+        fleet_dir = f"/tmp/misaka-bench-fleet-{os.getpid()}-{n}"
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "MISAKA_FLEET": str(n),
+            "MISAKA_HTTP_WORKERS": str(http_workers or max(4, n + 2)),
+            "MISAKA_AUTORUN": "1",
+            "MISAKA_PORT": str(port),
+            "MISAKA_FLEET_DIR": fleet_dir,
+            "MISAKA_TTL_S": "600",
+            # the committed serving configuration (r08 sweep harness):
+            # B=1024 lockstep instances + in_cap=128 + chunk=2048 per
+            # replica — an unbatched 1-instance chunk-128 engine would
+            # measure the wrong tier
+            "MISAKA_BATCH": "1024",
+            "MISAKA_IN_CAP": "128",
+            "MISAKA_OUT_CAP": "128",
+            "MISAKA_CHUNK_STEPS": "2048",
+            **add2_env,
+        }
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "misaka_tpu.runtime.app"], env=env
+        )
+        try:
+            deadline = time.monotonic() + 180
+            base = f"http://127.0.0.1:{port}"
+            while True:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"fleet (N={n}) exited during boot: {proc.returncode}"
+                    )
+                try:
+                    with urllib.request.urlopen(
+                        base + "/healthz", timeout=5
+                    ) as r:
+                        payload = json.loads(r.read())
+                    if payload.get("ok") and not payload.get("degraded"):
+                        break
+                except OSError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"fleet (N={n}) never became healthy")
+                time.sleep(0.5)
+            n_procs = min(client_procs, clients)
+            per = [clients // n_procs + (1 if i < clients % n_procs else 0)
+                   for i in range(n_procs)]
+            fleets = [
+                subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--sweep-fleet", "127.0.0.1", str(port), str(per[i]),
+                     str(seconds), str(payload_values), str(200 + i)],
+                    stdout=subprocess.PIPE,
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                )
+                for i in range(n_procs)
+            ]
+            outs = [
+                json.loads(p.communicate(timeout=timeout)[0]) for p in fleets
+            ]
+            for o in outs:
+                if o["errors"]:
+                    raise RuntimeError(
+                        f"fleet lane N={n} client error: {o['errors'][0]}"
+                    )
+            lats = np.concatenate([np.asarray(o["lats_ms"]) for o in outs])
+            n_reqs = sum(o["requests"] for o in outs)
+            elapsed = max(o["elapsed_s"] for o in outs)
+            return {
+                "replicas": n,
+                "clients": clients,
+                "payload_values": payload_values,
+                "requests": n_reqs,
+                "p50_ms": round(float(np.percentile(lats, 50)), 3),
+                "p99_ms": round(float(np.percentile(lats, 99)), 3),
+                "throughput": round(n_reqs * payload_values / elapsed, 1),
+            }
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            import shutil
+
+            shutil.rmtree(fleet_dir, ignore_errors=True)
+
+    lanes = []
+    for n in replicas:
+        entry = run_lane(n)
+        if lanes:
+            entry["speedup_vs_1"] = round(
+                entry["throughput"] / lanes[0]["throughput"], 2
+            )
+        lanes.append(entry)
+        print(
+            f"# fleet: N={entry['replicas']} reqs={entry['requests']} "
+            f"p50={entry['p50_ms']:.2f}ms p99={entry['p99_ms']:.2f}ms "
+            f"throughput={entry['throughput']:.0f}/s"
+            + (f" ({entry['speedup_vs_1']}x vs 1 replica)"
+               if "speedup_vs_1" in entry else ""),
+            file=sys.stderr,
+        )
+    return {"clients": clients, "payload_values": payload_values,
+            "lanes": lanes}
+
+
 def bench_multi_tenant(
     clients=64,
     payload_values=64,
@@ -1708,6 +1860,16 @@ R08_COALESCED_64 = 220_000.0
 # independently, so each sees a third of the traffic.)
 R11_MULTI_TENANT_64 = 49_000.0
 
+# The committed r13 fleet capture on this host (BENCH_cpu_r13.json): a
+# REAL MISAKA_FLEET=4 subprocess fleet — 4 engine replicas behind the
+# shared SO_REUSEPORT frontend tier, FleetPlaneRouter least-depth
+# dispatch, 64 keep-alive clients x 64-value payloads.  bench_smoke
+# gates the live measurement at HALF: a regression in the fleet router,
+# the plane-conns coalescing discipline, or replica supervision trips
+# it.  (3.35x the single-engine in-harness rate measured the same day —
+# the r8 single-process wall, horizontally broken.)
+R13_FLEET_64 = 237_980.6
+
 
 def bench_smoke(target=NORTH_STAR):
     """`make bench-smoke`: a ~5s bench_served through the multi-threaded
@@ -1796,6 +1958,24 @@ def bench_smoke(target=NORTH_STAR):
     except Exception as e:  # infra failure IS a smoke failure
         line["ok"] = False
         line["multi_tenant_error"] = str(e)[:200]
+    try:
+        # the fleet lane (r13): 4 engine replicas, 64 keep-alive clients
+        fl = bench_fleet_scaling(replicas=(4,), seconds=2.0)
+        agg = fl["lanes"][0]["throughput"]
+        line["fleet_throughput"] = round(agg, 1)
+        line["fleet_p50_ms"] = fl["lanes"][0]["p50_ms"]
+        line["fleet_target"] = round(0.5 * R13_FLEET_64, 1)
+        if agg < 0.5 * R13_FLEET_64:
+            line["ok"] = False
+            print(
+                f"# bench-smoke: fleet 4-replica lane {agg:.0f}/s < "
+                f"{0.5 * R13_FLEET_64:.0f}/s "
+                f"(50% of the committed r13 capture)",
+                file=sys.stderr,
+            )
+    except Exception as e:  # infra failure IS a smoke failure
+        line["ok"] = False
+        line["fleet_error"] = str(e)[:200]
     print(json.dumps(line))
     if not line["ok"]:
         print(
@@ -2547,6 +2727,42 @@ if __name__ == "__main__":
         # client-fleet worker subprocess (no jax import on this path)
         i = sys.argv.index("--sweep-fleet")
         _sweep_fleet_main(sys.argv[i + 1 : i + 7])
+    elif "--fleet" in sys.argv:
+        # Standalone horizontal scale-out capture (the r13 lane): real
+        # MISAKA_FLEET subprocess fleets, 1→4 engine replicas behind
+        # the shared frontend tier, 64 keep-alive clients — plus the
+        # single-engine IN-HARNESS baseline (one CPython HTTP process,
+        # no frontend plane: the r8 wall the fleet exists to break),
+        # measured in the same run so the ratio compares one host at
+        # one moment.  NOTE the headline ratio deliberately spans both
+        # the topology AND the client-harness change (subprocess client
+        # fleet vs in-process threads — the criterion's stated
+        # baseline); per-replica scaling alone is each lane's
+        # speedup_vs_1 (see BENCH_HISTORY r13).  Committed as
+        # BENCH_cpu_r13.json.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        payload = {"metric": "fleet_scaling"}
+        baseline = bench_concurrency_sweep(
+            clients=(64,), seconds=2.0, engine="native",
+            http_workers=0, fleet_procs=1,
+        )["lanes"][0]
+        payload["single_engine_inharness_64"] = baseline
+        payload["fleet_scaling"] = bench_fleet_scaling()
+        top = payload["fleet_scaling"]["lanes"][-1]
+        payload["speedup_vs_single_engine"] = round(
+            top["throughput"] / baseline["throughput"], 2
+        )
+        payload["ok"] = bool(payload["speedup_vs_single_engine"] >= 2.5)
+        print(json.dumps(payload))
+        if not payload["ok"]:
+            print(
+                f"# fleet scaling FAILED the 2.5x budget: "
+                f"{top['throughput']:.0f}/s at N={top['replicas']} vs "
+                f"{baseline['throughput']:.0f}/s single-engine "
+                f"({payload['speedup_vs_single_engine']}x)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
     elif "--trace-ab" in sys.argv:
         # Standalone tracing-overhead capture (the r10 twin of the r07
         # metrics-overhead artifact): both served lanes, tracing on vs

@@ -217,6 +217,8 @@ class MisakaClient:
             # http.client sets it for bytes bodies, but be explicit
             headers["Content-Length"] = str(len(data))
         refused = 0
+        replays = 0
+        fresh_replays = 0
         while True:
             conn, reused = self._checkout()
             try:
@@ -224,17 +226,32 @@ class MisakaClient:
                 resp = conn.getresponse()
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 conn.close()
-                if self.retry_stale and reused and isinstance(
+                if self.retry_stale and isinstance(
                     e, (http.client.RemoteDisconnected, ConnectionError,
                         BrokenPipeError)
-                ):
-                    # a pooled socket the server dropped between requests:
+                ) and (reused or (replays and fresh_replays < 1)):
+                    # A pooled socket the server dropped between requests:
                     # the send failed or ZERO response bytes arrived —
-                    # replay once on a fresh connection (see __init__'s
+                    # replay on a fresh connection (see __init__'s
                     # retry_stale for the at-least-once caveat).  Any
                     # other failure shape (e.g. a garbled partial status
                     # line) may mean a response was in flight — never
-                    # replay those.
+                    # replay those.  Reused-socket replays stay UNCAPPED:
+                    # after a server restart the whole idle pool is stale
+                    # and must drain, however many connections deep.
+                    #
+                    # `fresh_replays` additionally allows ONE replay of a
+                    # failed FRESH dial, but only once a stale replay has
+                    # begun: a kill -9'd SO_REUSEPORT worker keeps its
+                    # listening socket for a beat after its threads are
+                    # gone, so the replay's connect can land in the dying
+                    # worker's backlog and be reset before any byte of
+                    # response.  The at-least-once semantics are
+                    # unchanged; a request's FIRST attempt on a fresh
+                    # dial is still never replayed.
+                    if not reused:
+                        fresh_replays += 1
+                    replays += 1
                     continue
                 if (
                     not reused
@@ -399,6 +416,43 @@ class MisakaClient:
         busy/idle split (GET /debug/flamegraph; append ?html=1 in a
         browser for the self-contained viewer)."""
         return json.loads(self._request("/debug/flamegraph", None, "GET"))
+
+    # --- the engine fleet (server must run with MISAKA_FLEET >= 1) ----------
+
+    def fleet_status(self) -> dict:
+        """The fleet manager's state (GET /fleet): per-replica rows
+        (state, pid, port, restarts), restart/roll totals, and the
+        aggregate `degraded` flag (runtime/fleet.py)."""
+        return json.loads(self._request("/fleet", None, "GET"))
+
+    def fleet_roll(self, timeout: float | None = None) -> dict:
+        """Zero-loss rolling restart of every engine replica (POST
+        /fleet/roll): drain to quiescence -> manifest-verified checkpoint
+        -> replace -> bit-identical restore -> readmit, one replica at a
+        time.  Synchronous — returns the per-replica report; pass a
+        generous `timeout` (each replica pays an engine boot).  409 when
+        a roll is already in progress."""
+        if timeout is None:
+            timeout = max(self.timeout, 120.0 * 4)
+        # deliberately NOT the pooled _request path: a roll blocks for
+        # minutes (one engine boot per replica), and parking a pooled
+        # keep-alive connection on it — or mutating its timeout — would
+        # poison the pool for every concurrent compute call
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=timeout
+        )
+        try:
+            conn.request("POST", self._prefix + "/fleet/roll", b"",
+                         {"Content-Length": "0"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status >= 400:
+                raise MisakaClientError(
+                    resp.status, body.decode(errors="replace").strip()
+                )
+            return json.loads(body)
+        finally:
+            conn.close()
 
     # --- the program registry (server must run with MISAKA_PROGRAMS_DIR) ---
 

@@ -20,6 +20,30 @@ so the master's env surface is what survives:
                    MISAKA_PLANE_WINDOW_US coalesce window); non-compute
                    routes proxy to the engine's own server.  Default 0 =
                    single-process serving, exactly as before.
+  MISAKA_FLEET     N >= 1 starts the replicated engine fleet
+                   (runtime/fleet.py): this process supervises N engine
+                   replica subprocesses (each with its own native pool
+                   and serve scheduler) and the frontend workers route
+                   across them — least-queue-depth for stateless
+                   compute, consistent hashing on program ID for
+                   registry traffic, per-replica health gating
+                   admission, failed frames hedged onto siblings, and a
+                   typed 503 only when the whole fleet is down.  POST
+                   /fleet/roll performs a zero-loss rolling restart
+                   (drain -> manifest-verified checkpoint -> replace ->
+                   bit-identical restore, one replica at a time);
+                   /metrics aggregates every replica with a `replica`
+                   label; /status + /healthz carry per-replica rows.
+                   Knobs: MISAKA_FLEET_DIR (replica state + plane
+                   sockets; defaults to MISAKA_CHECKPOINT_DIR or /tmp),
+                   MISAKA_FLEET_PROBE_S (health probe cadence, 0.5),
+                   MISAKA_FLEET_DRAIN_S (per-replica drain budget in a
+                   roll, 30), MISAKA_FLEET_DOWN_GRACE_S (how long the
+                   router rides out a whole-fleet outage before the
+                   typed 503, 5).  Default 0 = single-engine serving,
+                   exactly as before.  (MISAKA_PLANE_SERVE=1,
+                   MISAKA_FLEET_REPLICA, and MISAKA_FLEET_RESTORE are
+                   the fleet's internal replica-side envs.)
   MISAKA_SERVE_BATCH  "0" disables the in-engine serve scheduler
                    (ServeBatcher): requests then claim instance slots
                    directly (the pre-r8 behavior).  Scheduler knobs:
@@ -38,6 +62,9 @@ so the master's env surface is what survives:
   MISAKA_BATCH     run N independent network instances in lockstep and serve
                    concurrent /compute requests round-robin across them
                    (default: one instance, strictly serialized /compute)
+  MISAKA_CHUNK_STEPS  device-loop ticks per engine call (default 128;
+                   serving deployments tune up — the committed bench
+                   harness runs 2048 for fewer round trips per pass)
   MISAKA_ENGINE    device-loop chunk runner: "auto" (default — the fused
                    Pallas kernel when batched+untraced+on-TPU+within budget;
                    the native C++ host tier when NO TPU is attached and a
@@ -271,6 +298,7 @@ def _serve_http(
         plane = frontends.start_compute_plane(
             master, plane_path, registry=registry
         )
+        server.misaka_plane = plane  # POST /fleet/drain reaches it
         # Supervised worker pool (not bare spawn_frontends): a dead worker
         # is respawned with backoff, a crash loop trips a circuit breaker,
         # and the pool's health rides /healthz + /status (the server reads
@@ -297,12 +325,34 @@ def _serve_http(
         master, port, checkpoint_dir=checkpoint_dir, profile_dir=profile_dir,
         registry=registry,
     )
+    plane = None
+    if (
+        environ.get("MISAKA_PLANE_SERVE") == "1"
+        and hasattr(master, "compute_coalesced")
+    ):
+        # A fleet engine replica (runtime/fleet.py): serve the compute
+        # plane even with no frontend workers of our own — the SHARED
+        # frontend tier (owned by the fleet parent) connects to it, and
+        # POST /fleet/drain drives it through rolling restarts.
+        from misaka_tpu.runtime import frontends
+
+        plane_path = environ.get(
+            "MISAKA_PLANE_SOCKET", f"/tmp/misaka-plane-{os.getpid()}.sock"
+        )
+        plane = frontends.start_compute_plane(
+            master, plane_path, registry=registry
+        )
+        server.misaka_plane = plane
+        log_.info("compute plane serving at %s", plane_path)
     log_.info("starting http server on :%d", port)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         master.pause()
         sys.exit(0)
+    finally:
+        if plane is not None:
+            plane.close()
 
 
 def main() -> None:
@@ -382,6 +432,24 @@ def main() -> None:
         # distributed master cannot snapshot (the fused engine can).
         _serve_http(master, environ)
     elif node_type == "master":
+        fleet_n = int(environ.get("MISAKA_FLEET", "0") or 0)
+        if fleet_n >= 1 and not environ.get("MISAKA_FLEET_REPLICA"):
+            # The replicated engine fleet (runtime/fleet.py): this
+            # process becomes the fleet parent — it spawns N engine
+            # replicas (each a full master-mode subprocess of this same
+            # entrypoint), the frontend worker tier routing across
+            # them, and the aggregating control server.  MISAKA_FLEET=1
+            # still runs the fleet plumbing, but a 1-replica roll has a
+            # client-visible gap: the replacement's engine boot (tens of
+            # seconds) exceeds the router's MISAKA_FLEET_DOWN_GRACE_S
+            # (default 5s), so requests in that window answer 503 —
+            # zero-loss rolls need N >= 2 (or a grace raised past the
+            # boot time, with clients that tolerate the stall).  0/unset
+            # keeps single-engine serving exactly as before.
+            from misaka_tpu.runtime.fleet import run_fleet
+
+            run_fleet(fleet_n, environ)
+            return
         topology = build_topology_from_env()
         trace_cap = int(environ.get("MISAKA_TRACE_CAP", "0")) or None
         batch = int(environ.get("MISAKA_BATCH", "0")) or None
@@ -389,6 +457,9 @@ def main() -> None:
             topology,
             trace_cap=trace_cap,
             batch=batch,
+            # serving deployments tune this up (the committed bench
+            # harness runs 2048: fewer engine round trips per pass)
+            chunk_steps=int(environ.get("MISAKA_CHUNK_STEPS", "0")) or 128,
             engine=environ.get("MISAKA_ENGINE", "auto"),
             trace_instance=int(environ.get("MISAKA_TRACE_INSTANCE", "0")),
             data_parallel=int(environ.get("MISAKA_DATA_PARALLEL", "0")) or None,
@@ -407,6 +478,7 @@ def main() -> None:
                 "MISAKA_AUTOCKPT requires MISAKA_CHECKPOINT_DIR (snapshots "
                 "need a directory to rotate in)"
             )
+        fleet_restore = environ.get("MISAKA_FLEET_RESTORE")
         if autockpt_s > 0:
             # Crash recovery BEFORE any traffic or autorun: install the
             # newest auto snapshot that passes the durability gate,
@@ -414,18 +486,39 @@ def main() -> None:
             # AutoCheckpointer) — then keep snapshotting on the interval.
             from misaka_tpu.runtime.master import AutoCheckpointer
 
-            restored = AutoCheckpointer.restore_latest(master, checkpoint_dir)
-            if restored:
-                log_.info("auto-restored checkpoint %s", restored)
+            if fleet_restore:
+                # a roll replacement loads its strictly-newer roll
+                # checkpoint below — the auto-restore would be a full
+                # engine-state load immediately thrown away, and every
+                # wasted boot second extends the roll's reduced-capacity
+                # window
+                log_.info("skipping auto-restore: fleet roll checkpoint "
+                          "takes precedence")
             else:
-                log_.info(
-                    "no valid auto checkpoint under %s; fresh state",
-                    checkpoint_dir,
+                restored = AutoCheckpointer.restore_latest(
+                    master, checkpoint_dir
                 )
+                if restored:
+                    log_.info("auto-restored checkpoint %s", restored)
+                else:
+                    log_.info(
+                        "no valid auto checkpoint under %s; fresh state",
+                        checkpoint_dir,
+                    )
             autockpt = AutoCheckpointer(
                 master, checkpoint_dir, autockpt_s,
                 keep=int(environ.get("MISAKA_AUTOCKPT_KEEP", "4")),
             )
+        if fleet_restore:
+            # A rolling-restart replacement replica (runtime/fleet.py
+            # roll): restore the drained predecessor's manifest-verified
+            # checkpoint BEFORE any traffic — the replacement continues
+            # bit-identically where the old replica stopped.  Takes
+            # precedence over an auto-checkpoint restore (skipped above:
+            # the roll checkpoint is strictly newer, cut at quiescence
+            # moments ago).
+            master.load_checkpoint(fleet_restore)
+            log_.info("restored fleet roll checkpoint %s", fleet_restore)
         registry = None
         programs_dir = environ.get("MISAKA_PROGRAMS_DIR")
         if programs_dir:

@@ -1,0 +1,1463 @@
+"""The replicated engine fleet: N engine processes behind one endpoint.
+
+Why this exists: PR 3 measured the single-engine wall — one CPython
+process tops out near ~3.5k HTTP requests/s no matter how fast the
+native pool underneath is, and the SO_REUSEPORT frontend tier (r8) only
+scales the REQUEST side of that equation.  The engine process itself
+(plane handling + ServeBatcher + scatter/gather are Python) becomes the
+next wall.  The reference system's whole shape is N independent nodes
+behind one master (docker-compose'd gRPC program/stack nodes); this
+module is that shape for the fused engine: N engine-replica
+subprocesses, each with its OWN native pool and ServeBatcher, behind
+the existing frontend tier acting as a data-parallel router.
+
+    clients ──HTTP──▶ frontend workers (SO_REUSEPORT, unchanged)
+                          │ FleetPlaneRouter (runtime/frontends.py)
+            ┌─────────────┼──────────────┐
+            ▼             ▼              ▼
+        replica 0      replica 1  ...  replica N-1     (this module
+        engine proc    engine proc     engine proc      supervises them)
+            ▲             ▲              ▲
+            └──── fleet control server ──┘  (aggregated /metrics /status
+                  /healthz, POST /fleet/roll, lifecycle fan-out, proxy)
+
+Routing policy (implemented in frontends.FleetPlaneRouter, the hash
+ring lives here so both sides share one implementation):
+
+  * stateless compute (no program address) — least-queue-depth across
+    healthy replicas, ties broken by lowest replica index;
+  * program-addressed compute — consistent hashing on the program name
+    (HashRing below), so per-program coalescing and registry engine
+    state stay sticky on one replica; on failover only ~1/N of the
+    keyspace moves;
+  * a replica that dies mid-frame gets the frame hedged onto a healthy
+    sibling within a bounded budget; a typed 503 is answered only when
+    the WHOLE fleet is down.
+
+Failure discipline (the r9 supervisor's, applied one level up): a dead
+replica is respawned with exponential backoff + jitter, a crash loop
+trips a circuit breaker, and per-replica up/degraded/down health (probed
+via each replica's /healthz) gates routing and rides the aggregated
+/healthz + /status payloads — a shrunk fleet is never silent.
+
+Rolling restart (`POST /fleet/roll`): one replica at a time — drain to
+quiescence (the replica's compute plane answers new frames with a
+reroute status the router absorbs), checkpoint through the r9
+manifest-verified durable path, kill, boot the replacement with the
+checkpoint restored (bit-identical state), wait healthy, readmit.  A
+deploy loses zero requests.
+
+This module imports stdlib only at module level (plus the stdlib-only
+utils) — the jax-free frontend workers import HashRing from here, and
+the fleet parent only pays heavy imports inside functions that need
+them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from bisect import bisect_left
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# no cycle: frontends (stdlib-only too) imports this module only lazily,
+# inside functions — and its pick_free_port is the one canonical copy
+from misaka_tpu.runtime.frontends import pick_free_port  # noqa: F401
+from misaka_tpu.utils import faults
+from misaka_tpu.utils import metrics
+from misaka_tpu.utils.backoff import Backoff
+
+log = logging.getLogger("misaka_tpu.fleet")
+
+M_FLEET_CONFIGURED = metrics.gauge(
+    "misaka_fleet_replicas_configured",
+    "Engine replicas the fleet is configured for (live fleet manager)",
+)
+M_FLEET_ALIVE = metrics.gauge(
+    "misaka_fleet_replicas_alive",
+    "Engine replica processes currently alive (live fleet manager)",
+)
+M_FLEET_RESTARTS = metrics.counter(
+    "misaka_fleet_replica_restarts_total",
+    "Engine replica processes respawned by the fleet manager",
+    ("reason",),  # "crash" | "roll"
+)
+M_FLEET_ROLLS = metrics.counter(
+    "misaka_fleet_rolls_total",
+    "Rolling restarts completed by the fleet manager",
+    ("status",),  # "ok" | "failed"
+)
+
+
+# --- consistent hashing -----------------------------------------------------
+
+
+class HashRing:
+    """Consistent hashing over replica indices (sha1, virtual nodes).
+
+    `lookup(key)` returns EVERY replica exactly once, in ring order from
+    the key's position — a preference list the router walks for the
+    first healthy replica.  The property that matters for failover and
+    join/leave: removing one replica from an N-replica ring changes the
+    FIRST preference of only ~1/N of the keyspace (its keys), and every
+    other key keeps its owner — per-program engine state and coalescing
+    stay sticky through fleet churn.
+    """
+
+    def __init__(self, replicas, vnodes: int = 64):
+        self.replicas = sorted(replicas)
+        self._vnodes = vnodes
+        points = []
+        for rid in self.replicas:
+            for v in range(vnodes):
+                h = hashlib.sha1(f"misaka-replica-{rid}#{v}".encode()).digest()
+                points.append((int.from_bytes(h[:8], "big"), rid))
+        points.sort()
+        self._points = points
+        self._hashes = [p[0] for p in points]
+
+    def lookup(self, key: str) -> list[int]:
+        """Preference order of replica indices for `key` (all replicas,
+        each once, deterministic)."""
+        if not self._points:
+            return []
+        h = int.from_bytes(hashlib.sha1(key.encode()).digest()[:8], "big")
+        start = bisect_left(self._hashes, h) % len(self._points)
+        order: list[int] = []
+        seen = set()
+        for i in range(len(self._points)):
+            rid = self._points[(start + i) % len(self._points)][1]
+            if rid not in seen:
+                seen.add(rid)
+                order.append(rid)
+                if len(order) == len(self.replicas):
+                    break
+        return order
+
+    def owner(self, key: str) -> int:
+        return self.lookup(key)[0]
+
+
+# --- small shared helpers ---------------------------------------------------
+
+
+def verify_manifest(path: str) -> None:
+    """Stdlib-only strict manifest gate for a JUST-WRITTEN checkpoint:
+    the sidecar must exist and its size + sha256 must match the file.
+
+    The full verifier (runtime/master.py verify_checkpoint) tolerates
+    manifest-less legacy files and stale sidecars because it gates
+    RESTORES of arbitrary history; a roll checkpoint was written
+    milliseconds ago by the durable save path, so anything short of an
+    exact match means the save tore — abort the roll, never kill the
+    replica whose state this was.  Raises RuntimeError on mismatch.
+    """
+    mpath = path + ".manifest"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        want_size = int(manifest["size"])
+        want_sha = str(manifest["sha256"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise RuntimeError(
+            f"roll checkpoint {path}: unreadable manifest ({e})"
+        ) from e
+    size = os.path.getsize(path)
+    if size != want_size:
+        raise RuntimeError(
+            f"roll checkpoint {path}: {size} bytes on disk vs "
+            f"{want_size} in the manifest (torn write)"
+        )
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    if h.hexdigest() != want_sha:
+        raise RuntimeError(
+            f"roll checkpoint {path}: sha256 mismatch against the manifest"
+        )
+
+
+class _ReplicaHTTP:
+    """Tiny keep-alive-free HTTP helper against one replica's loopback
+    server (control-plane calls are rare; simplicity over pooling)."""
+
+    def __init__(self, port: int, timeout: float = 10.0):
+        self.port = port
+        self.timeout = timeout
+
+    def request(self, method: str, path: str, body: bytes | None = None,
+                headers: dict | None = None,
+                timeout: float | None = None) -> tuple[int, bytes, dict]:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", self.port,
+            timeout=self.timeout if timeout is None else timeout,
+        )
+        try:
+            conn.request(method, path, body, headers or {})
+            resp = conn.getresponse()
+            payload = resp.read()
+            return resp.status, payload, dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    def get_json(self, path: str, timeout: float | None = None):
+        status, body, _ = self.request("GET", path, timeout=timeout)
+        if status != 200:
+            raise RuntimeError(
+                f"GET {path} on :{self.port} -> {status}: "
+                f"{body[:200].decode(errors='replace')}"
+            )
+        return json.loads(body)
+
+    def post_form(self, path: str, timeout: float | None = None,
+                  **fields) -> tuple[int, bytes]:
+        from urllib.parse import urlencode
+
+        body = urlencode(fields).encode()
+        status, payload, _ = self.request(
+            "POST", path, body,
+            {"Content-Type": "application/x-www-form-urlencoded"},
+            timeout=timeout,
+        )
+        return status, payload
+
+
+# --- the fleet manager ------------------------------------------------------
+
+
+class ReplicaDown(RuntimeError):
+    """A control-plane call needed a live replica and none qualified."""
+
+
+class FleetManager:
+    """Spawns and supervises N engine-replica processes.
+
+    Each replica is a full `misaka_tpu.runtime.app` master-mode process
+    (own jax runtime, own native pool, own ServeBatcher) pinned to a
+    fixed slot identity: loopback HTTP port + compute-plane unix socket
+    path.  Slot identity survives respawns and rolls, so the frontend
+    router re-admits a replacement the moment its plane socket accepts —
+    no reconfiguration anywhere.
+
+    Supervision mirrors the r9 FrontendSupervisor: a monitor thread
+    reaps deaths and respawns on a bounded backoff curve; a slot whose
+    replicas keep dying fast trips a circuit breaker.  Health is probed
+    per replica (GET /healthz on its loopback port, concurrent per-slot
+    prober threads — down-detection cadence must not depend on how many
+    replicas are dead): "up" on a passing probe, "degraded" while
+    probes fail, "down" when the process is dead or probes have failed
+    past `down_after`, "draining"/"starting" during a roll.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        fleet_dir: str,
+        base_env: dict | None = None,
+        backoff_base: float = 0.5,
+        backoff_cap: float = 15.0,
+        fast_crash_s: float = 5.0,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 60.0,
+        poll_s: float = 0.2,
+        probe_s: float = 0.5,
+        down_after: int = 3,
+        boot_timeout_s: float = 180.0,
+        drain_timeout_s: float = 30.0,
+    ):
+        self.n = max(1, int(n))
+        self.fleet_dir = fleet_dir
+        os.makedirs(fleet_dir, exist_ok=True)
+        self._base_env = dict(base_env if base_env is not None else os.environ)
+        self._backoff = Backoff(base=backoff_base, cap=backoff_cap)
+        self._fast_crash_s = float(fast_crash_s)
+        self._breaker_threshold = max(1, int(breaker_threshold))
+        self._breaker_reset_s = float(breaker_reset_s)
+        self._poll_s = float(poll_s)
+        self._probe_s = float(probe_s)
+        self._down_after = max(1, int(down_after))
+        self._boot_timeout_s = float(boot_timeout_s)
+        self._drain_timeout_s = float(drain_timeout_s)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._restarts_total = 0
+        self._rolls_total = 0
+        self._last_roll: dict | None = None
+        self._roll_lock = threading.Lock()  # one roll at a time
+        # ALL replica Popen calls run on one long-lived spawner thread:
+        # each replica arms PR_SET_PDEATHSIG (lifecycle.py), and Linux
+        # delivers that signal when the spawning THREAD exits, not just
+        # the process — a replica forked from a transient HTTP handler
+        # thread (a /fleet/roll request) would be SIGTERMed the moment
+        # the response was written.
+        import queue
+
+        self._spawn_q: queue.Queue = queue.Queue()
+        self._spawner = threading.Thread(
+            target=self._spawner_loop, daemon=True,
+            name="misaka-fleet-spawner",
+        )
+        self._spawner.start()
+        now = time.monotonic()
+        self._slots: list[dict] = []
+        for i in range(self.n):
+            self._slots.append({
+                "idx": i,
+                "port": pick_free_port(),
+                "plane": os.path.join(fleet_dir, f"plane-{i}.sock"),
+                "ckpt_dir": os.path.join(fleet_dir, f"replica-{i}"),
+                "proc": None,
+                "spawned_at": now,
+                "restarts": 0,
+                "fast_crashes": 0,
+                "next_spawn": 0.0,
+                "breaker_until": None,
+                "probe_fails": 0,
+                "probe_ok": False,
+                "running": None,    # replica's network run state (probed)
+                "rolling": False,   # roll owns this slot; monitor hands off
+                "restore": None,    # checkpoint to restore on next spawn
+                "run_on_boot": None,  # roll-preserved run state (one-shot)
+            })
+        self._threads: list[threading.Thread] = []
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self, wait_ready: bool = True) -> None:
+        for slot in self._slots:
+            self._spawn(slot)
+        if wait_ready:
+            deadline = time.monotonic() + self._boot_timeout_s
+            for slot in self._slots:
+                self._wait_replica_ready(slot, deadline)
+        import weakref
+
+        ref = weakref.ref(self)
+        M_FLEET_CONFIGURED.set_function(
+            lambda: f.n if (f := ref()) is not None else 0
+        )
+        M_FLEET_ALIVE.set_function(
+            lambda: f.alive() if (f := ref()) is not None else 0
+        )
+        monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="misaka-fleet-monitor"
+        )
+        monitor.start()
+        self._threads.append(monitor)
+        for slot in self._slots:
+            t = threading.Thread(
+                target=self._probe_loop, args=(slot,), daemon=True,
+                name=f"misaka-fleet-probe-{slot['idx']}",
+            )
+            t.start()
+            self._threads.append(t)
+        # Chaos harness (utils/faults.py): `replica_kill=N` SIGKILLs one
+        # live replica N seconds after fleet start — the kill(9)-without-
+        # kill lever the failover contract is exercised against.  Fired
+        # ONCE per fleet boot (firing per spawn would kill every respawn
+        # into a loop the breaker would then misread as a crash loop).
+        kill_after = faults.fire("replica_kill")
+        if kill_after is not None:
+            threading.Thread(
+                target=self._chaos_kill, args=(max(0.0, kill_after),),
+                daemon=True, name="misaka-fleet-chaos-kill",
+            ).start()
+
+    def _chaos_kill(self, delay: float) -> None:
+        time.sleep(delay)
+        with self._lock:
+            live = [s for s in self._slots
+                    if s["proc"] is not None and s["proc"].poll() is None]
+            if not live:
+                return
+            victim = live[0]
+            pid = victim["proc"].pid
+        log.warning("replica_kill fault: SIGKILL replica %d (pid %d)",
+                    victim["idx"], pid)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            procs = [s["proc"] for s in self._slots if s["proc"] is not None]
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    p.kill()
+                    p.wait(timeout=2)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        self._spawn_q.put(None)
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # --- spawning -----------------------------------------------------------
+
+    def plane_paths(self) -> list[str]:
+        return [s["plane"] for s in self._slots]
+
+    def _replica_env(self, slot: dict) -> dict:
+        env = dict(self._base_env)
+        env.update({
+            # a replica must never recurse into fleet mode or spawn its
+            # own frontend tier — it is one engine behind the shared one
+            "MISAKA_FLEET": "0",
+            "MISAKA_HTTP_WORKERS": "0",
+            "MISAKA_PORT": str(slot["port"]),
+            "MISAKA_PLANE_SOCKET": slot["plane"],
+            "MISAKA_PLANE_SERVE": "1",
+            "MISAKA_FLEET_REPLICA": str(slot["idx"]),
+            # per-replica durable state: each replica checkpoints (and
+            # auto-restores) under its own directory — replica states
+            # are independent (they serve disjoint request streams)
+            "MISAKA_CHECKPOINT_DIR": slot["ckpt_dir"],
+        })
+        env.pop("MISAKA_ORPHAN_OK", None)  # replicas die with the fleet
+        if not self._base_env.get("MISAKA_NATIVE_THREADS") and self.n > 1:
+            # N replicas share one box: a full-width native pool EACH
+            # (the single-engine default) oversubscribes every core N
+            # times and convoys — split the cores instead.  An explicit
+            # MISAKA_NATIVE_THREADS always wins (multi-host operators
+            # size per host).
+            env["MISAKA_NATIVE_THREADS"] = str(
+                max(2, (os.cpu_count() or 8) // self.n)
+            )
+        programs_dir = self._base_env.get("MISAKA_PROGRAMS_DIR")
+        if programs_dir:
+            # per-replica registry stores: every replica can serve every
+            # program (uploads fan out via the control server), but the
+            # persistent stores must not share files across processes
+            env["MISAKA_PROGRAMS_DIR"] = os.path.join(
+                programs_dir, f"replica-{slot['idx']}"
+            )
+        if slot["restore"]:
+            env["MISAKA_FLEET_RESTORE"] = slot["restore"]
+        else:
+            env.pop("MISAKA_FLEET_RESTORE", None)
+        if slot["run_on_boot"] is not None:
+            # a roll replacement inherits its predecessor's run state (a
+            # deploy must not flip a paused network back on, and the
+            # restored tick must stay frozen if the operator froze it)
+            env["MISAKA_AUTORUN"] = "1" if slot["run_on_boot"] else "0"
+        return env
+
+    def _spawner_loop(self) -> None:
+        while True:
+            item = self._spawn_q.get()
+            if item is None:
+                return
+            slot, outcome, done = item
+            try:
+                self._spawn_inline(slot)
+            except BaseException as e:  # re-raised on the caller's thread
+                outcome.append(e)
+            done.set()
+
+    def _spawn(self, slot: dict) -> None:
+        """Spawn via the spawner thread (see __init__); raises whatever
+        Popen raised, on the calling thread."""
+        if threading.current_thread() is self._spawner:
+            self._spawn_inline(slot)
+            return
+        outcome: list = []
+        done = threading.Event()
+        self._spawn_q.put((slot, outcome, done))
+        done.wait()
+        if outcome:
+            raise outcome[0]
+
+    def _spawn_inline(self, slot: dict) -> None:
+        os.makedirs(slot["ckpt_dir"], exist_ok=True)
+        cmd = [sys.executable, "-m", "misaka_tpu.runtime.app"]
+        slot["proc"] = subprocess.Popen(cmd, env=self._replica_env(slot))
+        slot["spawned_at"] = time.monotonic()
+        slot["probe_fails"] = 0
+        slot["probe_ok"] = False
+        log.info(
+            "replica %d spawned (pid %d, http :%d, plane %s%s)",
+            slot["idx"], slot["proc"].pid, slot["port"], slot["plane"],
+            ", restoring " + slot["restore"] if slot["restore"] else "",
+        )
+        # restore/run_on_boot stay ARMED until this replica passes a
+        # health check (_mark_healthy): a replacement that crashes
+        # DURING boot gets its verified checkpoint retried on the
+        # respawn instead of silently booting fresh — "a broken roll
+        # never loses a replica's state".  Once the replica has served,
+        # they clear, so a LATER crash respawns fresh (base-env
+        # MISAKA_AUTORUN rules; stale state must not resurrect).
+
+    def _wait_replica_ready(self, slot: dict, deadline: float) -> None:
+        rh = _ReplicaHTTP(slot["port"], timeout=2.0)
+        while time.monotonic() < deadline:
+            proc = slot["proc"]
+            if proc is not None and proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {slot['idx']} exited during boot "
+                    f"(code {proc.returncode})"
+                )
+            try:
+                payload = rh.get_json("/healthz")
+                if payload.get("ok"):
+                    slot["running"] = bool(payload.get("running"))
+                    self._mark_healthy(slot)
+                    return
+            except (OSError, RuntimeError, ValueError):
+                pass
+            time.sleep(0.2)
+        raise RuntimeError(
+            f"replica {slot['idx']} did not become healthy within "
+            f"{self._boot_timeout_s:.0f}s"
+        )
+
+    # --- supervision --------------------------------------------------------
+
+    def alive(self) -> int:
+        with self._lock:
+            return sum(
+                1 for s in self._slots
+                if s["proc"] is not None and s["proc"].poll() is None
+            )
+
+    def replica_state(self, slot: dict) -> str:
+        proc = slot["proc"]
+        if proc is None or proc.poll() is not None:
+            return "down"
+        if slot["rolling"]:
+            return "draining"
+        if slot["probe_ok"]:
+            return "up"
+        if slot["probe_fails"] >= self._down_after:
+            return "down"
+        return "degraded" if slot["probe_fails"] else "starting"
+
+    def state(self) -> dict:
+        """The /healthz + /status fleet block: per-replica rows plus an
+        explicit `degraded` flag (any replica not up) — the same
+        no-silent-degradation contract as the frontend supervisor."""
+        now = time.monotonic()
+        with self._lock:
+            rows = []
+            for s in self._slots:
+                st = self.replica_state(s)
+                rows.append({
+                    "replica": s["idx"],
+                    "state": st,
+                    "pid": s["proc"].pid if s["proc"] is not None else None,
+                    "port": s["port"],
+                    "restarts": s["restarts"],
+                    # network run state from the last /healthz probe
+                    # (<= probe_s stale; None until first probe)
+                    "running": s["running"],
+                    "breaker_open": bool(
+                        s["breaker_until"] is not None
+                        and s["breaker_until"] > now
+                    ),
+                })
+            restarts = self._restarts_total
+            rolls = self._rolls_total
+            last_roll = self._last_roll
+        alive = sum(1 for r in rows if r["state"] not in ("down",))
+        up = sum(1 for r in rows if r["state"] == "up")
+        return {
+            "configured": len(rows),
+            "alive": alive,
+            "up": up,
+            "replicas": rows,
+            "restarts_total": restarts,
+            "rolls_total": rolls,
+            "last_roll": last_roll,
+            "degraded": up < len(rows),
+        }
+
+    def up_slots(self) -> list[dict]:
+        with self._lock:
+            return [
+                s for s in self._slots if self.replica_state(s) == "up"
+            ]
+
+    def slot_states(self) -> list[tuple[dict, str]]:
+        """Every configured slot with its state, in index order — the
+        fan-out path needs the non-up ones too (a skipped replica must
+        be reported, never silently excluded)."""
+        with self._lock:
+            return [(s, self.replica_state(s)) for s in self._slots]
+
+    def _mark_healthy(self, slot: dict) -> None:
+        slot["probe_ok"] = True
+        slot["probe_fails"] = 0
+        if slot["rolling"]:
+            # the roll owns the slot: its own readiness wait lands here
+            # while slot["restore"] is armed for the REPLACEMENT — a
+            # disarm now (e.g. a straggling probe of the old, still-
+            # alive replica) would make the replacement silently boot
+            # without restoring.  Only a post-roll probe disarms; until
+            # then a boot crash inside the roll window retries the
+            # checkpoint, which is exactly the contract below.
+            return
+        # the replica reached healthy with its restore applied: disarm
+        # it (see _spawn_inline — until here a boot crash retries the
+        # checkpoint; from here a crash respawns fresh)
+        slot["restore"] = None
+        slot["run_on_boot"] = None
+
+    def _probe_loop(self, slot: dict) -> None:
+        rh = _ReplicaHTTP(slot["port"], timeout=2.0)
+        while not self._closed:
+            time.sleep(self._probe_s)
+            if slot["rolling"]:
+                # the roll owns this slot (the same hand-off the monitor
+                # honors): a probe passing against the OLD still-alive
+                # replica after the roll arms slot["restore"] would
+                # _mark_healthy -> disarm the checkpoint, and the
+                # replacement would silently boot without restoring
+                continue
+            proc = slot["proc"]
+            if proc is None or proc.poll() is not None:
+                slot["probe_ok"] = False
+                continue
+            try:
+                payload = rh.get_json("/healthz")
+                ok = bool(payload.get("ok"))
+                slot["running"] = bool(payload.get("running"))
+            except (OSError, RuntimeError, ValueError):
+                ok = False
+            if ok:
+                self._mark_healthy(slot)
+            else:
+                slot["probe_ok"] = False
+                slot["probe_fails"] += 1
+
+    def _monitor_loop(self) -> None:
+        while True:
+            time.sleep(self._poll_s)
+            due: list[dict] = []
+            with self._lock:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                for slot in self._slots:
+                    proc = slot["proc"]
+                    if (
+                        proc is not None and proc.poll() is not None
+                        and not slot["rolling"]
+                    ):
+                        lifetime = now - slot["spawned_at"]
+                        slot["proc"] = None
+                        slot["probe_ok"] = False
+                        fast = lifetime < self._fast_crash_s
+                        slot["fast_crashes"] = (
+                            slot["fast_crashes"] + 1 if fast else 0
+                        )
+                        if slot["fast_crashes"] >= self._breaker_threshold:
+                            slot["breaker_until"] = (
+                                now + self._breaker_reset_s
+                            )
+                            log.error(
+                                "replica %d crash loop (%d fast deaths, "
+                                "last exit %s): circuit breaker open for "
+                                "%.0fs", slot["idx"], slot["fast_crashes"],
+                                proc.returncode, self._breaker_reset_s,
+                            )
+                        else:
+                            delay = self._backoff.delay_for(
+                                max(0, slot["fast_crashes"] - 1)
+                            )
+                            slot["next_spawn"] = now + delay
+                            log.warning(
+                                "replica %d died (exit %s after %.1fs); "
+                                "respawn in %.2fs", slot["idx"],
+                                proc.returncode, lifetime, delay,
+                            )
+                    if slot["proc"] is None and not slot["rolling"]:
+                        if slot["breaker_until"] is not None:
+                            if now < slot["breaker_until"]:
+                                continue
+                            slot["breaker_until"] = None
+                            log.warning(
+                                "replica %d breaker half-open: one respawn",
+                                slot["idx"],
+                            )
+                        elif now < slot["next_spawn"]:
+                            continue
+                        due.append(slot)
+            spawned: list[dict] = []
+            for slot in due:
+                # only the monitor (or a roll holding `rolling`) mutates
+                # a slot's proc, so spawning outside the lock cannot race
+                # another writer — just the close() check below
+                try:
+                    self._spawn(slot)
+                except OSError as e:
+                    log.error("replica %d spawn failed (%s); backing off",
+                              slot["idx"], e)
+                    with self._lock:
+                        slot["fast_crashes"] += 1
+                        slot["next_spawn"] = (
+                            time.monotonic()
+                            + self._backoff.delay_for(slot["fast_crashes"] - 1)
+                        )
+                    continue
+                spawned.append(slot)
+            if not spawned:
+                continue
+            with self._lock:
+                if self._closed:
+                    for slot in spawned:
+                        try:
+                            slot["proc"].terminate()
+                            slot["proc"].wait(timeout=2)
+                        except (OSError, subprocess.TimeoutExpired):
+                            pass
+                    return
+                for slot in spawned:
+                    slot["restarts"] += 1
+                    self._restarts_total += 1
+                    M_FLEET_RESTARTS.labels(reason="crash").inc()
+                    log.info("replica %d respawned (pid %d)",
+                             slot["idx"], slot["proc"].pid)
+
+    # --- rolling restart ----------------------------------------------------
+
+    def roll(self, drain_timeout_s: float | None = None) -> dict:
+        """Zero-loss rolling restart: drain → checkpoint → verify →
+        replace → restore → readmit, one replica at a time.
+
+        Returns a per-replica report.  Raises RuntimeError when a step
+        fails (the failing replica is undrained and left serving — a
+        broken roll must degrade to "deploy didn't happen", never to
+        "replica lost").  Concurrent rolls are rejected.
+        """
+        if not self._roll_lock.acquire(blocking=False):
+            raise RuntimeError("a rolling restart is already in progress")
+        try:
+            return self._roll_locked(
+                self._drain_timeout_s if drain_timeout_s is None
+                else float(drain_timeout_s)
+            )
+        finally:
+            self._roll_lock.release()
+
+    def _roll_locked(self, drain_timeout_s: float) -> dict:
+        report: list[dict] = []
+        t_start = time.monotonic()
+        for slot in self._slots:
+            try:
+                entry = self._roll_one(slot, drain_timeout_s)
+            except Exception:
+                M_FLEET_ROLLS.labels(status="failed").inc()
+                with self._lock:
+                    self._last_roll = {
+                        "ok": False,
+                        "replicas": report,
+                        "failed_replica": slot["idx"],
+                    }
+                raise
+            report.append(entry)
+        with self._lock:
+            self._rolls_total += 1
+            self._last_roll = {
+                "ok": True,
+                "replicas": report,
+                "duration_s": round(time.monotonic() - t_start, 3),
+            }
+            out = dict(self._last_roll)
+        M_FLEET_ROLLS.labels(status="ok").inc()
+        return out
+
+    def _roll_one(self, slot: dict, drain_timeout_s: float) -> dict:
+        idx = slot["idx"]
+        rh = _ReplicaHTTP(slot["port"], timeout=10.0)
+        entry: dict = {"replica": idx}
+        # A roll ordered right after a failover is routine (kill one
+        # replica, then deploy): wait for a replica that is merely
+        # BOOTING to come up before giving up on the roll.
+        heal_deadline = time.monotonic() + self._boot_timeout_s
+        while True:
+            with self._lock:
+                state = self.replica_state(slot)
+                if state == "up":
+                    slot["rolling"] = True  # monitor hands the slot to us
+                    break
+            if time.monotonic() >= heal_deadline:
+                raise RuntimeError(
+                    f"roll aborted: replica {idx} is {state}, not up "
+                    f"(heal the fleet before rolling)"
+                )
+            time.sleep(0.2)
+        try:
+            # 1. drain: the replica's compute plane answers new frames
+            #    with the reroute status; the router shifts traffic to
+            #    siblings with zero client-visible errors.  In-flight
+            #    frames finish.
+            t0 = time.monotonic()
+            try:
+                was_running = bool(rh.get_json("/healthz").get("running"))
+            except (OSError, RuntimeError, ValueError):
+                was_running = True  # serving is the safe default
+            status, body = rh.post_form("/fleet/drain", state="on")
+            if status != 200:
+                raise RuntimeError(
+                    f"replica {idx}: drain request failed "
+                    f"({status}: {body[:200].decode(errors='replace')})"
+                )
+            deadline = time.monotonic() + drain_timeout_s
+            quiescent = 0
+            while time.monotonic() < deadline:
+                payload = json.loads(rh.post_form("/fleet/drain",
+                                                  state="on")[1])
+                if (
+                    payload.get("inflight", 1) == 0
+                    and payload.get("http_inflight", 0) == 0
+                ):
+                    quiescent += 1
+                    if quiescent >= 2:  # two consecutive clean reads
+                        break
+                else:
+                    quiescent = 0
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(
+                    f"replica {idx}: did not drain to quiescence within "
+                    f"{drain_timeout_s:.0f}s"
+                )
+            entry["drained_in_s"] = round(time.monotonic() - t0, 3)
+
+            # 2. checkpoint through the durable manifest-verified path
+            name = f"fleet-roll-{int(time.time())}"
+            status, body = rh.post_form("/checkpoint", name=name, timeout=60)
+            if status != 200:
+                raise RuntimeError(
+                    f"replica {idx}: roll checkpoint failed "
+                    f"({status}: {body[:200].decode(errors='replace')})"
+                )
+            ckpt = os.path.join(slot["ckpt_dir"], name + ".npz")
+            verify_manifest(ckpt)
+            entry["checkpoint"] = ckpt
+
+            # 3. replace: terminate (the replica is quiescent), boot the
+            #    replacement restoring the verified checkpoint on the
+            #    SAME port + plane path — the router re-admits it the
+            #    moment the plane socket accepts again.
+            proc = slot["proc"]
+            slot["restore"] = ckpt
+            slot["run_on_boot"] = was_running
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            t_boot = time.monotonic()
+            self._spawn(slot)
+            with self._lock:
+                slot["restarts"] += 1
+                self._restarts_total += 1
+            M_FLEET_RESTARTS.labels(reason="roll").inc()
+            self._wait_replica_ready(
+                slot, time.monotonic() + self._boot_timeout_s
+            )
+            entry["booted_in_s"] = round(time.monotonic() - t_boot, 3)
+            entry["restored"] = True
+            return entry
+        except Exception:
+            # leave the replica serving if it still can: undrain — and
+            # keep retrying in the background, because the roll failure
+            # may BE this replica being wedged, in which case the
+            # inline undrain fails too and the replica would otherwise
+            # sit draining forever behind a passing /healthz (1/N of
+            # capacity silently parked with no degraded signal)
+            self._undrain_async(slot)
+            raise
+        finally:
+            with self._lock:
+                slot["rolling"] = False
+
+    def _undrain_async(self, slot: dict) -> None:
+        """Best-effort background undrain after a failed roll step.
+        Retries until the undrain lands, the replica is replaced (a
+        respawn boots undrained), a NEW roll takes the slot (its own
+        failure path spawns its own retryer), or the manager closes."""
+        proc = slot["proc"]
+
+        def loop() -> None:
+            # our roll's `finally` clears `rolling` right after this
+            # thread is spawned; wait it out before treating `rolling`
+            # as "a newer roll owns the slot"
+            settle = time.monotonic() + 2.0
+            while slot["rolling"] and time.monotonic() < settle:
+                time.sleep(0.02)
+            rh = _ReplicaHTTP(slot["port"], timeout=5.0)
+            while not self._closed:
+                if slot["rolling"] or slot["proc"] is not proc:
+                    return
+                if proc is None or proc.poll() is not None:
+                    return
+                try:
+                    status, _ = rh.post_form("/fleet/drain", state="off")
+                    if status == 200:
+                        return
+                except Exception:
+                    pass  # wedged replica: keep trying
+                time.sleep(0.5)
+
+        threading.Thread(
+            target=loop, daemon=True,
+            name=f"misaka-fleet-undrain-{slot['idx']}",
+        ).start()
+
+
+# --- the fleet control server -----------------------------------------------
+
+# routes fanned out to EVERY up replica (lifecycle must stay consistent
+# across the fleet; /programs uploads must land everywhere so failover
+# and ring reshuffles find the program on any sibling)
+_FANOUT_ROUTES = frozenset({
+    "/run", "/pause", "/reset", "/load", "/programs",
+    "/checkpoint", "/restore",
+})
+
+# stateful singleton routes proxied to ONE deterministic replica: the
+# jax profiler is process-global with paired start/stop calls, so
+# round-robin would land /profile/stop on a different replica than its
+# /profile/start (409 "not running" while the capture runs forever on
+# the first); flamegraph reads pin with them so repeated scrapes watch
+# one process
+_STICKY_ROUTES = frozenset({
+    "/profile/start", "/profile/stop", "/debug/flamegraph",
+})
+
+
+def relabel_metrics_text(text: str, replica: int) -> tuple[str, list[str]]:
+    """Inject `replica="<i>"` into every sample of one replica's
+    Prometheus exposition.  Returns (sample_lines, header_lines): headers
+    (# HELP / # TYPE) are returned separately so the aggregator emits
+    each exactly once across the fleet."""
+    samples: list[str] = []
+    headers: list[str] = []
+    label = f'replica="{replica}"'
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            headers.append(line)
+            continue
+        brace = line.find("{")
+        if brace == -1:
+            # name value  ->  name{replica="i"} value
+            name, sep, rest = line.partition(" ")
+            if not sep:
+                continue  # malformed; drop rather than mislabel
+            samples.append(f"{name}{{{label}}} {rest}")
+        else:
+            samples.append(f"{line[:brace + 1]}{label},{line[brace + 1:]}")
+    return samples, headers
+
+
+def make_fleet_http_server(
+    fleet: FleetManager, port: int = 0, host: str = "127.0.0.1"
+) -> ThreadingHTTPServer:
+    """The fleet control-plane HTTP server (the proxy target the frontend
+    workers use for every non-compute route, and the operator surface for
+    POST /fleet/roll).
+
+    Aggregation contract:
+      * GET /metrics    — every up replica's exposition with a
+                          `replica` label injected, one HELP/TYPE per
+                          family, plus this process's own series
+                          (fleet gauges, frontend supervisor);
+      * GET /healthz    — fleet block with per-replica rows; `degraded`
+                          whenever any replica is not up (or the
+                          frontend pool / any replica says so);
+      * GET /status     — fleet block + each replica's own /status row;
+      * GET /debug/requests, /debug/perfetto — merged across replicas
+                          (perfetto pids are offset per replica so the
+                          UI shows which replica served each request);
+      * POST /fleet/roll — the zero-loss rolling restart;
+      * lifecycle POSTs (/run /pause /reset /load /programs ...) fan out
+        to every up replica; everything else proxies to one up replica
+        (program-addressed paths ride the hash ring for stickiness).
+    """
+    ring = HashRing(range(fleet.n))
+    rr_counter = [0]
+    import re
+
+    program_re = re.compile(r"^/programs/([^/]+)(/.*)?$")
+
+    def _gather(slots: list[dict], fn):
+        """Apply `fn(slot)` to every slot CONCURRENTLY and return the
+        results in slot order (None where fn raised).  The aggregation
+        routes must not query replicas serially: one wedged-but-alive
+        replica would stall every /metrics scrape by its full timeout —
+        monitoring degrading exactly during the grey failure it should
+        be showing.  Concurrency bounds the whole fetch to the slowest
+        single replica."""
+        out: list = [None] * len(slots)
+
+        def run(i: int, slot: dict) -> None:
+            try:
+                out[i] = fn(slot)
+            except Exception:
+                out[i] = None
+
+        threads = [
+            threading.Thread(target=run, args=(i, s), daemon=True)
+            for i, s in enumerate(slots)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    class FleetHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug(fmt, *args)
+
+        def _reply(self, code: int, data: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _text(self, code: int, body: str) -> None:
+            self._reply(code, body.encode(), "text/plain; charset=utf-8")
+
+        def _json(self, obj, code: int = 200) -> None:
+            self._reply(
+                code, (json.dumps(obj) + "\n").encode(), "application/json"
+            )
+
+        def _read_body(self) -> bytes:
+            length = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(length) if length else b""
+
+        def _pick_slot(self, path: str) -> dict | None:
+            """A healthy replica for a proxied request: hash-ring owner
+            for program-addressed paths (stickiness), round-robin
+            otherwise."""
+            up = fleet.up_slots()
+            if not up:
+                return None
+            m = program_re.match(path)
+            if m:
+                by_idx = {s["idx"]: s for s in up}
+                for idx in ring.lookup(m.group(1).partition("@")[0]):
+                    if idx in by_idx:
+                        return by_idx[idx]
+            if path in _STICKY_ROUTES:
+                return min(up, key=lambda s: s["idx"])
+            rr_counter[0] += 1
+            return up[rr_counter[0] % len(up)]
+
+        def _proxy(self, method: str, body: bytes | None = None) -> None:
+            slot = self._pick_slot(self.path.split("?", 1)[0])
+            if slot is None:
+                self._text(503, "fleet down: no healthy engine replica")
+                return
+            headers = {}
+            for h in ("Content-Type", "X-Misaka-Program", "X-Misaka-Trace"):
+                v = self.headers.get(h)
+                if v:
+                    headers[h] = v
+            rh = _ReplicaHTTP(slot["port"], timeout=60.0)
+            try:
+                status, payload, resp_headers = rh.request(
+                    method, self.path, body, headers
+                )
+            except OSError as e:
+                self._text(502, f"replica {slot['idx']} unreachable: {e}")
+                return
+            self.send_response(status)
+            ctype = resp_headers.get(
+                "Content-Type", "text/plain; charset=utf-8"
+            )
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("X-Misaka-Replica", str(slot["idx"]))
+            for h in ("X-Misaka-Trace", "Server-Timing", "Deprecation",
+                      "Link"):
+                v = resp_headers.get(h)
+                if v:
+                    self.send_header(h, v)
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _fanout(self, body: bytes) -> None:
+            """Apply one lifecycle POST to every up replica; a uniform
+            outcome with the WHOLE fleet reached answers as one replica
+            did, anything else is reported per replica — including
+            replicas skipped because they were down/draining.  A /pause
+            that silently missed a mid-roll replica would leave the
+            fleet divergent (that replica free-running against paused
+            siblings) behind a success response."""
+            states = fleet.slot_states()
+            if not any(st == "up" for _, st in states):
+                self._text(503, "fleet down: no healthy engine replica")
+                return
+            headers = {}
+            ctype = self.headers.get("Content-Type")
+            if ctype:
+                headers["Content-Type"] = ctype
+
+            def apply(slot: dict) -> tuple[int, bytes]:
+                rh = _ReplicaHTTP(slot["port"], timeout=60.0)
+                try:
+                    status, payload, _ = rh.request(
+                        "POST", self.path, body, headers
+                    )
+                except (OSError, http.client.HTTPException) as e:
+                    status, payload = 502, str(e).encode()
+                return status, payload
+
+            # concurrent like the GET aggregations (_gather): one
+            # wedged replica must not stall the fan-out by its full
+            # 60s timeout per sibling
+            up = [slot for slot, st in states if st == "up"]
+            applied = dict(
+                zip((s["idx"] for s in up), _gather(up, apply))
+            )
+            results = []
+            ok = True
+            skipped = 0
+            for slot, st in states:
+                if st != "up":
+                    results.append({
+                        "replica": slot["idx"],
+                        "status": 503,
+                        "body": f"replica {st}; lifecycle change "
+                                f"not applied",
+                        "skipped": True,
+                    })
+                    ok = False
+                    skipped += 1
+                    continue
+                status, payload = (
+                    applied.get(slot["idx"])
+                    or (502, b"fan-out request failed")
+                )
+                results.append({
+                    "replica": slot["idx"],
+                    "status": status,
+                    "body": payload[:500].decode(errors="replace"),
+                })
+                ok = ok and 200 <= status < 300
+            if not skipped and (len(results) == 1 or all(
+                r["body"] == results[0]["body"]
+                and r["status"] == results[0]["status"] for r in results
+            )):
+                # uniform outcome across the whole fleet: answer exactly
+                # what one replica said, success or not (keeps `curl -d
+                # value=5 /run` -> "Success" ergonomics, and a
+                # fleet-wide 400 "parse error" stays a 400 — rewriting
+                # it to 502 would misclassify a bad request as fleet
+                # unavailability)
+                first = results[0]
+                self._text(first["status"], first["body"])
+                return
+            self._json({"ok": ok, "replicas": results},
+                       code=200 if ok else 502)
+
+        def do_GET(self):
+            try:
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    st = fleet.state()
+                    up_rows = [
+                        r for r in st["replicas"] if r["state"] == "up"
+                    ]
+                    payload = {
+                        "ok": st["up"] > 0,
+                        "engine": "fleet",
+                        # the single-engine /healthz contract: `running`
+                        # is the NETWORK run state, not process liveness
+                        # — a fully paused fleet must not read as
+                        # serving (probed per replica, <= probe_s stale)
+                        "running": bool(up_rows) and all(
+                            r.get("running") for r in up_rows
+                        ),
+                        "fleet": st,
+                        "degraded": st["degraded"],
+                    }
+                    sup = getattr(self.server, "misaka_supervisor", None)
+                    if sup is not None:
+                        fs = sup.state()
+                        payload["frontends"] = fs
+                        payload["degraded"] = (
+                            payload["degraded"] or fs["degraded"]
+                        )
+                    self._json(payload)
+                    return
+                if path in ("/fleet", "/fleet/state"):
+                    self._json(fleet.state())
+                    return
+                if path == "/status":
+                    st = fleet.state()
+                    payload = {
+                        "engine": "fleet",
+                        "fleet": st,
+                        "replicas": {},
+                    }
+                    sup = getattr(self.server, "misaka_supervisor", None)
+                    if sup is not None:
+                        payload["frontends"] = sup.state()
+
+                    def fetch_status(slot: dict):
+                        rh = _ReplicaHTTP(slot["port"], timeout=5.0)
+                        try:
+                            return rh.get_json("/status")
+                        except (OSError, RuntimeError, ValueError) as e:
+                            return {"error": str(e)}
+
+                    slots = fleet.up_slots()
+                    for slot, row in zip(
+                        slots, _gather(slots, fetch_status)
+                    ):
+                        payload["replicas"][str(slot["idx"])] = (
+                            row if row is not None else {"error": "fetch"}
+                        )
+                    self._json(payload)
+                    return
+                if path == "/metrics":
+                    sample_lines: list[str] = []
+                    header_seen: dict[str, str] = {}
+                    slots = fleet.up_slots()
+                    fetched = _gather(
+                        slots,
+                        lambda s: _ReplicaHTTP(
+                            s["port"], timeout=5.0
+                        ).request("GET", "/metrics"),
+                    )
+                    for slot, resp in zip(slots, fetched):
+                        if resp is None:
+                            continue
+                        status, body, _ = resp
+                        if status != 200:
+                            continue
+                        samples, headers = relabel_metrics_text(
+                            body.decode(errors="replace"), slot["idx"]
+                        )
+                        sample_lines.extend(samples)
+                        for h in headers:
+                            header_seen.setdefault(h, h)
+                    # the parent's own series (fleet gauges, frontend
+                    # supervisor, build info) ride unlabeled — but their
+                    # HELP/TYPE lines dedupe against the replica
+                    # headers, since both sides register many of the
+                    # same families and a second TYPE line for one name
+                    # is invalid exposition
+                    for line in metrics.render().splitlines():
+                        if line.startswith("#"):
+                            header_seen.setdefault(line, line)
+                        elif line.strip():
+                            sample_lines.append(line)
+                    out = []
+                    out.extend(header_seen.values())
+                    out.extend(sample_lines)
+                    self._send_metrics("\n".join(out))
+                    return
+                if path == "/debug/requests":
+                    merged = {"recent": [], "slowest": [], "replicas": {}}
+                    qs = ("?" + self.path.split("?", 1)[1]
+                          if "?" in self.path else "")
+                    slots = fleet.up_slots()
+                    fetched = _gather(
+                        slots,
+                        lambda s: _ReplicaHTTP(
+                            s["port"], timeout=5.0
+                        ).get_json("/debug/requests" + qs),
+                    )
+                    for slot, payload in zip(slots, fetched):
+                        if payload is None:
+                            continue
+                        for key in ("recent", "slowest"):
+                            for row in payload.get(key, ()):
+                                row["replica"] = slot["idx"]
+                                merged[key].append(row)
+                        merged["replicas"][str(slot["idx"])] = {
+                            "enabled": payload.get("enabled"),
+                        }
+                    merged["slowest"].sort(
+                        key=lambda r: -(r.get("duration_ms") or 0)
+                    )
+                    self._json(merged)
+                    return
+                if path == "/debug/perfetto":
+                    events = []
+                    slots = fleet.up_slots()
+                    fetched = _gather(
+                        slots,
+                        lambda s: _ReplicaHTTP(
+                            s["port"], timeout=10.0
+                        ).get_json("/debug/perfetto"),
+                    )
+                    for slot, payload in zip(slots, fetched):
+                        if payload is None:
+                            continue
+                        base = (slot["idx"] + 1) * 100
+                        for ev in payload.get("traceEvents", ()):
+                            if "pid" in ev:
+                                ev["pid"] = base + int(ev["pid"])
+                            if (
+                                ev.get("ph") == "M"
+                                and ev.get("name") == "process_name"
+                            ):
+                                ev["args"]["name"] = (
+                                    f"replica {slot['idx']} · "
+                                    f"{ev['args'].get('name', '')}"
+                                )
+                            events.append(ev)
+                    self._json({"traceEvents": events,
+                                "displayTimeUnit": "ms"})
+                    return
+                # anything else: proxy to one healthy replica
+                self._proxy("GET")
+            except Exception as e:  # defensive: never kill the server
+                log.exception("fleet handler error")
+                try:
+                    self._text(500, f"internal error: {e}")
+                except Exception:
+                    pass
+
+        def _send_metrics(self, text: str) -> None:
+            if not text.endswith("\n"):
+                text += "\n"
+            self._reply(200, text.encode(), metrics.CONTENT_TYPE)
+
+        def do_POST(self):
+            try:
+                path = self.path.split("?", 1)[0]
+                body = self._read_body()
+                if path == "/fleet/drain":
+                    # replica-internal roll control: proxying it would
+                    # arm drain on a ROUND-ROBIN replica the caller
+                    # cannot target again to undrain — capacity lost
+                    # until a roll or restart.  The roll drives drain on
+                    # each replica's own loopback port directly.
+                    self._text(
+                        400,
+                        "/fleet/drain is replica-internal (the roll "
+                        "protocol drives it); use POST /fleet/roll",
+                    )
+                    return
+                if path == "/fleet/roll":
+                    try:
+                        report = fleet.roll()
+                    except RuntimeError as e:
+                        code = (
+                            409 if "already in progress" in str(e) else 500
+                        )
+                        self._text(code, f"rolling restart failed: {e}")
+                        return
+                    self._json(report)
+                    return
+                if path in _FANOUT_ROUTES:
+                    self._fanout(body)
+                    return
+                self._proxy("POST", body)
+            except Exception as e:
+                log.exception("fleet handler error")
+                try:
+                    self._text(500, f"internal error: {e}")
+                except Exception:
+                    pass
+
+    return ThreadingHTTPServer((host, port), FleetHandler)
+
+
+# --- app entrypoint ---------------------------------------------------------
+
+
+def run_fleet(n: int, environ=None) -> None:
+    """`MISAKA_FLEET=N` entrypoint (called by runtime/app.py): spawn and
+    supervise N engine replicas, the frontend worker tier routing across
+    them, and the fleet control server; serve until signalled."""
+    environ = dict(os.environ if environ is None else environ)
+    from misaka_tpu.runtime import frontends
+    from misaka_tpu.runtime.lifecycle import install_guards
+    from misaka_tpu.utils import buildinfo
+
+    buildinfo.install_metric()
+    public_port = int(environ.get("MISAKA_PORT", "8000"))
+    fleet_dir = (
+        environ.get("MISAKA_FLEET_DIR")
+        or environ.get("MISAKA_CHECKPOINT_DIR")
+        or f"/tmp/misaka-fleet-{os.getpid()}"
+    )
+    fleet = FleetManager(
+        n,
+        fleet_dir,
+        base_env=environ,
+        probe_s=float(environ.get("MISAKA_FLEET_PROBE_S", "0.5") or 0.5),
+        drain_timeout_s=float(
+            environ.get("MISAKA_FLEET_DRAIN_S", "30") or 30
+        ),
+    )
+    install_guards(fleet.close, environ)
+    log.info("booting %d engine replicas under %s", fleet.n, fleet_dir)
+    fleet.start(wait_ready=True)
+
+    server = make_fleet_http_server(fleet, port=0)
+    control_port = server.server_address[1]
+    # The frontend tier is the public surface: default it ON in fleet
+    # mode (a fleet without frontends would serve nothing).
+    workers = int(
+        environ.get("MISAKA_HTTP_WORKERS", "") or max(2, fleet.n)
+    )
+    # Plane connections per (worker, replica) pair: the fleet default is
+    # 1 for a multi-replica fleet — frame pipelining already comes from
+    # having N replicas, and a second connection per replica only splits
+    # each worker's backlog into smaller frames (measured: the 4-replica
+    # 64-client lane coalesces ~30% more values/s at 1 conn than 2).
+    # The single-plane default stays 2 (there, a second in-flight frame
+    # is the only pipelining).  MISAKA_PLANE_CONNS overrides either way.
+    plane_conns = int(
+        environ.get("MISAKA_PLANE_CONNS", "")
+        or (1 if fleet.n > 1 else 2)
+    )
+    supervisor = frontends.FrontendSupervisor(
+        workers,
+        public_port,
+        f"http://127.0.0.1:{control_port}",
+        ",".join(fleet.plane_paths()),
+        plane_conns=plane_conns,
+        fleet=True,  # a 1-replica fleet still needs the reroute grace
+    )
+    server.misaka_supervisor = supervisor
+    log.info(
+        "fleet up: %d replicas, control on 127.0.0.1:%d, %d frontend "
+        "workers on :%d", fleet.n, control_port, workers, public_port,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        supervisor.close()
+        fleet.close()

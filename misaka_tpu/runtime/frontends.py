@@ -71,6 +71,20 @@ M_FE_CONFIGURED = metrics.gauge(
     "misaka_frontend_workers_configured",
     "Frontend worker processes the pool is configured for (live supervisor)",
 )
+M_PLANE_FRAMES = metrics.counter(
+    "misaka_plane_frames_total",
+    "Compute-plane frames served by this engine replica",
+)
+M_PLANE_HEDGED = metrics.counter(
+    "misaka_plane_hedged_requests_total",
+    "Requests served here after being hedged away from a failed sibling "
+    "replica (fleet router failover)",
+)
+M_PLANE_DRAIN_REROUTES = metrics.counter(
+    "misaka_plane_drain_reroutes_total",
+    "Compute-plane frames answered with the drain reroute status "
+    "(the fleet router re-dispatches them to a sibling)",
+)
 
 # Compute-plane wire format (unix SOCK_STREAM, one frame in flight per
 # connection — pipelining comes from running several connections):
@@ -105,6 +119,19 @@ M_FE_CONFIGURED = metrics.gauge(
 # build; there is no cross-version frame compatibility to keep.
 _REQ_HDR = struct.Struct("<II")
 _RESP_HDR = struct.Struct("<iI")
+
+# Plane-private response status for a draining replica: not an HTTP code
+# on purpose — the FLEET ROUTER absorbs it by re-dispatching the frame's
+# requests onto a healthy sibling (zero client-visible errors during a
+# rolling restart); it must never leak to a client, and a single-replica
+# PlaneClient maps it to 503 if it ever sees one.  The frame metadata
+# may additionally carry {"probe": 1} (a zero-value health probe the
+# router's prober sends — answered 200/PLANE_DRAINING without touching
+# the engine) and {"hedged": k} (k requests in this frame were re-routed
+# here after a sibling failed — counted on
+# misaka_plane_hedged_requests_total so failovers are visible in the
+# aggregated fleet /metrics).
+PLANE_DRAINING = 599
 
 # One frame's value budget.  Big enough that a frontend's whole in-hand
 # backlog ships at once; small enough to bound engine-side buffering.
@@ -156,8 +183,14 @@ class ComputePlane:
     """
 
     def __init__(self, master, path: str, timeout: float = 30.0,
-                 registry=None):
+                 registry=None, replica_label: str | None = None):
         self._master = master
+        # which fleet replica this plane serves (scopes the
+        # replica_blackhole:<idx> chaos point; None outside a fleet)
+        self._replica_label = (
+            replica_label if replica_label is not None
+            else os.environ.get("MISAKA_FLEET_REPLICA") or None
+        )
         # the program registry (runtime/registry.py) when multi-program
         # serving is armed: frames then resolve their engine through a
         # registry lease (activating cold programs, parking through
@@ -173,6 +206,16 @@ class ComputePlane:
         self._sock.bind(path)
         self._sock.listen(64)
         self._closed = False
+        # Fleet drain support (runtime/fleet.py): while draining, new
+        # compute frames answer PLANE_DRAINING (the router re-dispatches
+        # them to a sibling) and `inflight` counts frames still being
+        # served — the roll waits for it to reach zero before replacing
+        # this replica.
+        self._draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="misaka-plane-accept"
         )
@@ -180,6 +223,21 @@ class ComputePlane:
 
     def close(self) -> None:
         self._closed = True
+        # closing (or even shutting down) the listening socket does NOT
+        # wake a thread already blocked in accept() on Linux — without a
+        # nudge every closed plane leaks its accept thread for the life
+        # of the process (enough of them measurably perturbed the
+        # timing-sensitive SLO suite).  A self-connect pops accept(),
+        # the loop re-checks _closed and exits.
+        try:
+            wake = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            wake.settimeout(0.5)
+            try:
+                wake.connect(self.path)
+            finally:
+                wake.close()
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -188,6 +246,31 @@ class ComputePlane:
             os.unlink(self.path)
         except OSError:
             pass
+        # sever live frontend connections too: a closed plane must look
+        # exactly like a dead replica (in-process chaos tests kill a
+        # replica this way; a real SIGKILL drops the sockets itself)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def set_draining(self, on: bool) -> None:
+        self._draining = bool(on)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def inflight(self) -> int:
+        """Compute frames currently being served (0 = plane quiescent)."""
+        return self._inflight
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -195,6 +278,8 @@ class ComputePlane:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # closed
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True,
                 name="misaka-plane-conn",
@@ -204,8 +289,10 @@ class ComputePlane:
         master = self._master
         registry = self._registry
 
-        def parse_meta(blob: bytes) -> tuple[str | None, list, list]:
-            """(program, traces, edge) from the frame's JSON metadata.
+        def parse_meta(blob: bytes) -> tuple[str | None, list, list, bool,
+                                             int]:
+            """(program, traces, edge, probe, hedged) from the frame's
+            JSON metadata.
 
             The program address must decode even with tracing killed; an
             UNDECODABLE blob raises _BadMeta and fails the frame (it may
@@ -221,15 +308,19 @@ class ComputePlane:
             also lenient: a malformed edge list costs the observation,
             never the frame."""
             if not blob:
-                return None, [], []
+                return None, [], [], False, 0
             import json as _json
 
+            probe = False
+            hedged = 0
             try:
                 obj = _json.loads(blob.decode())
                 if isinstance(obj, dict):
                     program = obj.get("program") or None
                     segs = obj.get("traces", ())
                     edge_raw = obj.get("edge", ())
+                    probe = bool(obj.get("probe"))
+                    hedged = int(obj.get("hedged") or 0)
                 elif isinstance(obj, list):
                     # the pre-registry traces-only list form
                     program, segs, edge_raw = None, obj, ()
@@ -262,7 +353,7 @@ class ComputePlane:
                     edge = [float(t0) for t0 in edge_raw]
                 except (ValueError, TypeError):
                     log.debug("dropping malformed plane edge metadata")
-            return program, traces, edge
+            return program, traces, edge, probe, hedged
 
         def slo_record(program, edge, t_recv, error: bool) -> None:
             """Feed the frame's outcome into the per-program SLO windows:
@@ -294,102 +385,153 @@ class ComputePlane:
                 raw = _recv_exact(conn, n * 4)
                 meta = _recv_exact(conn, n_meta) if n_meta else b""
                 try:
-                    program, traces, edge = parse_meta(meta)
+                    program, traces, edge, probe, hedged = parse_meta(meta)
                 except _BadMeta as e:
                     body = f"malformed plane metadata: {e}".encode()
                     conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
                     continue
-                t_recv = time.monotonic()
-                import numpy as np
+                if probe:
+                    # router health probe: liveness + drain state only,
+                    # zero engine work
+                    status = PLANE_DRAINING if self._draining else 200
+                    conn.sendall(_RESP_HDR.pack(status, 0))
+                    continue
+                # In-flight accounting STARTS before the drain check: a
+                # roll polls `inflight` after arming the drain, and a
+                # frame that passed the check un-counted could be missed
+                # by the quiescence wait.  Counted-then-drained frames
+                # just reroute (the finally decrements on `continue`).
+                with self._inflight_lock:
+                    self._inflight += 1
+                try:
+                    if self._draining:
+                        # rolling restart: hand this frame back to the
+                        # router, which re-dispatches it onto a healthy
+                        # sibling — the client never sees an error
+                        M_PLANE_DRAIN_REROUTES.inc()
+                        body = b"replica draining; reroute"
+                        conn.sendall(
+                            _RESP_HDR.pack(PLANE_DRAINING, len(body)) + body
+                        )
+                        for tr in traces:
+                            tracespan.end(tr, status=PLANE_DRAINING)
+                        continue
+                    bh = faults.fire("replica_blackhole")
+                    if bh is None and self._replica_label is not None:
+                        bh = faults.fire(
+                            f"replica_blackhole:{self._replica_label}"
+                        )
+                    if bh is not None:
+                        # chaos (utils/faults.py): hold the frame
+                        # unanswered — the router's frame deadline must
+                        # fire and hedge the requests onto a sibling
+                        log.warning(
+                            "replica_blackhole fault: holding frame %.1fs",
+                            bh,
+                        )
+                        time.sleep(max(0.0, bh))
+                    M_PLANE_FRAMES.inc()
+                    if hedged:
+                        M_PLANE_HEDGED.inc(hedged)
+                    t_recv = time.monotonic()
+                    import numpy as np
 
-                values = np.frombuffer(raw, dtype="<i4")
-                # Lease resolution FIRST, in its own try: only this step
-                # may answer 404 (ProgramNotFound is a KeyError subclass —
-                # this module stays registry-import-free).  A KeyError
-                # escaping the compute itself must stay a 500: classifying
-                # an engine bug as "program not found" would hide it from
-                # 5xx alerting.
-                lease_ctx = None
-                try:
-                    if registry is not None:
-                        # the registry lease: resolves the program (the
-                        # seeded default for None), activates cold
-                        # engines, parks through hot-swaps, and counts
-                        # the per-program metric series
-                        lease_ctx = registry.lease(
-                            program, values=int(values.size)
+                    values = np.frombuffer(raw, dtype="<i4")
+                    # Lease resolution FIRST, in its own try: only this
+                    # step may answer 404 (ProgramNotFound is a KeyError
+                    # subclass — this module stays registry-import-free).
+                    # A KeyError escaping the compute itself must stay a
+                    # 500: classifying an engine bug as "program not
+                    # found" would hide it from 5xx alerting.
+                    lease_ctx = None
+                    try:
+                        if registry is not None:
+                            # the registry lease: resolves the program
+                            # (the seeded default for None), activates
+                            # cold engines, parks through hot-swaps, and
+                            # counts the per-program metric series
+                            lease_ctx = registry.lease(
+                                program, values=int(values.size)
+                            )
+                            m = lease_ctx.__enter__()
+                        elif program:
+                            raise KeyError(
+                                f"program registry disabled; cannot "
+                                f"route to program {program!r}"
+                            )
+                        else:
+                            m = master
+                    except KeyError as e:
+                        # args[0] dodges KeyError's repr-quoting of its
+                        # message
+                        msg = e.args[0] if e.args and isinstance(
+                            e.args[0], str
+                        ) else str(e)
+                        body = msg.encode()
+                        conn.sendall(_RESP_HDR.pack(404, len(body)) + body)
+                        for tr in traces:
+                            tracespan.end(tr, status=404)
+                        continue
+                    except Exception as e:
+                        # activation failure (RegistryError, compile
+                        # error...)
+                        body = str(e).encode()
+                        conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
+                        slo_record(program, edge, t_recv, error=True)
+                        for tr in traces:
+                            tracespan.end(tr, status=500)
+                        continue
+                    try:
+                        if not m.is_running:
+                            raise _NotRunning()
+                        out = m.compute_coalesced(
+                            values, timeout=self._timeout,
+                            return_array=True, traces=tuple(traces),
                         )
-                        m = lease_ctx.__enter__()
-                    elif program:
-                        raise KeyError(
-                            f"program registry disabled; cannot "
-                            f"route to program {program!r}"
-                        )
-                    else:
-                        m = master
-                except KeyError as e:
-                    # args[0] dodges KeyError's repr-quoting of its message
-                    msg = e.args[0] if e.args and isinstance(
-                        e.args[0], str
-                    ) else str(e)
-                    body = msg.encode()
-                    conn.sendall(_RESP_HDR.pack(404, len(body)) + body)
-                    for tr in traces:
-                        tracespan.end(tr, status=404)
-                    continue
-                except Exception as e:
-                    # activation failure (RegistryError, compile error...)
-                    body = str(e).encode()
-                    conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
-                    slo_record(program, edge, t_recv, error=True)
-                    for tr in traces:
-                        tracespan.end(tr, status=500)
-                    continue
-                try:
-                    if not m.is_running:
-                        raise _NotRunning()
-                    out = m.compute_coalesced(
-                        values, timeout=self._timeout,
-                        return_array=True, traces=tuple(traces),
+                    except _NotRunning:
+                        # the route's 400 body
+                        body = b"network is not running"
+                        conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
+                        for tr in traces:
+                            tracespan.end(tr, status=400)
+                        continue
+                    except Exception as e:
+                        body = str(e).encode()
+                        conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
+                        slo_record(program, edge, t_recv, error=True)
+                        for tr in traces:
+                            tracespan.add_span(
+                                tr, "plane.recv", t_recv,
+                                time.monotonic() - t_recv,
+                            )
+                            tracespan.end(tr, status=500)
+                        continue
+                    finally:
+                        if lease_ctx is not None:
+                            lease_ctx.__exit__(None, None, None)
+                    payload = out.astype("<i4").tobytes()
+                    conn.sendall(
+                        _RESP_HDR.pack(200, len(payload) // 4) + payload
                     )
-                except _NotRunning:
-                    body = b"network is not running"  # the route's 400 body
-                    conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
-                    for tr in traces:
-                        tracespan.end(tr, status=400)
-                    continue
-                except Exception as e:
-                    body = str(e).encode()
-                    conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
-                    slo_record(program, edge, t_recv, error=True)
+                    slo_record(program, edge, t_recv, error=False)
+                    dur = time.monotonic() - t_recv
                     for tr in traces:
                         tracespan.add_span(
-                            tr, "plane.recv", t_recv,
-                            time.monotonic() - t_recv,
+                            tr, "plane.recv", t_recv, dur,
+                            {"frame_values": int(n)},
                         )
-                        tracespan.end(tr, status=500)
-                    continue
+                        tracespan.end(tr, status=200)
                 finally:
-                    if lease_ctx is not None:
-                        lease_ctx.__exit__(None, None, None)
-                payload = out.astype("<i4").tobytes()
-                conn.sendall(
-                    _RESP_HDR.pack(200, len(payload) // 4) + payload
-                )
-                slo_record(program, edge, t_recv, error=False)
-                dur = time.monotonic() - t_recv
-                for tr in traces:
-                    tracespan.add_span(
-                        tr, "plane.recv", t_recv, dur,
-                        {"frame_values": int(n)},
-                    )
-                    tracespan.end(tr, status=200)
+                    with self._inflight_lock:
+                        self._inflight -= 1
         except (ConnectionError, OSError) as e:
             # frontend went away; its requests fail on their side
             log.debug("compute-plane connection closed: %r", e)
         except Exception:  # pragma: no cover — must not die silently
             log.exception("compute-plane connection handler crashed")
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -397,8 +539,10 @@ class ComputePlane:
 
 
 def start_compute_plane(master, path: str, timeout: float = 30.0,
-                        registry=None) -> ComputePlane:
-    return ComputePlane(master, path, timeout=timeout, registry=registry)
+                        registry=None,
+                        replica_label: str | None = None) -> ComputePlane:
+    return ComputePlane(master, path, timeout=timeout, registry=registry,
+                        replica_label=replica_label)
 
 
 # --- frontend side ----------------------------------------------------------
@@ -415,9 +559,10 @@ class PlaneError(RuntimeError):
 
 class _PlaneRequest:
     __slots__ = ("body", "out", "error", "event", "cancelled", "trace",
-                 "enqueued", "program")
+                 "enqueued", "program", "hedged")
 
-    def __init__(self, body: bytes, trace=None, program=None):
+    def __init__(self, body: bytes, trace=None, program=None,
+                 hedged: bool = False):
         self.body = body          # raw little-endian int32 values
         self.out: bytes | None = None
         self.error: PlaneError | None = None
@@ -426,6 +571,7 @@ class _PlaneRequest:
         self.trace = trace        # request trace (utils/tracespan.py) | None
         self.enqueued = time.monotonic()  # frontend.coalesce span start
         self.program = program    # registry address (None = default program)
+        self.hedged = hedged      # re-routed here after a sibling failed
 
 
 class PlaneClient:
@@ -437,9 +583,11 @@ class PlaneClient:
     offset.  The mirror of the engine's ServeBatcher, one level out.
     """
 
-    def __init__(self, path: str, conns: int = 2, timeout: float = 60.0):
+    def __init__(self, path: str, conns: int = 2, timeout: float = 60.0,
+                 replica: int | None = None):
         self._path = path
         self._timeout = timeout
+        self.replica = replica  # fleet slot index (None = single engine)
         self._cond = threading.Condition()
         self._pending: deque[_PlaneRequest] = deque()
         self._closed = False
@@ -464,12 +612,22 @@ class PlaneClient:
             self._closed = True
             self._cond.notify_all()
 
+    def depth(self) -> int:
+        """Queued + in-flight frames on this client — the router's
+        least-queue-depth signal."""
+        with self._cond:
+            return len(self._pending) + self._inflight
+
     def compute_raw(self, body: bytes, timeout: float = 30.0,
-                    program: str | None = None) -> bytes:
+                    program: str | None = None,
+                    hedged: bool = False) -> bytes:
         """One request's raw int32 body in, raw int32 outputs out.
         `program` addresses a registry program (None = the seeded
-        default); frames coalesce strictly per program."""
-        req = _PlaneRequest(body, trace=tracespan.current(), program=program)
+        default); frames coalesce strictly per program.  `hedged` marks
+        a request re-routed here after a sibling replica failed (rides
+        the frame metadata into the replica's hedge counter)."""
+        req = _PlaneRequest(body, trace=tracespan.current(), program=program,
+                            hedged=hedged)
         with self._cond:
             self._pending.append(req)
             self._cond.notify()
@@ -542,6 +700,7 @@ class PlaneClient:
             meta = b""
             now = time.monotonic()
             traced = [r for r in batch if r.trace is not None]
+            hedged_count = sum(1 for r in batch if r.hedged)
             # Ship edge timestamps when THIS process sees objectives OR a
             # registry is configured: per-program overrides are installed
             # engine-side (slo.set_objectives on upload) and a frontend
@@ -554,7 +713,7 @@ class PlaneClient:
             slo_armed = slo.armed() or bool(
                 os.environ.get("MISAKA_PROGRAMS_DIR")
             )
-            if traced or program is not None or slo_armed:
+            if traced or program is not None or slo_armed or hedged_count:
                 import json as _json
 
                 entries = []
@@ -584,44 +743,362 @@ class PlaneClient:
                 obj = {"program": program, "traces": entries}
                 if edge:
                     obj["edge"] = edge
+                if hedged_count:
+                    obj["hedged"] = hedged_count
                 meta = _json.dumps(obj).encode()
             t_ship = now
-            try:
-                if sock is None:
-                    sock = self._connect()
-                sock.sendall(
-                    _REQ_HDR.pack(total // 4, len(meta))
-                    + b"".join(r.body for r in batch) + meta
-                )
-                status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
-                if status == 200:
-                    payload = _recv_exact(sock, length * 4)
-                    off = 0
-                    for r in batch:
-                        r.out = payload[off:off + len(r.body)]
-                        off += len(r.body)
-                else:
-                    err = PlaneError(status, _recv_exact(sock, length))
+            frame = (
+                _REQ_HDR.pack(total // 4, len(meta))
+                + b"".join(r.body for r in batch) + meta
+            )
+            # One stale-socket replay, the client-pool discipline
+            # (client.py retry_stale) one level down: a REUSED plane
+            # connection that fails is most often a replica that
+            # restarted between frames — retry once on a fresh dial
+            # before failing the batch (which in fleet mode would mark
+            # the whole replica down and hedge for nothing).
+            for attempt in (0, 1):
+                reused = sock is not None
+                try:
+                    if sock is None:
+                        sock = self._connect()
+                    sock.sendall(frame)
+                    status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
+                    if status == 200:
+                        payload = _recv_exact(sock, length * 4)
+                        off = 0
+                        for r in batch:
+                            r.out = payload[off:off + len(r.body)]
+                            off += len(r.body)
+                    else:
+                        err = PlaneError(status, _recv_exact(sock, length))
+                        if status == PLANE_DRAINING and self.replica is None:
+                            # plane-private status: a single-engine client
+                            # has no sibling to reroute to — surface as a
+                            # retryable 503 (the fleet router intercepts
+                            # the raw status before this mapping matters)
+                            err = PlaneError(503, err.body)
+                        for r in batch:
+                            r.error = err
+                    dur = time.monotonic() - t_ship
+                    ship_attrs = (
+                        {"replica": self.replica}
+                        if self.replica is not None else None
+                    )
+                    for r in traced:
+                        tracespan.add_span(r.trace, "plane.ship", t_ship,
+                                           dur, ship_attrs)
+                except (ConnectionError, OSError, struct.error) as e:
+                    try:
+                        if sock is not None:
+                            sock.close()
+                    except OSError:
+                        pass
+                    sock = None  # reconnect on the next frame
+                    if (
+                        reused and attempt == 0
+                        and not isinstance(e, TimeoutError)
+                    ):
+                        # (a TIMEOUT is not a stale socket — the replica
+                        # is slow or silent; replaying would double the
+                        # stall while the waiter has already hedged)
+                        continue
+                    err = PlaneError(
+                        502, f"compute plane error: {e}".encode()
+                    )
                     for r in batch:
                         r.error = err
-                dur = time.monotonic() - t_ship
-                for r in traced:
-                    tracespan.add_span(r.trace, "plane.ship", t_ship, dur)
-            except (ConnectionError, OSError, struct.error) as e:
-                try:
-                    if sock is not None:
-                        sock.close()
-                except OSError:
-                    pass
-                sock = None  # reconnect on the next frame
-                err = PlaneError(502, f"compute plane error: {e}".encode())
-                for r in batch:
-                    r.error = err
+                break
             with self._cond:
                 self._inflight -= 1
                 self._cond.notify()  # a window-waiting dispatcher can go
             for r in batch:
                 r.event.set()
+
+
+class _RouterReplica:
+    """One replica slot as the router sees it: a PlaneClient plus a
+    health state the prober keeps fresh."""
+
+    __slots__ = ("idx", "path", "client", "state", "since",
+                 "suspect_until", "suspect_streak")
+
+    def __init__(self, idx: int, path: str, client: PlaneClient):
+        self.idx = idx
+        self.path = path
+        self.client = client
+        # optimistic start: the first real frame corrects a wrong "up"
+        # within one round trip, while a pessimistic start would refuse
+        # traffic until the prober's first pass
+        self.state = "up"          # "up" | "down" | "draining"
+        self.since = time.monotonic()
+        # frame-failure hold-down (see suspect()): until this instant a
+        # probe success alone may not readmit the replica
+        self.suspect_until = 0.0
+        self.suspect_streak = 0
+
+    def mark(self, state: str) -> None:
+        if self.state != state:
+            self.state = state
+            self.since = time.monotonic()
+
+    def suspect(self, hold_base: float) -> None:
+        """A REAL frame failed here (transport error or frame deadline):
+        mark down and hold the replica out of probe readmission on a
+        doubling backoff.  The probe path touches nothing but the plane
+        socket, so a wedged-but-alive engine (grey failure) still
+        answers probes instantly — without this hold the prober would
+        flip it back "up" every probe_s and the hash ring would keep
+        handing it every sticky request's first half-deadline."""
+        now = time.monotonic()
+        if now < self.suspect_until:
+            # Escalate once per failure EVENT, not per request: one
+            # failed frame fans out to every caller it coalesced, and
+            # 64 concurrent suspects would jump the doubling curve
+            # (0.5s, 1s, 2s...) straight to the 30s cap on a single
+            # stall.  Failures landing inside the current hold are the
+            # same event; only a failure after the hold expired proves
+            # the replica is still bad and doubles it.
+            self.mark("down")
+            return
+        self.suspect_streak += 1
+        hold = min(30.0, hold_base * (2 ** (self.suspect_streak - 1)))
+        self.suspect_until = now + hold
+        self.mark("down")
+
+    def absolve(self) -> None:
+        """Frame-failure history no longer applies: a frame was served
+        here, or the plane stopped accepting (the process is dead —
+        whatever accepts next is a fresh replacement)."""
+        self.suspect_streak = 0
+        self.suspect_until = 0.0
+
+
+class FleetPlaneRouter:
+    """Routes requests across N engine-replica compute planes.
+
+    The data-parallel router of the fleet plane (runtime/fleet.py): one
+    PlaneClient (local coalescer + persistent connections) per replica,
+    and a policy layer deciding which replica each request rides:
+
+      * program-addressed requests follow the consistent-hash ring on
+        the program name (sticky per-program coalescing and registry
+        engine state; only ~1/N of the keyspace moves when a replica
+        joins or leaves);
+      * stateless requests go to the healthy replica with the LEAST
+        local queue depth, ties broken by lowest index (deterministic);
+      * a replica that fails a frame (transport error, frame deadline,
+        or the drain reroute status) is marked unhealthy and the request
+        is HEDGED onto the next healthy candidate — each attempt rides
+        the remaining request deadline, and re-routed requests are
+        flagged in frame metadata so the serving replica's
+        misaka_plane_hedged_requests_total makes failovers visible;
+      * when NO replica is healthy the router keeps probing for
+        `down_grace` seconds (riding out a supervisor respawn or a
+        1-replica roll), then answers a typed 503 — the only way a
+        client ever sees the fleet's internals fail.
+
+    A background prober revives replicas: a zero-cost probe frame
+    against each non-up replica's plane socket flips it back to "up"
+    the moment a replacement binds and serves — re-admission after a
+    kill or a roll needs no coordination beyond the socket itself.
+    One exception: a replica marked down by a REAL frame failure sits
+    out a doubling hold (`suspect_hold` base, 30s cap) before a probe
+    success may readmit it, because probes cannot distinguish a healthy
+    engine from a wedged-but-alive one; a probe that finds the socket
+    dead resets the hold (the replacement is a fresh process), so
+    crash/kill recovery readmits at probe speed.
+    """
+
+    #: plane statuses that mean "this replica cannot serve this frame,
+    #: a sibling can": transport failure maps to 502 inside PlaneClient,
+    #: PLANE_DRAINING is the roll's reroute signal
+    REROUTE_STATUSES = frozenset({502, PLANE_DRAINING})
+
+    def __init__(self, paths: list[str], conns: int = 2,
+                 timeout: float = 60.0, probe_s: float = 0.25,
+                 down_grace: float | None = None,
+                 suspect_hold: float = 0.5):
+        from misaka_tpu.runtime.fleet import HashRing
+
+        if not paths:
+            raise ValueError("FleetPlaneRouter needs at least one path")
+        self._replicas = [
+            _RouterReplica(i, p, PlaneClient(p, conns=conns,
+                                             timeout=timeout, replica=i))
+            for i, p in enumerate(paths)
+        ]
+        self._ring = HashRing(range(len(paths)))
+        self._probe_s = float(probe_s)
+        if down_grace is None:
+            down_grace = float(
+                os.environ.get("MISAKA_FLEET_DOWN_GRACE_S", "") or 5.0
+            )
+        self._down_grace = float(down_grace)
+        self._suspect_hold = float(suspect_hold)
+        self._closed = False
+        threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name="misaka-fleet-router-probe",
+        ).start()
+
+    def close(self) -> None:
+        self._closed = True
+        for r in self._replicas:
+            r.client.close()
+
+    def states(self) -> dict[int, str]:
+        return {r.idx: r.state for r in self._replicas}
+
+    # --- health probing -----------------------------------------------------
+
+    def _probe_once(self, r: _RouterReplica) -> str:
+        """One probe frame against `r`'s plane socket: "up", "draining",
+        or "down" as observed right now."""
+        try:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(1.0)
+            try:
+                sock.connect(r.path)
+                meta = b'{"probe": 1}'
+                sock.sendall(_REQ_HDR.pack(0, len(meta)) + meta)
+                status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
+                if length:
+                    _recv_exact(sock, length)
+            finally:
+                sock.close()
+        except OSError:
+            return "down"
+        if status == 200:
+            return "up"
+        if status == PLANE_DRAINING:
+            return "draining"
+        return "down"
+
+    def _probe_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self._probe_s)
+            for r in self._replicas:
+                if r.state == "up":
+                    continue
+                observed = self._probe_once(r)
+                if observed == "down":
+                    # an unreachable plane is a dead process: whatever
+                    # accepts next is a fresh replacement, so the
+                    # frame-failure hold stops applying
+                    r.absolve()
+                elif (observed == "up"
+                        and time.monotonic() < r.suspect_until):
+                    # a probe success is weaker evidence than the real
+                    # frame that just failed here — hold the replica
+                    # out (see _RouterReplica.suspect)
+                    continue
+                r.mark(observed)
+
+    # --- routing ------------------------------------------------------------
+
+    def _candidates(self, program: str | None,
+                    tried: set[int]) -> list[_RouterReplica]:
+        """Healthy replicas in preference order: hash-ring walk for a
+        program-addressed request (stickiness), least-queue-depth with
+        index tie-break otherwise."""
+        up = [r for r in self._replicas
+              if r.state == "up" and r.idx not in tried]
+        if not up:
+            return []
+        if program:
+            by_idx = {r.idx: r for r in up}
+            key = program.partition("@")[0]
+            return [by_idx[i] for i in self._ring.lookup(key)
+                    if i in by_idx]
+        return sorted(up, key=lambda r: (r.client.depth(), r.idx))
+
+    def compute_raw(self, body: bytes, timeout: float = 30.0,
+                    program: str | None = None) -> bytes:
+        deadline = time.monotonic() + timeout
+        tried: set[int] = set()
+        hedged = False
+        last_err: PlaneError | None = None
+        while True:
+            cands = self._candidates(program, tried)
+            if not cands:
+                # no healthy untried replica: forget attempt history (a
+                # replica the prober readmits mid-wait must be eligible
+                # even though we tried it — with one replica, `tried`
+                # would otherwise mask its OWN recovery forever) and
+                # ride out a respawn window before answering the typed
+                # fleet-down 503
+                tried = set()
+                grace_end = min(
+                    deadline, time.monotonic() + self._down_grace
+                )
+                cands = self._candidates(program, tried)
+                while not cands and time.monotonic() < grace_end:
+                    time.sleep(0.05)
+                    cands = self._candidates(program, tried)
+                if not cands:
+                    detail = (
+                        last_err.body.decode(errors="replace")
+                        if last_err is not None else "no replica up"
+                    )
+                    raise PlaneError(
+                        503,
+                        f"fleet down: no healthy engine replica "
+                        f"({detail})".encode(),
+                    )
+            r = cands[0]
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                if last_err is None:
+                    raise PlaneError(500, b"compute plane timed out")
+                if last_err.status == PLANE_DRAINING:
+                    # the plane-private reroute status must never reach
+                    # a client — a deadline eaten by drain reroutes is a
+                    # retryable unavailability, not a protocol status
+                    raise PlaneError(
+                        503, b"fleet draining: " + last_err.body
+                    )
+                raise last_err
+            # Hedge budget: while another candidate remains untried, an
+            # attempt only gets HALF the remaining deadline — a silent
+            # (blackholed) replica must leave time to hedge the request
+            # onto a sibling instead of eating the whole budget.  The
+            # last candidate gets everything left.
+            more = len(cands) > 1
+            attempt_timeout = remaining / 2 if more else remaining
+            try:
+                out = r.client.compute_raw(
+                    body, timeout=attempt_timeout, program=program,
+                    hedged=hedged,
+                )
+                r.absolve()  # a served frame clears the hold-down
+                return out
+            except PlaneError as e:
+                if e.status in self.REROUTE_STATUSES:
+                    if e.status == PLANE_DRAINING:
+                        # a drain reroute is ROUTINE (every roll does
+                        # it) and already counted on the draining
+                        # replica's misaka_plane_drain_reroutes_total —
+                        # flagging it hedged too would make the hedge
+                        # counter (documented as "a sibling is FAILING
+                        # frames", alert-worthy) fire on every deploy
+                        r.mark("draining")
+                    else:
+                        r.suspect(self._suspect_hold)
+                        hedged = True
+                    tried.add(r.idx)
+                    last_err = e
+                    continue
+                if e.status == 500 and e.body == b"compute plane timed out":
+                    # the frame deadline (a blackholed or wedged replica):
+                    # hedge like a transport failure, but the retry only
+                    # has whatever deadline remains
+                    r.suspect(self._suspect_hold)
+                    tried.add(r.idx)
+                    hedged = True
+                    last_err = e
+                    continue
+                raise  # an engine-level answer (400/404/413/500): final
 
 
 class _ReusePortHTTPServer(ThreadingHTTPServer):
@@ -641,11 +1118,18 @@ def make_frontend_server(
     plane_path: str,
     plane_conns: int = 2,
     max_body: int | None = None,
+    fleet: bool | None = None,
 ) -> ThreadingHTTPServer:
     """Build one frontend worker's HTTP server (call serve_forever on it).
 
     Hot routes answer from the compute plane; everything else proxies to
-    the engine's own HTTP server at `engine_url`.
+    the engine's own HTTP server at `engine_url` (the fleet control
+    server in fleet mode).  `plane_path` may be a comma-separated list
+    of replica plane sockets — the worker then routes across them with
+    the FleetPlaneRouter (health-gated least-queue-depth + program hash
+    ring + hedged failover).  `fleet=True` forces the router even for a
+    single path (a 1-replica fleet still needs the drain-reroute grace
+    during rolls); the default infers it from the path count.
     """
     import http.client
     from urllib.parse import urlsplit
@@ -654,7 +1138,13 @@ def make_frontend_server(
         max_body = int(
             os.environ.get("MISAKA_MAX_BODY", "") or 64 * 1024 * 1024
         )
-    plane = PlaneClient(plane_path, conns=plane_conns)
+    paths = [p for p in plane_path.split(",") if p]
+    if fleet is None:
+        fleet = len(paths) > 1
+    if fleet:
+        plane = FleetPlaneRouter(paths, conns=plane_conns)
+    else:
+        plane = PlaneClient(paths[0], conns=plane_conns)
     engine = urlsplit(engine_url)
     engine_host = engine.hostname or "127.0.0.1"
     engine_port = engine.port or 8000
@@ -879,6 +1369,31 @@ def make_frontend_server(
                 # response headers (queue/pass phases, deprecations) come
                 # back verbatim below
                 headers[tracespan.TRACE_HEADER] = tr.trace_id
+            if self.path.split("?", 1)[0] == "/fleet/roll":
+                # a synchronous roll pays one full engine boot per
+                # replica (tens of seconds each) and can far outlive the
+                # pooled 60s proxy timeout — which would answer 502
+                # while the roll keeps running invisibly (a retry then
+                # 409s).  Give it a dedicated unpooled connection with
+                # the client-side budget (client.fleet_roll passes up
+                # to 480s).
+                conn = http.client.HTTPConnection(
+                    engine_host, engine_port, timeout=600
+                )
+                try:
+                    conn.request(method, self.path, body or None, headers)
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                except (http.client.HTTPException, OSError) as e:
+                    self._text(502, f"engine unreachable: {e}")
+                    return
+                finally:
+                    conn.close()
+                self._reply(
+                    resp.status, payload,
+                    resp.getheader("Content-Type") or "text/plain",
+                )
+                return
             for attempt in (0, 1):
                 conn = getattr(local, "engine_conn", None)
                 fresh = conn is None
@@ -923,8 +1438,15 @@ def frontend_main(argv=None) -> int:
     parser.add_argument("--engine", required=True,
                         help="engine HTTP base url (proxy target)")
     parser.add_argument("--plane", required=True,
-                        help="compute-plane unix socket path")
+                        help="compute-plane unix socket path (comma-"
+                        "separated list in fleet mode: one per replica)")
     parser.add_argument("--plane-conns", type=int, default=2)
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="route across the plane paths with the fleet router even "
+        "when only one is given (rolling restarts need the reroute "
+        "grace); implied by multiple --plane paths",
+    )
     parser.add_argument(
         "--parent-pid", type=int, default=0,
         help="exit when this process disappears (spawn_frontends sets it: "
@@ -966,7 +1488,8 @@ def frontend_main(argv=None) -> int:
 
         threading.Thread(target=_watch_parent, daemon=True).start()
     httpd = make_frontend_server(
-        args.port, args.engine, args.plane, plane_conns=args.plane_conns
+        args.port, args.engine, args.plane, plane_conns=args.plane_conns,
+        fleet=True if args.fleet else None,
     )
     log.info("frontend worker on :%d (engine %s)", args.port, args.engine)
     try:
@@ -977,9 +1500,10 @@ def frontend_main(argv=None) -> int:
 
 
 def _worker_cmd(
-    public_port: int, engine_url: str, plane_path: str, plane_conns: int
+    public_port: int, engine_url: str, plane_path: str, plane_conns: int,
+    fleet: bool = False,
 ) -> list[str]:
-    return [
+    cmd = [
         sys.executable, "-m", "misaka_tpu.runtime.frontends",
         "--port", str(public_port),
         "--engine", engine_url,
@@ -987,6 +1511,9 @@ def _worker_cmd(
         "--plane-conns", str(plane_conns),
         "--parent-pid", str(os.getpid()),
     ]
+    if fleet:
+        cmd.append("--fleet")
+    return cmd
 
 
 def spawn_frontends(
@@ -995,6 +1522,7 @@ def spawn_frontends(
     engine_url: str,
     plane_path: str,
     plane_conns: int = 2,
+    fleet: bool = False,
 ) -> list[subprocess.Popen]:
     """Start n UNSUPERVISED frontend worker processes sharing `public_port`
     (benches and tests that own process lifetimes themselves; production
@@ -1006,7 +1534,7 @@ def spawn_frontends(
     """
     return [
         subprocess.Popen(_worker_cmd(public_port, engine_url, plane_path,
-                                     plane_conns))
+                                     plane_conns, fleet=fleet))
         for _ in range(n)
     ]
 
@@ -1047,9 +1575,10 @@ class FrontendSupervisor:
         breaker_threshold: int = 5,
         breaker_reset_s: float = 60.0,
         poll_s: float = 0.2,
+        fleet: bool = False,
     ):
         self._cmd = _worker_cmd(public_port, engine_url, plane_path,
-                                plane_conns)
+                                plane_conns, fleet=fleet)
         # used statelessly (delay_for): the exponent is each slot's
         # consecutive-fast-crash streak, not a global attempt counter
         self._backoff = Backoff(base=backoff_base, cap=backoff_cap)

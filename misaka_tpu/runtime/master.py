@@ -3323,6 +3323,34 @@ def make_http_server(
                         self._text(400, f"error publishing program: {e}")
                         return
                     self._json(result)
+                elif path == "/fleet/drain":
+                    # Fleet-roll drain control (runtime/fleet.py): arm or
+                    # disarm drain on this replica's compute plane and
+                    # report quiescence.  While draining, the plane
+                    # answers new frames with the reroute status (the
+                    # fleet router shifts them to siblings with zero
+                    # client-visible errors); the roll polls this route
+                    # until both in-flight counts reach zero before
+                    # checkpointing and replacing the process.
+                    form = self._form()  # body first (keep-alive)
+                    plane = getattr(self.server, "misaka_plane", None)
+                    if plane is None:
+                        self._text(
+                            404,
+                            "no compute plane on this server (a fleet "
+                            "replica runs with MISAKA_PLANE_SERVE=1)",
+                        )
+                        return
+                    on = form.get("state", "on") != "off"
+                    plane.set_draining(on)
+                    # the in-flight gauge counts THIS request too
+                    self._json({
+                        "draining": on,
+                        "inflight": plane.inflight(),
+                        "http_inflight": max(
+                            0, int(M_HTTP_INFLIGHT.value) - 1
+                        ),
+                    })
                 elif path == "/checkpoint":
                     # additive routes: the reference cannot checkpoint
                     name = self._form().get("name", "")  # body first

@@ -53,6 +53,24 @@ Named points (the hook sites live next to the code they break):
                     registry program's passes — the per-tenant SLO chaos
                     scenario (one tenant pages on /debug/alerts, its
                     neighbors stay green; tests/test_slo.py).
+  replica_kill    — the fleet manager SIGKILLs one live engine replica
+                    `value` seconds after fleet start (runtime/fleet.py
+                    FleetManager.start, fired once per boot): the
+                    kill(9)-under-load failover scenario — the router
+                    must hedge in-flight frames onto siblings with zero
+                    client-visible errors and the supervisor must
+                    respawn the replica.
+  replica_blackhole — an engine replica's compute plane HOLDS each
+                    frame unanswered for `value` seconds before serving
+                    it (runtime/frontends.py ComputePlane): the
+                    grey-failure twin of replica_kill — the process is
+                    alive but silent, so the router's frame deadline
+                    (not a connection error) must trip the hedge.  The
+                    scoped form `replica_blackhole:<idx>` blackholes
+                    ONLY the fleet replica with that MISAKA_FLEET_REPLICA
+                    index — siblings stay healthy, which is exactly what
+                    the hedge contract is tested against.  Use @prob to
+                    blackhole a fraction of frames.
 
 Fault checks are zero-cost when nothing is armed (`fire` returns None
 after one dict lookup on an empty dict); the module imports stdlib only —
@@ -73,6 +91,8 @@ POINTS = frozenset({
     "ckpt_crash",
     "swap_during_load",
     "serve_delay",
+    "replica_kill",
+    "replica_blackhole",
 })
 
 # Points that accept a ":<qualifier>" suffix scoping the fault to one
@@ -80,7 +100,7 @@ POINTS = frozenset({
 # registry program's serve passes (runtime/master.py ServeBatcher) — the
 # per-tenant SLO chaos scenario, where one program must page while its
 # neighbors stay green.
-SCOPED_POINTS = frozenset({"serve_delay"})
+SCOPED_POINTS = frozenset({"serve_delay", "replica_blackhole"})
 
 
 class FaultSpecError(ValueError):

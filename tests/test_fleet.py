@@ -1,0 +1,1021 @@
+"""The replicated engine fleet (runtime/fleet.py + the frontend router).
+
+Fast lane: routing invariants (consistent-hash stability under
+join/leave, least-queue-depth tie-breaking, typed fleet-down 503, drain
+reroute, scoped blackhole hedging), the stdlib manifest verifier, the
+metrics relabeler, and the /fleet/drain HTTP surface — all in-process
+against real ComputePlanes over stub engines (no jax boot per replica).
+
+Slow lane: the acceptance scenario against a REAL subprocess fleet —
+kill -9 of one replica under 64 pooled concurrent clients with zero
+client-visible errors, then a full POST /fleet/roll across every
+replica under the same load losing zero requests, with bit-identical
+per-replica checkpoint restore (the PR 6 np.load comparison pattern).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from misaka_tpu.runtime import frontends
+from misaka_tpu.runtime.fleet import (
+    HashRing,
+    relabel_metrics_text,
+    verify_manifest,
+)
+from misaka_tpu.utils import faults, metrics
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.configure(None)
+
+
+# --- consistent hashing -----------------------------------------------------
+
+
+def test_hash_ring_covers_and_is_deterministic():
+    ring = HashRing(range(4))
+    order = ring.lookup("tenant-a")
+    assert sorted(order) == [0, 1, 2, 3]  # every replica, exactly once
+    assert order == ring.lookup("tenant-a")  # deterministic
+    assert HashRing(range(4)).lookup("tenant-a") == order  # across builds
+
+
+def test_hash_ring_spreads_keys():
+    ring = HashRing(range(4))
+    owners = [ring.owner(f"prog-{i}") for i in range(2000)]
+    counts = {r: owners.count(r) for r in range(4)}
+    # perfect split is 500 each; vnode hashing keeps every replica well
+    # inside [250, 750]
+    assert all(250 < c < 750 for c in counts.values()), counts
+
+
+def test_hash_ring_leave_moves_only_departed_keys():
+    """The stickiness contract: removing one replica from an N-ring
+    remaps ONLY the keys it owned (~1/N); every other key keeps its
+    owner — per-program engine state survives fleet churn."""
+    keys = [f"prog-{i}" for i in range(2000)]
+    before = {k: HashRing(range(4)).owner(k) for k in keys}
+    after = {k: HashRing([0, 1, 3]).owner(k) for k in keys}  # 2 leaves
+    moved_wrongly = [
+        k for k in keys if before[k] != 2 and after[k] != before[k]
+    ]
+    assert moved_wrongly == []
+    departed = [k for k in keys if before[k] == 2]
+    assert departed  # replica 2 owned a real share
+    assert all(after[k] != 2 for k in keys)
+
+
+def test_hash_ring_join_moves_about_one_fifth():
+    keys = [f"prog-{i}" for i in range(2000)]
+    before = {k: HashRing(range(4)).owner(k) for k in keys}
+    after = {k: HashRing(range(5)).owner(k) for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    # a 5th replica should claim ~1/5 of the keyspace, not reshuffle it
+    assert 0.05 < moved / len(keys) < 0.40, moved
+
+
+# --- the in-process fleet harness -------------------------------------------
+
+
+class _StubMaster:
+    """A jax-free engine twin for the ComputePlane: values + 2, with
+    frame/value counters and an optional per-call delay.  `calls` counts
+    FRAMES (the PlaneClient coalesces many requests into one frame);
+    `values` counts every int32 served."""
+
+    is_running = True
+
+    def __init__(self, delay: float = 0.0):
+        self.calls = 0
+        self.values = 0
+        self.delay = delay
+        self._lock = threading.Lock()
+
+    def compute_coalesced(self, values, timeout=30.0, return_array=True,
+                          traces=()):
+        with self._lock:
+            self.calls += 1
+            self.values += int(np.asarray(values).size)
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(values) + 2
+
+
+class _StubRegistry:
+    """Just enough registry for program-addressed routing tests: every
+    program resolves to the replica's one stub master."""
+
+    def __init__(self, master):
+        self._master = master
+
+    def lease(self, program, values=0):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            yield self._master
+
+        return _cm()
+
+
+def _stub_fleet(tmp_path, n=2, delay=0.0, **router_kw):
+    masters = [_StubMaster(delay=delay) for _ in range(n)]
+    planes = [
+        frontends.start_compute_plane(
+            masters[i], str(tmp_path / f"plane-{i}.sock"),
+            registry=_StubRegistry(masters[i]),
+            replica_label=str(i),
+        )
+        for i in range(n)
+    ]
+    router_kw.setdefault("down_grace", 0.3)
+    router = frontends.FleetPlaneRouter(
+        [p.path for p in planes], **router_kw
+    )
+    return masters, planes, router
+
+
+BODY = np.arange(8, dtype=np.int32).tobytes()
+WANT = np.arange(8, dtype=np.int32) + 2
+
+
+def _check(out):
+    assert np.array_equal(np.frombuffer(out, dtype="<i4"), WANT)
+
+
+def test_router_least_depth_tie_breaks_to_lowest_index(tmp_path):
+    masters, planes, router = _stub_fleet(tmp_path, n=3)
+    try:
+        # idle fleet: every depth is 0, the tie-break must be
+        # deterministic (lowest index), so sequential traffic is stable
+        cands = router._candidates(None, set())
+        assert [r.idx for r in cands] == [0, 1, 2]
+        _check(router.compute_raw(BODY, timeout=5))
+        assert masters[0].calls == 1 and masters[1].calls == 0
+        # load replica 0's queue: the next choice must prefer the others
+        router._replicas[0].client._inflight += 1
+        try:
+            cands = router._candidates(None, set())
+            assert [r.idx for r in cands][0] == 1
+        finally:
+            router._replicas[0].client._inflight -= 1
+    finally:
+        router.close()
+        for p in planes:
+            p.close()
+
+
+def test_router_program_traffic_is_sticky(tmp_path):
+    masters, planes, router = _stub_fleet(tmp_path, n=3)
+    try:
+        for _ in range(12):
+            _check(router.compute_raw(BODY, timeout=5, program="tenant-a"))
+        served = [m.calls for m in masters]
+        assert sorted(served) == [0, 0, 12], served  # one replica only
+        # a different program may land elsewhere, but is itself sticky
+        for _ in range(6):
+            _check(router.compute_raw(BODY, timeout=5, program="tenant-b"))
+        assert sum(m.calls for m in masters) == 18
+        assert sum(1 for m in masters if m.calls) <= 2
+    finally:
+        router.close()
+        for p in planes:
+            p.close()
+
+
+def test_router_failover_under_concurrent_load_zero_errors(tmp_path):
+    """Kill one replica's plane mid-load: every in-flight and subsequent
+    request is hedged onto the sibling — zero client-visible errors, and
+    the dead replica is marked down."""
+    masters, planes, router = _stub_fleet(tmp_path, n=2, delay=0.002)
+    errors: list[Exception] = []
+
+    def worker(n):
+        try:
+            for _ in range(n):
+                _check(router.compute_raw(BODY, timeout=10))
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(25,)) for _ in range(12)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        planes[1].close()  # the in-process kill -9
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == []
+        # every request's values were served at least once (a frame the
+        # dying replica computed but never answered is re-served by the
+        # hedge — duplicates allowed, losses never)
+        assert masters[0].values + masters[1].values >= 12 * 25 * 8
+        assert masters[0].values > 0  # the survivor took the failover
+        assert router.states()[1] == "down"
+    finally:
+        router.close()
+        for p in planes:
+            p.close()
+
+
+def test_router_readmits_restarted_replica(tmp_path):
+    masters, planes, router = _stub_fleet(tmp_path, n=2, probe_s=0.05)
+    try:
+        planes[1].close()
+        # The router starts optimistic and only learns from traffic: tilt
+        # the depth tie-break toward the dead replica so a frame actually
+        # hits it (idle traffic would pile onto replica 0 and never
+        # notice), then watch the hedge mark it down.
+        router._replicas[0].client._inflight += 1
+        try:
+            _check(router.compute_raw(BODY, timeout=5))
+        finally:
+            router._replicas[0].client._inflight -= 1
+        assert router.states()[1] == "down"
+        # a replacement binds the SAME path: the prober readmits it with
+        # no coordination beyond the socket itself
+        m2 = _StubMaster()
+        p2 = frontends.start_compute_plane(m2, planes[1].path)
+        try:
+            deadline = time.monotonic() + 5
+            while router.states()[1] != "up" and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert router.states()[1] == "up"
+        finally:
+            p2.close()
+    finally:
+        router.close()
+        planes[0].close()
+
+
+def test_router_drain_reroutes_with_zero_errors(tmp_path):
+    """The roll's drain step: a draining replica answers PLANE_DRAINING,
+    the router absorbs it (no client-visible error) and shifts traffic
+    to siblings; inflight reaches zero."""
+    masters, planes, router = _stub_fleet(tmp_path, n=2, delay=0.002)
+    errors: list[Exception] = []
+
+    def worker(n):
+        try:
+            for _ in range(n):
+                _check(router.compute_raw(BODY, timeout=10))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(20,)) for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        planes[0].set_draining(True)
+        deadline = time.monotonic() + 5
+        while planes[0].inflight() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert planes[0].inflight() == 0  # drained to quiescence
+        calls_at_drain = masters[0].calls
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == []
+        assert masters[0].calls == calls_at_drain  # nothing after drain
+        assert masters[1].calls > 0
+        assert router.states()[0] == "draining"
+        # undrain: the prober readmits without reconnection churn
+        planes[0].set_draining(False)
+        deadline = time.monotonic() + 5
+        while router.states()[0] != "up" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.states()[0] == "up"
+    finally:
+        router.close()
+        for p in planes:
+            p.close()
+
+
+def test_router_single_replica_readmits_inside_grace(tmp_path):
+    """A 1-replica fleet mid-roll: every candidate has been tried, so
+    the down-grace wait must FORGET attempt history — the one replica's
+    own recovery (prober flips draining back to up) has to satisfy the
+    request, not a guaranteed 503."""
+    masters, planes, router = _stub_fleet(
+        tmp_path, n=1, probe_s=0.05, down_grace=5.0
+    )
+    try:
+        planes[0].set_draining(True)
+
+        def undrain():
+            time.sleep(0.4)
+            planes[0].set_draining(False)
+
+        threading.Thread(target=undrain, daemon=True).start()
+        _check(router.compute_raw(BODY, timeout=10))  # no 503
+        assert masters[0].calls >= 1
+    finally:
+        router.close()
+        for p in planes:
+            p.close()
+
+
+def test_router_draining_fleet_maps_to_503_never_599(tmp_path):
+    """The plane-private PLANE_DRAINING status must never reach a
+    caller: a fleet that stays draining past the request deadline
+    answers a retryable 503."""
+    masters, planes, router = _stub_fleet(
+        tmp_path, n=1, probe_s=0.05, down_grace=30.0
+    )
+    try:
+        planes[0].set_draining(True)
+        with pytest.raises(frontends.PlaneError) as exc:
+            router.compute_raw(BODY, timeout=1.0)
+        assert exc.value.status == 503
+        assert exc.value.status != frontends.PLANE_DRAINING
+    finally:
+        router.close()
+        for p in planes:
+            p.close()
+
+
+def test_router_fleet_down_is_typed_503(tmp_path):
+    masters, planes, router = _stub_fleet(tmp_path, n=2, down_grace=0.2)
+    try:
+        for p in planes:
+            p.close()
+        t0 = time.monotonic()
+        with pytest.raises(frontends.PlaneError) as exc:
+            router.compute_raw(BODY, timeout=5)
+        assert exc.value.status == 503
+        assert b"fleet down" in exc.value.body
+        # bounded: the grace window, not the full request timeout
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        router.close()
+
+
+def test_router_hedges_scoped_blackhole(tmp_path):
+    """replica_blackhole:<idx> holds frames on ONE replica; the router's
+    split deadline hedges onto the healthy sibling well inside the
+    request budget."""
+    masters, planes, router = _stub_fleet(tmp_path, n=2)
+    try:
+        faults.configure("replica_blackhole:0=30")
+        t0 = time.monotonic()
+        _check(router.compute_raw(BODY, timeout=4))
+        took = time.monotonic() - t0
+        assert took < 3.5
+        assert masters[1].calls >= 1
+        assert router.states()[0] == "down"
+    finally:
+        faults.configure(None)
+        router.close()
+        for p in planes:
+            p.close()
+
+
+def test_router_probe_cannot_readmit_frame_failed_replica(tmp_path):
+    """Grey failure: a wedged-but-alive replica still answers probe
+    frames instantly (the probe path touches nothing but the plane
+    socket), so a probe success must NOT readmit a replica a REAL frame
+    just failed on — it sits out a doubling hold instead of bouncing
+    up<->down every probe_s and re-eating every sticky request's first
+    half-deadline."""
+    masters, planes, router = _stub_fleet(
+        tmp_path, n=2, probe_s=0.05, suspect_hold=1.2
+    )
+    try:
+        faults.configure("replica_blackhole:0=30")
+        _check(router.compute_raw(BODY, timeout=2))  # hedges to 1
+        assert router.states()[0] == "down"
+        faults.configure(None)  # the wedge lifts; probes now succeed
+        time.sleep(0.4)  # ~8 probe rounds, all inside the hold window
+        assert router.states()[0] == "down"  # probe alone may not revive
+        deadline = time.monotonic() + 5
+        while router.states()[0] != "up" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.states()[0] == "up"  # hold expired -> readmitted
+    finally:
+        faults.configure(None)
+        router.close()
+        for p in planes:
+            p.close()
+
+
+def test_suspect_escalates_per_event_not_per_request():
+    """One failed frame fans out to every request it coalesced: 64
+    concurrent suspect() calls are ONE failure event and must leave the
+    hold at its base, not jump the doubling curve to the 30s cap (which
+    would turn a single stall into a half-minute lockout).  Only a
+    failure AFTER the hold expired doubles it."""
+    r = frontends._RouterReplica(0, "/nowhere", None)
+    t0 = time.monotonic()
+    for _ in range(64):
+        r.suspect(0.5)
+    assert r.state == "down"
+    assert r.suspect_streak == 1
+    assert r.suspect_until - t0 < 0.5 + 0.25  # base hold, not the cap
+    r.suspect_until = time.monotonic() - 0.01  # hold expires
+    r.suspect(0.5)
+    assert r.suspect_streak == 2  # doubling resumes per real event
+    r.absolve()
+    assert r.suspect_streak == 0 and r.suspect_until == 0.0
+
+
+def test_plane_client_replays_one_stale_socket(tmp_path):
+    """A replica restart between frames costs ZERO hedges: the
+    dispatcher replays the frame once on a fresh dial instead of failing
+    the batch (which would mark the whole replica down)."""
+    m1 = _StubMaster()
+    p1 = frontends.start_compute_plane(m1, str(tmp_path / "p.sock"))
+    client = frontends.PlaneClient(p1.path, conns=1)
+    try:
+        _check(client.compute_raw(BODY, timeout=5))
+        p1.close()  # restart: established sockets die with it
+        m2 = _StubMaster()
+        p2 = frontends.start_compute_plane(m2, p1.path)
+        try:
+            _check(client.compute_raw(BODY, timeout=5))  # no error
+            assert m2.calls == 1
+        finally:
+            p2.close()
+    finally:
+        client.close()
+
+
+# --- the stdlib manifest verifier -------------------------------------------
+
+
+def _write_ckpt(tmp_path, name="c.npz"):
+    import hashlib
+
+    path = str(tmp_path / name)
+    np.savez(path.replace(".npz", ""), a=np.arange(32, dtype=np.int32))
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path + ".manifest", "w") as f:
+        json.dump(
+            {"size": len(blob), "sha256": hashlib.sha256(blob).hexdigest()},
+            f,
+        )
+    return path
+
+
+def test_verify_manifest_accepts_exact_match(tmp_path):
+    verify_manifest(_write_ckpt(tmp_path))
+
+
+def test_verify_manifest_rejects_truncation_and_corruption(tmp_path):
+    path = _write_ckpt(tmp_path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(RuntimeError, match="torn write"):
+        verify_manifest(path)
+    flipped = bytearray(blob)
+    flipped[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(flipped))
+    with pytest.raises(RuntimeError, match="sha256 mismatch"):
+        verify_manifest(path)
+
+
+def test_verify_manifest_rejects_missing_manifest(tmp_path):
+    path = _write_ckpt(tmp_path)
+    os.unlink(path + ".manifest")
+    # strict on purpose: a roll checkpoint was JUST written by the
+    # manifest-emitting save path — no sidecar means the save tore
+    with pytest.raises(RuntimeError, match="manifest"):
+        verify_manifest(path)
+
+
+# --- metrics relabeling -----------------------------------------------------
+
+
+def test_relabel_metrics_text_injects_replica_label():
+    text = (
+        "# HELP misaka_x_total things\n"
+        "# TYPE misaka_x_total counter\n"
+        "misaka_x_total 41\n"
+        'misaka_y_total{route="/compute",method="POST"} 7\n'
+        'misaka_h_bucket{le="0.1"} 3\n'
+    )
+    samples, headers = relabel_metrics_text(text, 2)
+    assert headers == [
+        "# HELP misaka_x_total things",
+        "# TYPE misaka_x_total counter",
+    ]
+    assert 'misaka_x_total{replica="2"} 41' in samples
+    assert (
+        'misaka_y_total{replica="2",route="/compute",method="POST"} 7'
+        in samples
+    )
+    assert 'misaka_h_bucket{replica="2",le="0.1"} 3' in samples
+    # round-trips through the strict exposition parser
+    parsed = metrics.parse_text("\n".join(samples) + "\n")
+    assert parsed['misaka_x_total{replica="2"}'] == 41.0
+
+
+# --- the /fleet/drain HTTP surface ------------------------------------------
+
+
+def test_fleet_drain_route_drives_plane(tmp_path):
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    m = MasterNode(
+        networks.add2(in_cap=16, out_cap=16, stack_cap=16),
+        chunk_steps=32, engine="scan",
+    )
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    plane = frontends.start_compute_plane(m, str(tmp_path / "plane.sock"))
+    httpd.misaka_plane = plane
+    import urllib.request
+
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def drain(state):
+        req = urllib.request.Request(
+            base + "/fleet/drain", data=f"state={state}".encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    try:
+        payload = drain("on")
+        assert payload["draining"] is True
+        assert payload["inflight"] == 0
+        assert payload["http_inflight"] == 0  # this request is excluded
+        assert plane.draining
+        payload = drain("off")
+        assert payload["draining"] is False
+        assert not plane.draining
+    finally:
+        plane.close()
+        m.close()
+        httpd.shutdown()
+
+
+def test_fleet_drain_route_404_without_plane():
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    m = MasterNode(
+        networks.add2(in_cap=16, out_cap=16, stack_cap=16),
+        chunk_steps=32, engine="scan",
+    )
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    import urllib.error
+    import urllib.request
+
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{httpd.server_address[1]}/fleet/drain",
+            data=b"state=on", method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 404
+    finally:
+        m.close()
+        httpd.shutdown()
+
+
+class _FakeProc:
+    """A live-looking replica process for control-plane unit tests."""
+
+    pid = 4242
+
+    def poll(self):
+        return None
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+    def wait(self, timeout=None):
+        return 0
+
+
+def test_fanout_reports_skipped_replicas(tmp_path):
+    """A lifecycle fan-out (/pause, /run, ...) that could not reach
+    every CONFIGURED replica must not answer a uniform success: the
+    skipped replica is reported per-replica and the status is non-2xx —
+    a /pause that silently missed a mid-roll replica would leave the
+    fleet divergent (one replica free-running against paused siblings)
+    behind a 200."""
+    import http.client
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from misaka_tpu.runtime.fleet import FleetManager, make_fleet_http_server
+
+    class _OkHandler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            body = b"Success"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    replica_srv = ThreadingHTTPServer(("127.0.0.1", 0), _OkHandler)
+    threading.Thread(target=replica_srv.serve_forever, daemon=True).start()
+    fm = FleetManager(2, str(tmp_path / "fleet"))
+    ctrl = None
+    try:
+        # slot 0 looks up (fake live proc + passing probe, pointed at the
+        # stub replica); slot 1 stays proc=None -> "down"
+        fm._slots[0]["proc"] = _FakeProc()
+        fm._slots[0]["probe_ok"] = True
+        fm._slots[0]["port"] = replica_srv.server_address[1]
+        ctrl = make_fleet_http_server(fm, port=0)
+        threading.Thread(target=ctrl.serve_forever, daemon=True).start()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", ctrl.server_address[1], timeout=10
+        )
+        conn.request("POST", "/pause", b"", {})
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        assert resp.status == 502  # never a uniform 200 "Success"
+        assert payload["ok"] is False
+        rows = {r["replica"]: r for r in payload["replicas"]}
+        assert rows[0]["status"] == 200  # the up replica took the change
+        assert rows[1]["skipped"] is True  # the down one is REPORTED
+        assert rows[1]["status"] == 503
+        # whole fleet up again: the uniform one-replica ergonomics hold
+        fm._slots[1]["proc"] = _FakeProc()
+        fm._slots[1]["probe_ok"] = True
+        fm._slots[1]["port"] = replica_srv.server_address[1]
+        conn.request("POST", "/pause", b"", {})
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200 and body == b"Success"
+        conn.close()
+    finally:
+        if ctrl is not None:
+            ctrl.shutdown()
+        replica_srv.shutdown()
+        fm.close()
+
+
+def test_fleet_healthz_running_reflects_network_state(tmp_path):
+    """The single-engine /healthz contract: `running` is the NETWORK
+    run state, not process liveness — a fully paused fleet must not
+    read as serving (the probers feed each slot's probed run state)."""
+    import http.client
+
+    from misaka_tpu.runtime.fleet import FleetManager, make_fleet_http_server
+
+    fm = FleetManager(2, str(tmp_path / "fleet"))
+    ctrl = None
+    try:
+        for s in fm._slots:
+            s["proc"] = _FakeProc()
+            s["probe_ok"] = True
+            s["running"] = True
+        ctrl = make_fleet_http_server(fm, port=0)
+        threading.Thread(target=ctrl.serve_forever, daemon=True).start()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", ctrl.server_address[1], timeout=10
+        )
+
+        def healthz():
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            return json.loads(resp.read())
+
+        payload = healthz()
+        assert payload["ok"] is True and payload["running"] is True
+        fm._slots[1]["running"] = False  # one replica paused
+        payload = healthz()
+        assert payload["ok"] is True  # processes are fine...
+        assert payload["running"] is False  # ...but the fleet is not serving
+        rows = {r["replica"]: r for r in payload["fleet"]["replicas"]}
+        assert rows[0]["running"] is True and rows[1]["running"] is False
+        conn.close()
+    finally:
+        if ctrl is not None:
+            ctrl.shutdown()
+        fm.close()
+
+
+def test_undrain_async_retries_until_replica_recovers(tmp_path):
+    """A failed roll's undrain must not give up when the replica is
+    wedged at that moment (the roll failure may BE the wedge): the
+    background retry keeps posting /fleet/drain state=off until it
+    lands, then stops — a recovered replica never sits draining
+    forever behind a passing /healthz."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from misaka_tpu.runtime.fleet import FleetManager
+
+    calls: list[str] = []
+
+    class _FlakyDrain(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            calls.append(self.path)
+            code = 500 if len(calls) < 3 else 200  # wedged twice, then ok
+            body = b"ok" if code == 200 else b"wedged"
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FlakyDrain)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    fm = FleetManager(1, str(tmp_path / "fleet"))
+    try:
+        slot = fm._slots[0]
+        slot["proc"] = _FakeProc()
+        slot["port"] = srv.server_address[1]
+        fm._undrain_async(slot)
+        deadline = time.monotonic() + 10
+        while len(calls) < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert calls == ["/fleet/drain"] * 3  # retried past the wedge
+        time.sleep(1.2)
+        assert len(calls) == 3  # and stopped once the undrain landed
+    finally:
+        srv.shutdown()
+        fm.close()
+
+
+def test_mark_healthy_keeps_restore_armed_while_rolling(tmp_path):
+    """The roll arms slot["restore"] while the OLD replica is still
+    alive and answering /healthz: a probe passing in that window must
+    NOT disarm the checkpoint — the replacement would silently boot
+    without restoring, breaking the roll's bit-identity guarantee.
+    After the roll hands the slot back, the next healthy probe disarms
+    as before (crash respawns fresh from there on)."""
+    from misaka_tpu.runtime.fleet import FleetManager
+
+    fm = FleetManager(1, str(tmp_path / "fleet"))
+    try:
+        slot = fm._slots[0]
+        slot["rolling"] = True
+        slot["restore"] = "/some/ckpt.npz"
+        slot["run_on_boot"] = True
+        fm._mark_healthy(slot)  # the roll's own readiness wait
+        assert slot["probe_ok"] is True
+        assert slot["restore"] == "/some/ckpt.npz"  # still armed
+        assert slot["run_on_boot"] is True
+        slot["rolling"] = False
+        fm._mark_healthy(slot)  # first post-roll probe
+        assert slot["restore"] is None and slot["run_on_boot"] is None
+    finally:
+        fm.close()
+
+
+def test_fleet_fault_points_parse():
+    spec = faults.parse_spec("replica_kill=2,replica_blackhole:1=5@0.5")
+    assert spec["replica_kill"] == (2.0, 1.0)
+    assert spec["replica_blackhole:1"] == (5.0, 0.5)
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec("replica_kill:0=2")  # not a scoped point
+
+
+# --- the real thing ---------------------------------------------------------
+
+
+ADD2_ENV = {
+    "NODE_INFO": json.dumps({
+        "misaka1": {"type": "program"},
+        "misaka2": {"type": "program"},
+        "misaka3": {"type": "stack"},
+    }),
+    "MISAKA_PROGRAMS": json.dumps({
+        "misaka1": "IN ACC\nADD 1\nMOV ACC, misaka2:R0\nMOV R0, ACC\n"
+                   "OUT ACC\n",
+        "misaka2": "MOV R0, ACC\nADD 1\nPUSH ACC, misaka3\n"
+                   "POP misaka3, ACC\nMOV ACC, misaka1:R0\n",
+    }),
+}
+
+
+def _boot_fleet(tmp_path, port, replicas=4, workers=3, extra=None):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MISAKA_FLEET": str(replicas),
+        "MISAKA_HTTP_WORKERS": str(workers),
+        "MISAKA_AUTORUN": "1",
+        "MISAKA_PORT": str(port),
+        "MISAKA_FLEET_DIR": str(tmp_path),
+        "MISAKA_TTL_S": "600",
+        **ADD2_ENV,
+        **(extra or {}),
+    }
+    return subprocess.Popen(
+        [sys.executable, "-m", "misaka_tpu.runtime.app"], env=env
+    )
+
+
+def _wait_fleet_healthy(base, deadline_s=180):
+    import urllib.request
+
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("ok") and not payload.get("degraded"):
+                return payload
+        except OSError:
+            pass
+        time.sleep(0.5)
+    raise AssertionError("fleet never became healthy")
+
+
+@pytest.mark.slow
+def test_fleet_kill9_and_roll_under_load_zero_errors(tmp_path):
+    """The acceptance scenario, against a REAL subprocess fleet of 4
+    engine replicas behind supervised frontends:
+
+      1. kill -9 one replica under 64 pooled concurrent clients — zero
+         client-visible errors, the supervisor respawns it;
+      2. a full POST /fleet/roll across all 4 replicas under the same
+         load — zero client-visible errors, drain/checkpoint/replace
+         per replica visible in the report;
+      3. quiesce, checkpoint every replica, roll again, checkpoint
+         again: per-replica state is BIT-IDENTICAL across the roll
+         (np.load array comparison — the restore really installed the
+         drained state).
+    """
+    from misaka_tpu.client import MisakaClient
+
+    port = frontends.pick_free_port()
+    base = f"http://127.0.0.1:{port}"
+    proc = _boot_fleet(tmp_path, port, replicas=4, workers=3)
+    errors: list[Exception] = []
+    stop = threading.Event()
+    counts = [0] * 64
+
+    def client_loop(i):
+        c = MisakaClient(base, timeout=60)
+        vals = (np.arange(16, dtype=np.int32) + i) % 1000
+        try:
+            while not stop.is_set():
+                out = c.compute_raw(vals)
+                if not np.array_equal(out, vals + 2):
+                    raise AssertionError(f"client {i}: wrong outputs")
+                counts[i] += 1
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append(e)
+        finally:
+            c.close()
+
+    try:
+        _wait_fleet_healthy(base)
+        client = MisakaClient(base, timeout=60)
+        threads = [
+            threading.Thread(target=client_loop, args=(i,), daemon=True)
+            for i in range(64)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30
+        while sum(counts) < 64 and time.monotonic() < deadline:
+            time.sleep(0.1)  # every client warmed (socket pooled)
+        assert errors == []
+
+        # 1. kill -9 one replica under load
+        st = client.fleet_status()
+        victim = st["replicas"][1]["pid"]
+        os.kill(victim, signal.SIGKILL)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = client.fleet_status()
+            if st["replicas"][1]["state"] == "up" and \
+                    st["replicas"][1]["restarts"] >= 1:
+                break
+            time.sleep(0.25)
+        assert st["replicas"][1]["state"] == "up"
+        assert errors == []
+
+        # 2. rolling restart under the same load
+        report = client.fleet_roll(timeout=600)
+        assert report["ok"] is True
+        assert len(report["replicas"]) == 4
+        for entry in report["replicas"]:
+            assert entry["restored"] is True
+            assert os.path.exists(entry["checkpoint"])
+        time.sleep(1.0)  # keep serving through and after the roll
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert errors == []
+        assert sum(counts) > 64  # the load was real
+
+        # hedges/reroutes surfaced in the aggregated metrics
+        text = client.metrics()
+        parsed = metrics.parse_text(text)
+        assert any(
+            k.startswith("misaka_fleet_rolls_total") and v >= 1
+            for k, v in parsed.items() if 'status="ok"' in k
+        )
+        # valid exposition: ONE TYPE line per family across the whole
+        # fleet (replicas and the parent register many of the same
+        # families; duplicates break strict Prometheus parsers)
+        type_lines = [
+            ln for ln in text.splitlines() if ln.startswith("# TYPE ")
+        ]
+        assert len(type_lines) == len(set(type_lines))
+
+        # 3. bit-identical restore across a quiescent roll.  The TIS
+        # machine free-runs (tick advances with no traffic), so freeze
+        # it first: /pause fans out to every replica, and the roll must
+        # PRESERVE the paused state (a deploy never flips a frozen
+        # network back on) — only then is state comparable bit-for-bit.
+        client.pause()
+        resp = client._post_form("/checkpoint", name="verify-a")
+        assert b"Success" in resp
+        before = {}
+        for i in range(4):
+            path = str(tmp_path / f"replica-{i}" / "verify-a.npz")
+            with np.load(path) as z:
+                before[i] = {k: z[k].copy() for k in z.files}
+        report = client.fleet_roll(timeout=600)
+        assert report["ok"] is True
+        # the replacements came back PAUSED (run state preserved)
+        st = json.loads(client._request("/status", None, "GET"))
+        for idx, row in st["replicas"].items():
+            assert row["running"] is False, f"replica {idx} resumed"
+        resp = client._post_form("/checkpoint", name="verify-b")
+        assert b"Success" in resp
+        for i in range(4):
+            path = str(tmp_path / f"replica-{i}" / "verify-b.npz")
+            with np.load(path) as z:
+                after = {k: z[k].copy() for k in z.files}
+            assert set(after) == set(before[i])
+            for k in after:
+                assert np.array_equal(after[k], before[i][k]), (
+                    f"replica {i} array {k!r} changed across the roll"
+                )
+        client.close()
+    finally:
+        stop.set()
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.mark.slow
+def test_fleet_replica_kill_fault_point(tmp_path):
+    """MISAKA_FAULTS=replica_kill=N SIGKILLs one replica after boot; the
+    fleet absorbs it: the supervisor respawns, traffic never errors."""
+    from misaka_tpu.client import MisakaClient
+
+    port = frontends.pick_free_port()
+    base = f"http://127.0.0.1:{port}"
+    proc = _boot_fleet(
+        tmp_path, port, replicas=2, workers=2,
+        extra={"MISAKA_FAULTS": "replica_kill=3"},
+    )
+    try:
+        _wait_fleet_healthy(base)
+        client = MisakaClient(base, timeout=60)
+        vals = np.arange(16, dtype=np.int32)
+        deadline = time.monotonic() + 60
+        saw_restart = False
+        while time.monotonic() < deadline:
+            out = client.compute_raw(vals)
+            assert np.array_equal(out, vals + 2)
+            st = client.fleet_status()
+            if st["restarts_total"] >= 1 and st["up"] == 2:
+                saw_restart = True
+                break
+            time.sleep(0.2)
+        assert saw_restart, "replica_kill fault never fired or never healed"
+        client.close()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()

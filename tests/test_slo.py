@@ -283,7 +283,15 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
     program's /debug/alerts state to page within a short window, /healthz
     reports degraded, and recovery clears it."""
     _native_or_skip()
-    _arm(monkeypatch, spec="p99<40ms", windows="0.5,1,2,4", min_events=3)
+    # Margins matter more than realism here: the un-faulted neighbor's
+    # REAL p99 creeps toward 40ms late in a full tier-1 run (one process,
+    # accumulated threads + sampler load), which flipped this scenario's
+    # "neighbor stays green" pin on box noise.  A 150ms objective against
+    # a 400ms injected fault keeps every assertion (page fires, neighbor
+    # green, recovery clears) with ~3-4x headroom either side; the
+    # windows scale with the fault cadence (~0.4s/event) so the page
+    # rule's short window still collects min_events.
+    _arm(monkeypatch, spec="p99<150ms", windows="2,4,8,16", min_events=3)
     reg = ProgramRegistry(None, batch=8, engine="native", caps=CAPS)
     top = networks.add2(**CAPS)
     master = MasterNode(top, chunk_steps=64, batch=8, engine="native")
@@ -345,8 +353,8 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
                 break
             time.sleep(0.1)
         assert states() == ("ok", "ok"), states()
-        # inject 100ms into ONLY ten-b's serve passes
-        faults.configure("serve_delay:ten-b=0.1")
+        # inject 400ms into ONLY ten-b's serve passes
+        faults.configure("serve_delay:ten-b=0.4")
         deadline = time.monotonic() + 15
         while time.monotonic() < deadline and not stop.is_set():
             a, b = states()
