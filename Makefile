@@ -124,9 +124,22 @@ ci:
 	$(MAKE) trace-smoke
 	$(MAKE) registry-smoke
 	$(MAKE) usage-smoke
+	$(MAKE) edge-smoke
 	$(MAKE) chaos-smoke
 	$(MAKE) fleet-smoke
 	$(MAKE) bench-smoke
+
+# Production-edge tripwire (~15s): a REAL subprocess server behind TLS
+# (throwaway self-signed cert) with API-key auth + per-tenant quotas and
+# the SO_REUSEPORT frontend tier — asserts the TLS handshake (CA-pinned
+# client ok, untrusted + plaintext refused), bad key -> typed 401,
+# non-admin lifecycle -> 403, quota exhaustion -> typed 429 WITH
+# Retry-After on the hot compute-plane path, and recovery after the
+# advertised backoff.  The same assertions run inside tier-1
+# (tests/test_edge.py); docs/ARCHITECTURE.md "The production edge".
+edge-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/edge_smoke.py
 
 # Fault-tolerance tripwire (~15s): the fast chaos lane, driven through the
 # MISAKA_FAULTS harness (utils/faults.py) — durable-checkpoint rejection of
@@ -189,4 +202,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke edge-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean

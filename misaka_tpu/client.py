@@ -50,14 +50,22 @@ class MisakaClientError(RuntimeError):
     names the exact request to grep for in `/debug/requests/<id>` and
     the server's JSON logs)."""
 
-    def __init__(self, status: int, body: str, trace_id: str | None = None):
+    def __init__(self, status: int, body: str, trace_id: str | None = None,
+                 retry_after: float | None = None):
         msg = f"HTTP {status}: {body}"
         if trace_id:
             msg += f" [trace {trace_id}]"
+        if retry_after is not None:
+            msg += f" (retry after {retry_after:g}s)"
         super().__init__(msg)
         self.status = status
         self.body = body
         self.trace_id = trace_id
+        #: seconds from the response's Retry-After header (None when the
+        #: server sent none).  A 429 carries it always — back off for
+        #: this long instead of retrying hot (the edge's token bucket
+        #: will just burn your next request too).
+        self.retry_after = retry_after
 
 
 class TracedInt(int):
@@ -119,7 +127,8 @@ class MisakaClient:
     def __init__(self, base_url: str = "http://localhost:8000",
                  timeout: float = 30.0, pool_size: int = 4,
                  retry_stale: bool = True, connect_retries: int = 3,
-                 program: str | None = None):
+                 program: str | None = None, api_key: str | None = None,
+                 ca: str | None = None, tls_insecure: bool = False):
         """`retry_stale` (default True) replays a request ONCE when a
         POOLED connection proves dead at send time or before any
         response byte arrives — the stale-keep-alive case.  This is
@@ -142,19 +151,48 @@ class MisakaClient:
         "name@latest", or "name@<version>"; requires the server to run
         with MISAKA_PROGRAMS_DIR (unknown programs answer 404).  None
         (default) keeps the legacy routes, which serve the seeded
-        default program."""
+        default program.
+
+        `api_key` authenticates this session against a server with the
+        edge armed (MISAKA_API_KEYS): sent as X-Misaka-Key on every
+        request.  Defaults to the MISAKA_API_KEY env var, so ops scripts
+        need no code change to authenticate.  A 401/403/429 surfaces as
+        MisakaClientError with `.status` and (for 429) `.retry_after`.
+
+        An `https://` base_url speaks TLS (server-side MISAKA_TLS_CERT/
+        KEY): `ca` pins a CA bundle path (the `make cert` ca.cert, or
+        the self-signed service cert itself); `tls_insecure=True` skips
+        verification (lab use).  Default with neither: the system trust
+        store."""
+        import os as _os
+
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.retry_stale = bool(retry_stale)
         self.connect_retries = max(0, int(connect_retries))
+        self.api_key = (
+            api_key if api_key is not None
+            else _os.environ.get("MISAKA_API_KEY") or None
+        )
         split = urllib.parse.urlsplit(self.base_url)
-        if split.scheme not in ("http", ""):
+        if split.scheme not in ("http", "https", ""):
             raise ValueError(
-                f"unsupported scheme {split.scheme!r} (the master speaks "
-                f"plain HTTP; TLS terminates at the deployment layer)"
+                f"unsupported scheme {split.scheme!r} (use http:// or "
+                f"https://)"
             )
+        self._tls = split.scheme == "https"
+        self._ssl_ctx = None
+        if self._tls:
+            import ssl
+
+            if tls_insecure:
+                ctx = ssl._create_unverified_context()
+            else:
+                ctx = ssl.create_default_context(cafile=ca)
+            self._ssl_ctx = ctx
         self._host = split.hostname or "localhost"
-        self._port = split.port or 80  # urllib's default, kept exactly
+        # urllib's defaults, kept exactly
+        self._port = split.port or (443 if self._tls else 80)
         self._prefix = split.path.rstrip("/")
         self._pool: list[http.client.HTTPConnection] = []
         self._pool_lock = threading.Lock()
@@ -184,17 +222,22 @@ class MisakaClient:
 
     # --- plumbing ----------------------------------------------------------
 
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._tls:
+            return http.client.HTTPSConnection(
+                self._host, self._port, timeout=self.timeout,
+                context=self._ssl_ctx,
+            )
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout
+        )
+
     def _checkout(self) -> tuple[http.client.HTTPConnection, bool]:
         """An idle pooled connection (reused=True) or a fresh one."""
         with self._pool_lock:
             if self._pool:
                 return self._pool.pop(), True
-        return (
-            http.client.HTTPConnection(
-                self._host, self._port, timeout=self.timeout
-            ),
-            False,
-        )
+        return self._connection(), False
 
     def _checkin(self, conn: http.client.HTTPConnection) -> None:
         with self._pool_lock:
@@ -216,6 +259,8 @@ class MisakaClient:
             # the server's bulk lanes answer 411 without a length;
             # http.client sets it for bytes bodies, but be explicit
             headers["Content-Length"] = str(len(data))
+        if self.api_key is not None:
+            headers["X-Misaka-Key"] = self.api_key
         refused = 0
         replays = 0
         fresh_replays = 0
@@ -288,9 +333,18 @@ class MisakaClient:
                 "Server-Timing": resp.getheader("Server-Timing"),
             }
             if resp.status >= 400:
+                retry_after = None
+                ra = resp.getheader("Retry-After")
+                if ra:
+                    try:
+                        retry_after = float(ra)
+                    except ValueError:
+                        pass  # HTTP-date form: surface the header's
+                        # presence through the body text instead
                 raise MisakaClientError(
                     resp.status, body.decode(errors="replace").strip(),
                     trace_id=resp_headers["X-Misaka-Trace"],
+                    retry_after=retry_after,
                 )
             return body, resp_headers
 
@@ -438,12 +492,13 @@ class MisakaClient:
         # minutes (one engine boot per replica), and parking a pooled
         # keep-alive connection on it — or mutating its timeout — would
         # poison the pool for every concurrent compute call
-        conn = http.client.HTTPConnection(
-            self._host, self._port, timeout=timeout
-        )
+        conn = self._connection()
+        conn.timeout = timeout  # applied at connect time
         try:
-            conn.request("POST", self._prefix + "/fleet/roll", b"",
-                         {"Content-Length": "0"})
+            headers = {"Content-Length": "0"}
+            if self.api_key is not None:
+                headers["X-Misaka-Key"] = self.api_key
+            conn.request("POST", self._prefix + "/fleet/roll", b"", headers)
             resp = conn.getresponse()
             body = resp.read()
             if resp.status >= 400:

@@ -186,6 +186,59 @@ so the master's env surface is what survives:
                    (0.02 — the duty-cycle cap: the sampler measures its
                    own per-sample cost and stretches its period to stay
                    under this fraction of one core)
+  MISAKA_TLS_CERT / MISAKA_TLS_KEY  serve the PUBLIC HTTP listener over
+                   TLS (stdlib ssl; PEM cert chain + private key).  In
+                   single-process mode the engine's own listener wraps;
+                   with MISAKA_HTTP_WORKERS / MISAKA_FLEET the frontend
+                   workers terminate TLS and the engine / fleet control
+                   server stay loopback HTTP.  Unset = plain HTTP,
+                   exactly as before.  `make cert` output works:
+                   MISAKA_TLS_CERT=deploy/certs/service.pem
+                   MISAKA_TLS_KEY=deploy/certs/service.key
+  MISAKA_API_KEYS  arm API-key auth (runtime/edge.py): path to a
+                   reloadable JSON key file ({"keys": [{"key": ...,
+                   "tenant": ..., "admin": bool, "programs": [...],
+                   "quota": "spec"}]}); defaults to
+                   <MISAKA_PROGRAMS_DIR>/api_keys.json when that file
+                   exists.  Keys map requests to TENANTS (quota,
+                   fair-share, and the misaka_edge_* metric labels);
+                   admin routes (/run /pause /load /checkpoint
+                   /fleet/roll ...) need "admin": true keys; /healthz +
+                   /metrics stay open for probes/scrapers.  The file
+                   hot-reloads on mtime change — rotate keys without a
+                   restart.  Unset = no auth, exactly as before
+  MISAKA_QUOTA     env-default per-tenant quota spec, e.g.
+                   "rps<100,vps<500000,cpu<0.5" (requests/s, values/s,
+                   core-seconds/s against the usage ledger over
+                   MISAKA_QUOTA_CPU_WINDOW_S [60]).  Field-wise
+                   overridable per program (`quota` field on POST
+                   /programs) and per key (key-file `quota`); exhaustion
+                   answers typed 429 + Retry-After.
+                   MISAKA_QUOTA_BURST_S (2) sets bucket burst depth
+  MISAKA_ADMISSION_HIGH  overload admission control's soft watermark in
+                   ServeBatcher waiting VALUES (default: clears the
+                   largest MISAKA_MAX_BODY-legal request — tune DOWN to
+                   your latency budget, waiting/rate ~= delay): beyond
+                   it, tenants above their fair share of the recent
+                   admission window shed with typed 429 + Retry-After
+                   (a paging SLO halves the watermark; 2x is the
+                   hard cap that sheds everyone).  Frontend workers add
+                   a local frame-backlog cap, MISAKA_PLANE_DEPTH_MAX
+                   (256 frames)
+  MISAKA_EDGE      "0" kills the WHOLE edge chain; per-stage switches
+                   MISAKA_EDGE_AUTH / MISAKA_EDGE_QUOTA /
+                   MISAKA_EDGE_ADMISSION=0 disarm one layer (the A/B
+                   overhead gate isolates stages with these)
+  MISAKA_PLANE_SECRET  shared-secret handshake on the compute plane
+                   (runtime/frontends.py): every plane connection must
+                   open with an HMAC of this secret or it is closed
+                   (MISAKA_PLANE_SECRET_FILE reads it from a file).
+                   Unset = open plane, exactly as before
+  MISAKA_LANE_SMALL  priority-lane split for the serve scheduler in
+                   VALUES (default 8192): entries at or under it ride
+                   the hot lane and preempt bulk backlog in pass
+                   packing — an interactive request never queues behind
+                   a 64 MiB bulk body.  0 = single lane, as before
   MISAKA_COORDINATOR  join a multi-host jax.distributed runtime before any
                    device touch ("host:port", or "auto" on Cloud TPU pods);
                    with MISAKA_NUM_PROCESSES + MISAKA_PROCESS_ID
@@ -290,6 +343,10 @@ def _serve_http(
         server = make_http_server(
             master, 0, checkpoint_dir=checkpoint_dir,
             profile_dir=profile_dir, registry=registry,
+            # TLS terminates at the frontend workers (they inherit
+            # MISAKA_TLS_* from this env); the engine's own listener is
+            # their loopback proxy target and must stay plain HTTP
+            tls=False,
         )
         engine_port = server.server_address[1]
         plane_path = environ.get(

@@ -1,0 +1,1146 @@
+"""The production edge: one route table + ordered middleware chain shared
+by every HTTP surface of the serving plane.
+
+The reference ships its control plane over gRPC **with TLS** and nothing
+else at the door; this build's ROADMAP ("heavy traffic from millions of
+users") needs the rest of a production edge too.  Before this module,
+edge policy was scattered: app.py owned the listeners, utils/httpfast.py
+the parsing, runtime/frontends.py its own copy of the body-limit checks —
+and NOTHING kept one overloaded or abusive tenant from saturating the
+ServeBatcher and timing everyone else out after a 30s ComputeTimeout.
+
+This module extracts the route table + middleware chain so edge policy
+composes per route, identically on all three serving surfaces:
+
+  * the ENGINE server (runtime/master.py make_http_server) — direct HTTP;
+  * the FRONTEND workers (runtime/frontends.py) — TLS termination + the
+    local backpressure guard; auth/quota/admission for their hot-route
+    traffic run engine-side per compute-plane frame (state must be
+    global: N workers each holding 1/Nth of a token bucket would not be
+    a quota);
+  * the FLEET control server (runtime/fleet.py) — auth on the operator
+    surface, policy enforced by the replica a request lands on.
+
+The chain, in order (each stage has its own kill switch):
+
+  1. AUTH (MISAKA_EDGE_AUTH=0 disables) — API keys in a reloadable JSON
+     file (MISAKA_API_KEYS, or <MISAKA_PROGRAMS_DIR>/api_keys.json when
+     present).  Keys map to TENANTS; lookups are constant-time HMAC
+     digests of the presented key, never the key itself.  Missing key ->
+     401; known key without the required scope (admin routes, program
+     allowlists) -> 403.  The key file hot-reloads on mtime change — no
+     restart to rotate a key.
+  2. QUOTA (MISAKA_EDGE_QUOTA=0 disables) — per-tenant token buckets for
+     requests/s (`rps`) and values/s (`vps`), plus a `cpu` budget (core-
+     seconds per second over a sliding window) enforced against the PR 7
+     usage ledger's per-program cpu_seconds.  Specs use the MISAKA_SLO
+     grammar shape: MISAKA_QUOTA="rps<100,vps<500000,cpu<0.5".
+     Precedence is FIELD-WISE, most specific wins:
+     key-file entry  >  program upload metadata (`quota` form field)  >
+     MISAKA_QUOTA env default.  Exhaustion answers a typed 429 with a
+     computed Retry-After.
+  3. ADMISSION (MISAKA_EDGE_ADMISSION=0 disables) — a concurrency/queue-
+     depth governor fed by the LIVE ServeBatcher waiting-values signal
+     and the SLO burn-rate state: beyond the soft watermark
+     (MISAKA_ADMISSION_HIGH values, halved while any SLO pages) tenants
+     above their fair share of the recent admission window are shed
+     (typed 429 + Retry-After) while under-share neighbors keep flowing;
+     beyond the hard watermark (2x) everything is shed — the plane keeps
+     headroom and admitted requests never die of ComputeTimeout.
+
+TLS rides next to the chain (MISAKA_TLS_CERT/MISAKA_TLS_KEY wrap the
+public listener via stdlib ssl), and the fleet compute plane gets a
+shared-secret handshake (MISAKA_PLANE_SECRET): a connecting PlaneClient
+must present an HMAC of the plane protocol tag before any frame is read.
+
+Every decision is observable: misaka_edge_admitted_total{tenant} /
+misaka_edge_rejected_total{reason,tenant} (cardinality-guarded like every
+per-tenant series), and a rejected traced request carries an `edge.reject`
+span with the tenant + reason.
+
+Stdlib-only (+ the stdlib-only utils.metrics/faults/tracespan/slo): the
+jax-free frontend workers import this module.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import hmac
+import json
+import logging
+import os
+import ssl
+import threading
+import time
+
+from misaka_tpu.utils import faults, metrics
+
+log = logging.getLogger(__name__)
+
+# --- metrics ----------------------------------------------------------------
+
+M_EDGE_ADMITTED = metrics.counter(
+    "misaka_edge_admitted_total",
+    "Requests admitted through the edge middleware chain, by tenant",
+    ("tenant",),
+)
+M_EDGE_REJECTED = metrics.counter(
+    "misaka_edge_rejected_total",
+    "Requests rejected at the edge, by reason "
+    "(unauthenticated/forbidden/rate/values/cpu/overload) and tenant",
+    ("reason", "tenant"),
+)
+
+# Tenant label cardinality rides the ONE health-plane budget
+# (MISAKA_USAGE_LABEL_MAX via metrics.tenant_label_budget): client-chosen
+# tenant names must not mint unbounded series.
+_tenant_labels_lock = threading.Lock()
+_tenant_labels: set[str] = set()
+
+
+def tenant_metric_label(tenant: str | None) -> str:
+    """`tenant` resolved against the shared cardinality budget (new
+    tenants past the cap collapse to "other").  Lock-free on the hot
+    path: a known label is a plain set read (GIL-atomic); only a NEW
+    label takes the lock."""
+    label = tenant or "default"
+    if label in _tenant_labels:
+        return label
+    with _tenant_labels_lock:
+        label = metrics.capped_label(
+            _tenant_labels, label, metrics.tenant_label_budget()
+        )
+        _tenant_labels.add(label)
+    return label
+
+
+# Program-keyed edge STATE (cpu meters) rides its own capped set — the
+# same budget, but program names must not consume the tenant slots.
+_program_labels_lock = threading.Lock()
+_program_labels: set[str] = set()
+
+
+def _program_state_label(program: str) -> str:
+    if program in _program_labels:
+        return program
+    with _program_labels_lock:
+        label = metrics.capped_label(
+            _program_labels, program, metrics.tenant_label_budget()
+        )
+        _program_labels.add(label)
+    return label
+
+
+# Per-tenant metric children resolved once (the labels() walk + its lock
+# must not run per admitted request — the r12 ledger's discipline).
+_children_lock = threading.Lock()
+_admitted_children: dict[str, object] = {}
+
+
+def _admitted_child(label: str):
+    c = _admitted_children.get(label)
+    if c is None:
+        with _children_lock:
+            c = _admitted_children.setdefault(
+                label, M_EDGE_ADMITTED.labels(tenant=label)
+            )
+    return c
+
+
+_rejected_children: dict[tuple[str, str], object] = {}
+
+
+def _rejected_child(reason: str, label: str):
+    # a shed is the edge's highest-QPS state — the rejection path must
+    # not pay the labels() walk per request either
+    k = (reason, label)
+    c = _rejected_children.get(k)
+    if c is None:
+        with _children_lock:
+            c = _rejected_children.setdefault(
+                k, M_EDGE_REJECTED.labels(reason=reason, tenant=label)
+            )
+    return c
+
+
+# --- decisions --------------------------------------------------------------
+
+
+class EdgeReject(Exception):
+    """A typed edge rejection: HTTP status + machine-readable reason +
+    optional Retry-After seconds.  Raised by middleware `check` hooks and
+    rendered by each surface (HTTP header Retry-After; plane frames ship
+    it as a JSON body so the frontend can restore the header).  `tenant`
+    is attached where known so a worker honoring the Retry-After locally
+    can report its shed counts under the right label."""
+
+    def __init__(self, status: int, reason: str, message: str,
+                 retry_after: float | None = None,
+                 tenant: str | None = None):
+        super().__init__(message)
+        self.status = int(status)
+        self.reason = reason
+        self.message = message
+        self.retry_after = retry_after
+        self.tenant = tenant
+
+    def headers(self) -> list[tuple[str, str]]:
+        out = []
+        if self.retry_after is not None:
+            # ceil to whole seconds: Retry-After is delta-seconds
+            out.append(("Retry-After", str(max(1, int(-(-self.retry_after // 1))))))
+        if self.status == 401:
+            out.append(("WWW-Authenticate",
+                        'Bearer realm="misaka", charset="UTF-8"'))
+        return out
+
+    def to_wire(self) -> bytes:
+        """The plane-frame body shape: JSON so the frontend worker can
+        rebuild the Retry-After header client-side."""
+        obj = {"error": self.message, "reason": self.reason}
+        if self.retry_after is not None:
+            obj["retry_after"] = round(self.retry_after, 3)
+        if self.tenant is not None:
+            obj["tenant"] = self.tenant
+        return json.dumps(obj).encode()
+
+    @staticmethod
+    def from_wire(status: int, body: bytes) -> "EdgeReject | None":
+        """Inverse of to_wire (None when the body is not an edge payload)."""
+        try:
+            obj = json.loads(body.decode())
+            if not isinstance(obj, dict) or "reason" not in obj:
+                return None
+            return EdgeReject(
+                status, str(obj["reason"]), str(obj.get("error", "")),
+                retry_after=float(obj["retry_after"])
+                if obj.get("retry_after") is not None else None,
+                tenant=str(obj["tenant"])
+                if obj.get("tenant") is not None else None,
+            )
+        except (ValueError, TypeError, UnicodeDecodeError):
+            return None
+
+
+# every reason the chain can emit — frame-carried shed reports are
+# clamped to this set so wire metadata cannot mint label values
+REASONS = frozenset({
+    "unauthenticated", "forbidden", "rate", "values", "cpu", "overload",
+})
+
+
+def count_shed(tenant: str | None, reason: str, n: int = 1) -> None:
+    """Record `n` edge rejections made AWAY from a chain (the frontend
+    workers' local shed cache honors an engine-issued Retry-After and
+    ships its counts back in frame metadata — without this the headline
+    misaka_edge_rejected_total would under-report by the cache's whole
+    hit rate during exactly the floods it exists to measure)."""
+    _rejected_child(
+        reason if reason in REASONS else "other",
+        tenant_metric_label(tenant),
+    ).inc(max(1, int(n)))
+
+
+class Decision:
+    """One edge evaluation: the resolved tenant (always set — metrics and
+    traces label rejections too) and the rejection, if any."""
+
+    __slots__ = ("tenant", "reject", "key_entry")
+
+    def __init__(self, tenant: str | None, reject: EdgeReject | None = None,
+                 key_entry: "dict | None" = None):
+        self.tenant = tenant
+        self.reject = reject
+        self.key_entry = key_entry
+
+
+# --- route table ------------------------------------------------------------
+
+# Which middleware stages apply per route class.  The table is the
+# composition contract every surface shares:
+#   * OPEN      — no edge at all (load-balancer probes, Prometheus
+#                 scrapers; locking these behind keys breaks monitoring);
+#   * COMPUTE   — the full chain: auth + quota + admission (the data
+#                 plane is where overload and abuse live);
+#   * ADMIN     — auth with the `admin` scope (lifecycle and operator
+#                 mutations; no quota/admission — a /pause must land even
+#                 during an overload shed);
+#   * READ      — auth only (introspection: /status, /debug/*, registry
+#                 listings).
+OPEN_ROUTES = frozenset({"/healthz", "/metrics"})
+COMPUTE_ROUTES = frozenset({"/compute", "/compute_batch", "/compute_raw"})
+ADMIN_ROUTES = frozenset({
+    "/run", "/pause", "/reset", "/load", "/checkpoint", "/restore",
+    "/profile/start", "/profile/stop", "/fleet/roll", "/fleet/drain",
+})
+
+
+def route_policy(route: str, method: str = "POST") -> tuple[str, ...]:
+    """The ordered middleware stages for one (route, method).  Returns a
+    tuple drawn from ("auth", "auth_admin", "quota", "admission")."""
+    if route in OPEN_ROUTES:
+        return ()
+    if route in COMPUTE_ROUTES:
+        return ("auth", "quota", "admission")
+    if route in ADMIN_ROUTES:
+        return ("auth_admin",)
+    if route == "/programs" and method == "POST":
+        # publishing a program version mutates the registry: admin scope
+        return ("auth_admin",)
+    return ("auth",)
+
+
+# --- API key file -----------------------------------------------------------
+
+
+def _digest(key: str) -> bytes:
+    """Constant-shape identifier for a presented key: HMAC-SHA256 under a
+    fixed tag.  Lookups compare digests (hmac.compare_digest), so neither
+    the table walk nor the comparison leaks key bytes through timing."""
+    return hmac.new(b"misaka-api-key-v1", key.encode(), hashlib.sha256).digest()
+
+
+class KeyFile:
+    """A reloadable API-key table.
+
+    File shape (JSON, lives next to MISAKA_PROGRAMS_DIR by convention):
+
+        {"keys": [
+          {"key": "alice-secret", "tenant": "alice", "admin": true},
+          {"key": "bob-secret", "tenant": "bob",
+           "programs": ["dense"], "quota": "rps<50,vps<20000"}
+        ]}
+
+    Entries: `key` (required), `tenant` (required — the label quotas,
+    fair-share, and metrics use), `admin` (default false — required for
+    ADMIN_ROUTES), `programs` (optional allowlist; a request addressed to
+    a program outside it is 403), `quota` (optional per-key spec,
+    field-wise overriding the program/env specs), `disabled` (true ->
+    403, the revocation-without-deletion state).
+
+    Hot reload: the file's mtime+size are stat'd at most every 0.5s; a
+    change swaps the parsed table atomically.  A file that fails to parse
+    KEEPS the previous table (and logs loudly) — a typo'd rotation must
+    not open the edge or lock every tenant out.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._by_digest: dict[bytes, dict] = {}
+        self._stamp: tuple[float, int] | None = None
+        self._next_stat = 0.0
+        self._load(force=True)
+
+    def _load(self, force: bool = False) -> None:
+        try:
+            st = os.stat(self.path)
+            stamp = (st.st_mtime, st.st_size)
+        except OSError:
+            if force:
+                log.warning("edge: key file %s unreadable; no keys loaded",
+                            self.path)
+            return
+        if not force and stamp == self._stamp:
+            return
+        try:
+            with open(self.path) as f:
+                obj = json.load(f)
+            entries = obj["keys"] if isinstance(obj, dict) else obj
+            table: dict[bytes, dict] = {}
+            for e in entries:
+                key = e["key"]
+                tenant = e["tenant"]
+                if not isinstance(key, str) or not isinstance(tenant, str):
+                    raise ValueError("key and tenant must be strings")
+                quota_spec = None
+                if e.get("quota") is not None:
+                    # parse ONCE at load: the hot path reads the dict
+                    quota_spec = parse_quota_spec(e["quota"])
+                    if quota_spec.pop("cpu", None) is not None:
+                        # cpu budgets are measured per PROGRAM (the
+                        # usage ledger's attribution unit) — a key-level
+                        # cpu field would bill one tenant for a program
+                        # all tenants share, shedding the innocent one
+                        log.warning(
+                            "edge: key for tenant %r declares a `cpu` "
+                            "quota; cpu budgets are per-program (use "
+                            "the POST /programs quota field or "
+                            "MISAKA_QUOTA) — ignored", tenant,
+                        )
+                table[_digest(key)] = {
+                    "tenant": tenant,
+                    "admin": bool(e.get("admin")),
+                    "programs": (
+                        frozenset(e["programs"])
+                        if e.get("programs") is not None else None
+                    ),
+                    "quota": e.get("quota"),
+                    "quota_spec": quota_spec,
+                    "disabled": bool(e.get("disabled")),
+                }
+        except (OSError, ValueError, TypeError, KeyError) as e:
+            log.error("edge: key file %s failed to parse (%s); keeping the "
+                      "previous table", self.path, e)
+            self._stamp = stamp  # don't re-parse the same broken file hot
+            return
+        self._by_digest = table
+        self._stamp = stamp
+        log.info("edge: loaded %d API key(s) from %s", len(table), self.path)
+
+    def lookup(self, key: str | None) -> dict | None:
+        """The entry for a presented key (None = unknown/missing).  Stats
+        the file for changes at most every 0.5s."""
+        now = time.monotonic()
+        if now >= self._next_stat:
+            with self._lock:
+                if now >= self._next_stat:
+                    self._next_stat = now + 0.5
+                    self._load()
+        if key is None:
+            return None
+        # the table is keyed by HMAC digest of the key, so the dict walk
+        # never touches key bytes — timing can only leak the digest,
+        # which is exactly what HMAC makes safe to leak
+        return self._by_digest.get(_digest(key))
+
+    def __len__(self) -> int:
+        return len(self._by_digest)
+
+
+# --- quota specs ------------------------------------------------------------
+
+
+class QuotaSpecError(ValueError):
+    """Malformed quota spec (grammar: "rps<100,vps<500000,cpu<0.5")."""
+
+
+_QUOTA_FIELDS = ("rps", "vps", "cpu")
+
+
+def parse_quota_spec(text: str | None) -> dict[str, float]:
+    """`"rps<100,vps<500000,cpu<0.5"` -> {"rps": 100.0, ...}.  The `<`
+    separator mirrors the MISAKA_SLO grammar (utils/slo.py); `=` is
+    accepted as a synonym."""
+    out: dict[str, float] = {}
+    for raw in (text or "").split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        for sep in ("<", "="):
+            if sep in entry:
+                name, _, val = entry.partition(sep)
+                break
+        else:
+            raise QuotaSpecError(
+                f"cannot parse quota term {entry!r} (want name<value)"
+            )
+        name = name.strip()
+        if name not in _QUOTA_FIELDS:
+            raise QuotaSpecError(
+                f"unknown quota field {name!r} (known: {_QUOTA_FIELDS})"
+            )
+        try:
+            limit = float(val.strip())
+        except ValueError:
+            raise QuotaSpecError(
+                f"cannot parse quota value {val!r} in {entry!r}"
+            ) from None
+        if limit <= 0:
+            raise QuotaSpecError(f"quota {name} must be > 0, got {limit}")
+        out[name] = limit
+    return out
+
+
+class TokenBucket:
+    """A classic token bucket: `rate` tokens/s, capacity `rate*burst_s`.
+    take(n) either admits (True, 0.0) or rejects with the seconds until
+    n tokens will exist (the Retry-After)."""
+
+    __slots__ = ("rate", "capacity", "tokens", "stamp", "_lock")
+
+    def __init__(self, rate: float, burst_s: float = 2.0):
+        self.rate = float(rate)
+        self.capacity = max(1.0, self.rate * burst_s)
+        self.tokens = self.capacity
+        self.stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> tuple[bool, float]:
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(
+                self.capacity, self.tokens + (now - self.stamp) * self.rate
+            )
+            self.stamp = now
+            if self.tokens >= n:
+                self.tokens -= n
+                return True, 0.0
+            need = min(n, self.capacity) - self.tokens
+            return False, need / self.rate if self.rate > 0 else 60.0
+
+
+class CpuMeter:
+    """Sliding-window cpu-seconds enforcement against the PR 7 usage
+    ledger: `reader()` returns a program's cumulative cpu_seconds; the
+    meter keeps (t, cpu) samples over `window_s` and rejects while the
+    windowed consumption exceeds `limit_frac * window_s` core-seconds."""
+
+    __slots__ = ("window_s", "_samples", "_lock")
+
+    def __init__(self, window_s: float):
+        self.window_s = float(window_s)
+        self._samples: list[tuple[float, float]] = []
+        self._lock = threading.Lock()
+
+    def check(self, cpu_now: float, limit_frac: float) -> tuple[bool, float]:
+        now = time.monotonic()
+        with self._lock:
+            s = self._samples
+            if not s or now - s[-1][0] >= 0.05:
+                s.append((now, cpu_now))
+            while s and now - s[0][0] > self.window_s:
+                s.pop(0)
+            if not s:
+                return True, 0.0
+            consumed = cpu_now - s[0][1]
+        budget = limit_frac * self.window_s
+        if consumed <= budget:
+            return True, 0.0
+        # assume consumption stops: the window must slide far enough that
+        # the overage ages out — proportional estimate, clamped sane
+        frac_over = (consumed - budget) / max(consumed, 1e-9)
+        return False, min(self.window_s, max(1.0, frac_over * self.window_s))
+
+
+# --- admission governor -----------------------------------------------------
+
+
+class AdmissionGovernor:
+    """Queue-depth + fair-share load shedding at the door.
+
+    `signals()` returns (waiting_values, slo_page): the LIVE ServeBatcher
+    backlog (summed across per-program engines) and whether any SLO pages.
+    Policy:
+
+      * waiting < soft            -> admit everyone;
+      * soft <= waiting < hard    -> shed tenants ABOVE their fair share
+        of the recent (1s) admission window — the flooding tenant sheds
+        first while an in-quota neighbor keeps flowing.  With a single
+        active tenant there is no one to be fair to: admit until hard.
+      * waiting >= hard (2x soft) -> shed everything (the plane keeps
+        headroom; admitted work must never die of ComputeTimeout).
+
+    A paging SLO halves the soft watermark: burn-rate pressure tightens
+    admission before latency collapses.  Retry-After is derived from the
+    observed drain rate of the recent window (clamped [0.05s, 5s]).
+    """
+
+    # fair-share slack: a tenant may hold up to 1.5x its equal share of
+    # the admission window before the soft zone sheds it
+    FAIR_SLACK = 1.5
+
+    def __init__(self, signals, high_values: int):
+        self._signals = signals
+        self.high = max(1, int(high_values))
+        self._lock = threading.Lock()
+        # incremental window accounting: the deque holds the raw
+        # admissions, the dict the RUNNING per-tenant sums — evicting
+        # expired entries is amortized O(1) per admission, so the hot
+        # path never rebuilds shares from the whole window (the first
+        # implementation did, under this lock, and the conc64 A/B
+        # measured 16% — serialized O(window) work per request)
+        self._events: collections.deque = collections.deque()
+        self._sums: dict[str, int] = {}
+        self._total = 0
+        self.window_s = 1.0
+
+    def _evict(self, now: float) -> None:
+        """Drop admissions older than the window (call under _lock)."""
+        dq = self._events
+        while dq and now - dq[0][0] > self.window_s:
+            _, tenant, values = dq.popleft()
+            self._total -= values
+            s = self._sums.get(tenant, 0) - values
+            if s <= 0:
+                self._sums.pop(tenant, None)
+            else:
+                self._sums[tenant] = s
+
+    def check(self, tenant: str, values: int) -> EdgeReject | None:
+        waiting, page = self._signals()
+        now = time.monotonic()
+        # chaos (utils/faults.py): `overload` saturates the governor for
+        # everyone, `overload:<tenant>` for one tenant — the shed drill
+        # without needing 4x real load in a unit test
+        if faults.armed():
+            forced = faults.fire("overload")
+            if forced is None:
+                forced = faults.fire(f"overload:{tenant}")
+            if forced is not None:
+                return self._reject(waiting, values, 0, forced=True)
+        soft = self.high // 2 if page else self.high
+        hard = self.high * 2
+        # one lock hold, never re-entered: the rejection itself is built
+        # OUTSIDE (the ledger/SLO planes each once grew a recursive
+        # resolve under a non-reentrant lock and self-deadlocked)
+        with self._lock:
+            self._evict(now)
+            drained = self._total
+            shed = waiting >= hard
+            if not shed and waiting >= soft and len(self._sums) > 1:
+                fair = self.FAIR_SLACK / len(self._sums)
+                shed = (
+                    self._sums.get(tenant, 0) / (drained or 1) > fair
+                )
+            if not shed:
+                self._events.append((now, tenant, values))
+                self._total += values
+                self._sums[tenant] = self._sums.get(tenant, 0) + values
+        if shed:
+            return self._reject(waiting, values, drained)
+        return None
+
+    def _reject(self, waiting: int, values: int, drained: int,
+                forced: bool = False) -> EdgeReject:
+        """Build the typed 429 (lock-free: `drained` — admitted values in
+        the recent window, the observed drain rate — comes from the
+        caller's lock hold)."""
+        rate = max(drained / self.window_s, 1.0)
+        retry = min(5.0, max(0.05, (waiting + values) / rate)) \
+            if not forced else 1.0
+        return EdgeReject(
+            429, "overload",
+            f"admission control: {waiting} values already waiting "
+            f"(watermark {self.high}); retry after backoff",
+            retry_after=retry,
+        )
+
+
+# --- the chain --------------------------------------------------------------
+
+
+class EdgeChain:
+    """The ordered middleware chain + route table, evaluated by every
+    serving surface via `check()`.  Build one per process with
+    `from_env()` and install it (`install()`); the compute plane and the
+    HTTP handlers read the installed chain."""
+
+    def __init__(
+        self,
+        keyfile: KeyFile | None = None,
+        quota_defaults: dict[str, float] | None = None,
+        governor: AdmissionGovernor | None = None,
+        cpu_reader=None,
+        rate_scale: float = 1.0,
+        auth_enabled: bool = True,
+        quota_enabled: bool = True,
+        admission_enabled: bool = True,
+        burst_s: float = 2.0,
+        cpu_window_s: float = 60.0,
+        internal_token: str | None = None,
+    ):
+        # MISAKA_EDGE_INTERNAL_TOKEN: a per-boot secret the fleet parent
+        # mints and hands its replicas, presented as the key on the
+        # fleet's OWN control-plane calls (drain, roll checkpoints,
+        # aggregation fetches) — without it an authenticated fleet could
+        # never roll, because no operator key lives in the parent.
+        # Admin-scoped, never persisted, dies with the fleet process.
+        self.internal_token = internal_token
+        self.keyfile = keyfile if auth_enabled else None
+        self.quota_defaults = dict(quota_defaults or {})
+        self.governor = governor if admission_enabled else None
+        self.cpu_reader = cpu_reader
+        self.rate_scale = max(1e-9, float(rate_scale))
+        # armed even with no env defaults: per-key and per-program specs
+        # may arrive later (key-file reload, registry upload)
+        self.quota_enabled = bool(quota_enabled)
+        self.burst_s = burst_s
+        self.cpu_window_s = cpu_window_s
+        self._lock = threading.Lock()
+        self._buckets: dict[tuple[str, str, float], TokenBucket] = {}
+        self._cpu_meters: dict[str, CpuMeter] = {}
+        self._program_quotas: dict[str, dict[str, float]] = {}
+
+    # -- configuration hooks -------------------------------------------------
+
+    @property
+    def armed(self) -> bool:
+        """True when ANY stage can reject (the fast-path gate)."""
+        return (
+            self.keyfile is not None
+            or self.quota_enabled
+            or self.governor is not None
+        )
+
+    def set_program_quota(self, program: str, spec: str | None) -> None:
+        """Install/clear a per-program quota override (the registry calls
+        this when a version with a `quota` upload field becomes latest).
+        Raises QuotaSpecError on a malformed spec — validate-first, like
+        the registry's slo field."""
+        with self._lock:
+            if spec is None:
+                self._program_quotas.pop(program, None)
+            else:
+                self._program_quotas[program] = parse_quota_spec(spec)
+
+    def program_quota(self, program: str | None) -> dict[str, float] | None:
+        # lock-free read: installs swap whole dict VALUES under the
+        # lock, and a dict get is GIL-atomic
+        if program is None or not self._program_quotas:
+            return None
+        return self._program_quotas.get(program)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def resolve_tenant(self, key: str | None,
+                       program: str | None) -> tuple[str, dict | None]:
+        """The tenant a request bills to: its API key's tenant when auth
+        is armed and the key resolves, else the program label (the
+        pre-edge per-program tenancy).  The fleet's per-boot internal
+        token resolves to an admin-scoped synthetic tenant."""
+        if (
+            self.internal_token is not None
+            and key is not None
+            and hmac.compare_digest(
+                # compare BYTES: compare_digest raises TypeError on
+                # non-ASCII str input, and a client-chosen header must
+                # never turn into a 500 (or kill a plane connection)
+                key.encode("utf-8", "surrogateescape"),
+                self.internal_token.encode(),
+            )
+        ):
+            return "_fleet", {"tenant": "_fleet", "admin": True,
+                              "programs": None, "quota": None,
+                              "quota_spec": None, "disabled": False}
+        entry = self.keyfile.lookup(key) if self.keyfile is not None else None
+        if entry is not None:
+            return entry["tenant"], entry
+        return (program or "default"), None
+
+    def _bucket(self, tenant: str, field: str, rate: float) -> TokenBucket:
+        # the RATE is part of the key: a tenant alternating between
+        # programs with different quota overrides must drain two
+        # separate buckets (each bounded), never cause a keyed-by-tenant
+        # bucket to be recreated at full burst on every flip — that
+        # recreation was a complete rate-limit bypass.  Rates come from
+        # validated operator config (env/key-file/program specs), never
+        # from clients, so the per-tenant rate count is small; the cap
+        # below only bounds drift across years of quota ROTATIONS.
+        k = (tenant, field, rate)
+        b = self._buckets.get(k)
+        if b is None:
+            with self._lock:
+                b = self._buckets.get(k)
+                if b is None:
+                    same = [
+                        k2 for k2 in self._buckets
+                        if k2[0] == tenant and k2[1] == field
+                    ]
+                    if len(same) >= 8:
+                        del self._buckets[same[0]]
+                    b = TokenBucket(rate * self.rate_scale, self.burst_s)
+                    self._buckets[k] = b
+        return b
+
+    def _effective_quota(self, entry: dict | None,
+                         program: str | None) -> dict[str, float]:
+        """Field-wise precedence: key entry > program metadata > env.
+        The overwhelmingly common case (no overrides) returns the shared
+        env-default dict without copying — callers only read it.  Key
+        specs are parsed ONCE at key-file load (`quota_spec`)."""
+        pq = self.program_quota(program.partition("@")[0] if program else None)
+        kq = entry.get("quota_spec") if entry is not None else None
+        if pq is None and not kq:
+            return self.quota_defaults
+        q = dict(self.quota_defaults)
+        if pq:
+            q.update(pq)
+        if kq:
+            q.update(kq)
+        return q
+
+    def check(
+        self,
+        route: str,
+        method: str = "POST",
+        key: str | None = None,
+        program: str | None = None,
+        values: int = 1,
+        requests: int = 1,
+    ) -> Decision:
+        """Evaluate the chain for one request (or one compute-plane
+        frame fusing `requests` client requests — frames pack per
+        tenant, so a frame decision IS a tenant decision).  Never
+        raises: the rejection (if any) rides the returned Decision.
+        Metrics are recorded here — every surface gets the same
+        accounting."""
+        stages = route_policy(route, method)
+        tenant, entry = self.resolve_tenant(key, program)
+        if not stages or not self.armed:
+            return Decision(tenant, None, entry)
+        # ALL per-tenant state (buckets, cpu meters, the governor's
+        # fair-share sums) keys on the CAPPED label, like the metric
+        # series: tenant names are client-chosen (the program header
+        # when auth is off), and unbounded dict growth on invented
+        # names would be a memory DoS.  Past the budget, excess tenants
+        # share the "other" state — the same collapse the whole health
+        # plane applies.
+        label = tenant_metric_label(tenant)
+        reject = self._run_stages(stages, label, entry, key, program,
+                                  values, requests)
+        # count per fused client REQUEST, not per frame: a plane frame
+        # coalesces `requests` of them, and the headline counters must
+        # not under-report by exactly the coalescing factor under load
+        if reject is None:
+            _admitted_child(label).inc(max(1, requests))
+        else:
+            _rejected_child(reject.reason, label).inc(max(1, requests))
+        return Decision(tenant, reject, entry)
+
+    def _run_stages(self, stages, tenant_label, entry, key, program,
+                    values, requests=1) -> EdgeReject | None:
+        """`tenant_label` is the CAPPED tenant (check() resolves it) —
+        every stateful stage keys on it."""
+        tenant = tenant_label
+        for stage in stages:
+            if stage in ("auth", "auth_admin") and self.keyfile is not None:
+                if key is None:
+                    return EdgeReject(
+                        401, "unauthenticated",
+                        "API key required (X-Misaka-Key header or "
+                        "Authorization: Bearer <key>)",
+                    )
+                if entry is None:
+                    return EdgeReject(
+                        401, "unauthenticated", "unknown API key"
+                    )
+                if entry.get("disabled"):
+                    return EdgeReject(403, "forbidden", "API key disabled")
+                if stage == "auth_admin" and not entry.get("admin"):
+                    return EdgeReject(
+                        403, "forbidden",
+                        "this route requires an admin-scoped API key",
+                    )
+                allow = entry.get("programs")
+                if allow is not None and program is not None and (
+                    program.partition("@")[0] not in allow
+                ):
+                    return EdgeReject(
+                        403, "forbidden",
+                        f"API key not authorized for program "
+                        f"{program.partition('@')[0]!r}",
+                    )
+            elif stage == "quota" and self.quota_enabled:
+                r = self._check_quota(tenant, entry, program, values,
+                                      requests)
+                if r is not None:
+                    return r
+            elif stage == "admission" and self.governor is not None:
+                r = self.governor.check(tenant, values)
+                if r is not None:
+                    return r
+        return None
+
+    def _check_quota(self, tenant, entry, program,
+                     values, requests=1) -> EdgeReject | None:
+        if faults.armed() and faults.fire("quota_exhaust") is not None:
+            return EdgeReject(
+                429, "rate", "quota exhausted (injected fault)",
+                retry_after=1.0,
+            )
+        q = self._effective_quota(entry, program)
+        if not q:
+            return None
+        if "rps" in q:
+            bucket = self._bucket(tenant, "rps", q["rps"])
+            # a coalesced frame can fuse more requests than the burst
+            # capacity holds tokens — the clients each sent ONE request,
+            # so unlike the oversized-vps case there is nothing for them
+            # to split; clamp the charge at capacity so the frame can
+            # eventually be admitted (the vps/value quota remains the
+            # precise limiter)
+            ok, retry = bucket.take(
+                min(max(1.0, float(requests)), bucket.capacity)
+            )
+            if not ok:
+                return EdgeReject(
+                    429, "rate",
+                    f"request rate quota exhausted "
+                    f"({q['rps']:g} requests/s)",
+                    retry_after=retry,
+                )
+        if "vps" in q:
+            bucket = self._bucket(tenant, "vps", q["vps"])
+            if values > bucket.capacity and requests <= 1:
+                # a SINGLE request the bucket can never hold: a finite
+                # Retry-After would send a compliant client into an
+                # infinite retry loop — answer a terminal 413 instead.
+                # A COALESCED frame (requests > 1) fuses individually
+                # admittable requests, so like the rps stage the charge
+                # clamps at capacity below — 'split the request' would
+                # be unactionable for clients that each sent 50 values.
+                return EdgeReject(
+                    413, "values",
+                    f"request of {values} values exceeds this tenant's "
+                    f"burst capacity ({bucket.capacity:g} at "
+                    f"{q['vps']:g} values/s); split the request",
+                )
+            ok, retry = bucket.take(
+                min(max(1.0, float(values)), bucket.capacity)
+            )
+            if not ok:
+                return EdgeReject(
+                    429, "values",
+                    f"value rate quota exhausted ({q['vps']:g} values/s)",
+                    retry_after=retry,
+                )
+        if "cpu" in q and self.cpu_reader is not None:
+            # cpu budgets are PER PROGRAM by construction: the usage
+            # ledger attributes cpu_seconds to programs, so a program's
+            # budget (its own quota override, or the env default) is
+            # evaluated against its own measured burn — key-level cpu
+            # fields are rejected at key load (billing one tenant for a
+            # program all tenants share would shed the innocent one).
+            # The label rides its own capped set so client-chosen
+            # program names cannot eat the tenant budget.
+            label = _program_state_label(
+                program.partition("@")[0] if program else "default"
+            )
+            with self._lock:
+                meter = self._cpu_meters.get(label)
+                if meter is None:
+                    meter = self._cpu_meters[label] = CpuMeter(
+                        self.cpu_window_s
+                    )
+            ok, retry = meter.check(float(self.cpu_reader(label)), q["cpu"])
+            if not ok:
+                return EdgeReject(
+                    429, "cpu",
+                    f"cpu quota exhausted ({q['cpu']:g} core-seconds/s "
+                    f"over {self.cpu_window_s:g}s)",
+                    retry_after=retry,
+                )
+        return None
+
+    def debug_payload(self) -> dict:
+        """The /healthz `edge` block: which stages are armed."""
+        return {
+            "auth": self.keyfile is not None,
+            "keys": len(self.keyfile) if self.keyfile is not None else 0,
+            "quota": self.quota_enabled,
+            "admission": self.governor is not None,
+            "admission_high": self.governor.high
+            if self.governor is not None else None,
+        }
+
+
+# --- construction -----------------------------------------------------------
+
+_DISARMED = EdgeChain(
+    keyfile=None, quota_defaults=None, governor=None,
+    auth_enabled=False, quota_enabled=False, admission_enabled=False,
+)
+
+_installed: EdgeChain = _DISARMED
+
+
+def install(chain: EdgeChain) -> EdgeChain:
+    """Make `chain` the process's edge (the compute plane and the HTTP
+    handlers read it via current())."""
+    global _installed
+    _installed = chain
+    return chain
+
+
+def reset() -> None:
+    """Restore the disarmed placeholder chain (tests: an installed chain
+    closes over a specific master/registry and must not outlive its
+    fixture)."""
+    install(_DISARMED)
+
+
+def current() -> EdgeChain:
+    return _installed
+
+
+def keyfile_path(environ=os.environ) -> str | None:
+    """MISAKA_API_KEYS, or the conventional <MISAKA_PROGRAMS_DIR>/
+    api_keys.json when that file exists."""
+    p = environ.get("MISAKA_API_KEYS")
+    if p:
+        return p
+    d = environ.get("MISAKA_PROGRAMS_DIR")
+    if d:
+        conv = os.path.join(d, "api_keys.json")
+        if os.path.exists(conv):
+            return conv
+    return None
+
+
+def from_env(
+    signals=None,
+    cpu_reader=None,
+    default_admission_high: int = 65536,
+    environ=os.environ,
+) -> EdgeChain:
+    """Build the process's chain from the env surface.
+
+    Kill switches: MISAKA_EDGE=0 disarms everything; MISAKA_EDGE_AUTH /
+    MISAKA_EDGE_QUOTA / MISAKA_EDGE_ADMISSION=0 disarm one stage — the
+    per-layer switches the A/B overhead gate isolates stages with.
+    MISAKA_ADMISSION_HIGH sets the soft watermark in waiting VALUES
+    (`default_admission_high` otherwise — the engine passes a value that
+    clears the largest legal request body, so the default NEVER sheds
+    what the body cap admits; tune the env down to your latency
+    budget); MISAKA_QUOTA the
+    env-default per-tenant quota spec; MISAKA_QUOTA_BURST_S the bucket
+    burst window (2s); MISAKA_QUOTA_CPU_WINDOW_S the cpu quota's sliding
+    window (60s).  In a fleet, EACH replica enforces the full quota
+    locally (see the in-body note on why 1/N scaling would starve
+    hash-ring-sticky tenants)."""
+    if environ.get("MISAKA_EDGE", "1") == "0":
+        return _DISARMED
+    auth_on = environ.get("MISAKA_EDGE_AUTH", "1") != "0"
+    quota_on = environ.get("MISAKA_EDGE_QUOTA", "1") != "0"
+    admission_on = environ.get("MISAKA_EDGE_ADMISSION", "1") != "0"
+    kf_path = keyfile_path(environ)
+    keyfile = KeyFile(kf_path) if (kf_path and auth_on) else None
+    quota_defaults = parse_quota_spec(environ.get("MISAKA_QUOTA"))
+    governor = None
+    if admission_on and signals is not None:
+        governor = AdmissionGovernor(
+            signals,
+            int(environ.get("MISAKA_ADMISSION_HIGH", "")
+                or default_admission_high),
+        )
+    # In a fleet, every replica enforces the FULL quota locally.  The
+    # tempting 1/N scaling is wrong for program-addressed traffic, which
+    # the router hash-rings to ONE replica — that tenant would be shed
+    # at quota/N while the other replicas' buckets sit idle.  Full-quota
+    # per replica over-admits stateless traffic by up to Nx (admission
+    # control still protects capacity); sharing bucket state across
+    # replicas is the ROADMAP's named phase-2 item.
+    rate_scale = 1.0
+    return EdgeChain(
+        keyfile=keyfile,
+        quota_defaults=quota_defaults,
+        governor=governor,
+        cpu_reader=cpu_reader,
+        rate_scale=rate_scale,
+        auth_enabled=auth_on,
+        quota_enabled=quota_on,
+        admission_enabled=admission_on,
+        burst_s=float(environ.get("MISAKA_QUOTA_BURST_S", "") or 2.0),
+        cpu_window_s=float(
+            environ.get("MISAKA_QUOTA_CPU_WINDOW_S", "") or 60.0
+        ),
+        internal_token=environ.get("MISAKA_EDGE_INTERNAL_TOKEN") or None,
+    )
+
+
+# --- request-key extraction -------------------------------------------------
+
+
+def key_from_headers(headers) -> str | None:
+    """The presented API key: X-Misaka-Key, or Authorization: Bearer.
+    `headers` is any mapping with .get (email.message.Message works)."""
+    k = headers.get("X-Misaka-Key")
+    if k:
+        return k
+    auth = headers.get("Authorization")
+    if auth and auth.startswith("Bearer "):
+        return auth[len("Bearer "):].strip() or None
+    return None
+
+
+# --- TLS on the HTTP edge ---------------------------------------------------
+
+
+def tls_context_from_env(environ=os.environ) -> ssl.SSLContext | None:
+    """A server-side SSLContext from MISAKA_TLS_CERT/MISAKA_TLS_KEY
+    (None when unset — plain HTTP, exactly as before).  Raises on a
+    cert/key that fails to load: a server that silently fell back to
+    plaintext after a bad rotation would be worse than one that refused
+    to boot."""
+    cert = environ.get("MISAKA_TLS_CERT")
+    key = environ.get("MISAKA_TLS_KEY")
+    if not cert and not key:
+        return None
+    if not cert or not key:
+        raise ValueError(
+            "MISAKA_TLS_CERT and MISAKA_TLS_KEY must be set together"
+        )
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(cert, key)
+    return ctx
+
+
+def wrap_server_tls(httpd, context: ssl.SSLContext | None):
+    """Wrap an already-bound http.server socket for TLS.  No-op when
+    `context` is None.  Returns httpd for chaining.
+
+    do_handshake_on_connect=False is load-bearing: with it on, the
+    handshake runs inside accept() — the server's SINGLE accept loop —
+    so one client that connects and sends nothing (a slow-loris, or any
+    plaintext prober) would park the listener and outage every other
+    client.  Deferred, the handshake happens on the handler THREAD's
+    first read, which is exactly where a plain-HTTP idle connection
+    already sits."""
+    if context is not None:
+        httpd.socket = context.wrap_socket(
+            httpd.socket, server_side=True, do_handshake_on_connect=False
+        )
+        httpd.misaka_tls = True
+    return httpd
+
+
+def drain_or_close(handler, max_drain: int = 65536) -> None:
+    """The keep-alive discipline shared by every surface that rejects a
+    POST before its route body runs: a small unread body is drained (the
+    connection stays synchronized), a bulk or unparseable one closes the
+    connection — rejecting at the door must not buffer the flood it is
+    shedding."""
+    try:
+        length = int(handler.headers.get("Content-Length") or 0)
+    except ValueError:
+        length = -1
+    if 0 <= length <= max_drain:
+        handler.rfile.read(length)
+    else:
+        handler.close_connection = True
+
+
+# --- compute-plane shared secret --------------------------------------------
+
+_PLANE_TAG = b"misaka-plane-v1"
+PLANE_HANDSHAKE_LEN = 32
+
+
+def plane_secret(environ=os.environ) -> bytes | None:
+    """MISAKA_PLANE_SECRET (the shared secret the fleet compute plane's
+    handshake uses; unset = open plane, exactly as before).  Accepts
+    MISAKA_PLANE_SECRET_FILE for file-based secret distribution."""
+    s = environ.get("MISAKA_PLANE_SECRET")
+    if s:
+        return s.encode()
+    p = environ.get("MISAKA_PLANE_SECRET_FILE")
+    if p:
+        try:
+            with open(p, "rb") as f:
+                return f.read().strip() or None
+        except OSError:
+            log.error("edge: plane secret file %s unreadable", p)
+            return None
+    return None
+
+
+def plane_handshake(secret: bytes) -> bytes:
+    """The 32 bytes a PlaneClient writes immediately after connect()."""
+    return hmac.new(secret, _PLANE_TAG, hashlib.sha256).digest()
+
+
+def verify_plane_handshake(secret: bytes, presented: bytes) -> bool:
+    return hmac.compare_digest(plane_handshake(secret), presented)

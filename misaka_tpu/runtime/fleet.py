@@ -190,9 +190,15 @@ class _ReplicaHTTP:
     """Tiny keep-alive-free HTTP helper against one replica's loopback
     server (control-plane calls are rare; simplicity over pooling)."""
 
-    def __init__(self, port: int, timeout: float = 10.0):
+    def __init__(self, port: int, timeout: float = 10.0,
+                 key: str | None = None):
         self.port = port
         self.timeout = timeout
+        # the fleet's per-boot internal token (see FleetManager): the
+        # parent's own control-plane calls must pass the replica-side
+        # edge chain when auth is armed — an authenticated fleet that
+        # could not drain/checkpoint its own replicas could never roll
+        self.key = key
 
     def request(self, method: str, path: str, body: bytes | None = None,
                 headers: dict | None = None,
@@ -201,8 +207,11 @@ class _ReplicaHTTP:
             "127.0.0.1", self.port,
             timeout=self.timeout if timeout is None else timeout,
         )
+        headers = dict(headers or {})
+        if self.key is not None and "X-Misaka-Key" not in headers:
+            headers["X-Misaka-Key"] = self.key
         try:
-            conn.request(method, path, body, headers or {})
+            conn.request(method, path, body, headers)
             resp = conn.getresponse()
             payload = resp.read()
             return resp.status, payload, dict(resp.getheaders())
@@ -289,6 +298,13 @@ class FleetManager:
         self._drain_timeout_s = float(drain_timeout_s)
         self._lock = threading.Lock()
         self._closed = False
+        # Per-boot internal control-plane credential: replicas accept it
+        # as an admin-scoped key (MISAKA_EDGE_INTERNAL_TOKEN in
+        # _replica_env -> runtime/edge.py resolve_tenant), so the
+        # fleet's OWN drain/checkpoint/aggregation calls pass the
+        # replica-side edge chain when operator auth is armed.  Random
+        # per boot, never persisted, dies with this process.
+        self._internal_token = os.urandom(16).hex()
         self._restarts_total = 0
         self._rolls_total = 0
         self._last_roll: dict | None = None
@@ -434,6 +450,22 @@ class FleetManager:
             "MISAKA_CHECKPOINT_DIR": slot["ckpt_dir"],
         })
         env.pop("MISAKA_ORPHAN_OK", None)  # replicas die with the fleet
+        # TLS terminates at the frontend workers: a replica serves
+        # loopback HTTP to the control server's proxy and would be
+        # unreachable if it wrapped its own listener
+        env.pop("MISAKA_TLS_CERT", None)
+        env.pop("MISAKA_TLS_KEY", None)
+        from misaka_tpu.runtime import edge as edge_mod
+
+        keyfile = edge_mod.keyfile_path(self._base_env)
+        if keyfile and not self._base_env.get("MISAKA_API_KEYS"):
+            # the conventional <MISAKA_PROGRAMS_DIR>/api_keys.json would
+            # not resolve under the replica's per-replica store override
+            # below — pin the parent's resolved path explicitly
+            env["MISAKA_API_KEYS"] = keyfile
+        # the fleet's own control-plane calls authenticate with this
+        # per-boot token (see __init__)
+        env["MISAKA_EDGE_INTERNAL_TOKEN"] = self._internal_token
         if not self._base_env.get("MISAKA_NATIVE_THREADS") and self.n > 1:
             # N replicas share one box: a full-width native pool EACH
             # (the single-engine default) oversubscribes every core N
@@ -508,7 +540,8 @@ class FleetManager:
         # MISAKA_AUTORUN rules; stale state must not resurrect).
 
     def _wait_replica_ready(self, slot: dict, deadline: float) -> None:
-        rh = _ReplicaHTTP(slot["port"], timeout=2.0)
+        rh = _ReplicaHTTP(slot["port"], timeout=2.0,
+                          key=self._internal_token)
         while time.monotonic() < deadline:
             proc = slot["proc"]
             if proc is not None and proc.poll() is not None:
@@ -622,7 +655,8 @@ class FleetManager:
         slot["run_on_boot"] = None
 
     def _probe_loop(self, slot: dict) -> None:
-        rh = _ReplicaHTTP(slot["port"], timeout=2.0)
+        rh = _ReplicaHTTP(slot["port"], timeout=2.0,
+                          key=self._internal_token)
         while not self._closed:
             time.sleep(self._probe_s)
             if slot["rolling"]:
@@ -787,7 +821,8 @@ class FleetManager:
 
     def _roll_one(self, slot: dict, drain_timeout_s: float) -> dict:
         idx = slot["idx"]
-        rh = _ReplicaHTTP(slot["port"], timeout=10.0)
+        rh = _ReplicaHTTP(slot["port"], timeout=10.0,
+                          key=self._internal_token)
         entry: dict = {"replica": idx}
         # A roll ordered right after a failover is routine (kill one
         # replica, then deploy): wait for a replica that is merely
@@ -910,7 +945,8 @@ class FleetManager:
             settle = time.monotonic() + 2.0
             while slot["rolling"] and time.monotonic() < settle:
                 time.sleep(0.02)
-            rh = _ReplicaHTTP(slot["port"], timeout=5.0)
+            rh = _ReplicaHTTP(slot["port"], timeout=5.0,
+                              key=self._internal_token)
             while not self._closed:
                 if slot["rolling"] or slot["proc"] is not proc:
                     return
@@ -1005,7 +1041,26 @@ def make_fleet_http_server(
     rr_counter = [0]
     import re
 
+    from misaka_tpu.runtime import edge as edge_mod
+
     program_re = re.compile(r"^/programs/([^/]+)(/.*)?$")
+
+    # The control server runs the edge chain's AUTH stage only: the
+    # operator surface (/fleet/roll, lifecycle fan-out) must reject a bad
+    # key HERE — a roll is not proxied, so no replica would — while
+    # quota/admission stay with the replica a request lands on (running
+    # them here too would double-bill every proxied compute request).
+    _kf_path = edge_mod.keyfile_path()
+    _auth_on = (
+        os.environ.get("MISAKA_EDGE", "1") != "0"
+        and os.environ.get("MISAKA_EDGE_AUTH", "1") != "0"
+    )
+    control_chain = edge_mod.EdgeChain(
+        keyfile=edge_mod.KeyFile(_kf_path) if (_kf_path and _auth_on)
+        else None,
+        quota_enabled=False,
+        admission_enabled=False,
+    )
 
     def _gather(slots: list[dict], fn):
         """Apply `fn(slot)` to every slot CONCURRENTLY and return the
@@ -1058,6 +1113,33 @@ def make_fleet_http_server(
             length = int(self.headers.get("Content-Length") or 0)
             return self.rfile.read(length) if length else b""
 
+        def _edge_check(self, path: str, method: str) -> bool:
+            """The control surface's auth stage; True = admitted.  Call
+            AFTER the body is read (keep-alive stays synchronized)."""
+            if not control_chain.armed:
+                return True
+            m = program_re.match(path)
+            program = (
+                m.group(1).partition("@")[0] if m
+                else self.headers.get("X-Misaka-Program") or None
+            )
+            d = control_chain.check(
+                path, method,
+                key=edge_mod.key_from_headers(self.headers),
+                program=program, values=0,
+            )
+            if d.reject is None:
+                return True
+            data = d.reject.message.encode()
+            self.send_response(d.reject.status)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in d.reject.headers():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+            return False
+
         def _pick_slot(self, path: str) -> dict | None:
             """A healthy replica for a proxied request: hash-ring owner
             for program-addressed paths (stickiness), round-robin
@@ -1082,7 +1164,8 @@ def make_fleet_http_server(
                 self._text(503, "fleet down: no healthy engine replica")
                 return
             headers = {}
-            for h in ("Content-Type", "X-Misaka-Program", "X-Misaka-Trace"):
+            for h in ("Content-Type", "X-Misaka-Program", "X-Misaka-Trace",
+                      "X-Misaka-Key", "Authorization"):
                 v = self.headers.get(h)
                 if v:
                     headers[h] = v
@@ -1102,7 +1185,7 @@ def make_fleet_http_server(
             self.send_header("Content-Length", str(len(payload)))
             self.send_header("X-Misaka-Replica", str(slot["idx"]))
             for h in ("X-Misaka-Trace", "Server-Timing", "Deprecation",
-                      "Link"):
+                      "Link", "Retry-After", "WWW-Authenticate"):
                 v = resp_headers.get(h)
                 if v:
                     self.send_header(h, v)
@@ -1125,6 +1208,12 @@ def make_fleet_http_server(
             ctype = self.headers.get("Content-Type")
             if ctype:
                 headers["Content-Type"] = ctype
+            for h in ("X-Misaka-Key", "Authorization"):
+                # credentials fan out with the request: every replica's
+                # own edge chain authenticates the lifecycle change
+                v = self.headers.get(h)
+                if v:
+                    headers[h] = v
 
             def apply(slot: dict) -> tuple[int, bytes]:
                 rh = _ReplicaHTTP(slot["port"], timeout=60.0)
@@ -1187,6 +1276,8 @@ def make_fleet_http_server(
         def do_GET(self):
             try:
                 path = self.path.split("?", 1)[0]
+                if not self._edge_check(path, "GET"):
+                    return
                 if path == "/healthz":
                     st = fleet.state()
                     up_rows = [
@@ -1229,7 +1320,8 @@ def make_fleet_http_server(
                         payload["frontends"] = sup.state()
 
                     def fetch_status(slot: dict):
-                        rh = _ReplicaHTTP(slot["port"], timeout=5.0)
+                        rh = _ReplicaHTTP(slot["port"], timeout=5.0,
+                                          key=fleet._internal_token)
                         try:
                             return rh.get_json("/status")
                         except (OSError, RuntimeError, ValueError) as e:
@@ -1290,7 +1382,8 @@ def make_fleet_http_server(
                     fetched = _gather(
                         slots,
                         lambda s: _ReplicaHTTP(
-                            s["port"], timeout=5.0
+                            s["port"], timeout=5.0,
+                            key=fleet._internal_token,
                         ).get_json("/debug/requests" + qs),
                     )
                     for slot, payload in zip(slots, fetched):
@@ -1314,7 +1407,8 @@ def make_fleet_http_server(
                     fetched = _gather(
                         slots,
                         lambda s: _ReplicaHTTP(
-                            s["port"], timeout=10.0
+                            s["port"], timeout=10.0,
+                            key=fleet._internal_token,
                         ).get_json("/debug/perfetto"),
                     )
                     for slot, payload in zip(slots, fetched):
@@ -1354,6 +1448,8 @@ def make_fleet_http_server(
             try:
                 path = self.path.split("?", 1)[0]
                 body = self._read_body()
+                if not self._edge_check(path, "POST"):
+                    return
                 if path == "/fleet/drain":
                     # replica-internal roll control: proxying it would
                     # arm drain on a ROUND-ROBIN replica the caller

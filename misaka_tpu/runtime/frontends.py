@@ -41,6 +41,7 @@ import logging
 import os
 import re
 import socket
+import ssl
 import struct
 import subprocess
 import sys
@@ -50,6 +51,7 @@ from collections import deque
 from urllib.parse import unquote
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from misaka_tpu.runtime import edge as edge_mod
 from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
 from misaka_tpu.utils import slo
@@ -97,9 +99,19 @@ M_PLANE_DRAIN_REROUTES = metrics.counter(
 #     otherwise     -> payload is `length` bytes of utf-8 error body,
 #                      status is the HTTP code the frontend should answer
 #
-# The metadata is a JSON object {"program": name-or-null, "traces": [...],
+# When MISAKA_PLANE_SECRET is set, every plane connection opens with a
+# 32-byte HMAC handshake (runtime/edge.py plane_handshake) BEFORE the
+# first frame; the engine side closes any connection whose handshake is
+# absent or wrong.  Unset = open plane, exactly as before.
+#
+# The metadata is a JSON object {"program": name-or-null, "key":
+# api-key-or-null, "traces": [...],
 # "edge": [t0_mono, ...]} (a bare JSON list is accepted as
-# traces-only, the pre-registry form).  "edge" appears only while the SLO
+# traces-only, the pre-registry form).  "key" is the API key every
+# request in the frame presented (frames pack per (program, key), so one
+# frame = one tenant): the ENGINE-side edge chain (runtime/edge.py)
+# authenticates it and applies per-tenant quota/admission per frame —
+# quota state must be global, which N worker processes are not.  "edge" appears only while the SLO
 # engine is armed (utils/slo.py): one frontend-receive monotonic
 # timestamp per request, so the engine's
 # per-program SLO windows measure latency from the moment the request hit
@@ -199,6 +211,10 @@ class ComputePlane:
         # as the lease's KeyError (ProgramNotFound), answered as 404.
         self._registry = registry
         self._timeout = timeout
+        # shared-secret plane handshake (MISAKA_PLANE_SECRET,
+        # runtime/edge.py): when armed, a connection must open with the
+        # 32-byte HMAC before its first frame or it is closed
+        self._secret = edge_mod.plane_secret()
         self.path = path
         if os.path.exists(path):
             os.unlink(path)
@@ -289,10 +305,10 @@ class ComputePlane:
         master = self._master
         registry = self._registry
 
-        def parse_meta(blob: bytes) -> tuple[str | None, list, list, bool,
-                                             int]:
-            """(program, traces, edge, probe, hedged) from the frame's
-            JSON metadata.
+        def parse_meta(blob: bytes) -> tuple[str | None, str | None, int,
+                                             list, list, bool, int, list]:
+            """(program, key, reqs, traces, edge, probe, hedged, shed)
+            from the frame's JSON metadata.
 
             The program address must decode even with tracing killed; an
             UNDECODABLE blob raises _BadMeta and fails the frame (it may
@@ -306,21 +322,32 @@ class ComputePlane:
             entries (one receive timestamp per request) feed the SLO
             windows —
             also lenient: a malformed edge list costs the observation,
-            never the frame."""
+            never the frame.  "key" (the frame's API key — one per frame,
+            frames pack per tenant) and "reqs" (how many client requests
+            the frame fused) feed the engine-side edge chain; a
+            malformed key is FATAL like a malformed program — guessing
+            "no key" would turn an authentication failure into the
+            anonymous tenant's quota."""
             if not blob:
-                return None, [], [], False, 0
+                return None, None, 1, [], [], False, 0, []
             import json as _json
 
             probe = False
             hedged = 0
+            key = None
+            reqs = 1
+            shed: list = []
             try:
                 obj = _json.loads(blob.decode())
                 if isinstance(obj, dict):
                     program = obj.get("program") or None
+                    key = obj.get("key") or None
                     segs = obj.get("traces", ())
                     edge_raw = obj.get("edge", ())
                     probe = bool(obj.get("probe"))
                     hedged = int(obj.get("hedged") or 0)
+                    reqs = max(1, int(obj.get("reqs") or 1))
+                    shed = obj.get("shed") or []
                 elif isinstance(obj, list):
                     # the pre-registry traces-only list form
                     program, segs, edge_raw = None, obj, ()
@@ -328,6 +355,8 @@ class ComputePlane:
                     raise ValueError("metadata must be an object or list")
                 if program is not None and not isinstance(program, str):
                     raise ValueError("program must be a string")
+                if key is not None and not isinstance(key, str):
+                    raise ValueError("key must be a string")
             except (ValueError, TypeError, UnicodeDecodeError) as e:
                 raise _BadMeta(str(e)) from e
             traces = []
@@ -353,7 +382,7 @@ class ComputePlane:
                     edge = [float(t0) for t0 in edge_raw]
                 except (ValueError, TypeError):
                     log.debug("dropping malformed plane edge metadata")
-            return program, traces, edge, probe, hedged
+            return program, key, reqs, traces, edge, probe, hedged, shed
 
         def slo_record(program, edge, t_recv, error: bool) -> None:
             """Feed the frame's outcome into the per-program SLO windows:
@@ -376,6 +405,21 @@ class ComputePlane:
                 slo.observe(label, now - t_recv, error=error)
 
         try:
+            if self._secret is not None:
+                # shared-secret handshake BEFORE any frame: a peer that
+                # cannot present the HMAC never gets protocol access —
+                # the fleet compute plane's transport posture when it
+                # leaves the single-host unix socket (ROADMAP phase 2)
+                presented = _recv_exact(
+                    conn, edge_mod.PLANE_HANDSHAKE_LEN
+                )
+                if not edge_mod.verify_plane_handshake(
+                    self._secret, presented
+                ):
+                    log.warning(
+                        "compute plane: bad handshake; closing connection"
+                    )
+                    return
             while not self._closed:
                 n, n_meta = _REQ_HDR.unpack(_recv_exact(conn, 8))
                 if n > MAX_FRAME_VALUES:
@@ -385,7 +429,8 @@ class ComputePlane:
                 raw = _recv_exact(conn, n * 4)
                 meta = _recv_exact(conn, n_meta) if n_meta else b""
                 try:
-                    program, traces, edge, probe, hedged = parse_meta(meta)
+                    (program, key, reqs, traces, edge, probe,
+                     hedged, shed) = parse_meta(meta)
                 except _BadMeta as e:
                     body = f"malformed plane metadata: {e}".encode()
                     conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
@@ -433,6 +478,51 @@ class ComputePlane:
                     M_PLANE_FRAMES.inc()
                     if hedged:
                         M_PLANE_HEDGED.inc(hedged)
+                    if shed:
+                        # worker-local shed-cache hits since the last
+                        # frame: book them here so the headline
+                        # rejected counter covers the whole door, not
+                        # just the decisions this process made (lenient:
+                        # malformed rows cost the count, never the frame)
+                        try:
+                            for t, r, n in shed:
+                                edge_mod.count_shed(
+                                    t if isinstance(t, str) else None,
+                                    str(r), int(n),
+                                )
+                        except (ValueError, TypeError):
+                            log.debug("dropping malformed shed metadata")
+                    # The edge chain, per frame (runtime/edge.py): the
+                    # frontend workers terminate TLS and ship the API
+                    # key along; auth + per-tenant quota + admission run
+                    # HERE, where the state is global — one frame is one
+                    # (program, tenant), so a frame-level decision is a
+                    # tenant-level decision.  Rejections ship the typed
+                    # status with a JSON body the worker unpacks back
+                    # into Retry-After.
+                    chain = edge_mod.current()
+                    if chain.armed:
+                        decision = chain.check(
+                            "/compute_raw", "POST", key=key,
+                            program=program or (
+                                registry.default_name
+                                if registry is not None else None
+                            ),
+                            values=int(n), requests=reqs,
+                        )
+                        if decision.reject is not None:
+                            rej = decision.reject
+                            # the worker's shed cache reports under this
+                            # tenant when it honors the Retry-After
+                            rej.tenant = decision.tenant
+                            body = rej.to_wire()
+                            conn.sendall(
+                                _RESP_HDR.pack(rej.status, len(body))
+                                + body
+                            )
+                            for tr in traces:
+                                tracespan.end(tr, status=rej.status)
+                            continue
                     t_recv = time.monotonic()
                     import numpy as np
 
@@ -559,9 +649,9 @@ class PlaneError(RuntimeError):
 
 class _PlaneRequest:
     __slots__ = ("body", "out", "error", "event", "cancelled", "trace",
-                 "enqueued", "program", "hedged")
+                 "enqueued", "program", "key", "hedged")
 
-    def __init__(self, body: bytes, trace=None, program=None,
+    def __init__(self, body: bytes, trace=None, program=None, key=None,
                  hedged: bool = False):
         self.body = body          # raw little-endian int32 values
         self.out: bytes | None = None
@@ -571,6 +661,7 @@ class _PlaneRequest:
         self.trace = trace        # request trace (utils/tracespan.py) | None
         self.enqueued = time.monotonic()  # frontend.coalesce span start
         self.program = program    # registry address (None = default program)
+        self.key = key            # API key (frames pack per (program, key))
         self.hedged = hedged      # re-routed here after a sibling failed
 
 
@@ -587,11 +678,20 @@ class PlaneClient:
                  replica: int | None = None):
         self._path = path
         self._timeout = timeout
+        # cached once, like ComputePlane: MISAKA_PLANE_SECRET_FILE must
+        # not be re-read from disk on every reconnect
+        self._secret = edge_mod.plane_secret()
         self.replica = replica  # fleet slot index (None = single engine)
         self._cond = threading.Condition()
         self._pending: deque[_PlaneRequest] = deque()
         self._closed = False
         self._inflight = 0
+        # worker-local shed counts awaiting delivery: the shed cache
+        # rejects WITHOUT a plane round trip, so its counts ride the
+        # NEXT frame's metadata to the engine's misaka_edge_rejected
+        # series (eventual: a fully-shed quiet worker delivers when the
+        # hold expires and a request goes through)
+        self._shed: dict[tuple[str, str], int] = {}
         # Adaptive coalesce window, the engine scheduler's policy applied
         # one level out: a frame dispatches immediately when no frame is
         # in flight; while one IS, waiting a few hundred microseconds
@@ -618,16 +718,25 @@ class PlaneClient:
         with self._cond:
             return len(self._pending) + self._inflight
 
+    def report_shed(self, tenant: str | None, reason: str) -> None:
+        """Record one worker-local edge rejection for delivery to the
+        engine's metrics on the next frame."""
+        k = (tenant or "other", reason)
+        with self._cond:
+            self._shed[k] = self._shed.get(k, 0) + 1
+
     def compute_raw(self, body: bytes, timeout: float = 30.0,
-                    program: str | None = None,
+                    program: str | None = None, key: str | None = None,
                     hedged: bool = False) -> bytes:
         """One request's raw int32 body in, raw int32 outputs out.
         `program` addresses a registry program (None = the seeded
-        default); frames coalesce strictly per program.  `hedged` marks
-        a request re-routed here after a sibling replica failed (rides
-        the frame metadata into the replica's hedge counter)."""
+        default); `key` is the request's API key — frames coalesce
+        strictly per (program, key), so the engine-side edge chain can
+        make tenant-level quota/admission decisions per frame.  `hedged`
+        marks a request re-routed here after a sibling replica failed
+        (rides the frame metadata into the replica's hedge counter)."""
         req = _PlaneRequest(body, trace=tracespan.current(), program=program,
-                            hedged=hedged)
+                            key=key, hedged=hedged)
         with self._cond:
             self._pending.append(req)
             self._cond.notify()
@@ -646,6 +755,10 @@ class PlaneClient:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(self._timeout)
         sock.connect(self._path)
+        if self._secret is not None:
+            # shared-secret handshake (MISAKA_PLANE_SECRET): the engine
+            # side reads these 32 bytes before its first frame
+            sock.sendall(edge_mod.plane_handshake(self._secret))
         return sock
 
     def _dispatch_loop(self) -> None:
@@ -662,22 +775,27 @@ class PlaneClient:
                     self._cond.wait(self._window_s)
                     if self._closed:
                         return
-                # One frame = one PROGRAM: the engine side runs a frame
-                # through a single program's ServeBatcher, so coalescing
-                # stays per-program by construction.  The head request
-                # picks the frame's program; later requests for other
-                # programs keep their FIFO position for the next frame
-                # (other dispatcher connections pick them up in parallel).
+                # One frame = one (PROGRAM, KEY): the engine side runs a
+                # frame through a single program's ServeBatcher, so
+                # coalescing stays per-program by construction — and the
+                # edge chain makes a per-TENANT quota/admission decision
+                # per frame, so requests presenting different API keys
+                # must never fuse.  The head request picks the frame's
+                # identity; later requests for other programs/keys keep
+                # their FIFO position for the next frame (other
+                # dispatcher connections pick them up in parallel).
                 batch: list[_PlaneRequest] = []
                 skipped: deque[_PlaneRequest] = deque()
                 program: str | None = None
+                key: str | None = None
                 total = 0
                 while self._pending and total < MAX_FRAME_VALUES * 4:
                     req = self._pending[0]
                     if req.cancelled:
                         self._pending.popleft()
                         continue
-                    if batch and req.program != program:
+                    if batch and (req.program != program
+                                  or req.key != key):
                         skipped.append(self._pending.popleft())
                         continue
                     if total and total + len(req.body) > MAX_FRAME_VALUES * 4:
@@ -685,6 +803,7 @@ class PlaneClient:
                     self._pending.popleft()
                     if not batch:
                         program = req.program
+                        key = req.key
                     batch.append(req)
                     total += len(req.body)
                 while skipped:  # restore FIFO order for other programs
@@ -692,6 +811,9 @@ class PlaneClient:
                 if not batch:
                     continue
                 self._inflight += 1
+                shed_report, self._shed = (
+                    (self._shed, {}) if self._shed else (None, self._shed)
+                )
             # Trace metadata for the frame: each traced request ships its
             # ID + value offset + the spans already complete at frame
             # build (http.parse, frontend.coalesce) so the engine-side
@@ -713,7 +835,9 @@ class PlaneClient:
             slo_armed = slo.armed() or bool(
                 os.environ.get("MISAKA_PROGRAMS_DIR")
             )
-            if traced or program is not None or slo_armed or hedged_count:
+            if (traced or program is not None or key is not None
+                    or slo_armed or hedged_count or len(batch) > 1
+                    or shed_report):
                 import json as _json
 
                 entries = []
@@ -741,10 +865,23 @@ class PlaneClient:
                         edge.append(round(r.enqueued, 6))
                     off += len(r.body) // 4
                 obj = {"program": program, "traces": entries}
+                if key is not None:
+                    obj["key"] = key
+                if len(batch) > 1:
+                    # how many client requests this frame fused: the
+                    # engine-side quota stage bills the rps bucket per
+                    # REQUEST, not per frame
+                    obj["reqs"] = len(batch)
                 if edge:
                     obj["edge"] = edge
                 if hedged_count:
                     obj["hedged"] = hedged_count
+                if shed_report:
+                    # worker-local shed cache hits since the last frame:
+                    # the engine books them on misaka_edge_rejected_total
+                    obj["shed"] = [
+                        [t, r, n] for (t, r), n in shed_report.items()
+                    ]
                 meta = _json.dumps(obj).encode()
             t_ship = now
             frame = (
@@ -808,6 +945,15 @@ class PlaneClient:
                     )
                     for r in batch:
                         r.error = err
+                    if shed_report:
+                        # the frame carrying these shed counts never
+                        # arrived: put them back for the next frame —
+                        # losing them silently under-reports the
+                        # rejected counter during exactly the floods it
+                        # exists to measure
+                        with self._cond:
+                            for sk, n in shed_report.items():
+                                self._shed[sk] = self._shed.get(sk, 0) + n
                 break
             with self._cond:
                 self._inflight -= 1
@@ -936,6 +1082,9 @@ class FleetPlaneRouter:
             )
         self._down_grace = float(down_grace)
         self._suspect_hold = float(suspect_hold)
+        # probe sockets handshake too; cached once (the probe loop runs
+        # 4x/s and must not re-read MISAKA_PLANE_SECRET_FILE each time)
+        self._secret = edge_mod.plane_secret()
         self._closed = False
         threading.Thread(
             target=self._probe_loop, daemon=True,
@@ -950,6 +1099,20 @@ class FleetPlaneRouter:
     def states(self) -> dict[int, str]:
         return {r.idx: r.state for r in self._replicas}
 
+    def depth(self) -> int:
+        """Queued + in-flight frames across every replica client — the
+        worker's local backpressure signal (the edge guard in
+        make_frontend_server)."""
+        return sum(r.client.depth() for r in self._replicas)
+
+    def report_shed(self, tenant: str | None, reason: str) -> None:
+        """Route a worker-local shed count to a replica for metric
+        delivery (any replica: the fleet /metrics aggregates them)."""
+        up = [r for r in self._replicas if r.state == "up"]
+        (up[0] if up else self._replicas[0]).client.report_shed(
+            tenant, reason
+        )
+
     # --- health probing -----------------------------------------------------
 
     def _probe_once(self, r: _RouterReplica) -> str:
@@ -960,6 +1123,8 @@ class FleetPlaneRouter:
             sock.settimeout(1.0)
             try:
                 sock.connect(r.path)
+                if self._secret is not None:
+                    sock.sendall(edge_mod.plane_handshake(self._secret))
                 meta = b'{"probe": 1}'
                 sock.sendall(_REQ_HDR.pack(0, len(meta)) + meta)
                 status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
@@ -1014,7 +1179,8 @@ class FleetPlaneRouter:
         return sorted(up, key=lambda r: (r.client.depth(), r.idx))
 
     def compute_raw(self, body: bytes, timeout: float = 30.0,
-                    program: str | None = None) -> bytes:
+                    program: str | None = None,
+                    key: str | None = None) -> bytes:
         deadline = time.monotonic() + timeout
         tried: set[int] = set()
         hedged = False
@@ -1069,7 +1235,7 @@ class FleetPlaneRouter:
             try:
                 out = r.client.compute_raw(
                     body, timeout=attempt_timeout, program=program,
-                    hedged=hedged,
+                    key=key, hedged=hedged,
                 )
                 r.absolve()  # a served frame clears the hold-down
                 return out
@@ -1149,6 +1315,28 @@ def make_frontend_server(
     engine_host = engine.hostname or "127.0.0.1"
     engine_port = engine.port or 8000
     local = threading.local()
+    # Worker-side edge (runtime/edge.py): the workers TERMINATE TLS and
+    # run one cheap local guard — a hard cap on plane backlog
+    # (MISAKA_PLANE_DEPTH_MAX frames, 0 disables) so a flood cannot grow
+    # this worker's queue without bound while the engine sheds.  All
+    # tenant-stateful policy (auth, quota, admission fair-share) runs
+    # ENGINE-side per frame: N workers each holding 1/Nth of a token
+    # bucket would not be a quota.  MISAKA_EDGE=0 kills the guard too.
+    plane_depth_max = (
+        int(os.environ.get("MISAKA_PLANE_DEPTH_MAX", "") or 256)
+        if os.environ.get("MISAKA_EDGE", "1") != "0"
+        and os.environ.get("MISAKA_EDGE_ADMISSION", "1") != "0" else 0
+    )
+    # Negative-decision cache: when the engine sheds a (program, key)
+    # frame with 429 + Retry-After, this worker honors that Retry-After
+    # LOCALLY — subsequent requests of the same tenant shed in ~100us at
+    # this door instead of queueing a doomed frame behind real work (a
+    # flooding tenant would otherwise occupy plane round trips with
+    # rejections and slow its neighbors).  Entries expire exactly when
+    # the engine said to retry; 401/403 are never cached (a key-file
+    # rotation must take effect at the next request).
+    shed_lock = threading.Lock()
+    shed_until: dict[tuple, tuple[float, "edge_mod.EdgeReject"]] = {}
     # Bodies above this ride the PROXY path instead of the compute plane:
     # the plane exists to fuse many SMALL requests, its frame cap is
     # MAX_FRAME_VALUES, and a single-client bulk body (the big-batch
@@ -1195,6 +1383,12 @@ def make_frontend_server(
             except TimeoutError as e:
                 self.log_error("Request timed out: %r", e)
                 self.close_connection = True
+            except ssl.SSLError as e:
+                # deferred TLS handshake fails on this thread's first
+                # read (edge.wrap_server_tls): one closed connection,
+                # not a stderr traceback per plaintext probe
+                self.log_error("TLS handshake failed: %r", e)
+                self.close_connection = True
 
         def send_response(self, code, message=None):
             self._trace_code = code  # response status for the trace record
@@ -1224,6 +1418,91 @@ def make_frontend_server(
 
         def _text(self, code: int, body: str) -> None:
             self._reply(code, body.encode(), "text/plain; charset=utf-8")
+
+        def _plane_error(self, e: PlaneError, shed_key=None) -> None:
+            """Answer a PlaneError, restoring the edge's typed headers: a
+            401/403/429 frame rejection ships a JSON body with the
+            reason + retry_after (EdgeReject.to_wire) — the client must
+            see the same Retry-After it would on the direct surface.
+            A 429 with Retry-After also arms the local shed cache for
+            `shed_key`: this tenant's next requests reject at THIS door
+            until the advertised backoff expires."""
+            rej = edge_mod.EdgeReject.from_wire(e.status, e.body)
+            if rej is not None:
+                if (
+                    shed_key is not None and e.status == 429
+                    and rej.retry_after is not None
+                ):
+                    # hold at least 250ms even when the bucket's own
+                    # refill estimate is tiny: a flooding tenant must
+                    # not get a plane round trip every few dozen ms —
+                    # its bucket accumulates during the hold, so its
+                    # admitted rate still averages the quota
+                    now = time.monotonic()
+                    until = now + min(max(rej.retry_after, 0.25), 30.0)
+                    with shed_lock:
+                        if len(shed_until) >= 1024:
+                            # the key is client-controlled: sweep the
+                            # expired entries before the dict can grow
+                            # without bound on invented keys, and cap
+                            # hard if a flood outruns expiry
+                            for k in [
+                                k for k, (u, _) in shed_until.items()
+                                if u <= now
+                            ]:
+                                del shed_until[k]
+                            while len(shed_until) >= 4096:
+                                shed_until.pop(next(iter(shed_until)))
+                        shed_until[shed_key] = (until, rej)
+                for k, v in rej.headers():
+                    self._extra_headers.append((k, v))
+                self._text(e.status, rej.message)
+                return
+            self._text(e.status, e.body.decode(errors="replace"))
+
+        def _shed_cached(self, shed_key) -> bool:
+            """True (and answered 429) when this tenant is inside an
+            engine-advertised backoff window."""
+            if not shed_until:
+                return False
+            with shed_lock:
+                hit = shed_until.get(shed_key)
+                if hit is None:
+                    return False
+                until, rej = hit
+                remaining = until - time.monotonic()
+                if remaining <= 0:
+                    del shed_until[shed_key]
+                    return False
+            edge_mod.drain_or_close(self)  # keep-alive discipline
+            self._extra_headers.append(
+                ("Retry-After", str(max(1, int(-(-remaining // 1)))))
+            )
+            self._text(429, rej.message)
+            # the cache hit never reaches the engine: ship the count on
+            # the next frame so misaka_edge_rejected_total stays honest
+            plane.report_shed(getattr(rej, "tenant", None), rej.reason)
+            return True
+
+        def _edge_guard(self) -> bool:
+            """The worker's local backpressure check; True = proceed.
+            A worker whose plane backlog exceeds the cap answers a typed
+            429 + Retry-After WITHOUT reading the request body — the
+            shed must not buffer the flood (connection closes, like the
+            engine's bulk-reject path)."""
+            if not plane_depth_max or plane.depth() < plane_depth_max:
+                return True
+            self.close_connection = True
+            self._extra_headers.append(("Retry-After", "1"))
+            self._text(
+                429,
+                f"frontend overloaded: {plane.depth()} plane frames "
+                f"queued (cap {plane_depth_max}); retry after backoff",
+            )
+            # tenant unknown at this worker (no auth state here): the
+            # backlog-cap shed books under "other"
+            plane.report_shed(None, "overload")
+            return False
 
         def _with_trace(self, inner) -> None:
             """Begin/end the request trace around one handler dispatch —
@@ -1294,7 +1573,11 @@ def make_frontend_server(
                 route = "/" + pm.group(2)
             else:
                 program = self.headers.get("X-Misaka-Program") or None
+            key = edge_mod.key_from_headers(self.headers)
+            shed_key = (program, key)
             if route == "/compute_raw" and "spread=0" not in self.path:
+                if self._shed_cached(shed_key) or not self._edge_guard():
+                    return
                 length_hdr = self.headers.get("Content-Length", "")
                 if length_hdr.isdigit() and int(length_hdr) > plane_body_limit:
                     # bulk body: the engine stripes it directly (the
@@ -1308,13 +1591,15 @@ def make_frontend_server(
                     self._text(400, "body must be raw int32 values")
                     return
                 try:
-                    out = plane.compute_raw(body, program=program)
+                    out = plane.compute_raw(body, program=program, key=key)
                 except PlaneError as e:
-                    self._text(e.status, e.body.decode(errors="replace"))
+                    self._plane_error(e, shed_key)
                     return
                 self._reply(200, out, "application/octet-stream")
                 return
             if route == "/compute":
+                if self._shed_cached(shed_key) or not self._edge_guard():
+                    return
                 body = self._read_body(required=False)
                 if body is None:
                     return
@@ -1335,9 +1620,9 @@ def make_frontend_server(
                     return
                 raw = struct.pack("<i", value)
                 try:
-                    out = plane.compute_raw(raw, program=program)
+                    out = plane.compute_raw(raw, program=program, key=key)
                 except PlaneError as e:
-                    self._text(e.status, e.body.decode(errors="replace"))
+                    self._plane_error(e, shed_key)
                     return
                 result = struct.unpack("<i", out)[0]
                 self._reply(
@@ -1363,6 +1648,13 @@ def make_frontend_server(
                 # program addressing follows proxied requests (e.g. the
                 # legacy /compute_batch text lane) to the engine
                 headers["X-Misaka-Program"] = prog
+            for h in ("X-Misaka-Key", "Authorization"):
+                # credentials follow proxied requests: the engine's edge
+                # chain authenticates them (this worker terminates TLS
+                # but holds no auth state)
+                v = self.headers.get(h)
+                if v:
+                    headers[h] = v
             tr = getattr(self, "_misaka_trace", None)
             if tr is not None:
                 # the trace follows the request to the engine, whose
@@ -1414,7 +1706,8 @@ def make_frontend_server(
                         return
                     continue  # stale pooled socket: retry once, fresh
                 for h in (tracespan.TRACE_HEADER, "Server-Timing",
-                          "Deprecation", "Link"):
+                          "Deprecation", "Link", "Retry-After",
+                          "WWW-Authenticate"):
                     v = resp.getheader(h)
                     if v:
                         self._extra_headers.append((h, v))
@@ -1424,7 +1717,11 @@ def make_frontend_server(
                 )
                 return
 
-    return _ReusePortHTTPServer(("0.0.0.0", public_port), FrontendHandler)
+    httpd = _ReusePortHTTPServer(("0.0.0.0", public_port), FrontendHandler)
+    # TLS terminates at the workers (MISAKA_TLS_CERT/MISAKA_TLS_KEY —
+    # inherited env, so every worker of the pool serves the same cert);
+    # the engine/fleet proxy target behind them stays loopback HTTP.
+    return edge_mod.wrap_server_tls(httpd, edge_mod.tls_context_from_env())
 
 
 def frontend_main(argv=None) -> int:

@@ -27,6 +27,7 @@ import logging
 import os
 import queue
 import re
+import ssl
 import threading
 import time
 import weakref
@@ -156,6 +157,12 @@ M_SERVE_WAITING = metrics.gauge(
 )
 M_SERVE_PASSES = metrics.counter(
     "misaka_serve_passes_total", "Fused serve-scheduler passes dispatched"
+)
+M_SERVE_LANE_ENTRIES = metrics.counter(
+    "misaka_serve_lane_entries_total",
+    "Serve-scheduler entries by priority lane (hot = latency-class small "
+    "requests, cut into passes ahead of the bulk lane's backlog)",
+    ("lane",),
 )
 M_HTTP_REQS = metrics.counter(
     "misaka_http_requests_total", "HTTP requests by route and method",
@@ -293,13 +300,28 @@ class _BatcherShared:
     dead master (and its engine) alive, and exits within one poll interval
     of the master being collected."""
 
-    __slots__ = ("cond", "pending", "inflight", "closed")
+    __slots__ = ("cond", "pending", "hot", "inflight", "closed")
 
     def __init__(self):
         self.cond = threading.Condition()
+        # two priority lanes: `hot` (latency-class small entries) is cut
+        # into passes BEFORE `pending` (bulk) — an interactive request
+        # admitted at the edge never queues behind a 64 MiB bulk body,
+        # whose remaining stripes yield between passes
         self.pending: collections.deque[_BatchEntry] = collections.deque()
+        self.hot: collections.deque[_BatchEntry] = collections.deque()
         self.inflight = 0   # passes currently executing
         self.closed = False
+
+    def queues(self) -> tuple:
+        return (self.hot, self.pending)
+
+    def waiting(self) -> int:
+        """Values enqueued but not yet cut into a pass (both lanes).
+        Call under `cond`."""
+        return sum(
+            e.arr.size - e.taken for q in (self.hot, self.pending) for e in q
+        )
 
 
 def _batcher_worker(shared: _BatcherShared, ref) -> None:
@@ -310,11 +332,11 @@ def _batcher_worker(shared: _BatcherShared, ref) -> None:
         with shared.cond:
             if shared.closed:
                 return
-            if not shared.pending:
+            if not shared.pending and not shared.hot:
                 shared.cond.wait(0.5)
             if shared.closed:
                 return
-            empty = not shared.pending
+            empty = not shared.pending and not shared.hot
         if empty:
             if ref() is None:  # master collected: wind the pool down
                 with shared.cond:
@@ -385,30 +407,44 @@ class ServeBatcher:
         self._n_workers = int(
             os.environ.get("MISAKA_BATCH_PASSES", "") or 0
         ) or min(4, self._n_slots)
+        # Priority-lane split (MISAKA_LANE_SMALL, values): entries at or
+        # under this size ride the hot lane and preempt bulk backlog in
+        # pass packing.  0 disables the split (everything is bulk).
+        self._hot_max = int(os.environ.get("MISAKA_LANE_SMALL", "") or 8192)
         self._shared = _BatcherShared()
         self._started = False
         ref = weakref.ref(self)
         M_SERVE_WAITING.set_function(
-            lambda: len(b._shared.pending) if (b := ref()) is not None else 0
+            lambda: (
+                len(b._shared.pending) + len(b._shared.hot)
+                if (b := ref()) is not None else 0
+            )
         )
 
     # --- the caller side ---------------------------------------------------
 
     def waiting_values(self) -> int:
-        """Values enqueued but not yet cut into a pass (status gauge)."""
+        """Values enqueued but not yet cut into a pass (status gauge and
+        the edge admission governor's live backlog signal)."""
         with self._shared.cond:
-            return sum(e.arr.size - e.taken for e in self._shared.pending)
+            return self._shared.waiting()
 
     def compute(self, arr: np.ndarray, timeout: float,
-                traces=()) -> np.ndarray:
+                traces=(), lane: str | None = None) -> np.ndarray:
         """Enqueue one request's value stream and wait for its outputs
-        (len(arr) in, len(arr) out, order preserved)."""
+        (len(arr) in, len(arr) out, order preserved).  `lane` pins the
+        priority lane ("hot"/"bulk"); default classifies by size against
+        MISAKA_LANE_SMALL — small latency-class entries are cut into
+        passes ahead of bulk backlog."""
         self._ensure_workers()
         entry = _BatchEntry(arr, time.monotonic() + timeout, traces=traces)
         shared = self._shared
         master = self._master
+        if lane is None:
+            lane = "hot" if 0 < arr.size <= self._hot_max else "bulk"
+        M_SERVE_LANE_ENTRIES.labels(lane=lane).inc()
         with shared.cond:
-            shared.pending.append(entry)
+            (shared.hot if lane == "hot" else shared.pending).append(entry)
             shared.cond.notify()
         with master._waiters_lock:
             master._requests_total += 1
@@ -476,18 +512,15 @@ class ServeBatcher:
         # flight (an idle engine must dispatch immediately — no latency tax).
         if self._window_s > 0:
             with shared.cond:
-                if shared.inflight and shared.pending:
+                if shared.inflight and (shared.pending or shared.hot):
                     deadline = time.monotonic() + self._window_s
-                    while (
-                        sum(e.arr.size - e.taken for e in shared.pending)
-                        < self._max_values
-                    ):
+                    while shared.waiting() < self._max_values:
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             break
                         shared.cond.wait(remaining)
         with shared.cond:
-            waiting = sum(e.arr.size - e.taken for e in shared.pending)
+            waiting = shared.waiting()
         if waiting <= 0:
             return
         want = min(
@@ -499,11 +532,18 @@ class ServeBatcher:
             # callers): wait for a release instead of spinning — pass
             # completion notifies this condition.
             with shared.cond:
-                if shared.pending and not shared.closed:
+                if (shared.pending or shared.hot) and not shared.closed:
                     shared.cond.wait(0.05)
             return
         # --- cut: FIFO segments off the waiting entries, splitting a large
-        # tail entry so the pass fills exactly what its slots can refill ---
+        # tail entry so the pass fills exactly what its slots can refill.
+        # The HOT lane cuts first: a bulk entry's remaining stripes yield
+        # to every latency-class entry that arrived since the last pass.
+        # Anti-starvation: when BOTH lanes wait, the hot lane is capped
+        # at 3/4 of the pass budget — strict priority under a sustained
+        # hot stream would park an already-ADMITTED bulk entry until it
+        # died of ComputeTimeout, the exact death admission control
+        # promises admitted work never suffers ---
         budget = min(len(slots) * self._in_cap, self._max_values)
         segs: list[tuple[_BatchEntry, int, int]] = []
         with shared.cond:
@@ -512,27 +552,35 @@ class ServeBatcher:
             # observe a negative delay (seen as a negative serve.queue
             # span in the Perfetto export)
             now = time.monotonic()
-            while shared.pending and budget > 0:
-                e = shared.pending[0]
-                if e.cancelled:
-                    shared.pending.popleft()
-                    continue
-                take = min(budget, e.arr.size - e.taken)
-                if not e.dispatched:
-                    e.dispatched = True
-                    M_SERVE_QUEUE_DELAY.observe(now - e.enqueued)
-                    usage.add_queue(
-                        master.program_label, now - e.enqueued
-                    )
-                    for tr in e.traces:
-                        tracespan.add_span(
-                            tr, "serve.queue", e.enqueued, now - e.enqueued
+            reserve = (
+                max(1, budget // 4) if (shared.hot and shared.pending)
+                else 0
+            )
+            caps = (max(1, budget - reserve), budget)
+            for queue, cap in zip(shared.queues(), caps):
+                while queue and budget > 0 and cap > 0:
+                    e = queue[0]
+                    if e.cancelled:
+                        queue.popleft()
+                        continue
+                    take = min(budget, cap, e.arr.size - e.taken)
+                    if not e.dispatched:
+                        e.dispatched = True
+                        M_SERVE_QUEUE_DELAY.observe(now - e.enqueued)
+                        usage.add_queue(
+                            master.program_label, now - e.enqueued
                         )
-                segs.append((e, e.taken, take))
-                e.taken += take
-                budget -= take
-                if e.taken >= e.arr.size:
-                    shared.pending.popleft()
+                        for tr in e.traces:
+                            tracespan.add_span(
+                                tr, "serve.queue", e.enqueued,
+                                now - e.enqueued
+                            )
+                    segs.append((e, e.taken, take))
+                    e.taken += take
+                    budget -= take
+                    cap -= take
+                    if e.taken >= e.arr.size:
+                        queue.popleft()
             if segs:
                 shared.inflight += 1
         if not segs:  # another worker drained the queue first
@@ -2598,6 +2646,7 @@ def make_http_server(
     checkpoint_dir: str | None = None,
     profile_dir: str | None = None,
     registry=None,
+    tls=None,
 ) -> ThreadingHTTPServer:
     """The five client routes (master.go:90-224), byte-compatible, plus the
     additive /status, /trace, /checkpoint, /restore, /profile/* routes.
@@ -2613,6 +2662,19 @@ def make_http_server(
     program — full backward compatibility.  Unknown programs answer a
     typed 404.  registry=None (the default) keeps the pre-registry
     single-program surface exactly.
+
+    `tls` selects transport security for THIS listener: None (default)
+    reads MISAKA_TLS_CERT/MISAKA_TLS_KEY from the env (unset = plain
+    HTTP), False forces plain HTTP even with the env set (the engine
+    behind a TLS-terminating frontend tier listens on loopback), and an
+    ssl.SSLContext is used as given.
+
+    The edge middleware chain (runtime/edge.py) is built from the env and
+    evaluated ahead of every route body: API-key auth, per-tenant quotas,
+    and overload admission control fed by the LIVE ServeBatcher backlog.
+    MISAKA_EDGE=0 (or the per-stage switches) disarms it — the default
+    env (no key file, no MISAKA_QUOTA) keeps every existing surface
+    byte-compatible.
 
     HTTP checkpointing is DISABLED unless `checkpoint_dir` is configured;
     when enabled, clients pass a bare checkpoint NAME (no path separators)
@@ -2649,6 +2711,61 @@ def make_http_server(
     from misaka_tpu.utils import buildinfo
 
     buildinfo.install_metric()
+
+    # The production edge (runtime/edge.py): auth + quota + admission,
+    # composed per route, evaluated before any route body below.  The
+    # admission governor's live backlog signal is the ServeBatcher
+    # waiting-values count — summed across every active per-program
+    # engine when a registry is armed (the seeded default's engine IS
+    # `master`, so the registry sum already covers it).
+    from misaka_tpu.runtime import edge as edge_mod
+
+    _slo_page_cache = [0.0, False]  # (last-eval monotonic, page?)
+    _waiting_cache = [0.0, 0]       # (last-read monotonic, waiting values)
+
+    def _edge_signals() -> tuple[int, bool]:
+        now = time.monotonic()
+        # waiting_values takes the ServeBatcher's condition lock — the
+        # SAME lock the dispatcher workers hold while cutting passes —
+        # so a per-request read from 64 handler threads convoys against
+        # the scheduler itself.  A 25ms-stale backlog signal sheds the
+        # same sustained overloads (which build over hundreds of ms)
+        # without the contention.
+        if now - _waiting_cache[0] > 0.025:
+            _waiting_cache[0] = now
+            if registry is not None:
+                _waiting_cache[1] = registry.waiting_values()
+            else:
+                b = getattr(master, "_batcher", None)
+                _waiting_cache[1] = (
+                    b.waiting_values() if b is not None else 0
+                )
+        # burn-rate state changes on multi-second timescales but this
+        # closure runs per admitted request: cache the page bit for
+        # 0.25s (overall_state walks every program's windows)
+        if now - _slo_page_cache[0] > 0.25:
+            _slo_page_cache[0] = now
+            _slo_page_cache[1] = slo.overall_state() == "page"
+        return _waiting_cache[1], _slo_page_cache[1]
+
+    # Default admission watermark: clears TWO maximum-size legal bodies
+    # (MISAKA_MAX_BODY is int32 values x 4) — a request the body cap
+    # admits must never be shed by the default watermark right after.
+    # Real deployments tune MISAKA_ADMISSION_HIGH down to their latency
+    # budget (waiting values / serving rate ~= queueing delay).
+    _max_body_default = int(
+        os.environ.get("MISAKA_MAX_BODY", "") or 64 * 1024 * 1024
+    )
+    edge_chain = edge_mod.from_env(
+        signals=_edge_signals,
+        cpu_reader=lambda label: usage.account(label).cpu_seconds,
+        default_admission_high=max(65536, (_max_body_default // 4) * 2),
+    )
+    edge_mod.install(edge_chain)
+    if registry is not None:
+        # persisted per-program quota overrides predate this chain (the
+        # registry reloads its store at construction, before any server)
+        registry.install_quotas(edge_chain)
 
     _name_re = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
     # Request-body ceiling for the bulk lanes (default 64 MiB): an
@@ -2748,6 +2865,13 @@ def make_http_server(
                 # a read or write timed out: discard this connection
                 self.log_error("Request timed out: %r", e)
                 self.close_connection = True
+            except ssl.SSLError as e:
+                # deferred TLS handshake (edge.wrap_server_tls) fails on
+                # the handler thread's first read: plaintext probers and
+                # bad clients must cost one closed connection, not a
+                # stderr traceback per attempt
+                self.log_error("TLS handshake failed: %r", e)
+                self.close_connection = True
 
         def _observed(self, method: str, inner) -> None:
             """Per-route request counter + error counter by status code +
@@ -2761,6 +2885,7 @@ def make_http_server(
             self._metrics_code = None  # reset: keep-alive reuses the handler
             self._extra_headers = []   # per-request; keep-alive reuse
             self._misaka_program = None  # set by _handle_post's resolution
+            self._misaka_tenant = None   # set by the edge check
             trace = tracespan.begin(
                 self.headers.get(tracespan.TRACE_HEADER), route=route
             )
@@ -2840,6 +2965,38 @@ def make_http_server(
             self.end_headers()
             self.wfile.write(data)
 
+        def _edge_check(self, route: str, method: str,
+                        values: int = 1) -> bool:
+            """Evaluate the edge chain for this request; True = admitted.
+            A rejection answers the typed status (Retry-After /
+            WWW-Authenticate headers included) and records an
+            `edge.reject` span on the request trace so tenant + reason
+            ride the flight recorder."""
+            if not edge_chain.armed:
+                return True
+            decision = edge_chain.check(
+                route, method,
+                key=edge_mod.key_from_headers(self.headers),
+                program=self._misaka_program,
+                values=values,
+            )
+            self._misaka_tenant = decision.tenant
+            rej = decision.reject
+            if rej is None:
+                return True
+            if method == "POST":
+                edge_mod.drain_or_close(self)  # keep-alive discipline
+            for k, v in rej.headers():
+                self._extra_headers.append((k, v))
+            tr = getattr(self, "_misaka_trace", None)
+            if tr is not None:
+                tracespan.add_span(
+                    tr, "edge.reject", time.monotonic(), 0.0,
+                    {"tenant": decision.tenant, "reason": rej.reason},
+                )
+            self._text(rej.status, rej.message)
+            return False
+
         def _form(self) -> dict[str, str]:
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length).decode()
@@ -2869,6 +3026,8 @@ def make_http_server(
             # master.go:104).
             try:
                 parsed = urlparse(self.path)
+                if not self._edge_check(parsed.path, "GET", values=0):
+                    return
                 if parsed.path == "/metrics":
                     # Prometheus text exposition v0.0.4 from the process
                     # registry: HTTP surface, device loop, native pool,
@@ -2917,6 +3076,11 @@ def make_http_server(
                         degraded = bool(degraded) or slo_state == "page"
                     if degraded is not None:
                         payload["degraded"] = degraded
+                    if edge_chain.armed:
+                        # which edge stages guard this listener (and the
+                        # live admission watermark) — the ops view of
+                        # the door
+                        payload["edge"] = edge_chain.debug_payload()
                     self._json(payload)
                     return
                 if parsed.path == "/status":
@@ -3076,6 +3240,23 @@ def make_http_server(
                     else registry.default_name if registry is not None
                     else None
                 )
+                # The edge chain, BEFORE any route body: auth, quota, and
+                # admission reject at the door — typed 401/403/429 with
+                # Retry-After — while the plane still has headroom.  The
+                # value estimate for quota/admission comes from the wire
+                # size (raw int32s are 4 bytes each; decimal text ~8) —
+                # exact enough for fair-share, and free.
+                try:
+                    _clen = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    _clen = 0
+                _est = (
+                    max(1, _clen // 4) if path == "/compute_raw"
+                    else max(1, _clen // 8) if path == "/compute_batch"
+                    else 1
+                )
+                if not self._edge_check(path, "POST", values=_est):
+                    return
                 if path == "/run":
                     self._form()  # drain any body (keep-alive sync)
                     try:
@@ -3313,6 +3494,7 @@ def make_http_server(
                             topology_json=form.get("topology"),
                             compose=form.get("compose"),
                             slo_spec=form.get("slo"),
+                            quota_spec=form.get("quota"),
                         )
                     except (
                         RegistryError,
@@ -3419,4 +3601,11 @@ def make_http_server(
                 except Exception:
                     pass
 
-    return ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    if tls is False:
+        ctx = None
+    elif tls is None:
+        ctx = edge_mod.tls_context_from_env()
+    else:
+        ctx = tls
+    return edge_mod.wrap_server_tls(httpd, ctx)

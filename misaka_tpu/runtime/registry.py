@@ -58,6 +58,7 @@ import re
 import threading
 import time
 
+from misaka_tpu.runtime import edge as edge_mod
 from misaka_tpu.runtime import usage
 from misaka_tpu.runtime.topology import Topology
 from misaka_tpu.utils import faults
@@ -338,6 +339,11 @@ class ProgramRegistry:
                         "registry: ignoring corrupt slo spec on %s@%s",
                         name, entry.aliases["latest"],
                     )
+            # NOTE: persisted per-program quota overrides are installed by
+            # install_quotas() when make_http_server builds the process
+            # chain — the registry boots BEFORE any chain exists, and a
+            # write to edge.current() here would land on the disarmed
+            # placeholder (or a previous server's chain)
             log.info(
                 "registry: loaded program %s (%d version(s), latest %s)",
                 name, len(entry.versions), entry.aliases["latest"],
@@ -455,6 +461,44 @@ class ProgramRegistry:
     def default_name(self) -> str | None:
         return self._default
 
+    def waiting_values(self) -> int:
+        """Live ServeBatcher backlog summed across every active engine —
+        the edge admission governor's queue-depth signal (the seeded
+        default program's engine is the boot master, so this sum covers
+        the whole process)."""
+        with self._cond:
+            masters = [
+                e.master for e in self._engines.values()
+                if e.master is not None
+            ]
+        total = 0
+        for m in masters:
+            b = getattr(m, "_batcher", None)
+            if b is not None:
+                total += b.waiting_values()
+        return total
+
+    def install_quotas(self, chain) -> None:
+        """Install every program's latest `quota` override into an edge
+        chain.  make_http_server calls this after building the process
+        chain: the registry boots (and reloads its persisted store)
+        BEFORE the chain exists, so boot-time overrides would otherwise
+        land on the disarmed placeholder."""
+        with self._cond:
+            specs = {
+                name: entry.versions[entry.aliases["latest"]].get("quota")
+                for name, entry in self._entries.items()
+                if entry.aliases.get("latest") in entry.versions
+            }
+        for name, spec in specs.items():
+            if spec:
+                try:
+                    chain.set_program_quota(name, spec)
+                except edge_mod.QuotaSpecError:
+                    log.warning(
+                        "registry: ignoring corrupt quota spec on %s", name
+                    )
+
     # --- publish / hot-swap -------------------------------------------------
 
     def publish(
@@ -465,6 +509,7 @@ class ProgramRegistry:
         topology_json: str | None = None,
         compose: str | None = None,
         slo_spec: str | None = None,
+        quota_spec: str | None = None,
     ) -> dict:
         """Upload one program version; hot-swap the live engine when the
         `latest` alias moves under it.
@@ -480,7 +525,14 @@ class ProgramRegistry:
         burn-rate engine (utils/slo.py) when the version becomes
         `latest`, overriding the env-wide default objectives for this
         program.  Validated HERE — a malformed spec is a 400 that
-        touches nothing, same as a bad source."""
+        touches nothing, same as a bad source.
+
+        `quota_spec` (the upload form's `quota` field) declares the
+        per-program quota override in MISAKA_QUOTA grammar
+        ("rps<100,vps<500000,cpu<0.5", runtime/edge.py): installed into
+        the edge chain when the version becomes `latest`, field-wise
+        overriding the env default (a key-file quota still wins over
+        both).  Validated here like the slo field."""
         if not NAME_RE.match(name):
             raise RegistryError(f"invalid program name {name!r}")
         if slo_spec is not None:
@@ -488,6 +540,11 @@ class ProgramRegistry:
                 slo.parse_spec(slo_spec)  # validate-first, like the source
             except slo.SLOSpecError as e:
                 raise RegistryError(f"invalid slo spec: {e}") from e
+        if quota_spec is not None:
+            try:
+                edge_mod.parse_quota_spec(quota_spec)
+            except edge_mod.QuotaSpecError as e:
+                raise RegistryError(f"invalid quota spec: {e}") from e
         topo = self.parse_source(
             tis=tis, topology_json=topology_json, compose=compose
         )
@@ -497,6 +554,8 @@ class ProgramRegistry:
         meta = {"source": canonical, "created_unix": round(time.time(), 3)}
         if slo_spec is not None:
             meta["slo"] = slo_spec
+        if quota_spec is not None:
+            meta["quota"] = quota_spec
         with self._cond:
             entry = self._entries.get(name)
             if entry is not None and entry.pinned:
@@ -514,15 +573,22 @@ class ProgramRegistry:
                 slo_changed = False
                 if created:
                     entry.versions[version] = meta
-                elif (
-                    slo_spec is not None
-                    and entry.versions[version].get("slo") != slo_spec
-                ):
+                else:
                     # content-addressed dedup keeps the stored meta; an
-                    # slo re-declaration on a known version still lands
-                    # (and is the ONLY dedup'd case worth a disk rewrite)
-                    entry.versions[version]["slo"] = slo_spec
-                    slo_changed = True
+                    # slo/quota re-declaration on a known version still
+                    # lands (the ONLY dedup'd cases worth a disk rewrite)
+                    if (
+                        slo_spec is not None
+                        and entry.versions[version].get("slo") != slo_spec
+                    ):
+                        entry.versions[version]["slo"] = slo_spec
+                        slo_changed = True
+                    if (
+                        quota_spec is not None
+                        and entry.versions[version].get("quota") != quota_spec
+                    ):
+                        entry.versions[version]["quota"] = quota_spec
+                        slo_changed = True
                 meta = entry.versions[version]
                 prev = entry.aliases.get("latest")
                 old_key = (name, prev) if prev is not None else None
@@ -553,6 +619,16 @@ class ProgramRegistry:
             except slo.SLOSpecError as e:
                 log.warning("registry: slo override for %s not installed: %s",
                             name, e)
+            # the new `latest` owns this program's quota override too: a
+            # latest without one clears any previous override back to the
+            # env/key-file defaults (runtime/edge.py precedence)
+            try:
+                edge_mod.current().set_program_quota(name, meta.get("quota"))
+            except edge_mod.QuotaSpecError as e:
+                log.warning(
+                    "registry: quota override for %s not installed: %s",
+                    name, e,
+                )
             return {
                 "name": name,
                 "version": version,

@@ -71,6 +71,17 @@ Named points (the hook sites live next to the code they break):
                     index — siblings stay healthy, which is exactly what
                     the hedge contract is tested against.  Use @prob to
                     blackhole a fraction of frames.
+  overload        — the edge admission governor (runtime/edge.py) treats
+                    the plane as saturated and sheds with a typed 429 +
+                    Retry-After, without needing 4x real load.  The
+                    scoped form `overload:<tenant>` saturates ONLY that
+                    tenant's admissions — the fair-share shed drill (the
+                    flooded tenant sheds, its neighbor's in-quota
+                    traffic sees zero errors; tests/test_chaos.py).
+  quota_exhaust   — every quota check at the edge (runtime/edge.py)
+                    reports its token bucket empty: the typed-429 +
+                    Retry-After client-backoff path, exercised at the
+                    real admission sites.
 
 Fault checks are zero-cost when nothing is armed (`fire` returns None
 after one dict lookup on an empty dict); the module imports stdlib only —
@@ -93,6 +104,8 @@ POINTS = frozenset({
     "serve_delay",
     "replica_kill",
     "replica_blackhole",
+    "overload",
+    "quota_exhaust",
 })
 
 # Points that accept a ":<qualifier>" suffix scoping the fault to one
@@ -100,7 +113,7 @@ POINTS = frozenset({
 # registry program's serve passes (runtime/master.py ServeBatcher) — the
 # per-tenant SLO chaos scenario, where one program must page while its
 # neighbors stay green.
-SCOPED_POINTS = frozenset({"serve_delay", "replica_blackhole"})
+SCOPED_POINTS = frozenset({"serve_delay", "replica_blackhole", "overload"})
 
 
 class FaultSpecError(ValueError):

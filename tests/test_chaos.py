@@ -649,3 +649,122 @@ def test_hot_swap_under_pooled_load_zero_errors():
     master.pause()
     reg.close()
     httpd.shutdown()
+
+
+# --- the production edge: overload shed + quota exhaustion ------------------
+
+
+def test_overload_shed_tenant_isolation(tmp_path, monkeypatch):
+    """The edge shed drill at the REAL admission sites (runtime/edge.py):
+    with `overload:<tenant>` armed, every flooded-tenant request is shed
+    with a typed 429 + Retry-After at the door, while the neighbor
+    tenant's in-quota traffic sees ZERO client-visible errors — and the
+    shed is visible on misaka_edge_rejected_total with tenant labels."""
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+    from misaka_tpu.runtime import edge
+
+    keyfile = tmp_path / "keys.json"
+    with open(keyfile, "w") as f:
+        json.dump({"keys": [
+            {"key": "flood-key", "tenant": "flood"},
+            {"key": "good-key", "tenant": "good"},
+        ]}, f)
+    monkeypatch.setenv("MISAKA_API_KEYS", str(keyfile))
+    m = _master(batch=4)
+    m.run()
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        before = _snap().get(
+            'misaka_edge_rejected_total{reason="overload",tenant="flood"}', 0
+        )
+        faults.configure("overload:flood")
+        results = {"flood_429": 0, "flood_other": 0, "good_err": 0,
+                   "good_ok": 0}
+        lock = threading.Lock()
+
+        def flood_worker():
+            c = MisakaClient(base, api_key="flood-key")
+            for _ in range(10):
+                try:
+                    c.compute(1)
+                    with lock:
+                        results["flood_other"] += 1
+                except MisakaClientError as e:
+                    with lock:
+                        if e.status == 429 and e.retry_after is not None:
+                            results["flood_429"] += 1
+                        else:
+                            results["flood_other"] += 1
+            c.close()
+
+        def good_worker():
+            c = MisakaClient(base, api_key="good-key")
+            for i in range(10):
+                try:
+                    assert int(c.compute(i)) == i + 2
+                    with lock:
+                        results["good_ok"] += 1
+                except Exception:
+                    with lock:
+                        results["good_err"] += 1
+            c.close()
+
+        threads = [threading.Thread(target=flood_worker) for _ in range(4)]
+        threads += [threading.Thread(target=good_worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        # every flooded request shed with the typed 429; zero of anything
+        # else — and the neighbor saw zero errors of any kind
+        assert results["flood_429"] == 40
+        assert results["flood_other"] == 0
+        assert results["good_ok"] == 20
+        assert results["good_err"] == 0
+        after = _snap().get(
+            'misaka_edge_rejected_total{reason="overload",tenant="flood"}', 0
+        )
+        assert after - before == 40
+    finally:
+        faults.configure(None)
+        edge.reset()
+        m.pause()
+        httpd.shutdown()
+
+
+def test_quota_exhaust_fault_backs_clients_off(tmp_path, monkeypatch):
+    """`quota_exhaust` trips the quota stage at its real site: typed 429
+    whose Retry-After the client surfaces (MisakaClientError.retry_after)
+    so callers back off instead of retrying hot."""
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+    from misaka_tpu.runtime import edge
+
+    keyfile = tmp_path / "keys.json"
+    with open(keyfile, "w") as f:
+        json.dump({"keys": [{"key": "k", "tenant": "t"}]}, f)
+    monkeypatch.setenv("MISAKA_API_KEYS", str(keyfile))
+    m = _master(batch=2)
+    m.run()
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    c = MisakaClient(
+        f"http://127.0.0.1:{httpd.server_address[1]}", api_key="k"
+    )
+    try:
+        assert int(c.compute(1)) == 3
+        faults.configure("quota_exhaust")
+        with pytest.raises(MisakaClientError) as ei:
+            c.compute(1)
+        assert ei.value.status == 429
+        assert ei.value.retry_after is not None
+        # recovery: disarm and the tenant serves again
+        faults.configure(None)
+        assert int(c.compute(2)) == 4
+    finally:
+        faults.configure(None)
+        edge.reset()
+        c.close()
+        m.pause()
+        httpd.shutdown()

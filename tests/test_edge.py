@@ -1,0 +1,1007 @@
+"""The production edge (runtime/edge.py): middleware chain, auth, quotas,
+admission control, priority lanes, plane handshake, and TLS.
+
+Covers the chain itself (ordering, per-route composition, kill switches),
+the key file (scopes, hot reload, malformed-file behavior), the typed
+401/403/429 contract on every surface — direct engine HTTP, the frontend
+compute plane, and the fleet control server — plus the ServeBatcher's
+priority lanes and the chaos points (`overload[:<tenant>]`,
+`quota_exhaust`) at the real admission sites.
+"""
+
+import http.client
+import json
+import os
+import shutil
+import socket
+import struct
+import subprocess
+import threading
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.client import MisakaClient, MisakaClientError
+from misaka_tpu.runtime import edge
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.utils import faults
+
+
+def _master(batch=4, engine="scan", **kw):
+    return MasterNode(
+        networks.add2(in_cap=16, out_cap=16, stack_cap=16),
+        chunk_steps=32, batch=batch, engine=engine, **kw,
+    )
+
+
+def _write_keys(path, entries) -> str:
+    with open(path, "w") as f:
+        json.dump({"keys": entries}, f)
+    return str(path)
+
+
+KEYS = [
+    {"key": "adm-secret", "tenant": "ops", "admin": True},
+    {"key": "bob-secret", "tenant": "bob", "quota": "rps<2"},
+    {"key": "eve-secret", "tenant": "eve", "disabled": True},
+    {"key": "pin-secret", "tenant": "pin", "programs": ["dense"]},
+]
+
+
+@pytest.fixture(autouse=True)
+def _edge_cleanup():
+    yield
+    edge.reset()
+    faults.configure(None)
+
+
+@pytest.fixture
+def served(tmp_path, monkeypatch):
+    """An engine HTTP server with the edge armed: key file + env quota."""
+    kf = _write_keys(tmp_path / "keys.json", KEYS)
+    monkeypatch.setenv("MISAKA_API_KEYS", kf)
+    m = _master(batch=2)
+    m.run()
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield m, httpd.server_address[1], kf
+    finally:
+        m.pause()
+        httpd.shutdown()
+
+
+# --- chain units ------------------------------------------------------------
+
+
+def test_quota_spec_grammar():
+    assert edge.parse_quota_spec("rps<100,vps<50000,cpu<0.5") == {
+        "rps": 100.0, "vps": 50000.0, "cpu": 0.5,
+    }
+    assert edge.parse_quota_spec("rps=3") == {"rps": 3.0}
+    assert edge.parse_quota_spec(None) == {}
+    assert edge.parse_quota_spec("") == {}
+    for bad in ("zps<1", "rps<abc", "rps<0", "rps<-1", "rps"):
+        with pytest.raises(edge.QuotaSpecError):
+            edge.parse_quota_spec(bad)
+
+
+def test_token_bucket_math():
+    b = edge.TokenBucket(10.0, burst_s=2.0)  # capacity 20
+    ok, _ = b.take(20)
+    assert ok
+    ok, retry = b.take(1)
+    assert not ok and 0 < retry <= 0.2
+    time.sleep(0.15)
+    ok, _ = b.take(1)  # ~1.5 tokens refilled
+    assert ok
+
+
+def test_route_policy_composition():
+    assert edge.route_policy("/healthz", "GET") == ()
+    assert edge.route_policy("/metrics", "GET") == ()
+    assert edge.route_policy("/compute") == ("auth", "quota", "admission")
+    assert edge.route_policy("/compute_raw") == (
+        "auth", "quota", "admission")
+    for admin in ("/run", "/pause", "/load", "/checkpoint", "/fleet/roll"):
+        assert edge.route_policy(admin) == ("auth_admin",)
+    assert edge.route_policy("/programs", "POST") == ("auth_admin",)
+    assert edge.route_policy("/programs", "GET") == ("auth",)
+    assert edge.route_policy("/status", "GET") == ("auth",)
+    assert edge.route_policy("/debug/usage", "GET") == ("auth",)
+
+
+def test_chain_ordering_auth_rejects_before_quota(tmp_path):
+    """The chain is ORDERED: an unauthenticated request must answer 401,
+    never leak that a quota exists (or bill a bucket)."""
+    kf = edge.KeyFile(_write_keys(tmp_path / "k.json", KEYS))
+    chain = edge.EdgeChain(keyfile=kf, quota_defaults={"rps": 0.001})
+    d = chain.check("/compute", key="wrong")
+    assert d.reject is not None and d.reject.status == 401
+    assert d.reject.reason == "unauthenticated"
+    # a valid key then hits the quota stage
+    ok = chain.check("/compute", key="bob-secret")
+    assert ok.tenant == "bob" and ok.reject is None  # burst tokens
+    for _ in range(8):
+        d = chain.check("/compute", key="bob-secret")
+        if d.reject is not None:
+            break
+    assert d.reject is not None and d.reject.status == 429
+    assert d.reject.retry_after is not None and d.reject.retry_after > 0
+
+
+def test_key_scopes_admin_programs_disabled(tmp_path):
+    kf = edge.KeyFile(_write_keys(tmp_path / "k.json", KEYS))
+    chain = edge.EdgeChain(keyfile=kf)
+    # admin route needs admin scope
+    assert chain.check("/pause", key="adm-secret").reject is None
+    d = chain.check("/pause", key="bob-secret")
+    assert d.reject is not None and d.reject.status == 403
+    # disabled key: 403 everywhere
+    d = chain.check("/compute", key="eve-secret")
+    assert d.reject is not None and d.reject.status == 403
+    # program allowlist: 403 outside it, admitted inside
+    assert chain.check(
+        "/compute", key="pin-secret", program="dense"
+    ).reject is None
+    assert chain.check(
+        "/compute", key="pin-secret", program="dense@abc123"
+    ).reject is None
+    d = chain.check("/compute", key="pin-secret", program="compact")
+    assert d.reject is not None and d.reject.status == 403
+    # missing key on a guarded route
+    d = chain.check("/compute", key=None)
+    assert d.reject is not None and d.reject.status == 401
+
+
+def test_keyfile_hot_reload_and_malformed(tmp_path):
+    path = tmp_path / "k.json"
+    _write_keys(path, [{"key": "a", "tenant": "t1"}])
+    kf = edge.KeyFile(str(path))
+    assert kf.lookup("a")["tenant"] == "t1"
+    assert kf.lookup("b") is None
+    # rotate: stat throttle is 0.5s, so age past it and bump mtime
+    time.sleep(0.6)
+    _write_keys(path, [{"key": "b", "tenant": "t2"}])
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    assert kf.lookup("b")["tenant"] == "t2"
+    assert kf.lookup("a") is None
+    # a malformed rewrite KEEPS the previous table (never opens the edge,
+    # never locks everyone out)
+    time.sleep(0.6)
+    with open(path, "w") as f:
+        f.write("{not json")
+    os.utime(path, (time.time() + 10, time.time() + 10))
+    assert kf.lookup("b")["tenant"] == "t2"
+
+
+def test_kill_switches():
+    base = {"MISAKA_API_KEYS": "/nonexistent-keys.json",
+            "MISAKA_QUOTA": "rps<1"}
+    # master switch disarms everything
+    chain = edge.from_env(signals=lambda: (0, False),
+                          environ={**base, "MISAKA_EDGE": "0"})
+    assert not chain.armed
+    # per-stage switches
+    chain = edge.from_env(signals=lambda: (0, False),
+                          environ={**base, "MISAKA_EDGE_AUTH": "0"})
+    assert chain.keyfile is None and chain.quota_enabled
+    chain = edge.from_env(signals=lambda: (0, False),
+                          environ={**base, "MISAKA_EDGE_QUOTA": "0"})
+    assert not chain.quota_enabled and chain.governor is not None
+    chain = edge.from_env(signals=lambda: (0, False),
+                          environ={**base, "MISAKA_EDGE_ADMISSION": "0"})
+    assert chain.governor is None
+    # quota without auth: the program label is the tenant
+    chain = edge.from_env(signals=lambda: (0, False),
+                          environ={"MISAKA_QUOTA": "rps<1"})
+    got_429 = False
+    for _ in range(5):
+        d = chain.check("/compute", program="p1")
+        if d.reject is not None:
+            got_429 = True
+            assert d.reject.status == 429 and d.tenant == "p1"
+            break
+    assert got_429
+
+
+def test_admission_fair_share_sheds_flooder_first():
+    waiting = [0]
+    gov = edge.AdmissionGovernor(lambda: (waiting[0], False), 1000)
+    # below the watermark: everyone flows (and builds window history:
+    # the flooder holds ~97% of admitted values)
+    for _ in range(40):
+        assert gov.check("flood", 100) is None
+    for _ in range(3):
+        assert gov.check("good", 40) is None
+    # soft zone: the over-share tenant sheds, the neighbor keeps flowing
+    waiting[0] = 1500
+    rej = gov.check("flood", 100)
+    assert rej is not None and rej.status == 429
+    assert rej.reason == "overload" and rej.retry_after > 0
+    assert gov.check("good", 40) is None
+    # hard cap: everyone sheds
+    waiting[0] = 2500
+    assert gov.check("good", 40) is not None
+    assert gov.check("flood", 100) is not None
+
+
+def test_admission_single_tenant_rides_to_hard_cap():
+    waiting = [1500]
+    gov = edge.AdmissionGovernor(lambda: (waiting[0], False), 1000)
+    # one tenant in the soft zone: no one to be fair to — admit
+    assert gov.check("only", 100) is None
+    waiting[0] = 2500
+    assert gov.check("only", 100) is not None
+
+
+def test_admission_slo_page_halves_watermark():
+    page = [False]
+    gov = edge.AdmissionGovernor(lambda: (700, page[0]), 1000)
+    for _ in range(20):
+        assert gov.check("flood", 100) is None
+    assert gov.check("good", 10) is None
+    # 700 < soft(1000) while ok; page halves soft to 500 -> fair-share
+    # zone engages and the over-share tenant sheds
+    page[0] = True
+    assert gov.check("flood", 100) is not None
+    assert gov.check("good", 10) is None
+
+
+def test_chaos_overload_and_quota_exhaust_points():
+    chain = edge.EdgeChain(
+        governor=edge.AdmissionGovernor(lambda: (0, False), 1000),
+    )
+    # scoped overload: only the named tenant sheds, at the REAL site
+    faults.configure("overload:noisy")
+    d = chain.check("/compute", program="noisy")
+    assert d.reject is not None and d.reject.status == 429
+    assert d.reject.reason == "overload"
+    assert chain.check("/compute", program="quiet").reject is None
+    # unscoped overload sheds everyone
+    faults.configure("overload")
+    assert chain.check("/compute", program="quiet").reject is not None
+    # quota_exhaust trips the quota stage even with no spec configured
+    faults.configure("quota_exhaust")
+    d = chain.check("/compute", program="quiet")
+    assert d.reject is not None and d.reject.status == 429
+    assert d.reject.retry_after is not None
+    faults.configure(None)
+    assert chain.check("/compute", program="quiet").reject is None
+
+
+def test_reject_wire_round_trip():
+    r = edge.EdgeReject(429, "rate", "slow down", retry_after=2.5)
+    back = edge.EdgeReject.from_wire(429, r.to_wire())
+    assert back.reason == "rate" and back.retry_after == 2.5
+    assert back.message == "slow down"
+    assert ("Retry-After", "3") in r.headers()
+    assert edge.EdgeReject.from_wire(429, b"not an edge body") is None
+    # 401s carry the auth challenge
+    assert any(
+        k == "WWW-Authenticate"
+        for k, _ in edge.EdgeReject(401, "unauthenticated", "x").headers()
+    )
+
+
+def test_program_quota_precedence(tmp_path):
+    """Field-wise precedence: key > program > env default."""
+    kf = edge.KeyFile(_write_keys(
+        tmp_path / "k.json",
+        [{"key": "k1", "tenant": "t1", "quota": "rps<7"}],
+    ))
+    chain = edge.EdgeChain(
+        keyfile=kf, quota_defaults={"rps": 1.0, "vps": 100.0},
+    )
+    chain.set_program_quota("p", "rps<3,cpu<0.5")
+    q = chain._effective_quota(kf.lookup("k1"), "p@deadbeef")
+    assert q == {"rps": 7.0, "vps": 100.0, "cpu": 0.5}
+    q = chain._effective_quota(None, "p")
+    assert q == {"rps": 3.0, "vps": 100.0, "cpu": 0.5}
+    q = chain._effective_quota(None, "other")
+    assert q == {"rps": 1.0, "vps": 100.0}
+    # clearing restores the env default
+    chain.set_program_quota("p", None)
+    assert chain._effective_quota(None, "p") == {"rps": 1.0, "vps": 100.0}
+    with pytest.raises(edge.QuotaSpecError):
+        chain.set_program_quota("p", "bogus<1")
+
+
+def test_cpu_meter_sliding_window():
+    meter = edge.CpuMeter(window_s=10.0)
+    ok, _ = meter.check(0.0, 0.5)  # budget: 5 core-seconds per window
+    assert ok
+    ok, retry = meter.check(20.0, 0.5)  # 20s consumed in one hop
+    assert not ok and 1.0 <= retry <= 10.0
+
+
+# --- the direct engine surface ----------------------------------------------
+
+
+def test_http_typed_rejections_and_open_routes(served):
+    m, port, kf = served
+    base = f"http://127.0.0.1:{port}"
+    anon = MisakaClient(base, api_key="")
+    anon.api_key = None
+    # open routes answer without credentials (probes + scrapers)
+    assert anon.healthz()["ok"] is True
+    assert "misaka_edge_rejected_total" in anon.metrics()
+    # the /healthz ops view of the door
+    assert anon.healthz()["edge"]["auth"] is True
+    # 401 without a key: compute AND introspection
+    for call in (lambda: anon.compute(1), anon.status):
+        with pytest.raises(MisakaClientError) as ei:
+            call()
+        assert ei.value.status == 401
+    # Authorization: Bearer works like X-Misaka-Key
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", "/run", b"", {"Authorization": "Bearer adm-secret"})
+    r = conn.getresponse()
+    assert r.status == 200 and r.read() == b"Success"
+    # 401 carries the challenge header
+    conn.request("POST", "/compute", b"value=1")
+    r = conn.getresponse()
+    assert r.status == 401 and r.getheader("WWW-Authenticate")
+    r.read()
+    conn.close()
+    adm = MisakaClient(base, api_key="adm-secret")
+    assert int(adm.compute(7)) == 9
+    # 403: valid key without admin scope on a lifecycle route
+    bob = MisakaClient(base, api_key="bob-secret")
+    with pytest.raises(MisakaClientError) as ei:
+        bob.pause()
+    assert ei.value.status == 403
+    # 403: disabled (revoked-in-place) key
+    with pytest.raises(MisakaClientError) as ei:
+        MisakaClient(base, api_key="eve-secret").compute(1)
+    assert ei.value.status == 403
+    # 429 with Retry-After once bob's rps<2 burst is gone
+    statuses = []
+    for _ in range(10):
+        try:
+            bob.compute(1)
+            statuses.append(200)
+        except MisakaClientError as e:
+            statuses.append(e.status)
+            assert e.status == 429
+            assert e.retry_after is not None and e.retry_after > 0
+            break
+    assert statuses[-1] == 429
+    # the rejection series carries reason + tenant labels
+    text = adm.metrics()
+    assert 'misaka_edge_rejected_total{reason="rate",tenant="bob"}' in text
+    assert 'reason="unauthenticated"' in text
+
+
+def test_http_keyfile_hot_reload_rotation(served):
+    m, port, kf = served
+    base = f"http://127.0.0.1:{port}"
+    bob = MisakaClient(base, api_key="bob-secret")
+    assert int(bob.compute(1)) == 3
+    time.sleep(0.6)
+    _write_keys(kf, [{"key": "bob-rotated", "tenant": "bob"}])
+    os.utime(kf, (time.time() + 5, time.time() + 5))
+    with pytest.raises(MisakaClientError) as ei:
+        bob.compute(1)
+    assert ei.value.status == 401
+    assert int(MisakaClient(base, api_key="bob-rotated").compute(1)) == 3
+
+
+def test_edge_fully_disarmed_is_byte_compatible(monkeypatch):
+    """No key file, no quota env: every pre-edge behavior is intact
+    (the default-env compatibility contract)."""
+    monkeypatch.delenv("MISAKA_API_KEYS", raising=False)
+    monkeypatch.delenv("MISAKA_QUOTA", raising=False)
+    m = _master(batch=2)
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        c = MisakaClient(f"http://127.0.0.1:{httpd.server_address[1]}")
+        c.run()
+        assert int(c.compute(5)) == 7
+        assert c.status()["running"] is True
+    finally:
+        m.pause()
+        httpd.shutdown()
+
+
+# --- priority lanes in the ServeBatcher -------------------------------------
+
+
+def test_priority_lanes_small_preempts_bulk(monkeypatch):
+    """Hot-lane entries cut into passes ahead of a bulk entry's
+    remaining stripes: every small request finishes while the bulk
+    stream is still being served."""
+    monkeypatch.setenv("MISAKA_LANE_SMALL", "64")
+    m = _master(batch=4)
+    m.run()
+    try:
+        done: dict[str, float] = {}
+        bulk_vals = np.arange(4096, dtype=np.int32)  # 64 passes at 4x16
+
+        def run_bulk():
+            out = m.compute_coalesced(bulk_vals, timeout=120,
+                                      return_array=True)
+            done["bulk"] = time.monotonic()
+            np.testing.assert_array_equal(out, bulk_vals + 2)
+
+        t = threading.Thread(target=run_bulk)
+        t.start()
+        time.sleep(0.05)  # let the bulk entry occupy the scheduler
+        smalls = []
+        for i in range(6):
+            def run_small(i=i):
+                out = m.compute_coalesced(
+                    np.arange(8, dtype=np.int32) + i, timeout=120,
+                    return_array=True,
+                )
+                done[f"s{i}"] = time.monotonic()
+                np.testing.assert_array_equal(
+                    out, np.arange(8, dtype=np.int32) + i + 2
+                )
+            st = threading.Thread(target=run_small)
+            st.start()
+            smalls.append(st)
+        for st in smalls:
+            st.join(120)
+        t.join(120)
+        assert "bulk" in done and all(f"s{i}" in done for i in range(6))
+        # the preemption contract: every small beat the bulk stream out
+        assert max(done[f"s{i}"] for i in range(6)) < done["bulk"]
+    finally:
+        m.pause()
+
+
+def test_priority_lane_metric_and_kill_switch(monkeypatch):
+    from misaka_tpu.utils import metrics as metrics_mod
+    from misaka_tpu.runtime.master import M_SERVE_LANE_ENTRIES
+
+    monkeypatch.setenv("MISAKA_LANE_SMALL", "0")  # single lane: all bulk
+    m = _master(batch=2)
+    m.run()
+    try:
+        before = M_SERVE_LANE_ENTRIES.labels(lane="bulk").value
+        m.compute_coalesced(np.arange(4, dtype=np.int32))
+        assert M_SERVE_LANE_ENTRIES.labels(lane="bulk").value == before + 1
+    finally:
+        m.pause()
+    monkeypatch.setenv("MISAKA_LANE_SMALL", "8192")
+    m2 = _master(batch=2)
+    m2.run()
+    try:
+        before = M_SERVE_LANE_ENTRIES.labels(lane="hot").value
+        m2.compute_coalesced(np.arange(4, dtype=np.int32))
+        assert M_SERVE_LANE_ENTRIES.labels(lane="hot").value == before + 1
+    finally:
+        m2.pause()
+
+
+# --- the frontend compute-plane surface -------------------------------------
+
+
+@pytest.fixture
+def frontend_edge(tmp_path, monkeypatch):
+    """Engine + compute plane + in-process frontend worker, edge armed."""
+    from misaka_tpu.runtime import frontends
+
+    kf = _write_keys(tmp_path / "keys.json", KEYS)
+    monkeypatch.setenv("MISAKA_API_KEYS", kf)
+    m = _master(batch=4)
+    engine_httpd = make_http_server(m, port=0)
+    threading.Thread(target=engine_httpd.serve_forever, daemon=True).start()
+    plane_path = str(tmp_path / "plane.sock")
+    plane = frontends.start_compute_plane(m, plane_path)
+    fe = frontends.make_frontend_server(
+        0, f"http://127.0.0.1:{engine_httpd.server_address[1]}",
+        plane_path, plane_conns=2,
+    )
+    threading.Thread(target=fe.serve_forever, daemon=True).start()
+    m.run()
+    try:
+        yield m, fe.server_address[1]
+    finally:
+        m.pause()
+        fe.shutdown()
+        plane.close()
+        engine_httpd.shutdown()
+
+
+def test_plane_auth_and_quota_typing(frontend_edge):
+    """The frame-level edge: 401/403/429 decided engine-side per frame,
+    typed headers restored by the worker."""
+    m, port = frontend_edge
+    vals = np.arange(8, dtype=np.int32).astype("<i4").tobytes()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    # no key -> 401 through the plane, with the auth challenge
+    conn.request("POST", "/compute_raw?spread=1", vals)
+    r = conn.getresponse()
+    assert r.status == 401 and r.getheader("WWW-Authenticate")
+    r.read()
+    # valid key -> served
+    conn.request("POST", "/compute_raw?spread=1", vals,
+                 {"X-Misaka-Key": "adm-secret"})
+    r = conn.getresponse()
+    assert r.status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(r.read(), dtype="<i4"),
+        np.arange(8, dtype=np.int32) + 2,
+    )
+    # disabled key -> 403 through the plane
+    conn.request("POST", "/compute_raw?spread=1", vals,
+                 {"X-Misaka-Key": "eve-secret"})
+    r = conn.getresponse()
+    assert r.status == 403
+    r.read()
+    # bob's rps<2: burst out the bucket -> 429 WITH Retry-After header
+    status, retry_after = None, None
+    for _ in range(10):
+        conn.request("POST", "/compute_raw?spread=1", vals,
+                     {"X-Misaka-Key": "bob-secret"})
+        r = conn.getresponse()
+        status = r.status
+        retry_after = r.getheader("Retry-After")
+        r.read()
+        if status == 429:
+            break
+    assert status == 429 and retry_after is not None
+    assert float(retry_after) > 0
+    # the proxied scalar lifecycle path carries credentials to the engine
+    conn.request("POST", "/compute_batch", b"values=1+2+3",
+                 {"X-Misaka-Key": "adm-secret",
+                  "Content-Type": "application/x-www-form-urlencoded"})
+    r = conn.getresponse()
+    assert r.status == 200
+    assert json.loads(r.read())["values"] == [3, 4, 5]
+    conn.close()
+
+
+def test_plane_handshake_gates_connections(tmp_path, monkeypatch):
+    """MISAKA_PLANE_SECRET: a client presenting the HMAC serves frames;
+    a raw connection without it is cut before any frame is read."""
+    from misaka_tpu.runtime import frontends
+
+    monkeypatch.setenv("MISAKA_PLANE_SECRET", "sesame")
+    m = _master(batch=2)
+    plane_path = str(tmp_path / "plane.sock")
+    plane = frontends.start_compute_plane(m, plane_path)
+    m.run()
+    try:
+        client = frontends.PlaneClient(plane_path, conns=1)
+        out = client.compute_raw(
+            np.arange(4, dtype=np.int32).astype("<i4").tobytes()
+        )
+        np.testing.assert_array_equal(
+            np.frombuffer(out, dtype="<i4"),
+            np.arange(4, dtype=np.int32) + 2,
+        )
+        client.close()
+        # no handshake: the engine closes the connection unanswered
+        raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        raw.settimeout(5)
+        raw.connect(plane_path)
+        raw.sendall(struct.pack("<II", 1, 0) + struct.pack("<i", 1))
+        # the bytes we sent are consumed as a (bad) handshake and the
+        # server hangs up: EOF or a reset, never a served frame
+        try:
+            raw.sendall(b"\x00" * 24)
+            assert raw.recv(8) == b""
+        except ConnectionError:
+            pass
+        raw.close()
+        # wrong secret: same cut
+        monkeypatch.setenv("MISAKA_PLANE_SECRET", "wrong")
+        bad = frontends.PlaneClient(plane_path, conns=1)
+        with pytest.raises(frontends.PlaneError):
+            bad.compute_raw(
+                np.arange(4, dtype=np.int32).astype("<i4").tobytes(),
+                timeout=5,
+            )
+        bad.close()
+    finally:
+        m.pause()
+        plane.close()
+
+
+# --- the fleet control surface ----------------------------------------------
+
+
+def test_fleet_control_auth(tmp_path, monkeypatch):
+    """The operator surface rejects bad keys at the control server
+    itself (a roll is not proxied, so no replica would)."""
+    from misaka_tpu.runtime.fleet import FleetManager, make_fleet_http_server
+
+    kf = _write_keys(tmp_path / "keys.json", KEYS)
+    monkeypatch.setenv("MISAKA_API_KEYS", kf)
+    fm = FleetManager(2, str(tmp_path / "fleet"))
+    ctrl = None
+    try:
+        ctrl = make_fleet_http_server(fm, port=0)
+        threading.Thread(target=ctrl.serve_forever, daemon=True).start()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", ctrl.server_address[1], timeout=10
+        )
+        # 401: no key on the operator route
+        conn.request("POST", "/fleet/roll", b"")
+        r = conn.getresponse()
+        assert r.status == 401 and r.getheader("WWW-Authenticate")
+        r.read()
+        # 403: non-admin key
+        conn.request("POST", "/fleet/roll", b"",
+                     {"X-Misaka-Key": "bob-secret"})
+        r = conn.getresponse()
+        assert r.status == 403
+        r.read()
+        # 401 on lifecycle fan-out too
+        conn.request("POST", "/pause", b"")
+        r = conn.getresponse()
+        assert r.status == 401
+        r.read()
+        # admitted past auth: the admin key reaches the route body (503
+        # here — no replica is up in this stub fleet)
+        conn.request("POST", "/pause", b"",
+                     {"X-Misaka-Key": "adm-secret"})
+        r = conn.getresponse()
+        assert r.status == 503
+        r.read()
+        # open routes stay open on the control surface
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 200
+        r.read()
+        conn.close()
+    finally:
+        if ctrl is not None:
+            ctrl.shutdown()
+        fm.close()
+
+
+# --- TLS on the HTTP edge ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tls_certs(tmp_path_factory):
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl unavailable")
+    d = tmp_path_factory.mktemp("edge-certs")
+    cert, key = str(d / "service.pem"), str(d / "service.key")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "ec",
+            "-pkeyopt", "ec_paramgen_curve:prime256v1", "-nodes",
+            "-keyout", key, "-out", cert, "-days", "1",
+            "-subj", "/CN=localhost",
+            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+        ],
+        check=True, capture_output=True,
+    )
+    return cert, key
+
+
+def test_tls_engine_listener_and_client(tls_certs, monkeypatch):
+    cert, key = tls_certs
+    monkeypatch.setenv("MISAKA_TLS_CERT", cert)
+    monkeypatch.setenv("MISAKA_TLS_KEY", key)
+    m = _master(batch=2)
+    httpd = make_http_server(m, port=0)
+    assert getattr(httpd, "misaka_tls", False)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    try:
+        # CA-pinned client round-trips over TLS
+        c = MisakaClient(f"https://127.0.0.1:{port}", ca=cert)
+        c.run()
+        assert int(c.compute(5)) == 7
+        assert c.healthz()["ok"] is True
+        c.close()
+        # a client that does NOT trust the self-signed cert is refused
+        bad = MisakaClient(f"https://127.0.0.1:{port}", timeout=5)
+        with pytest.raises(urllib.error.URLError):
+            bad.healthz()
+        bad.close()
+        # plain HTTP against the TLS port fails the handshake
+        plain = MisakaClient(f"http://127.0.0.1:{port}", timeout=5,
+                             connect_retries=0, retry_stale=False)
+        with pytest.raises(urllib.error.URLError):
+            plain.healthz()
+        plain.close()
+    finally:
+        m.pause()
+        httpd.shutdown()
+
+
+def test_tls_env_validation(monkeypatch):
+    monkeypatch.setenv("MISAKA_TLS_CERT", "/nonexistent.pem")
+    monkeypatch.delenv("MISAKA_TLS_KEY", raising=False)
+    with pytest.raises(ValueError):
+        edge.tls_context_from_env()
+    monkeypatch.setenv("MISAKA_TLS_KEY", "/nonexistent.key")
+    with pytest.raises(OSError):
+        edge.tls_context_from_env()
+    monkeypatch.delenv("MISAKA_TLS_CERT", raising=False)
+    monkeypatch.delenv("MISAKA_TLS_KEY", raising=False)
+    assert edge.tls_context_from_env() is None
+
+
+# --- client surface ---------------------------------------------------------
+
+
+def test_client_api_key_env_default(monkeypatch):
+    monkeypatch.setenv("MISAKA_API_KEY", "env-key")
+    c = MisakaClient("http://localhost:1")
+    assert c.api_key == "env-key"
+    c2 = MisakaClient("http://localhost:1", api_key="explicit")
+    assert c2.api_key == "explicit"
+    monkeypatch.delenv("MISAKA_API_KEY")
+    c3 = MisakaClient("http://localhost:1")
+    assert c3.api_key is None
+
+
+def test_client_rejects_unknown_scheme():
+    with pytest.raises(ValueError):
+        MisakaClient("ftp://localhost:8000")
+
+
+# --- per-program quota overrides via upload metadata ------------------------
+
+
+def test_registry_quota_upload_override(monkeypatch):
+    """The `quota` upload field (like `slo`): validated compile-first,
+    installed into the edge chain when the version becomes latest, and
+    enforced per program — without auth the program label IS the
+    tenant, so only the uploaded program's tenant sheds."""
+    from misaka_tpu import networks as _networks
+    from misaka_tpu.runtime.master import MasterNode as _MasterNode
+    from misaka_tpu.runtime.registry import ProgramRegistry, RegistryError
+
+    monkeypatch.delenv("MISAKA_API_KEYS", raising=False)
+    small = dict(stack_cap=16, in_cap=16, out_cap=16)
+    reg = ProgramRegistry(None, batch=2, engine="scan", chunk_steps=32,
+                          caps=small)
+    top = _networks.add2(**small)
+    m = _MasterNode(top, chunk_steps=32, batch=2, engine="scan")
+    reg.seed("default", m, top)
+    m.run()
+    httpd = make_http_server(m, port=0, registry=reg)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # a malformed quota spec is a 400 that touches nothing
+        with pytest.raises(RegistryError):
+            reg.publish("bad", tis="IN ACC\nADD 1\nOUT ACC\n",
+                        quota_spec="zps<1")
+        reg.publish("tight", tis="IN ACC\nADD 10\nOUT ACC\n",
+                    quota_spec="rps<1")
+        c = MisakaClient(base, program="tight")
+        assert int(c.compute(1)) == 11  # burst tokens
+        statuses = []
+        for _ in range(6):
+            try:
+                c.compute(1)
+                statuses.append(200)
+            except MisakaClientError as e:
+                statuses.append(e.status)
+                assert e.status == 429
+                assert e.retry_after is not None
+                break
+        assert statuses[-1] == 429
+        # the default program's tenant is untouched by the override
+        d = MisakaClient(base)
+        for i in range(6):
+            assert int(d.compute(i)) == i + 2
+        # republishing latest WITHOUT a quota clears the override
+        reg.publish("tight", tis="IN ACC\nADD 11\nOUT ACC\n")
+        time.sleep(0.1)
+        for _ in range(6):
+            assert int(c.compute(1)) == 12
+        c.close()
+        d.close()
+    finally:
+        m.pause()
+        reg.close()
+        httpd.shutdown()
+
+
+def test_oversized_request_gets_terminal_413_not_retry_loop():
+    """A request larger than the vps burst capacity can NEVER be
+    admitted: it must answer a terminal 413, not a finite Retry-After
+    that sends a compliant client into an infinite retry loop."""
+    chain = edge.EdgeChain(quota_defaults={"vps": 10.0}, burst_s=2.0)
+    d = chain.check("/compute_raw", program="p", values=100)
+    assert d.reject is not None
+    assert d.reject.status == 413 and d.reject.reason == "values"
+    assert d.reject.retry_after is None
+    # a request within capacity still gets the 429 + Retry-After shape
+    chain.check("/compute_raw", program="p", values=20)  # drain burst
+    d = chain.check("/compute_raw", program="p", values=15)
+    assert d.reject is not None and d.reject.status == 429
+    assert d.reject.retry_after is not None
+
+
+def test_bucket_not_reset_by_program_quota_alternation(tmp_path):
+    """ONE tenant alternating between programs with different quota
+    overrides must not get a fresh full-burst bucket on every flip
+    (that recreation was a complete rate-limit bypass): each
+    (tenant, rate) pair is its own bounded bucket."""
+    kf = edge.KeyFile(_write_keys(
+        tmp_path / "k.json", [{"key": "k", "tenant": "t"}]
+    ))
+    chain = edge.EdgeChain(
+        keyfile=kf, quota_defaults={"rps": 2.0}, burst_s=2.0,
+    )
+    chain.set_program_quota("slow", "rps<1")
+    admitted = 0
+    for i in range(40):
+        prog = "slow" if i % 2 else "fast"
+        d = chain.check("/compute", key="k", program=prog)
+        assert d.tenant == "t"
+        if d.reject is None:
+            admitted += 1
+    # one tenant, two buckets (rates 2.0 and 1.0): admissions bounded by
+    # the two burst capacities (4 + 2) plus a trickle of refill — the
+    # recreation bug admitted all 40
+    assert admitted <= 12
+
+
+def test_sustained_hot_stream_does_not_starve_bulk(monkeypatch):
+    """The anti-starvation reservation: with the hot lane saturated
+    continuously, an admitted bulk entry still gets its slice of every
+    pass and completes (strict priority would park it until
+    ComputeTimeout)."""
+    monkeypatch.setenv("MISAKA_LANE_SMALL", "64")
+    m = _master(batch=4)
+    m.run()
+    stop = threading.Event()
+    errors = []
+
+    def hot_spam():
+        vals = np.arange(16, dtype=np.int32)
+        try:
+            while not stop.is_set():
+                out = m.compute_coalesced(vals, timeout=60,
+                                          return_array=True)
+                np.testing.assert_array_equal(out, vals + 2)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=hot_spam) for _ in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # hot lane saturated before the bulk arrives
+        bulk = np.arange(2048, dtype=np.int32)
+        out = m.compute_coalesced(bulk, timeout=60, return_array=True)
+        np.testing.assert_array_equal(out, bulk + 2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+        m.pause()
+    assert not errors
+
+
+def test_fleet_internal_token_admits_admin_routes(tmp_path):
+    """The fleet parent's per-boot internal token must pass the
+    replica-side chain as an admin credential (an authenticated fleet
+    could otherwise never drain/checkpoint its own replicas mid-roll),
+    while any other token stays a 401."""
+    kf = edge.KeyFile(_write_keys(tmp_path / "k.json", KEYS))
+    chain = edge.EdgeChain(keyfile=kf, internal_token="boot-secret")
+    for route in ("/fleet/drain", "/checkpoint", "/pause"):
+        d = chain.check(route, key="boot-secret")
+        assert d.reject is None and d.tenant == "_fleet"
+    d = chain.check("/fleet/drain", key="not-the-token")
+    assert d.reject is not None and d.reject.status == 401
+    # token unset: nothing special about the string
+    plain = edge.EdgeChain(keyfile=kf)
+    assert plain.check("/fleet/drain", key="boot-secret").reject.status == 401
+
+
+def test_keyfile_strips_cpu_from_key_quota(tmp_path):
+    """cpu budgets are per-program (the ledger's attribution unit): a
+    key-level cpu field is ignored at load — billing one tenant for a
+    program all tenants share would shed the innocent one."""
+    kf = edge.KeyFile(_write_keys(
+        tmp_path / "k.json",
+        [{"key": "k", "tenant": "t", "quota": "rps<5,cpu<0.1"}],
+    ))
+    entry = kf.lookup("k")
+    assert entry["quota_spec"] == {"rps": 5.0}
+
+
+def test_worker_shed_counts_reach_engine_metrics(frontend_edge):
+    """Worker-local shed-cache rejections ride frame metadata back to
+    the engine's misaka_edge_rejected_total — the headline counter must
+    cover the WHOLE door, not just engine-made decisions."""
+    from misaka_tpu.utils import metrics as metrics_mod
+
+    m, port = frontend_edge
+    series = 'misaka_edge_rejected_total{reason="rate",tenant="bob"}'
+
+    def scrape():
+        return metrics_mod.parse_text(metrics_mod.render()).get(series, 0)
+
+    before = scrape()
+    vals = np.arange(8, dtype=np.int32).astype("<i4").tobytes()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    seen_429 = 0
+    for _ in range(20):
+        conn.request("POST", "/compute_raw?spread=1", vals,
+                     {"X-Misaka-Key": "bob-secret"})
+        r = conn.getresponse()
+        r.read()
+        if r.status == 429:
+            seen_429 += 1
+    assert seen_429 >= 5  # burst gone; the cache absorbed most of these
+    # an admitted frame flushes the worker's pending shed report
+    conn.request("POST", "/compute_raw?spread=1", vals,
+                 {"X-Misaka-Key": "adm-secret"})
+    assert conn.getresponse().status == 200
+    conn.close()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and scrape() - before < seen_429:
+        time.sleep(0.1)
+    assert scrape() - before >= seen_429
+
+
+def test_tls_silent_connection_does_not_block_accept(tls_certs, monkeypatch):
+    """The deferred-handshake contract: a client that connects to the
+    TLS port and sends NOTHING must not park the accept loop — other
+    clients keep being served (with handshake-on-accept, one idle
+    socket was a full listener outage)."""
+    cert, key = tls_certs
+    monkeypatch.setenv("MISAKA_TLS_CERT", cert)
+    monkeypatch.setenv("MISAKA_TLS_KEY", key)
+    m = _master(batch=2)
+    m.run()
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    idle = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        c = MisakaClient(f"https://127.0.0.1:{port}", ca=cert, timeout=10)
+        for i in range(3):  # several accepts behind the idle socket
+            assert int(c.compute(i)) == i + 2
+        c.close()
+    finally:
+        idle.close()
+        m.pause()
+        httpd.shutdown()
+
+
+def test_round4_hardening_units(tmp_path):
+    """Fourth review pass pins: non-ASCII keys never crash an
+    internal-token-armed chain; coalesced frames over the vps burst
+    clamp instead of answering an unactionable 413; decision counters
+    bill per fused request, not per frame."""
+    from misaka_tpu.utils import metrics as metrics_mod
+
+    # non-ASCII key vs internal token: 401, not TypeError/500
+    chain = edge.EdgeChain(
+        keyfile=edge.KeyFile(_write_keys(
+            tmp_path / "k.json", [{"key": "k", "tenant": "t"}]
+        )),
+        internal_token="boot-secret",
+    )
+    d = chain.check("/compute", key="café")
+    assert d.reject is not None and d.reject.status == 401
+    # frame-fused values over burst capacity: clamped 429-or-admit,
+    # never the terminal 413 (each fused client sent a small request)
+    q = edge.EdgeChain(quota_defaults={"vps": 1000.0}, burst_s=2.0)
+    d = q.check("/compute_raw", program="p", values=5000, requests=100)
+    assert d.reject is None or d.reject.status == 429
+    # a SINGLE oversized request keeps the terminal 413
+    d = q.check("/compute_raw", program="p", values=5000, requests=1)
+    assert d.reject is not None and d.reject.status == 413
+    # decision counters bill per fused request
+    before = metrics_mod.parse_text(metrics_mod.render()).get(
+        'misaka_edge_admitted_total{tenant="counted"}', 0
+    )
+    c2 = edge.EdgeChain(quota_defaults={"rps": 1e9})
+    c2.check("/compute_raw", program="counted", values=64, requests=7)
+    after = metrics_mod.parse_text(metrics_mod.render()).get(
+        'misaka_edge_admitted_total{tenant="counted"}', 0
+    )
+    assert after - before == 7

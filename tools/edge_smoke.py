@@ -1,0 +1,202 @@
+"""`make edge-smoke`: the production edge proven end-to-end against a
+REAL subprocess server (~15s).
+
+Boots `python -m misaka_tpu.runtime.app` with TLS (a throwaway
+self-signed cert), API-key auth (reloadable key file), a per-tenant
+quota, and the SO_REUSEPORT frontend tier — the full production-edge
+topology — then asserts through the PUBLIC https:// surface:
+
+  1. the TLS handshake: a CA-pinned client round-trips; a client that
+     does not trust the cert is refused; plain HTTP against the TLS
+     port fails;
+  2. bad key -> typed 401 (with the WWW-Authenticate challenge) and a
+     non-admin key on a lifecycle route -> 403;
+  3. quota exhaustion -> typed 429 WITH Retry-After, on the hot
+     compute-plane path (the frame-level edge decision made engine-side
+     and restored by the worker);
+  4. recovery: after backing off for the advertised Retry-After, the
+     same tenant serves again — and an admin-keyed /metrics scrape shows
+     the tenant-labeled misaka_edge_{admitted,rejected}_total series.
+
+Exit 0 on success, 1 with a reason on any failed assertion.  The same
+assertions run inside tier-1 (tests/test_edge.py); this is the
+standalone tripwire against the real process + TLS boundary.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg):
+    print(f"# edge-smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    import socket
+
+    import numpy as np
+
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+
+    if shutil.which("openssl") is None:
+        print("# edge-smoke: openssl unavailable; skipping")
+        return 0
+
+    tmp = tempfile.mkdtemp(prefix="misaka-edge-smoke-")
+    cert = os.path.join(tmp, "service.pem")
+    key = os.path.join(tmp, "service.key")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "ec",
+            "-pkeyopt", "ec_paramgen_curve:prime256v1", "-nodes",
+            "-keyout", key, "-out", cert, "-days", "1",
+            "-subj", "/CN=localhost",
+            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+        ],
+        check=True, capture_output=True,
+    )
+    keyfile = os.path.join(tmp, "api_keys.json")
+    with open(keyfile, "w") as f:
+        json.dump({"keys": [
+            {"key": "smoke-admin", "tenant": "ops", "admin": True},
+            {"key": "smoke-tenant", "tenant": "tenant-a",
+             "quota": "rps<3"},
+        ]}, f)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MISAKA_PORT": str(port),
+        "MISAKA_BATCH": "4",
+        "MISAKA_AUTORUN": "1",
+        "MISAKA_IN_CAP": "32",
+        "MISAKA_OUT_CAP": "32",
+        "MISAKA_STACK_CAP": "16",
+        "MISAKA_HTTP_WORKERS": "2",  # workers terminate TLS; the edge
+        "MISAKA_TLS_CERT": cert,     # decision rides the compute plane
+        "MISAKA_TLS_KEY": key,
+        "MISAKA_API_KEYS": keyfile,
+        "NODE_INFO": json.dumps({"main": {"type": "program"}}),
+        "MISAKA_PROGRAMS": json.dumps({"main": "IN ACC\nADD 2\nOUT ACC\n"}),
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "misaka_tpu.runtime.app"], env=env
+    )
+    base = f"https://127.0.0.1:{port}"
+    try:
+        # --- 1. TLS handshake --------------------------------------------
+        admin = MisakaClient(base, ca=cert, api_key="smoke-admin",
+                             timeout=10)
+        deadline = time.monotonic() + 120
+        up = False
+        while time.monotonic() < deadline:
+            try:
+                if admin.healthz().get("ok"):
+                    up = True
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.25)
+        if not up:
+            fail("server did not come up over TLS")
+        print("# edge-smoke: TLS handshake ok (CA-pinned client)")
+        untrusted = MisakaClient(base, timeout=5)
+        try:
+            untrusted.healthz()
+            fail("untrusted client was not refused")
+        except urllib.error.URLError:
+            pass
+        untrusted.close()
+        plain = MisakaClient(f"http://127.0.0.1:{port}", timeout=5,
+                             connect_retries=0, retry_stale=False)
+        try:
+            plain.healthz()
+            fail("plain HTTP against the TLS port succeeded")
+        except urllib.error.URLError:
+            pass
+        plain.close()
+        print("# edge-smoke: untrusted + plaintext clients refused")
+
+        # --- 2. auth typing ----------------------------------------------
+        bad = MisakaClient(base, ca=cert, api_key="wrong-key", timeout=10)
+        try:
+            bad.compute(1)
+            fail("bad key was admitted")
+        except MisakaClientError as e:
+            if e.status != 401:
+                fail(f"bad key answered {e.status}, wanted 401")
+        bad.close()
+        tenant = MisakaClient(base, ca=cert, api_key="smoke-tenant",
+                              timeout=10)
+        try:
+            tenant.pause()
+            fail("non-admin key drove a lifecycle route")
+        except MisakaClientError as e:
+            if e.status != 403:
+                fail(f"non-admin pause answered {e.status}, wanted 403")
+        print("# edge-smoke: bad key -> 401, non-admin lifecycle -> 403")
+
+        # --- 3. quota exhaustion -> 429 + Retry-After --------------------
+        vals = np.arange(16, dtype=np.int32)
+        retry_after = None
+        served = 0
+        for _ in range(12):
+            try:
+                out = tenant.compute_raw(vals)
+                if not np.array_equal(np.asarray(out), vals + 2):
+                    fail("served values wrong")
+                served += 1
+            except MisakaClientError as e:
+                if e.status != 429:
+                    fail(f"quota rejection was {e.status}, wanted 429")
+                if e.retry_after is None:
+                    fail("429 carried no Retry-After")
+                retry_after = e.retry_after
+                break
+        if retry_after is None:
+            fail(f"no 429 after {served} requests against rps<3")
+        print(f"# edge-smoke: quota exhausted after {served} requests -> "
+              f"429 Retry-After={retry_after:g}s")
+
+        # --- 4. recovery after the advertised backoff --------------------
+        time.sleep(min(retry_after, 10.0) + 0.5)
+        out = tenant.compute_raw(vals)
+        if not np.array_equal(np.asarray(out), vals + 2):
+            fail("post-backoff request served wrong values")
+        tenant.close()
+        text = admin.metrics()
+        for needle in (
+            'misaka_edge_rejected_total{reason="rate",tenant="tenant-a"}',
+            "misaka_edge_admitted_total",
+        ):
+            if needle not in text:
+                fail(f"metrics missing {needle!r}")
+        admin.close()
+        print("# edge-smoke: tenant recovered after backoff; edge metrics "
+              "labeled")
+        print("# edge-smoke OK")
+        return 0
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
