@@ -2,7 +2,12 @@
 # /root/reference/Makefile:1-12) plus the native components and local QA.
 
 CXX ?= g++
-CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC -pthread
+# Warnings are load-bearing: the default build is -Werror so a warning
+# REGRESSION fails `make native` (and `make ci` through it) instead of
+# scrolling past.  utils/nativelib.py's on-demand rebuild keeps plain
+# flags — a stricter future compiler must not brick runtime rebuilds.
+WARNFLAGS ?= -Wall -Wextra -Werror
+CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC -pthread $(WARNFLAGS)
 
 native: native/libmisaka_assembler.so native/libmisaka_interp.so native/libmisaka_textcodec.so
 
@@ -17,6 +22,51 @@ native/libmisaka_interp.so: native/interpreter.cpp
 
 native/libmisaka_textcodec.so: native/textcodec.cpp
 	$(CXX) $(CXXFLAGS) -DMISAKA_SRC_HASH="\"$$(sha256sum $< | cut -c1-16)\"" $< -o $@
+
+# Sanitizer build lanes for the serving interpreter (the one native
+# component with worker threads + shared state).  These artifacts are
+# local-only (gitignored, never shipped): tools/sanitize_stress.py loads
+# them via the MISAKA_INTERP_SO override and runs the concurrent
+# serve/close/counter-read scenario — the PR 7 TOCTOU-UAF shape — under
+# each instrument.  docs/STATIC_ANALYSIS.md "Sanitizer lanes".
+SAN_CXXFLAGS = -O1 -g -fno-omit-frame-pointer -std=c++17 -shared -fPIC \
+	-pthread $(WARNFLAGS)
+
+native-asan: native/libmisaka_interp.asan.so
+native/libmisaka_interp.asan.so: native/interpreter.cpp
+	$(CXX) $(SAN_CXXFLAGS) -fsanitize=address $< -o $@
+
+native-tsan: native/libmisaka_interp.tsan.so
+native/libmisaka_interp.tsan.so: native/interpreter.cpp
+	$(CXX) $(SAN_CXXFLAGS) -fsanitize=thread $< -o $@
+
+native-ubsan: native/libmisaka_interp.ubsan.so
+native/libmisaka_interp.ubsan.so: native/interpreter.cpp
+	$(CXX) $(SAN_CXXFLAGS) -fsanitize=undefined -fno-sanitize-recover=all \
+		$< -o $@
+
+# Short ASan lane (~10s): the CI tripwire for native memory bugs.
+sanitize-smoke: native-asan
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer address --seconds 6
+
+# All three instruments, longer scenario (~60s) — the pre-merge lane for
+# native/interpreter.cpp changes.
+sanitize-all: native-asan native-tsan native-ubsan
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer address --seconds 15
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer thread --seconds 15
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer undefined --seconds 15
+
+# Project static analysis (misaka_tpu/lint): the repo's recurring bug
+# classes as machine-checked rules MSK001-MSK006.  Exit 1 on any NEW
+# finding; pre-existing intentional ones live in
+# misaka_tpu/lint/baseline.txt.  docs/STATIC_ANALYSIS.md has the rule
+# catalog and the add-a-checker / baseline workflows.
+lint:
+	python -m misaka_tpu.lint
 
 # Regenerate protobuf message classes for the per-process transport.  The
 # image ships protoc but not grpcio-tools; service stubs are hand-declared
@@ -119,7 +169,9 @@ usage-smoke:
 # throughput gate last (it is the slowest and the most environment-
 # sensitive).  Fails on the first broken stage.
 ci:
+	$(MAKE) lint
 	$(MAKE) test
+	$(MAKE) sanitize-smoke
 	$(MAKE) metrics-smoke
 	$(MAKE) trace-smoke
 	$(MAKE) registry-smoke
@@ -202,4 +254,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke edge-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke edge-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean

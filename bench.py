@@ -1142,6 +1142,7 @@ def bench_fleet_scaling(
     every response is parity-checked.  Returns per-N lanes with
     aggregate values/s, p50/p99, and speedup vs the 1-replica lane.
     """
+    import http.client
     import subprocess
     import urllib.request
 
@@ -1202,7 +1203,11 @@ def bench_fleet_scaling(
                         payload = json.loads(r.read())
                     if payload.get("ok") and not payload.get("degraded"):
                         break
-                except OSError:
+                except (OSError, http.client.HTTPException):
+                    # HTTPException too (MSK002): the fleet endpoint
+                    # mid-boot can tear a connection after the status
+                    # line — BadStatusLine must read as "not ready yet",
+                    # not crash the whole bench lane
                     pass
                 if time.monotonic() > deadline:
                     raise RuntimeError(f"fleet (N={n}) never became healthy")

@@ -91,6 +91,7 @@ _NATIVE = NativeLib(
     os.path.join(_REPO_ROOT, "native", "interpreter.cpp"),
     os.path.join(_REPO_ROOT, "native", "libmisaka_interp.so"),
     _configure,
+    so_env="MISAKA_INTERP_SO",  # sanitizer lanes load instrumented builds
 )
 
 

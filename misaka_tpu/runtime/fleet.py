@@ -1174,7 +1174,10 @@ def make_fleet_http_server(
                 status, payload, resp_headers = rh.request(
                     method, self.path, body, headers
                 )
-            except OSError as e:
+            except (OSError, http.client.HTTPException) as e:
+                # HTTPException too (MSK002): a replica dying MID-response
+                # raises BadStatusLine, not an OSError — the router must
+                # answer a typed 502 either way, not crash the handler
                 self._text(502, f"replica {slot['idx']} unreachable: {e}")
                 return
             self.send_response(status)

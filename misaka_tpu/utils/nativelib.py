@@ -30,11 +30,22 @@ _TAG = b"MISAKA-SRC-HASH:"
 
 
 class NativeLib:
-    """Lazy loader for one shared object built from one C++ source file."""
+    """Lazy loader for one shared object built from one C++ source file.
 
-    def __init__(self, src: str, so: str, configure: Callable[[ctypes.CDLL], None]):
+    `so_env` names an environment variable that, when set, OVERRIDES the
+    .so path and disables the staleness rebuild entirely: the sanitizer
+    lanes (make native-asan / tools/sanitize_stress.py) point it at an
+    instrumented build whose bytes never match the default flags' hash —
+    rebuilding "stale" here would silently replace the sanitized binary
+    with an uninstrumented one and the lane would test nothing.
+    """
+
+    def __init__(self, src: str, so: str,
+                 configure: Callable[[ctypes.CDLL], None],
+                 so_env: str | None = None):
         self._src = src
         self._so = so
+        self._so_env = so_env
         self._configure = configure  # declares restype/argtypes; may raise
         self._lock = threading.Lock()
         self._lib: ctypes.CDLL | None = None
@@ -84,6 +95,19 @@ class NativeLib:
     def load(self) -> ctypes.CDLL | None:
         with self._lock:
             if self._lib is not None or self._failed:
+                return self._lib
+            override = self._so_env and os.environ.get(self._so_env)
+            if override:
+                try:
+                    lib = ctypes.CDLL(override)
+                    self._configure(lib)
+                    self._lib = lib
+                except Exception:
+                    # loud, not latched-quiet: an armed override that
+                    # fails to load means the lane is NOT testing what
+                    # it thinks — degrade-to-Python would hide that
+                    self._failed = True
+                    raise
                 return self._lib
             try:
                 if os.path.exists(self._src) and not self._so_matches_src():

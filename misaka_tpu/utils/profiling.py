@@ -45,7 +45,10 @@ class Profiler:
     def __init__(self):
         self._lock = threading.Lock()
         self._active_dir: str | None = None
+        # wall clock for the operator-facing timestamp, monotonic for
+        # every elapsed computation (wall time steps under NTP — MSK005)
         self._started_unix: float | None = None
+        self._started_mono: float | None = None
 
     @property
     def active_dir(self) -> str | None:
@@ -63,7 +66,7 @@ class Profiler:
             return {
                 "dir": self._active_dir,
                 "started_unix": round(self._started_unix, 3),
-                "running_s": round(time.time() - self._started_unix, 1),
+                "running_s": round(time.monotonic() - self._started_mono, 1),
             }
 
     def start(self, log_dir: str) -> None:
@@ -76,13 +79,14 @@ class Profiler:
                 raise ProfilerError(
                     f"a jax profiler capture is already running: writing "
                     f"to {self._active_dir} for "
-                    f"{time.time() - self._started_unix:.0f}s — POST "
+                    f"{time.monotonic() - self._started_mono:.0f}s — POST "
                     f"/profile/stop to finish it first (JAX's profiler "
                     f"is process-global; one capture at a time)"
                 )
             jax.profiler.start_trace(log_dir)
             self._active_dir = log_dir
             self._started_unix = time.time()
+            self._started_mono = time.monotonic()
 
     def stop(self) -> str:
         """Stop the capture; returns the directory the trace was written to.
@@ -98,5 +102,6 @@ class Profiler:
                 raise ProfilerError("profiler is not capturing")
             out, self._active_dir = self._active_dir, None
             self._started_unix = None
+            self._started_mono = None
             jax.profiler.stop_trace()
             return out

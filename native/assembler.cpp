@@ -29,9 +29,6 @@ enum Dst { DST_ACC = 0, DST_NIL = 1 };
 enum Field { F_OP = 0, F_SRC, F_IMM, F_DST, F_TGT, F_PORT, F_JMP, NFIELDS };
 
 // --- grammar (tokenizer.go:41-101; \w kept ASCII as in Go) ------------------
-const char* W = "[0-9A-Za-z_]+";
-std::string S(const char* s) { return std::string(s); }
-
 const std::regex kLabel("^\\s*([0-9A-Za-z_]+):");
 const std::regex kPrefix("^(\\s*[0-9A-Za-z_]+:)?\\s*");
 const std::regex kComment("^#.*$");

@@ -1,0 +1,614 @@
+"""The project lint engine (misaka_tpu/lint): rules MSK001-MSK006.
+
+Every rule is pinned by a seeded-bad fixture (the EXACT defect shape
+from the review incident that motivated it — reintroducing the pattern
+must fail `make lint`) and a corrected good twin (the shipped fix's
+shape must stay clean).  Plus: the baseline suppress/stale round-trip,
+inline `lint: disable=`, the derived lock/launder registries over the
+REAL modules they were seeded from, and the acceptance gate — a full
+run over the live tree with the committed baseline reports zero new
+findings.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from misaka_tpu import lint
+from misaka_tpu.lint.checkers import (
+    ExceptionBreadth,
+    HandlerDrain,
+    LabelCardinality,
+    LockDiscipline,
+    ThreadLifecycle,
+)
+from misaka_tpu.lint.engine import Module, apply_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_rule(rule: str, source: str):
+    return lint.run_source(textwrap.dedent(source), [lint.checker_for(rule)])
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --- MSK001 lock-discipline --------------------------------------------------
+
+BAD_MSK001_MODULE = """
+    import threading
+
+    _lock = threading.Lock()
+    _accounts = {}
+
+    def account(label):
+        with _lock:
+            return _accounts.setdefault(label, object())
+
+    def flush():
+        with _lock:
+            acct = account("other")   # re-acquires _lock: deadlock
+            return acct
+"""
+
+GOOD_MSK001_MODULE = """
+    import threading
+
+    _lock = threading.Lock()
+    _accounts = {}
+
+    def account(label):
+        with _lock:
+            return _accounts.setdefault(label, object())
+
+    def flush():
+        acct = account("other")   # resolved BEFORE taking the lock
+        with _lock:
+            return acct
+"""
+
+
+def test_msk001_module_lock_self_deadlock_caught():
+    findings = run_rule("MSK001", BAD_MSK001_MODULE)
+    assert rules_of(findings) == ["MSK001"]
+    assert "account()" in findings[0].message
+    assert run_rule("MSK001", GOOD_MSK001_MODULE) == []
+
+
+BAD_MSK001_CLASS = """
+    import threading
+
+    class Governor:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._tenants = {}
+
+        def _evict(self, now):
+            with self._lock:
+                self._tenants.clear()
+
+        def check(self, tenant):
+            with self._lock:
+                self._evict(0.0)   # self._evict re-takes self._lock
+                return tenant in self._tenants
+"""
+
+GOOD_MSK001_CLASS = """
+    import threading
+
+    class Governor:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._tenants = {}
+
+        def _evict_locked(self, now):
+            self._tenants.clear()   # caller holds the lock
+
+        def check(self, tenant):
+            with self._lock:
+                self._evict_locked(0.0)
+                return tenant in self._tenants
+"""
+
+
+def test_msk001_instance_lock_self_deadlock_caught():
+    findings = run_rule("MSK001", BAD_MSK001_CLASS)
+    assert rules_of(findings) == ["MSK001"]
+    assert "self._evict()" in findings[0].message
+    assert run_rule("MSK001", GOOD_MSK001_CLASS) == []
+
+
+def test_msk001_rlock_and_nested_def_are_exempt():
+    src = """
+        import threading
+
+        _lock = threading.RLock()    # reentrant: re-entry is the point
+
+        def account(label):
+            with _lock:
+                return label
+
+        def flush():
+            with _lock:
+                return account("x")
+
+        _plain = threading.Lock()
+
+        def taker():
+            with _plain:
+                pass
+
+        def schedule():
+            with _plain:
+                def later():
+                    return taker()   # runs later, not under the lock
+                return later
+    """
+    assert run_rule("MSK001", src) == []
+
+
+def test_msk001_derived_registry_matches_known_modules():
+    """The derivation is seeded by the repo's real lock registries: the
+    usage ledger and SLO window modules (the r12 self-deadlocks) must
+    derive exactly the acquirer sets a reviewer would write down."""
+    checker = LockDiscipline()
+    for rel, lock, expect_some in [
+        ("misaka_tpu/runtime/usage.py", "_lock",
+         {"account", "snapshot", "reset"}),
+        ("misaka_tpu/utils/slo.py", "_lock",
+         {"set_objectives", "_windows_for"}),
+    ]:
+        path = os.path.join(REPO, rel)
+        with open(path, encoding="utf-8") as fh:
+            module = Module(path, rel, fh.read())
+        reg = checker.module_locks(module)
+        assert lock in reg, f"{rel}: module lock {lock} not derived"
+        missing = expect_some - reg[lock]
+        assert not missing, f"{rel}: {lock} acquirers missing {missing}"
+
+
+# --- MSK002 exception-breadth ------------------------------------------------
+
+BAD_MSK002 = """
+    import http.client
+
+    def proxy(rh, path):
+        try:
+            status, payload = rh.post_form(path)
+        except OSError as e:
+            return 502, str(e).encode()
+        return status, payload
+"""
+
+GOOD_MSK002 = """
+    import http.client
+
+    def proxy(rh, path):
+        try:
+            status, payload = rh.post_form(path)
+        except (OSError, http.client.HTTPException) as e:
+            return 502, str(e).encode()
+        return status, payload
+"""
+
+
+def test_msk002_narrow_oserror_around_http_caught():
+    findings = run_rule("MSK002", BAD_MSK002)
+    assert rules_of(findings) == ["MSK002"]
+    assert "post_form" in findings[0].message
+    assert run_rule("MSK002", GOOD_MSK002) == []
+
+
+def test_msk002_bare_except_caught_anywhere():
+    findings = run_rule("MSK002", """
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+    """)
+    assert rules_of(findings) == ["MSK002"]
+    assert "bare" in findings[0].message
+
+
+def test_msk002_split_handlers_and_exception_cover():
+    # a second handler naming HTTPException covers the try; so does a
+    # broad `except Exception`; plain socket cleanup is out of scope
+    assert run_rule("MSK002", """
+        import http.client
+
+        def f(conn):
+            try:
+                return conn.getresponse()
+            except http.client.HTTPException:
+                return None
+            except OSError:
+                return None
+    """) == []
+    assert run_rule("MSK002", """
+        def f(rh):
+            try:
+                return rh.post_form("/x")
+            except Exception:
+                return None
+    """) == []
+    assert run_rule("MSK002", """
+        def close(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+    """) == []
+
+
+# --- MSK003 label-cardinality ------------------------------------------------
+
+BAD_MSK003 = """
+    from misaka_tpu.utils import metrics
+
+    M = metrics.counter("m_total", "h", ("tenant",))
+
+    def record(tenant):
+        M.labels(tenant=tenant).inc()   # client-minted series, unbounded
+"""
+
+GOOD_MSK003 = """
+    from misaka_tpu.utils import metrics
+
+    M = metrics.counter("m_total", "h", ("tenant",))
+    _seen = set()
+
+    def record(tenant):
+        label = metrics.capped_label(_seen, tenant, 64)
+        _seen.add(label)
+        M.labels(tenant=label).inc()
+"""
+
+
+def test_msk003_unlaundered_tenant_label_caught():
+    findings = run_rule("MSK003", BAD_MSK003)
+    assert rules_of(findings) == ["MSK003"]
+    assert "tenant" in findings[0].message
+    assert run_rule("MSK003", GOOD_MSK003) == []
+
+
+def test_msk003_module_launder_wrappers_are_derived():
+    # a module function that calls capped_label is itself laundering —
+    # the edge.tenant_metric_label shape; and calling it inline is clean
+    src = """
+        from misaka_tpu.utils import metrics
+
+        M = metrics.counter("m_total", "h", ("tenant",))
+        _seen = set()
+
+        def tenant_metric_label(tenant):
+            label = metrics.capped_label(_seen, tenant, 64)
+            _seen.add(label)
+            return label
+
+        def record(tenant):
+            M.labels(tenant=tenant_metric_label(tenant)).inc()
+    """
+    assert run_rule("MSK003", src) == []
+    rel = "misaka_tpu/runtime/edge.py"
+    path = os.path.join(REPO, rel)
+    with open(path, encoding="utf-8") as fh:
+        module = Module(path, rel, fh.read())
+    assert "tenant_metric_label" in LabelCardinality()._launder_fns(module)
+
+
+def test_msk003_server_chosen_labels_are_exempt():
+    assert run_rule("MSK003", """
+        from misaka_tpu.utils import metrics
+
+        M = metrics.counter("m_total", "h", ("route",))
+
+        def record(route):
+            M.labels(route=route).inc()   # route names are server-chosen
+    """) == []
+
+
+# --- MSK004 thread-lifecycle -------------------------------------------------
+
+BAD_MSK004 = """
+    import threading
+
+    class Plane:
+        def __init__(self):
+            self._accept_thread = threading.Thread(target=self._accept)
+            self._accept_thread.start()
+
+        def _accept(self):
+            pass
+
+        def close(self):
+            pass   # never joins: one OS thread leaked per lifecycle
+"""
+
+GOOD_MSK004_JOIN = """
+    import threading
+
+    class Plane:
+        def __init__(self):
+            self._accept_thread = threading.Thread(target=self._accept)
+            self._accept_thread.start()
+
+        def _accept(self):
+            pass
+
+        def close(self):
+            self._accept_thread.join()
+"""
+
+
+def test_msk004_unjoined_accept_thread_caught():
+    findings = run_rule("MSK004", BAD_MSK004)
+    assert rules_of(findings) == ["MSK004"]
+    assert "_accept_thread" in findings[0].message
+    assert run_rule("MSK004", GOOD_MSK004_JOIN) == []
+
+
+def test_msk004_daemon_and_list_join_shapes_pass():
+    assert run_rule("MSK004", """
+        import threading
+
+        def fire():
+            threading.Thread(target=print, daemon=True).start()
+    """) == []
+    assert run_rule("MSK004", """
+        import threading
+
+        def fanout(items):
+            ts = [threading.Thread(target=print, args=(i,)) for i in items]
+            ts.append(threading.Thread(target=print))
+            extra = []
+            extra += [threading.Thread(target=print)]
+            for t in ts:
+                t.start()
+            for t in ts + extra:
+                t.join()
+    """) == []
+    # late daemonization before start() is the sampler's shape
+    assert run_rule("MSK004", """
+        import threading
+
+        def fire():
+            t = threading.Thread(target=print)
+            t.daemon = True
+            t.start()
+    """) == []
+
+
+def test_msk004_unjoined_list_caught():
+    findings = run_rule("MSK004", """
+        import threading
+
+        def fanout(items):
+            ts = [threading.Thread(target=print, args=(i,)) for i in items]
+            for t in ts:
+                t.start()
+    """)
+    assert rules_of(findings) == ["MSK004"]
+
+
+# --- MSK005 clock-discipline -------------------------------------------------
+
+BAD_MSK005 = """
+    import time
+
+    def running_s(started):
+        return time.time() - started   # wall clock as a duration
+"""
+
+GOOD_MSK005 = """
+    import time
+
+    def running_s(started_mono):
+        return time.monotonic() - started_mono
+
+    def stamp():
+        return round(time.time(), 3)   # timestamp VALUE: legal
+"""
+
+
+def test_msk005_walltime_duration_caught():
+    findings = run_rule("MSK005", BAD_MSK005)
+    assert rules_of(findings) == ["MSK005"]
+    assert "monotonic" in findings[0].message
+    assert run_rule("MSK005", GOOD_MSK005) == []
+
+
+def test_msk005_deadline_add_caught():
+    findings = run_rule("MSK005", """
+        import time
+
+        def deadline():
+            return time.time() + 30
+    """)
+    assert rules_of(findings) == ["MSK005"]
+
+
+# --- MSK006 handler-drain ----------------------------------------------------
+
+BAD_MSK006 = """
+    class Handler:
+        def _handle_post(self):
+            if self.headers.get("Content-Length") is None:
+                self._text(411, "Content-Length required")   # body unread
+                return
+            raw = self.rfile.read(10)
+            self._text(200, "ok")
+"""
+
+GOOD_MSK006_CLOSE = """
+    class Handler:
+        def _handle_post(self):
+            if self.headers.get("Content-Length") is None:
+                self.close_connection = True
+                self._text(411, "Content-Length required")
+                return
+            raw = self.rfile.read(10)
+            self._text(200, "ok")
+"""
+
+GOOD_MSK006_DRAIN = """
+    from misaka_tpu.runtime import edge as edge_mod
+
+    class Handler:
+        def _handle_post(self):
+            if not self._authorized():
+                edge_mod.drain_or_close(self)
+                self._text(401, "who are you")
+                return
+            form = self._form()
+            self._text(200, "ok")
+"""
+
+
+def test_msk006_undrained_post_error_caught():
+    findings = run_rule("MSK006", BAD_MSK006)
+    assert rules_of(findings) == ["MSK006"]
+    assert "drain_or_close" in findings[0].message
+    assert run_rule("MSK006", GOOD_MSK006_CLOSE) == []
+    assert run_rule("MSK006", GOOD_MSK006_DRAIN) == []
+
+
+def test_msk006_get_handlers_out_of_scope():
+    assert run_rule("MSK006", """
+        class Handler:
+            def _handle_get(self):
+                self._text(404, "not found")   # GETs carry no body
+    """) == []
+
+
+# --- engine mechanics --------------------------------------------------------
+
+
+def test_fingerprint_stable_across_line_drift():
+    base = run_rule("MSK005", BAD_MSK005)[0]
+    shifted = run_rule("MSK005", "\n\n# a comment\n" + textwrap.dedent(
+        BAD_MSK005))[0]
+    assert base.line != shifted.line
+    assert base.fingerprint == shifted.fingerprint
+
+
+def test_repeated_findings_get_distinct_fingerprints():
+    findings = run_rule("MSK005", """
+        import time
+
+        def f(a, b):
+            x = time.time() - a
+            y = time.time() - b
+            return x + y
+    """)
+    assert len(findings) == 2
+    assert len({f.fingerprint for f in findings}) == 2
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = run_rule("MSK005", BAD_MSK005)
+    path = str(tmp_path / "baseline.txt")
+    lint.save_baseline(path, findings, header="justify me")
+    baseline = lint.load_baseline(path)
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    assert (new, len(suppressed), stale) == ([], 1, set())
+    # the fixed tree: the entry goes stale (reported, not fatal)
+    new, suppressed, stale = apply_baseline([], baseline)
+    assert new == [] and suppressed == [] and len(stale) == 1
+    # comments and blank lines survive the parse
+    raw = open(path, encoding="utf-8").read()
+    assert raw.startswith("# justify me")
+
+
+def test_inline_disable_comment():
+    src = """
+        import time
+
+        def age(started):
+            return time.time() - started  # lint: disable=MSK005 epoch arg
+    """
+    assert run_rule("MSK005", src) == []
+    # the wrong rule name does not suppress
+    src2 = src.replace("MSK005", "MSK001")
+    assert rules_of(run_rule("MSK005", src2)) == ["MSK005"]
+    # sloppy separators still suppress; a FORGOTTEN rule list ("disable="
+    # with nothing after it) suppresses nothing and must not crash
+    src3 = src.replace("disable=MSK005 epoch arg",
+                       "disable=MSK001, MSK005")
+    assert run_rule("MSK005", src3) == []
+    src4 = src.replace("disable=MSK005 epoch arg", "disable=")
+    assert rules_of(run_rule("MSK005", src4)) == ["MSK005"]
+
+
+def test_syntax_error_is_a_located_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings = lint.run_tree([str(bad)], lint.ALL_CHECKERS,
+                             base=str(tmp_path))
+    assert [f.rule for f in findings] == ["MSK000"]
+    assert "syntax error" in findings[0].message
+
+
+# --- the acceptance gate -----------------------------------------------------
+
+
+def test_live_tree_zero_new_findings():
+    """`make lint` over the committed tree: every finding is either
+    fixed or baselined with a justification — zero NEW findings."""
+    from misaka_tpu.lint.__main__ import DEFAULT_ROOTS, BASELINE_DEFAULT
+
+    roots = [r for r in DEFAULT_ROOTS if os.path.exists(os.path.join(REPO, r))]
+    findings = lint.run_tree(roots, lint.ALL_CHECKERS, base=REPO)
+    baseline = lint.load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    assert new == [], "new lint findings:\n" + lint.format_findings(new)
+    assert not stale, f"stale baseline entries (remove them): {stale}"
+    # the committed baseline is real debt, not a dumping ground: every
+    # entry must carry a justification comment within the 6 lines above
+    lines = open(os.path.join(REPO, BASELINE_DEFAULT),
+                 encoding="utf-8").read().splitlines()
+    for i, line in enumerate(lines):
+        if line.strip() and not line.startswith("#"):
+            window = lines[max(0, i - 6):i]
+            assert any(w.startswith("#") for w in window), \
+                f"baseline entry without a justification comment: {line}"
+
+
+def test_cli_exit_codes(tmp_path):
+    """`python -m misaka_tpu.lint <file>` exits 1 on a fresh finding,
+    0 once it is baselined — the make-lint contract, end to end."""
+    victim = tmp_path / "victim.py"
+    victim.write_text(
+        "import time\n\n\ndef f(s):\n    return time.time() - s\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    cmd = [sys.executable, "-m", "misaka_tpu.lint", str(victim),
+           "--baseline", str(tmp_path / "b.txt")]
+    r1 = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert r1.returncode == 1, r1.stdout + r1.stderr
+    assert "MSK005" in r1.stdout
+    r2 = subprocess.run(cmd + ["--update-baseline"], capture_output=True,
+                        text=True, env=env)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    r3 = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert r3.returncode == 0, r3.stdout + r3.stderr
+
+
+@pytest.mark.parametrize("rule,bad", [
+    ("MSK001", BAD_MSK001_MODULE),
+    ("MSK001", BAD_MSK001_CLASS),
+    ("MSK002", BAD_MSK002),
+    ("MSK003", BAD_MSK003),
+    ("MSK004", BAD_MSK004),
+    ("MSK005", BAD_MSK005),
+    ("MSK006", BAD_MSK006),
+])
+def test_every_rule_catches_its_seed_under_full_checker_set(rule, bad):
+    """Seeded-bad fixtures stay caught when ALL checkers run together
+    (no checker masks another's findings)."""
+    findings = lint.run_source(textwrap.dedent(bad), lint.ALL_CHECKERS)
+    assert rule in {f.rule for f in findings}

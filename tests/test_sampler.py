@@ -186,8 +186,10 @@ def test_profiler_409_carries_active_info():
     p = Profiler()
     assert p.active() is None
     # simulate an in-flight capture without touching jax's global state
+    # (wall stamp for display, monotonic for the elapsed math — MSK005)
     p._active_dir = "/tmp/some-capture"
     p._started_unix = _time.time() - 42
+    p._started_mono = _time.monotonic() - 42
     info = p.active()
     assert info["dir"] == "/tmp/some-capture" and info["running_s"] >= 42
     with pytest.raises(ProfilerError) as e:

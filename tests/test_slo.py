@@ -286,12 +286,19 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
     # Margins matter more than realism here: the un-faulted neighbor's
     # REAL p99 creeps toward 40ms late in a full tier-1 run (one process,
     # accumulated threads + sampler load), which flipped this scenario's
-    # "neighbor stays green" pin on box noise.  A 150ms objective against
-    # a 400ms injected fault keeps every assertion (page fires, neighbor
-    # green, recovery clears) with ~3-4x headroom either side; the
-    # windows scale with the fault cadence (~0.4s/event) so the page
-    # rule's short window still collects min_events.
-    _arm(monkeypatch, spec="p99<150ms", windows="2,4,8,16", min_events=3)
+    # "neighbor stays green" pin on box noise — and the r14 suite grew
+    # enough neighbors that the 150ms/2s-window rescale started flaking
+    # again (a one-second scheduler stall put >14% of a 2s window's ~5
+    # neighbor samples over threshold, and a starved client thread could
+    # drop the short window below min_events entirely).  Current scale:
+    # a 250ms objective against a 400ms injected fault (fault 1.6x over,
+    # neighbor ~6x under even with creep), windows 3,6,12,24 so the
+    # ~0.4s-cadence fault still lands 7+ events in the SHORT window
+    # (min_events=3 with slack instead of exactly-at-the-floor).  The
+    # pins themselves — page fires, neighbor stays "ok", recovery
+    # clears — are unchanged; only margins and convergence deadlines
+    # widened (deadline waits poll, so green runs pay nothing extra).
+    _arm(monkeypatch, spec="p99<250ms", windows="3,6,12,24", min_events=3)
     reg = ProgramRegistry(None, batch=8, engine="native", caps=CAPS)
     top = networks.add2(**CAPS)
     master = MasterNode(top, chunk_steps=64, batch=8, engine="native")
@@ -347,7 +354,7 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
         for t in ts:
             t.start()
         # warm both tenants healthy first (activates ten-b's engine)
-        deadline = time.monotonic() + 20
+        deadline = time.monotonic() + 45
         while time.monotonic() < deadline and not stop.is_set():
             if states() == ("ok", "ok"):
                 break
@@ -355,7 +362,7 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
         assert states() == ("ok", "ok"), states()
         # inject 400ms into ONLY ten-b's serve passes
         faults.configure("serve_delay:ten-b=0.4")
-        deadline = time.monotonic() + 15
+        deadline = time.monotonic() + 30
         while time.monotonic() < deadline and not stop.is_set():
             a, b = states()
             if b == "page":
@@ -367,8 +374,11 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
         health = get_json("/healthz")
         assert health["slo"] == "page" and health["degraded"] is True
         # recovery: disarm, keep healthy traffic flowing, page clears
+        # (the 12s window must age the fault's bad events out, plus
+        # full-suite scheduling slack — the deadline is a poll, not a
+        # cost on green runs)
         faults.configure(None)
-        deadline = time.monotonic() + 25
+        deadline = time.monotonic() + 50
         while time.monotonic() < deadline and not stop.is_set():
             if states()[1] == "ok":
                 break

@@ -1,0 +1,317 @@
+"""Sanitizer stress lane: hammer the C++ serving pool under ASan/TSan/UBSan.
+
+The PR 7 review found a TOCTOU use-after-free in exactly this shape: the
+debug surfaces (/metrics, /debug/usage, /debug/flamegraph) read the
+pool's busy/idle counters from scrape threads while a registry eviction
+or hot-swap close()d the pool — the `_h is None` check alone left a
+window where a reader dereferenced a freed C++ Pool.  The fix
+(cinterp.NativePool._ctr_lock) is a Python-side discipline around native
+memory, which is precisely what only a sanitizer build can re-verify:
+this driver runs the concurrent serve / close / counter-read scenario
+against an INSTRUMENTED libmisaka_interp and lets ASan (heap UAF), TSan
+(data races between pool workers and readers), or UBSan (the int64
+wrap / JRO-saturation arithmetic, fed INT32_MIN/MAX) veto the build.
+
+Two-stage: invoked plain, it builds the sanitized .so (make native-asan
+and friends produce the same artifact), locates the sanitizer runtime,
+and re-execs itself under LD_PRELOAD with MISAKA_INTERP_SO pointing at
+the instrumented build (utils/nativelib.py honors the override and
+skips the staleness rebuild that would otherwise clobber it).  The
+child then runs the scenario through the SHIPPED wrappers — the point
+is to sanitize the production discipline, not a lookalike.
+
+Usage (or `make sanitize-smoke` / `make sanitize-all`):
+    python tools/sanitize_stress.py --sanitizer address [--seconds 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/...` puts tools/ first, not the repo
+    sys.path.insert(0, REPO)
+
+_SAN = {
+    # sanitizer -> (cc flag, runtime lib, .so suffix, env var, env value)
+    "address": ("-fsanitize=address", "libasan.so", "asan",
+                # python itself "leaks" interned objects by design; the
+                # lane polices the interpreter library, not CPython
+                "ASAN_OPTIONS", "detect_leaks=0:abort_on_error=1"),
+    "thread": ("-fsanitize=thread", "libtsan.so", "tsan",
+               "TSAN_OPTIONS", "halt_on_error=1:second_deadlock_stack=1"),
+    "undefined": ("-fsanitize=undefined -fno-sanitize-recover=all",
+                  "libubsan.so", "ubsan",
+                  "UBSAN_OPTIONS", "halt_on_error=1:print_stacktrace=1"),
+}
+
+
+def build_sanitized_so(kind: str) -> str:
+    """Build native/libmisaka_interp.<kind>.so when missing or older
+    than the source (mtime is fine for a local lane artifact — these
+    are never shipped, unlike the hash-tagged default build).
+
+    The Makefile's native-<kind> rule is the ONE flag definition (so
+    `make native-asan` and this script cannot drift apart and test
+    different binaries); the inline compile below is only the fallback
+    for environments without make, mirroring SAN_CXXFLAGS."""
+    flag, _, suffix, _, _ = _SAN[kind]
+    src = os.path.join(REPO, "native", "interpreter.cpp")
+    so = os.path.join(REPO, "native", f"libmisaka_interp.{suffix}.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    print(f"# building {os.path.relpath(so, REPO)}", file=sys.stderr)
+    made = subprocess.run(["make", "-C", REPO, f"native-{suffix}"],
+                          capture_output=True)
+    if made.returncode == 0 and os.path.exists(so):
+        return so
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O1", "-g", "-fno-omit-frame-pointer", "-std=c++17",
+           "-shared", "-fPIC", "-pthread", *flag.split(),
+           "-Wall", "-Wextra", "-Werror", src, "-o", so]
+    subprocess.run(cmd, check=True)
+    return so
+
+
+def reexec_under_sanitizer(kind: str, args) -> int:
+    so = build_sanitized_so(kind)
+    _, runtime, _, env_var, env_val = _SAN[kind]
+    cxx = os.environ.get("CXX", "g++")
+    lib = subprocess.run(
+        [cxx, f"-print-file-name={runtime}"],
+        check=True, capture_output=True, text=True,
+    ).stdout.strip()
+    if lib == runtime or not os.path.exists(lib):
+        print(f"sanitize: {runtime} not found next to {cxx}; cannot run "
+              f"the {kind} lane here", file=sys.stderr)
+        return 0  # missing toolchain degrades like the native tier does
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": lib,
+        env_var: env_val + ":" + env.get(env_var, ""),
+        "MISAKA_INTERP_SO": so,
+        "MISAKA_SANITIZE_CHILD": kind,
+        # never touch (or wedge on) a TPU relay from a sanitizer lane
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+    })
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--sanitizer", kind, "--seconds", str(args.seconds),
+           "--replicas", str(args.replicas),
+           "--pool-threads", str(args.pool_threads),
+           "--readers", str(args.readers)]
+    print(f"# re-exec under {os.path.basename(lib)} "
+          f"(MISAKA_INTERP_SO={os.path.relpath(so, REPO)})", file=sys.stderr)
+    return subprocess.run(cmd, env=env).returncode
+
+
+# --- the child scenario -----------------------------------------------------
+
+
+def _tables():
+    """One-lane IN; ADD 2; OUT — the minimal always-progressing serve
+    program, built straight from the ISA tables (no parser dependency)."""
+    import numpy as np
+
+    from misaka_tpu.tis import isa
+
+    code = np.zeros((1, 3, isa.NFIELDS), np.int32)
+    code[0, 0, isa.F_OP] = isa.OP_IN          # IN  ACC
+    code[0, 1, isa.F_OP] = isa.OP_ADD         # ADD 2
+    code[0, 1, isa.F_SRC] = isa.SRC_IMM
+    code[0, 1, isa.F_IMM] = 2
+    code[0, 2, isa.F_OP] = isa.OP_OUT         # OUT ACC
+    code[0, 2, isa.F_SRC] = isa.SRC_ACC
+    return code, np.array([3], np.int32)
+
+
+def _init_state(B: int, n: int, s: int, stack_cap: int, in_cap: int,
+                out_cap: int):
+    import numpy as np
+
+    from misaka_tpu.tis import isa
+
+    d = {
+        "acc": np.zeros((B, n), np.int32),
+        "bak": np.zeros((B, n), np.int32),
+        "acc_hi": np.zeros((B, n), np.int32),
+        "bak_hi": np.zeros((B, n), np.int32),
+        "pc": np.zeros((B, n), np.int32),
+        "port_val": np.zeros((B, n, isa.NUM_PORTS), np.int32),
+        "port_full": np.zeros((B, n, isa.NUM_PORTS), np.uint8),
+        "hold_val": np.zeros((B, n), np.int32),
+        "holding": np.zeros((B, n), np.uint8),
+        "stack_mem": np.zeros((B, s, stack_cap), np.int32),
+        "stack_top": np.zeros((B, s), np.int32),
+        "in_buf": np.zeros((B, in_cap), np.int32),
+        "out_buf": np.zeros((B, out_cap), np.int32),
+        "retired": np.zeros((B, n), np.int32),
+    }
+    for k in ("in_rd", "in_wr", "out_rd", "out_wr", "tick"):
+        d[k] = np.zeros((B,), np.int32)
+    return d
+
+
+def run_scenario(args) -> int:
+    import numpy as np
+
+    from misaka_tpu.core import cinterp
+
+    assert os.environ.get("MISAKA_INTERP_SO"), "child needs the override"
+    if not cinterp.available():
+        print("sanitize: instrumented interpreter failed to load",
+              file=sys.stderr)
+        return 1
+
+    B, in_cap = args.replicas, 16
+    code, prog_len = _tables()
+    stop = threading.Event()
+    serve_gate = threading.Event()   # set = serve thread may run
+    serve_idle = threading.Event()   # set = serve thread parked at the gate
+    serve_gate.set()
+    errors: list[BaseException] = []
+    stats = {"passes": 0, "values": 0, "reads": 0, "closed_reads": 0,
+             "cycles": 0}
+
+    def new_pool():
+        return cinterp.NativePool(
+            code, prog_len, 1, 16, in_cap, in_cap,
+            replicas=B, threads=args.pool_threads,
+        )
+
+    box = {"pool": new_pool()}
+    rng = np.random.default_rng(7)
+
+    def serve_loop():
+        # The single serve caller (the device-loop contract); pauses at
+        # the gate so close/recreate happens against a quiescent pool —
+        # exactly the drain-to-quiescence discipline the engine uses.
+        d = _init_state(B, 1, 1, 16, in_cap, in_cap)
+        try:
+            while not stop.is_set():
+                if not serve_gate.is_set():
+                    serve_idle.set()
+                    serve_gate.wait(timeout=1.0)
+                    d = _init_state(B, 1, 1, 16, in_cap, in_cap)
+                    continue
+                serve_idle.clear()
+                pool = box["pool"]
+                counts = rng.integers(0, 5, size=B).astype(np.int32)
+                # extreme magnitudes drive the 64-bit wrap arithmetic
+                # (UBSan's half of the lane); int32 wrap on the wire is
+                # the spec, so expectations wrap with i32 semantics
+                vals = np.zeros((B, in_cap), np.int32)
+                for b in range(B):
+                    vals[b, :counts[b]] = rng.choice(
+                        [-2**31, -7, 0, 5, 2**31 - 1, 2**31 - 2],
+                        size=counts[b],
+                    ).astype(np.int32)
+                d, packed = pool.serve(d, vals, counts, ticks=64)
+                # partial-fill serial fast path (n<=4 runs on THIS
+                # thread): a second shape through the same superstep
+                active = np.arange(min(2, B), dtype=np.int32)
+                d, _ = pool.serve(
+                    d, np.zeros((B, in_cap), np.int32),
+                    np.zeros((B,), np.int32), ticks=8, active=active,
+                )
+                for b in range(B):
+                    rd, wr = int(packed[b, 2]), int(packed[b, 3])
+                    got = packed[b, 4:][(rd + np.arange(wr - rd)) % in_cap]
+                    want = (vals[b, :counts[b]].astype(np.int64) + 2)
+                    want = want.astype(np.uint64).astype(np.uint32)
+                    # plain compare, NOT np.testing: numpy.testing's lazy
+                    # first import spawns a subprocess (check_support_sve),
+                    # and fork() under the TSan runtime deadlocks
+                    if not np.array_equal(got.astype(np.uint32), want):
+                        raise AssertionError(
+                            f"replica {b} served wrong values: "
+                            f"{got!r} != {want!r}"
+                        )
+                    stats["values"] += wr - rd
+                stats["passes"] += 1
+        except BaseException as e:  # noqa: BLE001 — surfaced at exit
+            errors.append(e)
+            stop.set()
+        finally:
+            serve_idle.set()
+
+    def reader_loop():
+        # Scrape-thread twin: hammers the counter read CONCURRENTLY with
+        # serve and with close/recreate.  "pool is closed" is the typed,
+        # expected outcome of losing the race; a UAF is what ASan/TSan
+        # are here to veto.
+        try:
+            while not stop.is_set():
+                pool = box["pool"]
+                try:
+                    c = pool.counters()
+                    assert c["busy_ns"] >= 0 and c["idle_ns"] >= 0
+                    pool.thread_counters()
+                    stats["reads"] += 1
+                except RuntimeError:
+                    stats["closed_reads"] += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=serve_loop)]
+    threads += [threading.Thread(target=reader_loop)
+                for _ in range(args.readers)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + args.seconds
+    try:
+        while time.monotonic() < deadline and not stop.is_set():
+            time.sleep(0.2)
+            # the PR 7 shape: close while scrape threads are mid-hammer
+            serve_gate.clear()
+            if not serve_idle.wait(timeout=10):
+                errors.append(RuntimeError("serve thread never quiesced"))
+                break
+            old = box["pool"]
+            box["pool"] = new_pool()
+            old.close()  # readers may hold `old` RIGHT NOW — the race
+            stats["cycles"] += 1
+            serve_gate.set()
+    finally:
+        stop.set()
+        serve_gate.set()
+        for t in threads:
+            t.join(timeout=30)
+        box["pool"].close()
+    if errors:
+        print(f"sanitize: scenario error: {errors[0]!r}", file=sys.stderr)
+        return 1
+    if not (stats["passes"] and stats["reads"] and stats["cycles"]):
+        print(f"sanitize: scenario did not exercise the race: {stats}",
+              file=sys.stderr)
+        return 1
+    print(f"# sanitize[{os.environ.get('MISAKA_SANITIZE_CHILD')}] green: "
+          f"{stats['passes']} serve passes / {stats['values']} values, "
+          f"{stats['reads']} counter reads "
+          f"({stats['closed_reads']} typed closed-pool losses), "
+          f"{stats['cycles']} close/recreate cycles", file=sys.stderr)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sanitizer", default="address",
+                    choices=sorted(_SAN))
+    ap.add_argument("--seconds", type=float, default=6.0)
+    ap.add_argument("--replicas", type=int, default=64)
+    ap.add_argument("--pool-threads", type=int, default=8)
+    ap.add_argument("--readers", type=int, default=4)
+    args = ap.parse_args()
+    if os.environ.get("MISAKA_SANITIZE_CHILD"):
+        return run_scenario(args)
+    return reexec_under_sanitizer(args.sanitizer, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
