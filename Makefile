@@ -162,6 +162,20 @@ usage-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/usage_smoke.py
 
+# Observatory tripwire (~30s): a REAL subprocess server with the
+# embedded TSDB + canary + watchdog at test cadence — >= 3 collected
+# intervals with well-formed /debug/series shapes, the self-contained
+# dashboard with populated sparklines, a green full-stack canary series,
+# and a watchdog page (with exemplar trace IDs, /healthz degraded)
+# fired by an injected serve_delay fault over POST /debug/faults and
+# cleared on recovery.  The same assertions run inside tier-1
+# (tests/test_observatory.py, tests/test_tsdb.py); the fleet-mode live
+# drill is tests/test_observatory.py -m slow (test-all / fleet lanes).
+# docs/OBSERVABILITY.md "The observatory".
+observatory-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/observatory_smoke.py
+
 # The CI entry point: tier-1 fast lane + every smoke tripwire +
 # bench-smoke, in one target — what a CI runner invokes (there is no
 # hosted CI config; this is the single command one would call).  Order:
@@ -176,6 +190,7 @@ ci:
 	$(MAKE) trace-smoke
 	$(MAKE) registry-smoke
 	$(MAKE) usage-smoke
+	$(MAKE) observatory-smoke
 	$(MAKE) edge-smoke
 	$(MAKE) chaos-smoke
 	$(MAKE) fleet-smoke
@@ -254,4 +269,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke edge-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke observatory-smoke edge-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean

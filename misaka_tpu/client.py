@@ -471,6 +471,40 @@ class MisakaClient:
         browser for the self-contained viewer)."""
         return json.loads(self._request("/debug/flamegraph", None, "GET"))
 
+    def series(self, name: str | None = None, window: str = "1h",
+               labels: dict | None = None) -> dict:
+        """Retained metric history from the embedded TSDB (GET
+        /debug/series, utils/tsdb.py).
+
+        ``series()`` with no name lists the catalog (series counts,
+        retention stages, drop counters).  With ``name`` — a counter
+        (returned as a rate), a gauge, or a derived histogram series
+        (``<hist>:p50`` / ``:p99`` / ``:rate``) — returns every matching
+        series over the trailing ``window`` ("30s"/"5m"/"1h" or bare
+        seconds), each as ``{labels, stage_s, points: [[unix, avg,
+        max], ...]}``.  ``labels`` filters by exact label values; on a
+        fleet endpoint every replica's series carries ``replica="<i>"``.
+        Raises MisakaClientError on a malformed window or filter (400)."""
+        from urllib.parse import quote
+
+        if name is None:
+            return json.loads(self._request("/debug/series", None, "GET"))
+        path = (
+            f"/debug/series?name={quote(name, safe=':')}"
+            f"&window={quote(str(window))}"
+        )
+        for k, v in (labels or {}).items():
+            path += f"&label={quote(f'{k}={v}')}"
+        return json.loads(self._request(path, None, "GET"))
+
+    def canary_status(self) -> dict | None:
+        """The synthetic canary's last cycle (runtime/canary.py), from
+        the /healthz ``canary`` block: per-tier outcomes, the
+        first-failing-tier attribution, and the consecutive full-stack
+        failure count.  None when the server runs no canary
+        (MISAKA_CANARY=0, or a bare test server)."""
+        return self.healthz().get("canary")
+
     # --- the engine fleet (server must run with MISAKA_FLEET >= 1) ----------
 
     def fleet_status(self) -> dict:

@@ -127,6 +127,56 @@ _G_POOL_REPLICAS.set_function(
 )
 _G_POOL_FILL.set_function(_fill_ratio)
 
+_G_POOL_BUSY = metrics.gauge(
+    "misaka_native_pool_busy_fraction",
+    "Fraction of pool thread time spent executing (vs cv-parked) over "
+    "the last ~1s window, from the C++ per-thread busy/idle counters — "
+    "the dashboard's native-tier saturation signal (the since-boot "
+    "fraction lives on /debug/usage)",
+)
+
+
+class _BusyWindow:
+    """Windowed busy fraction from the cumulative C++ ns counters: the
+    since-boot ratio converges and stops moving, so the gauge deltas the
+    counters over >= 1 s between refreshes — every scraper inside that
+    second sees one coherent value."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._prev: tuple[float, int, int] | None = None
+        self._value = 0.0
+
+    def read(self) -> float:
+        work = total = 0
+        for p in _live_pools():
+            try:
+                c = p._pool.counters()
+            except Exception:
+                continue
+            w = c["busy_ns"] + c["serial_ns"]
+            work += w
+            total += w + c["idle_ns"]
+        now = time.monotonic()
+        with self._lock:
+            prev = self._prev
+            if prev is None:
+                self._prev = (now, work, total)
+                return 0.0
+            dt_total = total - prev[2]
+            if now - prev[0] >= 1.0:
+                if dt_total > 0:
+                    self._value = max(
+                        0.0, min(1.0, (work - prev[1]) / dt_total)
+                    )
+                elif total == 0:
+                    self._value = 0.0  # pools closed: not busy
+                self._prev = (now, work, total)
+            return self._value
+
+
+_G_POOL_BUSY.set_function(_BusyWindow().read)
+
 
 def pool_counters() -> dict | None:
     """Busy/idle nanosecond counters across every live native pool (None

@@ -186,6 +186,44 @@ so the master's env surface is what survives:
                    (0.02 — the duty-cycle cap: the sampler measures its
                    own per-sample cost and stretches its period to stay
                    under this fraction of one core)
+  MISAKA_TSDB      "0" disables the embedded time-series history
+                   (utils/tsdb.py; default on): a governed collector
+                   samples the metrics registry every
+                   MISAKA_TSDB_INTERVAL_S (5) into staged rings
+                   (interval x 720 / 1m x 360 / 5m x 288 — 1h/6h/24h),
+                   counters as rates, histograms as :p50/:p99/:rate
+                   series, queried at GET /debug/series and drawn at
+                   GET /debug/dashboard (self-contained sparklines,
+                   per-program/per-replica drill-down).  Bounded:
+                   MISAKA_TSDB_MAX_SERIES (512; ~38 KiB each, ~20 MiB
+                   worst case, overflow dropped LOUDLY) and
+                   MISAKA_TSDB_BUDGET (0.01 duty-cycle cap, sampler
+                   discipline).  History snapshots into checkpoints
+                   (strictly-newer merge on restore), so /debug/series
+                   survives a /fleet/roll.
+  MISAKA_CANARY    "0" disables the synthetic canary (runtime/canary.py;
+                   default on when serving via this entrypoint): every
+                   MISAKA_CANARY_INTERVAL_S (5) it probes /healthz, the
+                   compute plane, a direct engine compute, and the FULL
+                   public stack with the pinned known-answer program
+                   `_canary`, attributing a failure to the first broken
+                   tier (the `canary` block on /healthz,
+                   misaka_canary_* series).  Canary traffic bills to
+                   the exempt `_canary` usage account and never feeds
+                   SLO windows.
+  MISAKA_WATCHDOG  regression watchdog rules over the TSDB
+                   (utils/watchdog.py; "0" disables, unset = defaults:
+                   canary failing 15s pages, p99 2x over its own 1h
+                   median for 5m warns, replica restarts >4/h warn).
+                   Grammar: "[name=]series[{label=value}] <|> N[x@win]
+                   [for Ns] [->warning|page]", comma-separated; findings
+                   ride GET /debug/alerts with exemplar trace IDs and a
+                   page raises the /healthz degraded flag.  Knobs:
+                   MISAKA_WATCHDOG_RECENT_S (60),
+                   MISAKA_WATCHDOG_MIN_POINTS (5).
+                   POST /debug/faults (admin) re-arms MISAKA_FAULTS on
+                   a running server (fleet-wide fan-out) — the drill
+                   entry point.
   MISAKA_TLS_CERT / MISAKA_TLS_KEY  serve the PUBLIC HTTP listener over
                    TLS (stdlib ssl; PEM cert chain + private key).  In
                    single-process mode the engine's own listener wraps;
@@ -329,6 +367,27 @@ def _serve_http(
     port = int(environ.get("MISAKA_PORT", "8000"))
     log_ = logging.getLogger("misaka_tpu.app")
     workers = int(environ.get("MISAKA_HTTP_WORKERS", "0") or 0)
+    # The synthetic canary (runtime/canary.py) probes the PUBLIC surface
+    # from inside this process; with API-key auth armed it needs a key,
+    # so mint the per-boot internal token the fleet parent would have
+    # (admin-scoped synthetic tenant, never leaves the process tree —
+    # frontend workers inherit it through their env).
+    from misaka_tpu.runtime import canary as canary_mod
+    from misaka_tpu.runtime import edge as edge_mod
+
+    if (
+        edge_mod.keyfile_path(environ)
+        and not environ.get("MISAKA_EDGE_INTERNAL_TOKEN")
+        and environ.get("MISAKA_EDGE", "1") != "0"
+    ):
+        environ["MISAKA_EDGE_INTERNAL_TOKEN"] = os.urandom(16).hex()
+    scheme = "https" if environ.get("MISAKA_TLS_CERT") else "http"
+
+    def arm_canary(server) -> None:
+        canary_mod.ensure_started(
+            f"{scheme}://127.0.0.1:{port}",
+            registry=registry, server=server, environ=environ,
+        )
     if workers > 0 and hasattr(master, "compute_coalesced"):
         # The multi-process serving plane (runtime/frontends.py): N
         # frontend worker processes share the PUBLIC port via SO_REUSEPORT
@@ -369,6 +428,7 @@ def _serve_http(
             "engine http on 127.0.0.1:%d; %d supervised frontend workers "
             "on :%d (plane %s)", engine_port, workers, port, plane_path,
         )
+        arm_canary(server)  # probes the PUBLIC (frontend) port + plane
         try:
             server.serve_forever()
         except KeyboardInterrupt:
@@ -402,6 +462,7 @@ def _serve_http(
         server.misaka_plane = plane
         log_.info("compute plane serving at %s", plane_path)
     log_.info("starting http server on :%d", port)
+    arm_canary(server)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -273,6 +273,7 @@ COMPUTE_ROUTES = frozenset({"/compute", "/compute_batch", "/compute_raw"})
 ADMIN_ROUTES = frozenset({
     "/run", "/pause", "/reset", "/load", "/checkpoint", "/restore",
     "/profile/start", "/profile/stop", "/fleet/roll", "/fleet/drain",
+    "/debug/faults",  # fault injection is an operator mutation
 })
 
 

@@ -340,6 +340,7 @@ class FleetManager:
                 "probe_fails": 0,
                 "probe_ok": False,
                 "running": None,    # replica's network run state (probed)
+                "degraded": False,  # replica-declared (probed /healthz)
                 "rolling": False,   # roll owns this slot; monitor hands off
                 "restore": None,    # checkpoint to restore on next spawn
                 "run_on_boot": None,  # roll-preserved run state (one-shot)
@@ -526,6 +527,7 @@ class FleetManager:
         slot["spawned_at"] = time.monotonic()
         slot["probe_fails"] = 0
         slot["probe_ok"] = False
+        slot["degraded"] = False  # fresh process: re-probed, not inherited
         log.info(
             "replica %d spawned (pid %d, http :%d, plane %s%s)",
             slot["idx"], slot["proc"].pid, slot["port"], slot["plane"],
@@ -602,6 +604,7 @@ class FleetManager:
                     # network run state from the last /healthz probe
                     # (<= probe_s stale; None until first probe)
                     "running": s["running"],
+                    "degraded": s["degraded"],
                     "breaker_open": bool(
                         s["breaker_until"] is not None
                         and s["breaker_until"] > now
@@ -620,7 +623,9 @@ class FleetManager:
             "restarts_total": restarts,
             "rolls_total": rolls,
             "last_roll": last_roll,
-            "degraded": up < len(rows),
+            "degraded": up < len(rows) or any(
+                r["state"] == "up" and r["degraded"] for r in rows
+            ),
         }
 
     def up_slots(self) -> list[dict]:
@@ -674,6 +679,10 @@ class FleetManager:
                 payload = rh.get_json("/healthz")
                 ok = bool(payload.get("ok"))
                 slot["running"] = bool(payload.get("running"))
+                # replica-declared degradation (SLO page, watchdog page,
+                # shrunk worker pool) surfaces on the FLEET healthz too:
+                # a fleet of up-but-degraded replicas must not read green
+                slot["degraded"] = bool(payload.get("degraded"))
             except (OSError, RuntimeError, ValueError):
                 ok = False
             if ok:
@@ -974,6 +983,9 @@ class FleetManager:
 _FANOUT_ROUTES = frozenset({
     "/run", "/pause", "/reset", "/load", "/programs",
     "/checkpoint", "/restore",
+    # fault (re-)arming must reach every replica: the observatory drill
+    # injects a scoped serve_delay/replica_blackhole on a RUNNING fleet
+    "/debug/faults",
 })
 
 # stateful singleton routes proxied to ONE deterministic replica: the
@@ -1042,6 +1054,14 @@ def make_fleet_http_server(
     import re
 
     from misaka_tpu.runtime import edge as edge_mod
+    from misaka_tpu.utils import tsdb as tsdb_mod
+    from misaka_tpu.utils import watchdog as watchdog_mod
+
+    # The parent retains its OWN history too (fleet gauges, frontend
+    # supervisor, restart counters — the watchdog's replica-restart rule
+    # reads them here), next to the replica-merged /debug/series below.
+    tsdb_mod.ensure_started()
+    watchdog_mod.ensure_started()
 
     program_re = re.compile(r"^/programs/([^/]+)(/.*)?$")
 
@@ -1061,6 +1081,48 @@ def make_fleet_http_server(
         quota_enabled=False,
         admission_enabled=False,
     )
+
+    def _merged_series(name: str, window_s: float,
+                       labels: dict | None = None) -> list[dict]:
+        """One series family across the fleet: every up replica's rows
+        with a `replica="<i>"` label injected (the relabeling
+        aggregator's discipline, applied to history), plus the parent's
+        own local rows.  A `replica` label filter is resolved HERE —
+        it selects which replicas to fetch — and never forwarded: the
+        replicas' own series carry no replica label (it is injected on
+        this side), so forwarding it would match nothing."""
+        from urllib.parse import quote, urlencode
+
+        labels = dict(labels or {})
+        want_replica = labels.pop("replica", None)
+        qs = urlencode({"name": name, "window": f"{window_s:g}s"})
+        extra = "".join(
+            f"&label={quote(f'{k}={v}')}" for k, v in labels.items()
+        )
+        slots = [
+            s for s in fleet.up_slots()
+            if want_replica is None or str(s["idx"]) == want_replica
+        ]
+        fetched = _gather(
+            slots,
+            lambda s: _ReplicaHTTP(
+                s["port"], timeout=5.0, key=fleet._internal_token,
+            ).get_json(f"/debug/series?{qs}{extra}"),
+        )
+        rows: list[dict] = []
+        for slot, payload in zip(slots, fetched):
+            if payload is None:
+                continue
+            for row in payload.get("series", ()):
+                row["labels"] = {
+                    **row.get("labels", {}), "replica": str(slot["idx"]),
+                }
+                rows.append(row)
+        if want_replica is None:
+            # the parent's own series carry no replica label, so any
+            # replica filter excludes them by definition
+            rows.extend(tsdb_mod.query(name, labels, window_s))
+        return rows
 
     def _gather(slots: list[dict], fn):
         """Apply `fn(slot)` to every slot CONCURRENTLY and return the
@@ -1306,6 +1368,27 @@ def make_fleet_http_server(
                         payload["degraded"] = (
                             payload["degraded"] or fs["degraded"]
                         )
+                    # parent-side watchdog (replica restart rate) and
+                    # canary state, same contract as the engine /healthz
+                    wd_state = watchdog_mod.overall_state()
+                    if wd_state is not None:
+                        payload["watchdog"] = wd_state
+                        payload["degraded"] = (
+                            payload["degraded"] or wd_state == "page"
+                        )
+                    from misaka_tpu.runtime import canary as canary_mod
+
+                    cst = canary_mod.state_payload()
+                    if cst is not None:
+                        payload["canary"] = {
+                            "failing_tier": cst["failing_tier"],
+                            "consecutive_full_failures":
+                                cst["consecutive_full_failures"],
+                            "tiers": {
+                                t: v.get("ok")
+                                for t, v in cst["tiers"].items()
+                            },
+                        }
                     self._json(payload)
                     return
                 if path in ("/fleet", "/fleet/state"):
@@ -1433,6 +1516,111 @@ def make_fleet_http_server(
                     self._json({"traceEvents": events,
                                 "displayTimeUnit": "ms"})
                     return
+                if path == "/debug/alerts":
+                    # one replica's SLO/watchdog view (sticky, like the
+                    # flamegraph) PLUS the parent's own watchdog —
+                    # replica restart-rate and fleet-canary rules fire
+                    # HERE, and proxying alone would hide them
+                    slot = self._pick_slot(path)
+                    payload = {}
+                    if slot is not None:
+                        try:
+                            payload = _ReplicaHTTP(
+                                slot["port"], timeout=5.0,
+                                key=fleet._internal_token,
+                            ).get_json("/debug/alerts")
+                            payload["replica"] = slot["idx"]
+                        except (OSError, RuntimeError, ValueError):
+                            payload = {}
+                    from misaka_tpu.utils import tracespan
+
+                    wd = watchdog_mod.debug_payload()
+                    for rule in wd.get("rules", ()):
+                        if rule.get("state") != "ok":
+                            rule["exemplars"] = \
+                                tracespan.slowest_exemplars()
+                    payload["fleet_watchdog"] = wd
+                    self._json(payload)
+                    return
+                if path == "/debug/series":
+                    # replica-merged history: every replica's series
+                    # under replica="<i>" labels + the parent's own
+                    from urllib.parse import parse_qs
+
+                    try:
+                        name, labels, window_s = tsdb_mod.parse_query(
+                            parse_qs(
+                                self.path.split("?", 1)[1]
+                                if "?" in self.path else ""
+                            )
+                        )
+                    except tsdb_mod.TSDBError as e:
+                        self._text(400, str(e))
+                        return
+                    if name is None:
+                        merged = tsdb_mod.index_payload()
+                        merged["replicas"] = {}
+                        slots = fleet.up_slots()
+                        for slot, payload in zip(slots, _gather(
+                            slots,
+                            lambda s: _ReplicaHTTP(
+                                s["port"], timeout=5.0,
+                                key=fleet._internal_token,
+                            ).get_json("/debug/series"),
+                        )):
+                            if payload is None:
+                                continue
+                            for n, c in payload.get("names", {}).items():
+                                merged["names"][n] = (
+                                    merged["names"].get(n, 0) + c
+                                )
+                            merged["replicas"][str(slot["idx"])] = {
+                                "series_count":
+                                    payload.get("series_count", 0),
+                                "dropped_series":
+                                    payload.get("dropped_series", 0),
+                            }
+                        self._json(merged)
+                        return
+                    self._json({
+                        "name": name,
+                        "window_s": window_s,
+                        "series": _merged_series(name, window_s, labels),
+                    })
+                    return
+                if path == "/debug/dashboard":
+                    # the same self-contained page the engine serves,
+                    # over the replica-merged series: the `replica`
+                    # label filter becomes the per-replica drill-down
+                    from urllib.parse import parse_qs
+
+                    from misaka_tpu.runtime import canary as canary_mod
+                    from misaka_tpu.utils import dashboard as dash_mod
+
+                    q = {
+                        k: v[0] for k, v in parse_qs(
+                            self.path.split("?", 1)[1]
+                            if "?" in self.path else ""
+                        ).items()
+                    }
+                    try:
+                        window_s = tsdb_mod.parse_window(
+                            q.get("window", "1h")
+                        )
+                    except tsdb_mod.TSDBError as e:
+                        self._text(400, str(e))
+                        return
+                    extra = {"watchdog": watchdog_mod.debug_payload()}
+                    cst = canary_mod.state_payload()
+                    if cst is not None:
+                        extra["canary"] = cst
+                    html = dash_mod.render_html(
+                        _merged_series, window_s, extra
+                    )
+                    self._reply(
+                        200, html.encode(), "text/html; charset=utf-8"
+                    )
+                    return
                 # anything else: proxy to one healthy replica
                 self._proxy("GET")
             except Exception as e:  # defensive: never kill the server
@@ -1552,6 +1740,20 @@ def run_fleet(n: int, environ=None) -> None:
     log.info(
         "fleet up: %d replicas, control on 127.0.0.1:%d, %d frontend "
         "workers on :%d", fleet.n, control_port, workers, public_port,
+    )
+    # The fleet-level canary (runtime/canary.py): probes the PUBLIC
+    # endpoint — edge through the frontend tier, full-stack through the
+    # router to a replica — with the per-boot internal (admin) token.
+    # Full-stack only when the replicas run registries; the parent
+    # registers the program over the fanned-out POST /programs.
+    from misaka_tpu.runtime import canary as canary_mod
+
+    scheme = "https" if environ.get("MISAKA_TLS_CERT") else "http"
+    canary_mod.ensure_started(
+        f"{scheme}://127.0.0.1:{public_port}",
+        token=fleet._internal_token,
+        full_stack=bool(environ.get("MISAKA_PROGRAMS_DIR")),
+        environ=environ,
     )
     try:
         server.serve_forever()

@@ -44,6 +44,8 @@ from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
 from misaka_tpu.utils import slo
 from misaka_tpu.utils import tracespan
+from misaka_tpu.utils import tsdb as tsdb_mod
+from misaka_tpu.utils import watchdog as watchdog_mod
 from misaka_tpu.utils.httpfast import fast_parse_request as _fast_parse_request
 from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
 
@@ -188,6 +190,7 @@ _METRIC_ROUTES = frozenset({
     "/profile/stop", "/status", "/trace", "/metrics", "/healthz",
     "/debug/requests", "/debug/perfetto", "/debug/isa_trace",
     "/debug/usage", "/debug/alerts", "/debug/flamegraph",
+    "/debug/series", "/debug/dashboard", "/debug/faults",
 })
 
 # The routes whose latency/error outcomes feed the per-program SLO windows
@@ -1876,7 +1879,7 @@ class MasterNode:
             last=last,
         )
 
-    def save_checkpoint(self, path: str) -> None:
+    def save_checkpoint(self, path: str, include_history: bool = True) -> None:
         """Whole-network state + topology to one .npz (SURVEY.md §5: the
         reference cannot checkpoint at all; here state is one pytree) —
         DURABLY:
@@ -1920,6 +1923,21 @@ class MasterNode:
             ).encode(),
             dtype=np.uint8,
         )
+        # Retained metric history rides the durable-checkpoint path
+        # (utils/tsdb.py): a fleet-roll replacement restores its
+        # predecessor's /debug/series history instead of booting blind.
+        # `include_history=False` (the registry's per-program eviction
+        # checkpoints) skips the blob: history is process-global, so N
+        # evicted programs would each carry a redundant copy that the
+        # strictly-newer restore merge discards anyway — and the
+        # whole-store snapshot walk is not worth paying on the
+        # capacity-pressure path.
+        if include_history:
+            _tsdb_blob = tsdb_mod.snapshot_bytes()
+            if _tsdb_blob:
+                arrays["__tsdb__"] = np.frombuffer(
+                    _tsdb_blob, dtype=np.uint8
+                )
         tmp = f"{path}.tmp.{os.getpid()}"
         mtmp = f"{manifest_path(path)}.tmp.{os.getpid()}"
         try:
@@ -1986,6 +2004,16 @@ class MasterNode:
         verify_checkpoint(path)
         with np.load(path) as data:
             meta = json.loads(bytes(data["__topology__"]).decode())
+            if "__tsdb__" in data:
+                # history restore is best-effort by design: a corrupt or
+                # stale history blob must never fail an engine-state
+                # restore (the strictly-newer merge also makes a replay
+                # of the same blob a no-op)
+                try:
+                    tsdb_mod.restore_bytes(bytes(data["__tsdb__"]))
+                except Exception:
+                    log.warning("checkpoint %s: tsdb history blob "
+                                "ignored (unreadable)", path)
             fields = {
                 f: jnp.asarray(data[f])
                 for f in NetworkState._fields if f in data
@@ -2705,6 +2733,14 @@ def make_http_server(
 
     _sampler.ensure_started()
 
+    # The embedded TSDB (utils/tsdb.py): every serving process retains
+    # its own metric history from boot — GET /debug/series and the
+    # /debug/dashboard sparklines read it, checkpoints snapshot it, and
+    # the regression watchdog (utils/watchdog.py) evaluates its rules on
+    # the collector's tick.  MISAKA_TSDB=0 / MISAKA_WATCHDOG=0 disarm.
+    tsdb_mod.ensure_started()
+    watchdog_mod.ensure_started()
+
     # Fleet-debugging stamp (utils/buildinfo.py): the misaka_build_info
     # gauge (version / git sha / runtime versions / native provenance in
     # labels, value 1) plus the /status `build` block below.
@@ -3074,6 +3110,30 @@ def make_http_server(
                     if slo_state is not None:
                         payload["slo"] = slo_state
                         degraded = bool(degraded) or slo_state == "page"
+                    # The regression watchdog (utils/watchdog.py): a
+                    # paging rule (canary failing, p99 drift) raises the
+                    # SAME degraded flag — one bit for every machinery
+                    # that can declare the box unwell.
+                    wd_state = watchdog_mod.overall_state()
+                    if wd_state is not None:
+                        payload["watchdog"] = wd_state
+                        degraded = bool(degraded) or wd_state == "page"
+                    # The synthetic canary (runtime/canary.py), when this
+                    # process runs one: last cycle's per-tier outcomes +
+                    # first-failing-tier attribution.
+                    from misaka_tpu.runtime import canary as canary_mod
+
+                    cst = canary_mod.state_payload()
+                    if cst is not None:
+                        payload["canary"] = {
+                            "failing_tier": cst["failing_tier"],
+                            "consecutive_full_failures":
+                                cst["consecutive_full_failures"],
+                            "tiers": {
+                                t: v.get("ok")
+                                for t, v in cst["tiers"].items()
+                            },
+                        }
                     if degraded is not None:
                         payload["degraded"] = degraded
                     if edge_chain.armed:
@@ -3130,8 +3190,76 @@ def make_http_server(
                 if parsed.path == "/debug/alerts":
                     # the SLO burn-rate engine (utils/slo.py): per-program
                     # ok/warning/page states with per-window burn rates
-                    # and latency quantiles
-                    self._json(slo.debug_payload())
+                    # and latency quantiles — plus the regression
+                    # watchdog's findings (utils/watchdog.py; same
+                    # surface, not a parallel one), and exemplar trace
+                    # IDs from the flight recorder on anything firing:
+                    # alert -> /debug/requests/<id> in one click/curl
+                    payload = slo.debug_payload()
+                    for prog, row in payload.get("programs", {}).items():
+                        if row.get("state") != "ok":
+                            row["exemplars"] = (
+                                tracespan.slowest_exemplars(program=prog)
+                                or tracespan.slowest_exemplars()
+                            )
+                    wd = watchdog_mod.debug_payload()
+                    for rule in wd.get("rules", ()):
+                        if rule.get("state") != "ok":
+                            prog = (rule.get("labels") or {}).get("program")
+                            rule["exemplars"] = (
+                                tracespan.slowest_exemplars(program=prog)
+                                if prog else tracespan.slowest_exemplars()
+                            )
+                    payload["watchdog"] = wd
+                    self._json(payload)
+                    return
+                if parsed.path == "/debug/series":
+                    # the embedded TSDB (utils/tsdb.py): retained metric
+                    # history — ?name=<series>[&label=k=v...][&window=5m]
+                    # queries one family; bare GET lists the catalog
+                    try:
+                        name, labels, window_s = tsdb_mod.parse_query(
+                            parse_qs(parsed.query)
+                        )
+                    except tsdb_mod.TSDBError as e:
+                        self._text(400, str(e))
+                        return
+                    if name is None:
+                        self._json(tsdb_mod.index_payload())
+                        return
+                    self._json(
+                        tsdb_mod.query_payload(name, labels, window_s)
+                    )
+                    return
+                if parsed.path == "/debug/dashboard":
+                    # the observatory (utils/dashboard.py): golden-signal
+                    # sparklines over the TSDB, one self-contained page
+                    from misaka_tpu.runtime import canary as canary_mod
+                    from misaka_tpu.utils import dashboard as dash_mod
+
+                    q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                    try:
+                        window_s = tsdb_mod.parse_window(
+                            q.get("window", "1h")
+                        )
+                    except tsdb_mod.TSDBError as e:
+                        self._text(400, str(e))
+                        return
+                    extra = {"watchdog": watchdog_mod.debug_payload()}
+                    cst = canary_mod.state_payload()
+                    if cst is not None:
+                        extra["canary"] = cst
+                    html = dash_mod.render_html(
+                        lambda n, w: tsdb_mod.query(n, window_s=w),
+                        window_s, extra,
+                    )
+                    self._send(html.encode(), "text/html; charset=utf-8")
+                    return
+                if parsed.path == "/debug/faults":
+                    # the chaos harness's live view (utils/faults.py):
+                    # what is armed right now (POST re-arms; see
+                    # _handle_post — the observatory drill's entry point)
+                    self._json({"armed": sorted(faults.active())})
                     return
                 if parsed.path == "/debug/flamegraph":
                     # the continuous profiler (utils/sampler.py): folded
@@ -3588,6 +3716,22 @@ def make_http_server(
                         self._text(409, str(e))
                         return
                     self._text(200, out)
+                elif path == "/debug/faults":
+                    # (re-)arm the chaos harness on a RUNNING server —
+                    # the observatory drill's entry point: a fleet fans
+                    # this out to every replica, so a scoped
+                    # serve_delay:<program> fault can be injected (and
+                    # cleared, spec="") across subprocess boundaries
+                    # where an in-process faults.configure cannot reach.
+                    # ADMIN-scoped at the edge (runtime/edge.py): fault
+                    # injection is an operator mutation.
+                    form = self._form()
+                    try:
+                        faults.configure(form.get("spec") or None)
+                    except faults.FaultSpecError as e:
+                        self._text(400, str(e))
+                        return
+                    self._json({"armed": sorted(faults.active())})
                 else:
                     # unknown POST: the body (arbitrary size) is unread —
                     # close instead of desynchronizing the connection

@@ -819,7 +819,13 @@ class ProgramRegistry:
         if checkpoint:
             try:
                 os.makedirs(self._name_dir(name), exist_ok=True)
-                master.save_checkpoint(self._state_path(name, version))
+                # include_history=False: the TSDB history is
+                # process-global — every evicted program carrying its
+                # own copy would multiply disk by the active set for a
+                # blob the strictly-newer restore merge discards anyway
+                master.save_checkpoint(
+                    self._state_path(name, version), include_history=False
+                )
             except Exception:
                 log.exception(
                     "eviction checkpoint for %s@%s failed; state lost",

@@ -53,6 +53,15 @@ from misaka_tpu.utils import metrics
 
 DEFAULT_LABEL = "default"
 
+# Accounts that always resolve verbatim, cardinality cap or not: the
+# synthetic canary (runtime/canary.py) books its probe traffic here so
+# no REAL tenant is ever billed for it — collapsing it into "other"
+# under label pressure would silently re-mix probe cost into a bucket
+# billing exports treat as tenant traffic.  Conservation still holds:
+# _canary's seconds are in both the per-program sum and the pass-wall
+# anchor, and exports exclude the account wholesale by name.
+EXEMPT_LABELS = ("_canary",)
+
 # One counter family per accumulator, program-labeled.  Children are
 # resolved once per program (cached on the _Account) — the serve hot path
 # must not pay a label-lookup dict walk per pass.
@@ -159,7 +168,9 @@ def account(program: str | None) -> _Account:
     if acct is not None:
         return acct
     with _lock:
-        label = metrics.capped_label(_accounts, label, _label_budget())
+        label = metrics.capped_label(
+            _accounts, label, _label_budget(), exempt=EXEMPT_LABELS
+        )
         acct = _accounts.get(label)
         if acct is None:
             acct = _accounts[label] = _Account(label)

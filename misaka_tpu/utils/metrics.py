@@ -406,6 +406,13 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def all_metrics(self) -> list:
+        """Every registered metric (the embedded TSDB's collection walk —
+        utils/tsdb.py reads values through each metric's own child locks,
+        so only the dict copy needs this registry lock)."""
+        with self._lock:
+            return list(self._metrics.values())
+
     def render(self) -> str:
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)

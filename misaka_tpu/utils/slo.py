@@ -80,6 +80,15 @@ UPPERS = metrics.DURATION_BUCKETS
 
 STATES = ("ok", "warning", "page")
 
+# Programs whose traffic NEVER feeds the SLO windows: the synthetic
+# canary (runtime/canary.py) deliberately probes through the full public
+# stack — including fault drills that make it slow on purpose — and must
+# not burn any tenant's error budget while doing it.  Canary failures
+# page through the watchdog (utils/watchdog.py) instead.  This is the
+# one chokepoint: every entry path (HTTP edge, compute plane) lands in
+# observe(), so the exclusion cannot be bypassed by route.
+EXCLUDED_PROGRAMS = frozenset({"_canary"})
+
 M_SLO_STATE = metrics.gauge(
     "misaka_slo_state",
     "Per-program SLO state (0 = ok, 1 = warning, 2 = page)",
@@ -371,8 +380,8 @@ def _windows_for(program: str) -> _ProgramWindows:
 
 def observe(program: str | None, dur_s: float, error: bool = False) -> None:
     """One edge-observed request outcome into `program`'s windows
-    (no-op while disarmed)."""
-    if not armed():
+    (no-op while disarmed, and for canary-tagged programs)."""
+    if not armed() or program in EXCLUDED_PROGRAMS:
         return
     _windows_for(program or "default").observe(
         time.monotonic(), dur_s, bool(error)

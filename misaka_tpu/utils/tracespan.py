@@ -458,6 +458,30 @@ def debug_payload() -> dict:
     }
 
 
+def slowest_exemplars(k: int = 3, program: str | None = None) -> list[dict]:
+    """Alert exemplars: the slowest completed traces (optionally only
+    those whose serve spans billed to `program`), each linking straight
+    to its full trace at /debug/requests/<id>.  The /debug/alerts route
+    attaches these to SLO pages and watchdog findings, so "p99 is
+    burning" comes with the actual requests that burned it."""
+    out: list[dict] = []
+    for t in RECORDER.slowest():
+        if program is not None and not any(
+            s.attrs and s.attrs.get("program") == program
+            for s in t.spans
+        ):
+            continue
+        out.append({
+            "trace_id": t.trace_id,
+            "route": t.route,
+            "duration_ms": t.duration_ms,
+            "href": f"/debug/requests/{t.trace_id}",
+        })
+        if len(out) >= k:
+            break
+    return out
+
+
 def perfetto() -> dict:
     """The whole recorder as Chrome trace-event JSON (the "JSON Array
     Format" both Perfetto and chrome://tracing load).

@@ -1,0 +1,638 @@
+"""Embedded time-series history: ring-buffer retention over the live
+metrics registry, served at GET /debug/series.
+
+Every surface the health plane grew through PR 7-9 — /metrics,
+/debug/alerts, /debug/usage, /debug/flamegraph — is a point-in-time
+snapshot: "is p99 drifting since the last roll?" and "which replica
+degraded first?" needed an external Prometheus nobody wires up on a
+single box.  This module is the retained-history substrate those
+questions (and the ROADMAP's autoscaling loop) read: a background
+collector samples the process metrics registry (utils/metrics.py) every
+``MISAKA_TSDB_INTERVAL_S`` seconds into fixed-size ring buffers with
+staged downsampling, and a query API slices any series over any window
+up to the retention horizon.
+
+Sampling semantics per metric kind:
+
+  * Counter   — stored as a RATE (delta / elapsed since the previous
+                sample; a process restart resets counters, so a negative
+                delta re-bases instead of spiking).  The series keeps the
+                counter's name.
+  * Gauge     — stored verbatim.
+  * Histogram — three derived series per child: ``<name>:p50`` and
+                ``<name>:p99`` estimated from the PER-INTERVAL bucket
+                delta (utils/metrics.quantile_from_buckets — the interval
+                with no observations writes nothing, not a false zero),
+                and ``<name>:rate`` (observations/s).
+
+Staged downsampling: every sample lands in all retention stages at once —
+by default ``interval x 720`` (1 h at the 5 s default), ``1 m x 360``
+(6 h), and ``5 m x 288`` (24 h).  A stage slot aggregates mean AND max
+(a p99 spike must survive downsampling), and slots are positional rings
+keyed by absolute epoch (``int(unix / width)``) — the same
+stale-slot-reclaim discipline as the SLO windows, so idle time cannot
+leak month-old points into a fresh window.  Wall-clock epochs are
+deliberate: they are timestamps (the dashboard's x-axis, and what lets a
+restored snapshot land in the right slots after a process restart);
+durations and deadlines elsewhere in this module use time.monotonic().
+
+Memory is bounded twice over: per series, the three stages hold
+720+360+288 = 1368 slots x 28 bytes (epoch int64 + sum double + count
+uint32 + max double in array-module storage) ~= 38 KiB; and at most
+``MISAKA_TSDB_MAX_SERIES`` (default 512) series are retained — worst
+case ~20 MiB.  Past the cap NEW series are dropped and counted
+(``dropped_series`` on the index payload — a silent cap would read as
+"covered everything").  Golden-signal families are collected first each
+sample, so a per-program label flood can never crowd out the dashboard's
+own series.
+
+Collector cost is governed like the PR 7 stack sampler: the loop EMAs
+its own per-sample wall cost and stretches its period to stay under
+``MISAKA_TSDB_BUDGET`` (default 1%) of one core.
+
+History survives restarts through the durable-checkpoint path:
+``snapshot_bytes()`` rides ``__tsdb__`` inside MasterNode checkpoints
+and ``restore_bytes()`` merges it back — a restored slot installs only
+where it is strictly NEWER than what the live ring holds, which makes a
+stale eviction-era checkpoint a no-op and a fleet-roll restore a full
+history handoff with the same rule.
+
+Stdlib-only, like the rest of the plane.  ``MISAKA_TSDB=0`` is the kill
+switch; ``shutdown()`` stops the collector (the bench A/B measures both
+sides).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from array import array
+
+from misaka_tpu.utils import metrics
+
+DEFAULT_INTERVAL_S = 5.0
+DEFAULT_MAX_SERIES = 512
+DEFAULT_BUDGET = 0.01
+
+# Families sampled FIRST each pass (the dashboard's golden signals and
+# the watchdog's default rules): a label flood elsewhere may exhaust the
+# series cap, but never these.
+PRIORITY_PREFIXES = (
+    "misaka_canary_",
+    "misaka_http_",
+    "misaka_compute_",
+    "misaka_serve_",
+    "misaka_edge_",
+    "misaka_fleet_",
+    "misaka_native_pool_",
+    "misaka_usage_values_total",
+    "misaka_slo_p99_seconds",
+    "misaka_frontend_",
+)
+
+
+class TSDBError(ValueError):
+    """Invalid query or snapshot content."""
+
+
+def parse_window(text: str | float | int, allow_zero: bool = False) -> float:
+    """``"30s"`` / ``"5m"`` / ``"1h"`` / bare seconds -> seconds.
+    `allow_zero` admits 0 (the watchdog's no-sustain clause); a query
+    window stays strictly positive."""
+    if isinstance(text, (int, float)):
+        v = float(text)
+    else:
+        t = str(text).strip().lower()
+        mult = 1.0
+        if t.endswith("h"):
+            mult, t = 3600.0, t[:-1]
+        elif t.endswith("m"):
+            mult, t = 60.0, t[:-1]
+        elif t.endswith("s"):
+            t = t[:-1]
+        try:
+            v = float(t) * mult
+        except ValueError:
+            raise TSDBError(f"cannot parse window {text!r} "
+                            f"(use e.g. 30s / 5m / 1h)") from None
+    if v < 0 or (v == 0 and not allow_zero):
+        raise TSDBError(f"window must be > 0, got {text!r}")
+    return v
+
+
+def parse_query(query: dict) -> tuple[str | None, dict[str, str], float]:
+    """The GET /debug/series query contract, shared by the engine and
+    fleet handlers (one copy of the grammar): `query` is a parse_qs
+    dict; returns (name-or-None, label filters, window seconds).
+    Raises TSDBError (the handlers answer it as 400) on a malformed
+    window or a label entry that is not k=v."""
+    window_s = parse_window(query.get("window", ["1h"])[0])
+    name = query.get("name", [None])[0]
+    labels: dict[str, str] = {}
+    for item in query.get("label", ()):
+        k, sep, v = item.partition("=")
+        if not sep:
+            raise TSDBError(f"label filter {item!r} is not k=v")
+        labels[k] = v
+    return name, labels, window_s
+
+
+def env_float(environ, name: str, default: float) -> float:
+    """An env knob parsed with a silent fallback (a typo'd MISAKA_*
+    value must not take down a booting server) — the one shared copy
+    for this module's and the watchdog's ensure_started."""
+    try:
+        return float(environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _stage_plan(interval_s: float) -> tuple[tuple[float, int], ...]:
+    """(width_s, length) per retention stage for one sample interval.
+    Coarser stages keep their absolute spans when the interval shrinks
+    (tests run 50 ms intervals; the 1 m/5 m tiers stay meaningful), and
+    widen to the interval when it grows past them."""
+    stages = [(interval_s, 720)]
+    for width, length in ((60.0, 360), (300.0, 288)):
+        if width > interval_s:
+            stages.append((width, length))
+    return tuple(stages)
+
+
+class _Ring:
+    """One retention stage of one series: positional slots keyed by
+    absolute epoch, each aggregating (sum, count, max) of the samples
+    that landed in its span."""
+
+    __slots__ = ("width", "length", "epochs", "sums", "counts", "maxs")
+
+    def __init__(self, width: float, length: int):
+        self.width = float(width)
+        self.length = int(length)
+        self.epochs = array("q", [-1]) * self.length
+        self.sums = array("d", [0.0]) * self.length
+        self.counts = array("L", [0]) * self.length
+        self.maxs = array("d", [0.0]) * self.length
+
+    def add(self, now_unix: float, value: float) -> None:
+        epoch = int(now_unix / self.width)
+        i = epoch % self.length
+        if self.epochs[i] != epoch:
+            self.epochs[i] = epoch
+            self.sums[i] = 0.0
+            self.counts[i] = 0
+            self.maxs[i] = value
+        self.sums[i] += value
+        self.counts[i] += 1
+        if value > self.maxs[i]:
+            self.maxs[i] = value
+
+    def points(self, now_unix: float, window_s: float) -> list[list[float]]:
+        """[[slot_start_unix, mean, max], ...] oldest -> newest over the
+        last `window_s` (unwritten / stale slots skipped)."""
+        n = min(self.length, max(1, math.ceil(window_s / self.width)))
+        epoch_now = int(now_unix / self.width)
+        out: list[list[float]] = []
+        for back in range(n - 1, -1, -1):
+            epoch = epoch_now - back
+            i = epoch % self.length
+            if self.epochs[i] != epoch or not self.counts[i]:
+                continue
+            out.append([
+                round(epoch * self.width, 3),
+                self.sums[i] / self.counts[i],
+                self.maxs[i],
+            ])
+        return out
+
+    def install(self, epoch: int, total: float, count: int,
+                peak: float) -> None:
+        """Snapshot restore: install a slot only where it is strictly
+        newer than the live ring's occupant — a stale (eviction-era)
+        snapshot must never clobber fresher history, and re-restoring
+        the same snapshot must never double-count."""
+        i = epoch % self.length
+        if epoch > self.epochs[i]:
+            self.epochs[i] = epoch
+            self.sums[i] = total
+            self.counts[i] = count
+            self.maxs[i] = peak
+
+    def dump(self) -> list[list[float]]:
+        out = []
+        for i in range(self.length):
+            if self.epochs[i] >= 0 and self.counts[i]:
+                out.append([
+                    int(self.epochs[i]), self.sums[i],
+                    int(self.counts[i]), self.maxs[i],
+                ])
+        return out
+
+
+class _Series:
+    """All retention stages of one series."""
+
+    __slots__ = ("name", "labels", "kind", "stages")
+
+    def __init__(self, name: str, labels: dict[str, str], kind: str,
+                 plan: tuple[tuple[float, int], ...]):
+        self.name = name
+        self.labels = labels
+        self.kind = kind  # "rate" | "gauge" | "quantile"
+        self.stages = tuple(_Ring(w, n) for w, n in plan)
+
+    def add(self, now_unix: float, value: float) -> None:
+        for ring in self.stages:
+            ring.add(now_unix, value)
+
+    def stage_for(self, window_s: float) -> _Ring:
+        """The finest stage whose retention covers the window (the
+        coarsest one when nothing does)."""
+        for ring in self.stages:
+            if ring.width * ring.length >= window_s:
+                return ring
+        return self.stages[-1]
+
+
+class TSDB:
+    """The store + the governed collector thread."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
+                 max_series: int = DEFAULT_MAX_SERIES,
+                 budget: float = DEFAULT_BUDGET, registry=None):
+        self.interval_s = max(0.02, float(interval_s))
+        self.max_series = max(16, int(max_series))
+        self.budget = min(0.5, max(0.001, float(budget)))
+        self._registry = registry if registry is not None else metrics.REGISTRY
+        self._plan = _stage_plan(self.interval_s)
+        self._lock = threading.Lock()
+        self._series: dict[tuple, _Series] = {}  # (name, sorted-label-items)
+        self._dropped: set[tuple] = set()
+        self._samples = 0
+        self._cost_ema = 0.0
+        # previous raw values for rate/quantile derivation, keyed like
+        # _series: counters -> float, histograms -> (counts, last_mono)
+        self._prev_counter: dict[tuple, float] = {}
+        self._prev_hist: dict[tuple, list[int]] = {}
+        self._last_mono: float | None = None
+        # per-tick hooks (the regression watchdog registers here: rules
+        # evaluate right after each sample lands, on this thread — no
+        # second clock, no second thread)
+        self._hooks: list = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle ----------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="misaka-tsdb"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+        self._thread = None
+
+    def add_hook(self, fn) -> None:
+        """Register fn(tsdb) to run after every collected sample."""
+        with self._lock:
+            if fn not in self._hooks:
+                self._hooks.append(fn)
+
+    def remove_hook(self, fn) -> None:
+        with self._lock:
+            if fn in self._hooks:
+                self._hooks.remove(fn)
+
+    # --- the collector ------------------------------------------------------
+
+    def _current_period(self) -> float:
+        """Nominal interval, stretched whenever one sample's measured
+        cost would blow the duty-cycle budget (the PR 7 sampler's
+        governor discipline)."""
+        return max(self.interval_s, self._cost_ema / self.budget)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._current_period()):
+            t0 = time.perf_counter()
+            try:
+                self.sample_once()
+            except Exception:  # pragma: no cover — the collector must
+                pass           # never take serving down with it
+            dt = time.perf_counter() - t0
+            self._cost_ema = (
+                dt if self._cost_ema == 0.0
+                else 0.8 * self._cost_ema + 0.2 * dt
+            )
+            hooks = list(self._hooks)
+            for fn in hooks:
+                try:
+                    fn(self)
+                except Exception:  # pragma: no cover — a broken rule
+                    pass           # must not stop history collection
+
+    def _series_for(self, name: str, labels: dict[str, str],
+                    kind: str) -> _Series | None:
+        key = (name, tuple(sorted(labels.items())))
+        s = self._series.get(key)
+        if s is not None:
+            return s
+        if len(self._series) >= self.max_series:
+            self._dropped.add(key)
+            return None
+        s = self._series[key] = _Series(name, labels, kind, self._plan)
+        return s
+
+    def _record(self, now_unix: float, name: str, labels: dict,
+                kind: str, value: float) -> None:
+        s = self._series_for(name, labels, kind)
+        if s is not None:
+            s.add(now_unix, value)
+
+    def sample_once(self) -> None:
+        """One collection pass over the metrics registry (the collector
+        thread's body; tests call it directly for deterministic time)."""
+        now_unix = time.time()
+        now_mono = time.monotonic()
+        last = self._last_mono
+        self._last_mono = now_mono
+        dt = (now_mono - last) if last is not None else None
+        if dt is not None and dt <= 0:
+            dt = None
+        all_metrics = self._registry.all_metrics()
+        # priority families first: the series cap must never starve the
+        # golden signals (see PRIORITY_PREFIXES)
+        all_metrics.sort(
+            key=lambda m: (
+                not m.name.startswith(PRIORITY_PREFIXES), m.name
+            )
+        )
+        with self._lock:
+            self._samples += 1
+            for m in all_metrics:
+                if isinstance(m, metrics.Histogram):
+                    self._sample_histogram(m, now_unix, dt)
+                elif isinstance(m, metrics.Counter):
+                    self._sample_counter(m, now_unix, dt)
+                elif isinstance(m, metrics.Gauge):
+                    for lkey, child in m._items():
+                        labels = dict(zip(m.labelnames, lkey))
+                        self._record(
+                            now_unix, m.name, labels, "gauge", child.value
+                        )
+
+    def _sample_counter(self, m, now_unix: float, dt: float | None) -> None:
+        for lkey, child in m._items():
+            key = (m.name, lkey)
+            cur = child.value
+            prev = self._prev_counter.get(key)
+            self._prev_counter[key] = cur
+            if prev is None or dt is None:
+                continue  # first sight: establish the baseline only
+            delta = cur - prev
+            if delta < 0:
+                delta = cur  # process/metric reset: re-base, don't spike
+            labels = dict(zip(m.labelnames, lkey))
+            self._record(now_unix, m.name, labels, "rate", delta / dt)
+
+    def _sample_histogram(self, m, now_unix: float,
+                          dt: float | None) -> None:
+        uppers = m.buckets
+        for lkey, child in m._items():
+            counts, _total = child.snapshot()
+            key = (m.name, lkey)
+            prev = self._prev_hist.get(key)
+            self._prev_hist[key] = counts
+            if prev is None or dt is None or len(prev) != len(counts):
+                continue
+            delta = [c - p for c, p in zip(counts, prev)]
+            n = sum(delta)
+            if n < 0:  # reset: re-base on the fresh counts
+                delta, n = counts, sum(counts)
+            labels = dict(zip(m.labelnames, lkey))
+            self._record(
+                now_unix, f"{m.name}:rate", labels, "rate", n / dt
+            )
+            if n <= 0:
+                continue  # an idle interval writes no false-zero quantile
+            for q, suffix in ((0.5, ":p50"), (0.99, ":p99")):
+                self._record(
+                    now_unix, f"{m.name}{suffix}", labels, "quantile",
+                    metrics.quantile_from_buckets(uppers, delta, q),
+                )
+
+    # --- the read side ------------------------------------------------------
+
+    def series_index(self) -> dict:
+        with self._lock:
+            names: dict[str, int] = {}
+            for s in self._series.values():
+                names[s.name] = names.get(s.name, 0) + 1
+            dropped = len(self._dropped)
+            count = len(self._series)
+        return {
+            "enabled": True,
+            "running": self.running,
+            "interval_s": self.interval_s,
+            "effective_interval_s": round(self._current_period(), 3),
+            "budget": self.budget,
+            "sample_cost_us": round(self._cost_ema * 1e6, 1),
+            "samples": self._samples,
+            "stages": [
+                {"width_s": w, "slots": n, "span_s": round(w * n, 1)}
+                for w, n in self._plan
+            ],
+            "series_count": count,
+            "max_series": self.max_series,
+            "dropped_series": dropped,
+            "bytes_per_series": sum(28 * n for _, n in self._plan),
+            "names": {k: names[k] for k in sorted(names)},
+        }
+
+    def query(self, name: str, labels: dict[str, str] | None = None,
+              window_s: float = 3600.0) -> list[dict]:
+        """Every series matching `name` (+ label subset filter) over the
+        last `window_s`: [{labels, stage_s, points: [[t, avg, max]...]}]."""
+        now_unix = time.time()
+        want = labels or {}
+        with self._lock:
+            matches = [
+                s for (n, _), s in self._series.items()
+                if n == name and all(
+                    s.labels.get(k) == v for k, v in want.items()
+                )
+            ]
+            out = []
+            for s in matches:
+                ring = s.stage_for(window_s)
+                out.append({
+                    "labels": s.labels,
+                    "kind": s.kind,
+                    "stage_s": ring.width,
+                    "points": ring.points(now_unix, window_s),
+                })
+        out.sort(key=lambda r: sorted(r["labels"].items()))
+        return out
+
+    # --- snapshot / restore (the durable-checkpoint ride) -------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "format": 1,
+                "interval_s": self.interval_s,
+                "saved_unix": round(time.time(), 3),
+                "series": [
+                    {
+                        "name": s.name,
+                        "labels": s.labels,
+                        "kind": s.kind,
+                        "stages": [
+                            {"width_s": ring.width, "slots": ring.dump()}
+                            for ring in s.stages
+                        ],
+                    }
+                    for s in self._series.values()
+                ],
+            }
+
+    def restore(self, snap: dict) -> int:
+        """Merge a snapshot() payload into the live rings (strictly-newer
+        slots only; see _Ring.install).  Returns the series count
+        touched.  Raises TSDBError on malformed content."""
+        if not isinstance(snap, dict) or snap.get("format") != 1:
+            raise TSDBError("unrecognized tsdb snapshot format")
+        touched = 0
+        with self._lock:
+            for row in snap.get("series", ()):
+                name = row.get("name")
+                labels = row.get("labels") or {}
+                if not isinstance(name, str) or not isinstance(labels, dict):
+                    raise TSDBError("malformed tsdb snapshot series row")
+                s = self._series_for(
+                    name, {str(k): str(v) for k, v in labels.items()},
+                    str(row.get("kind") or "gauge"),
+                )
+                if s is None:
+                    continue  # over the cap: counted in dropped_series
+                touched += 1
+                by_width = {ring.width: ring for ring in s.stages}
+                for st in row.get("stages", ()):
+                    ring = by_width.get(float(st.get("width_s", -1)))
+                    if ring is None:
+                        continue  # interval changed across the restore
+                    for slot in st.get("slots", ()):
+                        epoch, total, count, peak = slot
+                        ring.install(
+                            int(epoch), float(total), int(count), float(peak)
+                        )
+        return touched
+
+
+# --- the process-global instance --------------------------------------------
+
+_lock = threading.Lock()
+_tsdb: TSDB | None = None
+
+
+def enabled(environ=os.environ) -> bool:
+    return environ.get("MISAKA_TSDB", "1") != "0"
+
+
+def get() -> TSDB | None:
+    return _tsdb
+
+
+def ensure_started(environ=os.environ) -> TSDB | None:
+    """Start (or return) the process-global collector — called by
+    make_http_server so every serving process retains its own history
+    from boot; None when MISAKA_TSDB=0."""
+    global _tsdb
+    if not enabled(environ):
+        return None
+    with _lock:
+        if _tsdb is None:
+            _tsdb = TSDB(
+                interval_s=env_float(
+                    environ, "MISAKA_TSDB_INTERVAL_S", DEFAULT_INTERVAL_S
+                ),
+                max_series=int(env_float(
+                    environ, "MISAKA_TSDB_MAX_SERIES", DEFAULT_MAX_SERIES
+                )),
+                budget=env_float(
+                    environ, "MISAKA_TSDB_BUDGET", DEFAULT_BUDGET
+                ),
+            )
+        if not _tsdb.running:
+            _tsdb.start()
+    return _tsdb
+
+
+def shutdown() -> None:
+    """Stop and drop the global collector (tests; the A/B's off side)."""
+    global _tsdb
+    with _lock:
+        if _tsdb is not None:
+            _tsdb.stop()
+            _tsdb = None
+
+
+def query(name: str, labels: dict[str, str] | None = None,
+          window_s: float = 3600.0) -> list[dict]:
+    t = _tsdb
+    return t.query(name, labels, window_s) if t is not None else []
+
+
+def index_payload() -> dict:
+    t = _tsdb
+    if t is None:
+        return {
+            "enabled": enabled(),
+            "running": False,
+            "series_count": 0,
+            "names": {},
+            "hint": "tsdb not started (MISAKA_TSDB=0, or no HTTP server "
+                    "in this process)",
+        }
+    return t.series_index()
+
+
+def query_payload(name: str, labels: dict[str, str] | None = None,
+                  window_s: float = 3600.0) -> dict:
+    """The GET /debug/series?name=... body."""
+    return {
+        "name": name,
+        "window_s": window_s,
+        "series": query(name, labels, window_s),
+    }
+
+
+def snapshot_bytes() -> bytes | None:
+    """The __tsdb__ checkpoint payload (None when no collector runs)."""
+    t = _tsdb
+    if t is None:
+        return None
+    return json.dumps(t.snapshot(), separators=(",", ":")).encode()
+
+
+def restore_bytes(blob: bytes) -> int:
+    """Merge a snapshot_bytes() payload into the live store (starting it
+    if needed); returns series touched, 0 when the TSDB is disabled."""
+    t = ensure_started()
+    if t is None:
+        return 0
+    return t.restore(json.loads(blob.decode()))

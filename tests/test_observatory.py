@@ -1,0 +1,712 @@
+"""The observatory end to end (ISSUE 11): /debug/series shapes over a
+live server, the self-contained dashboard, POST /debug/faults, watchdog
+fire/clear through the alert surface, alert exemplars, the canary's
+tier attribution + billing/SLO exclusion contract, history surviving
+the checkpoint path — and the slow fleet-mode live drill (scoped fault
+-> canary attribution -> watchdog page with exemplars -> degraded ->
+recovery -> history across a /fleet/roll).
+"""
+
+import http.client
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.runtime import canary as canary_mod
+from misaka_tpu.runtime import usage
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.runtime.registry import ProgramRegistry
+from misaka_tpu.utils import faults
+from misaka_tpu.utils import slo
+from misaka_tpu.utils import tsdb
+from misaka_tpu.utils import watchdog
+
+CAPS = dict(in_cap=32, out_cap=32, stack_cap=16)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.configure(None)
+    slo.configure()
+    canary_mod.shutdown()
+    watchdog.shutdown()
+    tsdb.shutdown()
+    usage.reset()
+
+
+def _fast_tsdb(monkeypatch, watchdog_spec=None, recent="0.5"):
+    """Test-scale observatory knobs, set BEFORE make_http_server builds
+    the process-global collector."""
+    tsdb.shutdown()
+    watchdog.shutdown()
+    monkeypatch.setenv("MISAKA_TSDB_INTERVAL_S", "0.1")
+    # the duty-cycle governor would stretch a 100 ms interval on a busy
+    # test box; give it headroom — production keeps the 1% default
+    monkeypatch.setenv("MISAKA_TSDB_BUDGET", "0.5")
+    # the process-global metrics registry accumulates hundreds of series
+    # over a full suite run (per-program labels from every earlier test
+    # file); the default 512 cap would drop THESE tests' series late in
+    # the run — production keeps the documented default
+    monkeypatch.setenv("MISAKA_TSDB_MAX_SERIES", "8192")
+    monkeypatch.setenv("MISAKA_WATCHDOG_RECENT_S", recent)
+    if watchdog_spec is not None:
+        monkeypatch.setenv("MISAKA_WATCHDOG", watchdog_spec)
+
+
+class _Server:
+    def __init__(self, registry=True, batch=8):
+        top = networks.add2(**CAPS)
+        self.master = MasterNode(top, chunk_steps=64, batch=batch)
+        self.registry = None
+        if registry:
+            self.registry = ProgramRegistry(
+                None, batch=batch, engine="auto", caps=CAPS
+            )
+            self.registry.seed("default", self.master, top)
+        self.httpd = make_http_server(
+            self.master, port=0, registry=self.registry
+        )
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+        self.port = self.httpd.server_address[1]
+        self.master.run()
+
+    def get(self, path):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", self.port, timeout=30
+        )
+        conn.request("GET", path)
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        return r.status, body
+
+    def post(self, path, body=b"",
+             ctype="application/x-www-form-urlencoded"):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", self.port, timeout=30
+        )
+        conn.request("POST", path, body, {"Content-Type": ctype})
+        r = conn.getresponse()
+        out = r.read()
+        conn.close()
+        return r.status, out
+
+    def traffic(self, n=20, pause=0.0):
+        vals = np.arange(8, dtype=np.int32)
+        for _ in range(n):
+            st, out = self.post(
+                "/compute_raw?spread=1", vals.tobytes(),
+                "application/octet-stream",
+            )
+            assert st == 200, out
+            assert (np.frombuffer(out, "<i4") == vals + 2).all()
+            if pause:
+                time.sleep(pause)
+
+    def wait_samples(self, n, deadline_s=30):
+        db = tsdb.get()
+        assert db is not None
+        start = db._samples
+        deadline = time.monotonic() + deadline_s
+        while db._samples < start + n:
+            assert time.monotonic() < deadline, "collector too slow"
+            time.sleep(0.05)
+
+    def close(self):
+        self.master.pause()
+        if self.registry is not None:
+            self.registry.close()
+        self.httpd.shutdown()
+
+
+# --- /debug/series + dashboard ----------------------------------------------
+
+
+def test_series_route_shapes(monkeypatch):
+    _fast_tsdb(monkeypatch)
+    s = _Server(registry=False)
+    try:
+        s.traffic(10)
+        s.wait_samples(3)
+        s.traffic(10)
+        s.wait_samples(2)
+
+        st, body = s.get("/debug/series")
+        assert st == 200
+        idx = json.loads(body)
+        assert idx["running"] and idx["series_count"] > 0
+        assert idx["dropped_series"] == 0
+        assert [st_["width_s"] for st_ in idx["stages"]] == \
+            [0.1, 60.0, 300.0]
+        assert idx["bytes_per_series"] == 28 * (720 + 360 + 288)
+
+        st, body = s.get(
+            "/debug/series?name=misaka_compute_values_total&window=5m"
+        )
+        q = json.loads(body)
+        assert st == 200 and q["window_s"] == 300.0
+        [row] = q["series"]
+        assert row["kind"] == "rate" and row["points"]
+        t, avg, mx = row["points"][-1]
+        assert t > 0 and avg >= 0 and mx >= avg
+
+        # histogram-derived quantile series with a label filter
+        st, body = s.get(
+            "/debug/series?name=misaka_http_request_duration_seconds:p99"
+            "&window=5m&label=route=/compute_raw"
+        )
+        q = json.loads(body)
+        assert st == 200
+        for row in q["series"]:
+            assert row["labels"]["route"] == "/compute_raw"
+            assert row["kind"] == "quantile"
+
+        st, body = s.get("/debug/series?name=x&window=bogus")
+        assert st == 400
+        st, body = s.get("/debug/series?name=x&label=notkv")
+        assert st == 400
+    finally:
+        s.close()
+
+
+def test_dashboard_html_populated(monkeypatch):
+    _fast_tsdb(monkeypatch)
+    s = _Server(registry=False)
+    try:
+        s.traffic(10)
+        s.wait_samples(3)
+        s.traffic(10)
+        s.wait_samples(2)
+        st, body = s.get("/debug/dashboard?window=5m")
+        assert st == 200
+        page = body.decode()
+        assert "misaka observatory" in page
+        m = re.search(r"const DATA = (.*);\n", page)
+        assert m, "no baked DATA object"
+        data = json.loads(m.group(1))
+        assert data["window_s"] == 300.0
+        titles = [p["title"] for p in data["panels"]]
+        assert "Throughput (values/s)" in titles
+        assert "Canary success" in titles
+        populated = [
+            p for p in data["panels"]
+            if any(row["points"] for row in p["series"])
+        ]
+        assert populated, "no panel has any points"
+        assert "watchdog" in data
+        st, body = s.get("/debug/dashboard?window=junk")
+        assert st == 400
+    finally:
+        s.close()
+
+
+# --- POST /debug/faults -----------------------------------------------------
+
+
+def test_debug_faults_route(monkeypatch):
+    _fast_tsdb(monkeypatch)
+    s = _Server(registry=False)
+    try:
+        st, body = s.get("/debug/faults")
+        assert st == 200 and json.loads(body)["armed"] == []
+        st, body = s.post(
+            "/debug/faults", b"spec=serve_delay=0.01,rpc_drop@0.5"
+        )
+        assert st == 200
+        assert json.loads(body)["armed"] == ["rpc_drop", "serve_delay"]
+        assert faults.active() == {"rpc_drop", "serve_delay"}
+        st, body = s.post("/debug/faults", b"spec=bogus_point")
+        assert st == 400 and b"unknown fault point" in body
+        assert faults.active() == {"rpc_drop", "serve_delay"}  # unchanged
+        st, body = s.post("/debug/faults", b"spec=")
+        assert st == 200 and json.loads(body)["armed"] == []
+    finally:
+        s.close()
+
+
+# --- watchdog through the server --------------------------------------------
+
+
+def test_watchdog_fires_on_injected_fault_and_clears(monkeypatch):
+    _fast_tsdb(
+        monkeypatch,
+        watchdog_spec=(
+            "p99hot=misaka_http_request_duration_seconds:p99{route=/compute_raw}"
+            ">0.05 for 0.3s ->page"
+        ),
+    )
+    s = _Server(registry=False)
+    stop = threading.Event()
+    errors = []
+
+    def pump():
+        try:
+            while not stop.is_set():
+                s.traffic(1)
+                time.sleep(0.02)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    t = threading.Thread(target=pump, daemon=True)
+    try:
+        t.start()
+        s.wait_samples(3)
+        st, body = s.get("/debug/alerts")
+        assert json.loads(body)["watchdog"]["state"] == "ok"
+
+        # inject 150 ms into every serve pass THROUGH THE ROUTE (the
+        # drill's entry point), not an in-process configure
+        st, _ = s.post("/debug/faults", b"spec=serve_delay=0.15")
+        assert st == 200
+        deadline = time.monotonic() + 30
+        wd = None
+        while time.monotonic() < deadline:
+            wd = json.loads(s.get("/debug/alerts")[1])["watchdog"]
+            if wd["state"] == "page":
+                break
+            time.sleep(0.2)
+        assert wd and wd["state"] == "page", wd
+        [rule] = [r for r in wd["rules"] if r["state"] == "page"]
+        assert rule["rule"] == "p99hot"
+        # alert exemplars: the slowest traces ride the finding, each
+        # resolvable at /debug/requests/<id>
+        assert rule["exemplars"], rule
+        ex = rule["exemplars"][0]
+        assert ex["href"] == f"/debug/requests/{ex['trace_id']}"
+        st, body = s.get(ex["href"])
+        assert st == 200 and json.loads(body)["trace_id"] == ex["trace_id"]
+        # the page raises the shared degraded flag
+        health = json.loads(s.get("/healthz")[1])
+        assert health["watchdog"] == "page" and health["degraded"] is True
+
+        # recovery: clear the fault through the same route; the rule
+        # must sustain-clear and drop the degraded flag
+        st, _ = s.post("/debug/faults", b"spec=")
+        assert st == 200
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            wd = json.loads(s.get("/debug/alerts")[1])["watchdog"]
+            if wd["state"] == "ok":
+                break
+            time.sleep(0.2)
+        assert wd["state"] == "ok", wd
+        health = json.loads(s.get("/healthz")[1])
+        assert health.get("degraded") is not True
+        assert not errors, errors[0]
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        s.close()
+
+
+def test_slo_page_carries_exemplars(monkeypatch):
+    _fast_tsdb(monkeypatch)
+    monkeypatch.setenv("MISAKA_SLO", "p99<50ms")
+    monkeypatch.setenv("MISAKA_SLO_WINDOWS", "0.5,1,2,4")
+    monkeypatch.setenv("MISAKA_SLO_MIN_EVENTS", "3")
+    slo.configure()
+    s = _Server(registry=False)
+    try:
+        faults.configure("serve_delay=0.2")
+        deadline = time.monotonic() + 30
+        state = None
+        while time.monotonic() < deadline:
+            s.traffic(3)
+            payload = json.loads(s.get("/debug/alerts")[1])
+            state = payload["programs"].get("default", {})
+            if state.get("state") == "page":
+                break
+        assert state and state["state"] == "page", state
+        assert state["exemplars"], state
+        ex = state["exemplars"][0]
+        st, body = s.get(ex["href"])
+        assert st == 200
+        assert ex["duration_ms"] >= 150  # the injected delay shows
+    finally:
+        s.close()
+
+
+# --- the canary -------------------------------------------------------------
+
+
+def test_canary_probes_attributes_and_is_excluded(monkeypatch):
+    _fast_tsdb(monkeypatch)
+    monkeypatch.setenv("MISAKA_SLO", "p99<5s,err<5%")
+    slo.configure()
+    s = _Server(registry=True)
+    try:
+        usage.reset()
+        c = canary_mod.CanaryProber(
+            f"http://127.0.0.1:{s.port}", registry=s.registry,
+            server=s.httpd, interval_s=30,
+        )
+        state = c.probe_once()
+        tiers = state["tiers"]
+        assert tiers["edge"]["ok"] is True
+        assert tiers["engine"]["ok"] is True
+        assert tiers["full"]["ok"] is True
+        assert tiers["plane"]["ok"] is None  # no plane in this process
+        assert state["failing_tier"] is None
+        assert state["consecutive_full_failures"] == 0
+        # the known-answer program exists in the registry, unpinned
+        # (eviction re-exercises the checkpoint path, by design)
+        listing = s.registry.list_programs()["programs"]
+        assert canary_mod.PROGRAM in listing
+        assert listing[canary_mod.PROGRAM]["pinned"] is False
+
+        # EXCLUSION (the billing contract): probe traffic bills ONLY the
+        # _canary account — no real tenant moved
+        snap = usage.snapshot()
+        assert snap[canary_mod.PROGRAM]["values"] > 0
+        assert snap.get("default", {}).get("values", 0) == 0
+        # EXCLUSION (the SLO contract): no canary windows were minted,
+        # so a slow canary can never burn a tenant's budget
+        assert canary_mod.PROGRAM not in slo._windows
+        alerts = json.loads(s.get("/debug/alerts")[1])
+        assert canary_mod.PROGRAM not in alerts["programs"]
+        # and slo.observe is a hard chokepoint, not a route accident
+        slo.observe(canary_mod.PROGRAM, 99.0, error=True)
+        assert canary_mod.PROGRAM not in slo._windows
+
+        # canary metrics exist for the TSDB/dashboard to pick up
+        from misaka_tpu.utils import metrics as umetrics
+
+        text = umetrics.render()
+        assert 'misaka_canary_success{tier="full"} 1' in text
+        assert "misaka_canary_latency_seconds_count" in text
+
+        # ATTRIBUTION: delay ONLY the canary program's serve passes past
+        # the probe timeout — the shallow tiers stay green (the scoped
+        # serve_delay lives in the ServeBatcher, which the engine tier's
+        # direct lane bypasses), so the fault pins to the serving path
+        c2 = canary_mod.CanaryProber(
+            f"http://127.0.0.1:{s.port}", registry=s.registry,
+            server=s.httpd, interval_s=30, probe_timeout_s=1.0,
+        )
+        faults.configure(f"serve_delay:{canary_mod.PROGRAM}=3")
+        state = c2.probe_once()
+        assert state["tiers"]["edge"]["ok"] is True
+        assert state["tiers"]["engine"]["ok"] is True
+        assert state["tiers"]["full"]["ok"] is False
+        assert state["failing_tier"] == "serve"
+        assert state["consecutive_full_failures"] == 1
+        faults.configure(None)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            state = c2.probe_once()
+            if state["failing_tier"] is None:
+                break
+        assert state["failing_tier"] is None
+        assert state["consecutive_full_failures"] == 0
+    finally:
+        s.close()
+
+
+def test_canary_healthz_block(monkeypatch):
+    _fast_tsdb(monkeypatch)
+    s = _Server(registry=True)
+    try:
+        c = canary_mod.ensure_started(
+            f"http://127.0.0.1:{s.port}", registry=s.registry,
+            server=s.httpd,
+        )
+        c.probe_once()
+        health = json.loads(s.get("/healthz")[1])
+        assert health["canary"]["failing_tier"] is None
+        assert health["canary"]["tiers"]["full"] is True
+    finally:
+        s.close()
+
+
+# --- client helpers ---------------------------------------------------------
+
+
+def test_client_series_and_canary_status(monkeypatch):
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+
+    _fast_tsdb(monkeypatch)
+    s = _Server(registry=True)
+    c = MisakaClient(f"http://127.0.0.1:{s.port}")
+    try:
+        s.traffic(10)
+        s.wait_samples(3)
+        s.traffic(10)
+        s.wait_samples(2)
+        idx = c.series()
+        assert idx["series_count"] > 0 and "names" in idx
+        q = c.series("misaka_compute_values_total", window="5m")
+        assert q["window_s"] == 300.0
+        assert q["series"] and q["series"][0]["points"]
+        q = c.series(
+            "misaka_http_request_duration_seconds:p99", window="5m",
+            labels={"route": "/compute_raw"},
+        )
+        for row in q["series"]:
+            assert row["labels"]["route"] == "/compute_raw"
+        with pytest.raises(MisakaClientError):
+            c.series("x", window="bogus")
+        # no canary running in this process: a clean None, not a KeyError
+        assert c.canary_status() is None
+        prober = canary_mod.ensure_started(
+            f"http://127.0.0.1:{s.port}", registry=s.registry,
+            server=s.httpd,
+        )
+        prober.probe_once()
+        status = c.canary_status()
+        assert status["failing_tier"] is None
+        assert status["tiers"]["full"] is True
+    finally:
+        c.close()
+        s.close()
+
+
+# --- history across the checkpoint path -------------------------------------
+
+
+def test_history_rides_checkpoints(monkeypatch, tmp_path):
+    _fast_tsdb(monkeypatch)
+    s = _Server(registry=False)
+    try:
+        s.traffic(10)
+        s.wait_samples(3)
+        s.traffic(10)
+        s.wait_samples(2)
+        before = tsdb.query("misaka_compute_values_total", window_s=300)
+        assert before and before[0]["points"]
+        path = str(tmp_path / "obs.npz")
+        s.master.save_checkpoint(path)
+        # simulate the process restart a fleet roll performs: the new
+        # process boots a FRESH tsdb, then restores the checkpoint
+        tsdb.shutdown()
+        monkeypatch.setenv("MISAKA_TSDB_INTERVAL_S", "0.1")
+        s.master.load_checkpoint(path)
+        after = tsdb.query("misaka_compute_values_total", window_s=300)
+        assert after and after[0]["points"], "history lost across restore"
+        assert after[0]["points"][0][0] <= before[0]["points"][-1][0]
+        s.master.run()
+    finally:
+        s.close()
+
+
+# --- the live fleet drill (acceptance) --------------------------------------
+
+
+ADD2_ENV = {
+    "NODE_INFO": json.dumps({"main": {"type": "program"}}),
+    "MISAKA_PROGRAMS": json.dumps({"main": "IN ACC\nADD 2\nOUT ACC\n"}),
+}
+
+
+def _get_json(base, path, timeout=15):
+    import urllib.request
+
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, data, timeout=30):
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    body = urllib.parse.urlencode(data).encode()
+    req = urllib.request.Request(base + path, data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.mark.slow
+def test_fleet_observatory_drill(tmp_path):
+    """The ISSUE 11 acceptance drill on a REAL fleet-mode server: a
+    scoped fault injected over POST /debug/faults (fanned to every
+    replica) makes the canary fail with tier attribution, the watchdog
+    pages on /debug/alerts with exemplar trace IDs, /healthz flips
+    degraded, recovery clears it — and /debug/series history (replica-
+    labeled) survives a POST /fleet/roll."""
+    from misaka_tpu.runtime import frontends
+
+    port = frontends.pick_free_port()
+    base = f"http://127.0.0.1:{port}"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MISAKA_FLEET": "2",
+        "MISAKA_HTTP_WORKERS": "2",
+        "MISAKA_AUTORUN": "1",
+        "MISAKA_PORT": str(port),
+        "MISAKA_FLEET_DIR": str(tmp_path / "fleet"),
+        "MISAKA_PROGRAMS_DIR": str(tmp_path / "programs"),
+        "MISAKA_TTL_S": "600",
+        "MISAKA_BATCH": "8",
+        "MISAKA_IN_CAP": "32",
+        "MISAKA_OUT_CAP": "32",
+        "MISAKA_STACK_CAP": "16",
+        # observatory at test cadence (fans out to the replicas)
+        "MISAKA_TSDB_INTERVAL_S": "0.5",
+        "MISAKA_TSDB_BUDGET": "0.5",
+        "MISAKA_CANARY_INTERVAL_S": "0.5",
+        "MISAKA_WATCHDOG_RECENT_S": "2",
+        "MISAKA_WATCHDOG":
+            "canary=misaka_canary_success{tier=full}<1 for 2s ->page",
+        **ADD2_ENV,
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "misaka_tpu.runtime.app"], env=env
+    )
+    try:
+        # fleet healthy AND the parent canary green end to end
+        deadline = time.monotonic() + 240
+        health = None
+        while time.monotonic() < deadline:
+            try:
+                health = _get_json(base, "/healthz", timeout=5)
+                can = health.get("canary") or {}
+                if (
+                    health.get("ok")
+                    and not health.get("degraded")
+                    and can.get("tiers", {}).get("full") is True
+                ):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"fleet canary never went green: {health}")
+
+        # replica-labeled history on the merged /debug/series
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            q = _get_json(
+                base,
+                "/debug/series?name=misaka_canary_success&window=5m",
+            )
+            replicas = {
+                row["labels"].get("replica")
+                for row in q["series"] if row["points"]
+            }
+            if {"0", "1"} <= replicas:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"no replica-labeled canary history: {q}")
+
+        # the replica label is a server-side drill-down filter: it
+        # selects which replica's history comes back (resolved at the
+        # parent — the replicas' own series carry no replica label)
+        q0 = _get_json(
+            base,
+            "/debug/series?name=misaka_canary_success&window=5m"
+            "&label=replica=0",
+        )
+        assert q0["series"], q0
+        assert all(
+            row["labels"]["replica"] == "0" for row in q0["series"]
+        ), q0
+
+        # the merged dashboard serves with fleet data baked in
+        import urllib.request
+
+        with urllib.request.urlopen(
+            base + "/debug/dashboard?window=5m", timeout=15
+        ) as r:
+            page = r.read().decode()
+        assert "misaka observatory" in page and "Canary success" in page
+
+        # DRILL: scope a serve delay onto the canary program only, via
+        # the fanned-out route — longer than the canary's own probe
+        # timeout, so full-stack probes fail while real traffic and the
+        # shallow tiers stay green
+        st, body = _post(
+            base, "/debug/faults",
+            {"spec": f"serve_delay:{canary_mod.PROGRAM}=12"},
+        )
+        assert st == 200, body
+
+        deadline = time.monotonic() + 120
+        health = None
+        while time.monotonic() < deadline:
+            health = _get_json(base, "/healthz", timeout=10)
+            can = health.get("canary") or {}
+            if health.get("degraded") and can.get("failing_tier"):
+                break
+            time.sleep(1.0)
+        else:
+            pytest.fail(f"drill never degraded /healthz: {health}")
+        # the fault is BELOW the edge and plane: attribution names the
+        # serving path, not the door
+        assert health["canary"]["failing_tier"] in ("serve", "engine")
+
+        alerts = _get_json(base, "/debug/alerts", timeout=10)
+        fired = [
+            r for r in alerts["fleet_watchdog"]["rules"]
+            if r["state"] != "ok"
+        ]
+        assert fired, alerts["fleet_watchdog"]
+        assert "exemplars" in fired[0]
+
+        # RECOVERY: clear the fault the same way; everything greens
+        st, body = _post(base, "/debug/faults", {"spec": ""})
+        assert st == 200, body
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            health = _get_json(base, "/healthz", timeout=10)
+            can = health.get("canary") or {}
+            if (
+                not health.get("degraded")
+                and can.get("failing_tier") is None
+            ):
+                break
+            time.sleep(1.0)
+        else:
+            pytest.fail(f"drill never recovered: {health}")
+
+        # HISTORY SURVIVES THE ROLL: note the oldest canary point, roll
+        # the fleet, and require pre-roll points to still be there
+        q = _get_json(
+            base, "/debug/series?name=misaka_canary_success&window=10m"
+        )
+        oldest_before = min(
+            row["points"][0][0] for row in q["series"] if row["points"]
+        )
+        t_roll = time.time()
+        st, body = _post(base, "/fleet/roll", {}, timeout=600)
+        assert st == 200, body
+        report = json.loads(body)
+        assert report["ok"] and all(
+            r.get("restored") for r in report["replicas"]
+        )
+        q = _get_json(
+            base, "/debug/series?name=misaka_canary_success&window=10m"
+        )
+        survived = [
+            row for row in q["series"]
+            if row["labels"].get("replica") in ("0", "1")
+            and row["points"] and row["points"][0][0] < t_roll - 5
+        ]
+        assert survived, (
+            f"no pre-roll replica history survived the roll "
+            f"(oldest before: {oldest_before}): "
+            f"{[(r['labels'], r['points'][:1]) for r in q['series']]}"
+        )
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)

@@ -278,41 +278,73 @@ def _native_or_skip():
         pytest.skip("no C++ toolchain for the native engine")
 
 
-def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
+def test_tenant_latency_fault_pages_only_that_tenant(tmp_path):
     """Injected serve-path latency against ONE tenant flips only that
     program's /debug/alerts state to page within a short window, /healthz
-    reports degraded, and recovery clears it."""
+    reports degraded, and recovery clears it.
+
+    Runs against an ISOLATED SUBPROCESS server (ISSUE 11 deflake): the
+    in-process version shared its box with the whole grown suite's
+    accumulated threads, and under full-suite saturation the un-faulted
+    neighbor's real p99 crept over any sane objective (see the PR 10
+    history of margin rescales).  A dedicated process keeps the
+    neighbor's latency honest without weakening any pin — and the fault
+    now rides the production POST /debug/faults route, the same
+    mechanism the fleet drill uses across process boundaries."""
+    import os
+    import subprocess
+    import sys
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
     _native_or_skip()
-    # Margins matter more than realism here: the un-faulted neighbor's
-    # REAL p99 creeps toward 40ms late in a full tier-1 run (one process,
-    # accumulated threads + sampler load), which flipped this scenario's
-    # "neighbor stays green" pin on box noise — and the r14 suite grew
-    # enough neighbors that the 150ms/2s-window rescale started flaking
-    # again (a one-second scheduler stall put >14% of a 2s window's ~5
-    # neighbor samples over threshold, and a starved client thread could
-    # drop the short window below min_events entirely).  Current scale:
-    # a 250ms objective against a 400ms injected fault (fault 1.6x over,
-    # neighbor ~6x under even with creep), windows 3,6,12,24 so the
-    # ~0.4s-cadence fault still lands 7+ events in the SHORT window
-    # (min_events=3 with slack instead of exactly-at-the-floor).  The
-    # pins themselves — page fires, neighbor stays "ok", recovery
-    # clears — are unchanged; only margins and convergence deadlines
-    # widened (deadline waits poll, so green runs pay nothing extra).
-    _arm(monkeypatch, spec="p99<250ms", windows="3,6,12,24", min_events=3)
-    reg = ProgramRegistry(None, batch=8, engine="native", caps=CAPS)
-    top = networks.add2(**CAPS)
-    master = MasterNode(top, chunk_steps=64, batch=8, engine="native")
-    reg.seed("ten-a", master, top)
-    t2 = networks.acc_loop(**CAPS)
-    reg.publish("ten-b", topology_json=json.dumps(
-        {"nodes": t2.node_info, "programs": t2.programs, **CAPS}
-    ))
-    httpd = make_http_server(master, port=0, registry=reg)
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    port = httpd.server_address[1]
-    master.run()
+    from misaka_tpu.runtime import frontends
+
+    port = frontends.pick_free_port()
+    base = f"http://127.0.0.1:{port}"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MISAKA_PORT": str(port),
+        "MISAKA_BATCH": "8",
+        "MISAKA_ENGINE": "native",
+        "MISAKA_AUTORUN": "1",
+        "MISAKA_IN_CAP": "32",
+        "MISAKA_OUT_CAP": "32",
+        "MISAKA_STACK_CAP": "16",
+        "MISAKA_PROGRAMS_DIR": str(tmp_path / "programs"),
+        "MISAKA_DEFAULT_PROGRAM": "ten-a",
+        # a 250ms objective against a 400ms injected fault; short
+        # windows so page -> recovery fits the test lane
+        "MISAKA_SLO": "p99<250ms",
+        "MISAKA_SLO_WINDOWS": "3,6,12,24",
+        "MISAKA_SLO_MIN_EVENTS": "3",
+        "MISAKA_TTL_S": "600",
+        "NODE_INFO": json.dumps({"main": {"type": "program"}}),
+        "MISAKA_PROGRAMS": json.dumps(
+            {"main": "IN ACC\nADD 2\nOUT ACC\n"}
+        ),
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "misaka_tpu.runtime.app"], env=env
+    )
     stop = threading.Event()
     errors = []
+
+    def post_form(path, **fields):
+        body = urllib.parse.urlencode(fields).encode()
+        req = urllib.request.Request(base + path, data=body, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def get_json(path):
+        with urllib.request.urlopen(base + path, timeout=15) as r:
+            return json.loads(r.read())
 
     def client(name, delta):
         vals = np.arange(8, dtype=np.int32)
@@ -331,14 +363,6 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
             errors.append(e)
             stop.set()
 
-    def get_json(path):
-        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
-        conn.request("GET", path)
-        r = conn.getresponse()
-        data = json.loads(r.read())
-        conn.close()
-        return data
-
     def states():
         progs = get_json("/debug/alerts")["programs"]
         return (
@@ -346,23 +370,40 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
             progs.get("ten-b", {}).get("state"),
         )
 
-    ts = [
-        threading.Thread(target=client, args=("ten-a", 2)),
-        threading.Thread(target=client, args=("ten-b", 3)),
-    ]
+    ts = []
     try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            try:
+                if get_json("/healthz").get("ok"):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.25)
+        else:
+            raise AssertionError("subprocess server never came up")
+        st, body = post_form(
+            "/programs", name="ten-b", program="IN ACC\nADD 3\nOUT ACC\n"
+        )
+        assert st == 200, body
+        ts = [
+            threading.Thread(target=client, args=("ten-a", 2)),
+            threading.Thread(target=client, args=("ten-b", 3)),
+        ]
         for t in ts:
             t.start()
         # warm both tenants healthy first (activates ten-b's engine)
-        deadline = time.monotonic() + 45
+        deadline = time.monotonic() + 60
         while time.monotonic() < deadline and not stop.is_set():
             if states() == ("ok", "ok"):
                 break
             time.sleep(0.1)
         assert states() == ("ok", "ok"), states()
-        # inject 400ms into ONLY ten-b's serve passes
-        faults.configure("serve_delay:ten-b=0.4")
-        deadline = time.monotonic() + 30
+        # inject 400ms into ONLY ten-b's serve passes — over the
+        # production fault route, not an in-process configure
+        st, body = post_form("/debug/faults", spec="serve_delay:ten-b=0.4")
+        assert st == 200, body
+        deadline = time.monotonic() + 45
         while time.monotonic() < deadline and not stop.is_set():
             a, b = states()
             if b == "page":
@@ -373,12 +414,16 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
         assert a == "ok", (a, b)  # the neighbor stays green
         health = get_json("/healthz")
         assert health["slo"] == "page" and health["degraded"] is True
-        # recovery: disarm, keep healthy traffic flowing, page clears
-        # (the 12s window must age the fault's bad events out, plus
-        # full-suite scheduling slack — the deadline is a poll, not a
-        # cost on green runs)
-        faults.configure(None)
-        deadline = time.monotonic() + 50
+        # the page carries exemplar trace IDs linking to the flight
+        # recorder (ISSUE 11: alert -> /debug/requests/<id> in one curl)
+        alert_b = get_json("/debug/alerts")["programs"]["ten-b"]
+        assert alert_b.get("exemplars"), alert_b
+        # recovery: disarm over the same route, keep healthy traffic
+        # flowing, page clears (the 12s window must age the fault's bad
+        # events out; the deadline is a poll, not a cost on green runs)
+        st, body = post_form("/debug/faults", spec="")
+        assert st == 200, body
+        deadline = time.monotonic() + 60
         while time.monotonic() < deadline and not stop.is_set():
             if states()[1] == "ok":
                 break
@@ -391,9 +436,12 @@ def test_tenant_latency_fault_pages_only_that_tenant(monkeypatch):
         stop.set()
         for t in ts:
             t.join(timeout=10)
-        master.pause()
-        reg.close()
-        httpd.shutdown()
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
 
 
 # --- edge observations through the compute plane ----------------------------

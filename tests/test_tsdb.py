@@ -1,0 +1,413 @@
+"""The embedded TSDB (utils/tsdb.py): ring semantics, staged
+downsampling, kind derivation (counter rates, histogram quantiles),
+query stage selection, the cardinality cap's loud drop counter, and the
+strictly-newer snapshot/restore merge the checkpoint path rides.
+
+All deterministic: tests drive sample_once()/add() directly with
+synthetic clocks — the collector thread and its governor get one
+liveness check only.
+"""
+
+import time
+
+import pytest
+
+from misaka_tpu.utils import metrics
+from misaka_tpu.utils import tsdb
+from misaka_tpu.utils import watchdog
+
+# Unique metric names per test: the metrics registry is process-global
+# and get-or-create, so a reused name would leak state across tests.
+_seq = iter(range(10 ** 6))
+
+
+def _name(kind):
+    return f"t_tsdb_{kind}_{next(_seq)}"
+
+
+def _private_db(interval_s=1.0, **kw):
+    """A TSDB over its OWN registry: under the full suite the process
+    registry holds hundreds of series, and a fresh default-capped TSDB
+    sampling it would drop these tests' (non-priority) series."""
+    reg = metrics.Registry()
+    return tsdb.TSDB(interval_s=interval_s, registry=reg, **kw), reg
+
+
+# --- parse_window -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("text,want", [
+    ("30s", 30.0), ("5m", 300.0), ("1h", 3600.0), ("90", 90.0),
+    (120, 120.0), ("0.5s", 0.5),
+])
+def test_parse_window(text, want):
+    assert tsdb.parse_window(text) == want
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "-5s", "0", "5x"])
+def test_parse_window_rejects(bad):
+    with pytest.raises(tsdb.TSDBError):
+        tsdb.parse_window(bad)
+
+
+def test_parse_window_zero_gate():
+    with pytest.raises(tsdb.TSDBError):
+        tsdb.parse_window("0s")
+    assert tsdb.parse_window("0s", allow_zero=True) == 0.0
+
+
+# --- ring semantics ---------------------------------------------------------
+
+
+def test_ring_positional_reclaim_and_points():
+    ring = tsdb._Ring(width=1.0, length=4)
+    ring.add(1000.0, 5.0)
+    ring.add(1000.2, 7.0)   # same slot: aggregates mean + max
+    ring.add(1001.0, 1.0)
+    pts = ring.points(1001.5, window_s=4.0)
+    assert pts == [[1000.0, 6.0, 7.0], [1001.0, 1.0, 1.0]]
+    # wrap far enough that slot 1000 % 4 is reused: the stale epoch must
+    # be reclaimed, not leak month-old values into a fresh window
+    ring.add(1004.0, 9.0)   # 1004 % 4 == 1000 % 4
+    pts = ring.points(1004.5, window_s=4.0)
+    assert [p[0] for p in pts] == [1001.0, 1004.0]
+    # and an idle gap produces NO points, not zeros
+    assert ring.points(2000.0, window_s=4.0) == []
+
+
+def test_ring_install_strictly_newer_only():
+    ring = tsdb._Ring(width=1.0, length=8)
+    ring.add(1000.0, 5.0)
+    # older epoch on the same slot index: refused
+    ring.install(1000 - 8, 99.0, 1, 99.0)
+    assert ring.points(1000.5, 8.0) == [[1000.0, 5.0, 5.0]]
+    # same epoch: refused (re-restoring a snapshot must not double-count)
+    ring.install(1000, 99.0, 1, 99.0)
+    assert ring.points(1000.5, 8.0) == [[1000.0, 5.0, 5.0]]
+    # strictly newer: installs
+    ring.install(1001, 4.0, 2, 3.0)
+    assert ring.points(1001.5, 8.0) == [
+        [1000.0, 5.0, 5.0], [1001.0, 2.0, 3.0],
+    ]
+
+
+def test_stage_plan_tracks_interval():
+    assert tsdb._stage_plan(5.0) == ((5.0, 720), (60.0, 360), (300.0, 288))
+    # a test-scale interval keeps the coarser absolute tiers
+    assert tsdb._stage_plan(0.1)[0] == (0.1, 720)
+    assert len(tsdb._stage_plan(0.1)) == 3
+    # a huge interval drops the now-finer-than-interval tiers
+    assert tsdb._stage_plan(600.0) == ((600.0, 720),)
+
+
+def test_query_picks_finest_covering_stage():
+    db, reg = _private_db()
+    g = metrics.gauge(_name("g"), "x", registry=reg)
+    g.set(3.0)
+    db.sample_once()
+    [row] = db.query(g.name, window_s=10.0)
+    assert row["stage_s"] == 1.0        # stage 0 covers 720 s
+    [row] = db.query(g.name, window_s=1000.0)
+    assert row["stage_s"] == 60.0       # stage 0 (720 s) no longer covers
+    [row] = db.query(g.name, window_s=100000.0)
+    assert row["stage_s"] == 300.0      # beyond every span: coarsest
+
+
+# --- kind derivation --------------------------------------------------------
+
+
+def test_counter_becomes_rate_and_reset_rebases():
+    db, reg = _private_db()
+    c = metrics.counter(_name("c"), "x", registry=reg)
+    c.inc(10)
+    db.sample_once()                     # baseline only: no point yet
+    assert db.query(c.name, window_s=60) == []
+    time.sleep(0.05)
+    c.inc(10)
+    db.sample_once()
+    [row] = db.query(c.name, window_s=60)
+    assert row["kind"] == "rate"
+    assert row["points"][-1][1] > 0
+    # a counter RESET (process restart semantics) must re-base on the
+    # fresh value, never emit a negative spike
+    child = c._default()
+    with child._lock:
+        child._value = 1.0
+    time.sleep(0.05)
+    db.sample_once()
+    values = [p[1] for p in db.query(c.name, window_s=60)[0]["points"]]
+    assert all(v >= 0 for v in values)
+
+
+def test_histogram_derives_quantiles_and_rate():
+    db, reg = _private_db()
+    h = metrics.histogram(_name("h"), "x", registry=reg)
+    db.sample_once()                     # baseline
+    for _ in range(50):
+        h.observe(0.01)
+    h.observe(1.0)
+    time.sleep(0.05)
+    db.sample_once()
+    [p50] = db.query(f"{h.name}:p50", window_s=60)
+    [p99] = db.query(f"{h.name}:p99", window_s=60)
+    [rate] = db.query(f"{h.name}:rate", window_s=60)
+    assert p50["kind"] == "quantile" and p99["kind"] == "quantile"
+    assert p50["points"][-1][1] < 0.05          # the mass sits at 10 ms
+    assert p99["points"][-1][1] > 0.1           # the tail shows in p99
+    assert rate["points"][-1][1] > 0
+    # an idle interval writes NO false-zero quantile point
+    time.sleep(0.05)
+    db.sample_once()
+    assert len(db.query(f"{h.name}:p99", window_s=60)[0]["points"]) == 1
+
+
+def test_labeled_children_become_labeled_series():
+    db, reg = _private_db()
+    g = metrics.gauge(_name("gl"), "x", ("route",), registry=reg)
+    g.labels(route="/a").set(1.0)
+    g.labels(route="/b").set(2.0)
+    db.sample_once()
+    rows = db.query(g.name, window_s=60)
+    assert [r["labels"] for r in rows] == [
+        {"route": "/a"}, {"route": "/b"},
+    ]
+    [only_b] = db.query(g.name, labels={"route": "/b"}, window_s=60)
+    assert only_b["points"][-1][1] == 2.0
+
+
+# --- bounded cardinality ----------------------------------------------------
+
+
+def test_series_cap_drops_loudly_and_priority_survives():
+    db, reg = _private_db(max_series=16)  # 16 = the floor
+    flood = metrics.gauge(_name("flood"), "x", ("k",), registry=reg)
+    for i in range(40):
+        flood.labels(k=str(i)).set(1.0)
+    # a priority family registered AFTER the flood still gets a slot:
+    # priority prefixes sample first each pass
+    canary = metrics.gauge(
+        "misaka_canary_success", "x", ("tier",), registry=reg
+    )
+    canary.labels(tier="full").set(1.0)
+    db.sample_once()
+    idx = db.series_index()
+    assert idx["series_count"] == 16
+    assert idx["dropped_series"] > 0            # loud, not silent
+    assert db.query("misaka_canary_success", window_s=60)
+    # documented worst-case memory: bytes_per_series x max_series
+    assert idx["bytes_per_series"] == 28 * (720 + 360 + 288)
+
+
+# --- snapshot / restore -----------------------------------------------------
+
+
+def test_snapshot_restore_round_trip_and_idempotence():
+    db, reg = _private_db()
+    g = metrics.gauge(_name("snap"), "x", registry=reg)
+    g.set(42.0)
+    db.sample_once()
+    snap = db.snapshot()
+    fresh, _ = _private_db()
+    assert fresh.restore(snap) >= 1
+    [row] = fresh.query(g.name, window_s=60)
+    assert row["points"][-1][1] == 42.0
+    # replaying the same snapshot is a no-op (strictly-newer rule)
+    fresh.restore(snap)
+    [row2] = fresh.query(g.name, window_s=60)
+    assert row2["points"] == row["points"]
+
+
+def test_restore_never_clobbers_fresher_history():
+    db, reg = _private_db()
+    g = metrics.gauge(_name("clob"), "x", registry=reg)
+    g.set(1.0)
+    db.sample_once()
+    stale = db.snapshot()                # the eviction-era checkpoint
+    time.sleep(1.1)                      # next stage-0 slot
+    g.set(2.0)
+    db.sample_once()
+    db.restore(stale)
+    [row] = db.query(g.name, window_s=60)
+    assert row["points"][-1][1] == 2.0   # the live point survived
+
+
+def test_restore_rejects_garbage():
+    db, _ = _private_db()
+    with pytest.raises(tsdb.TSDBError):
+        db.restore({"format": 99})
+    with pytest.raises(tsdb.TSDBError):
+        db.restore({"format": 1, "series": [{"name": 7}]})
+
+
+def test_snapshot_bytes_module_surface(monkeypatch):
+    tsdb.shutdown()
+    monkeypatch.setenv("MISAKA_TSDB_INTERVAL_S", "1.0")
+    db = tsdb.ensure_started()
+    g = metrics.gauge(_name("mod"), "x")
+    g.set(5.0)
+    db.sample_once()
+    blob = tsdb.snapshot_bytes()
+    assert blob and isinstance(blob, bytes)
+    tsdb.shutdown()
+    assert tsdb.snapshot_bytes() is None
+    monkeypatch.setenv("MISAKA_TSDB_INTERVAL_S", "1.0")
+    assert tsdb.restore_bytes(blob) >= 1
+    [row] = tsdb.query(g.name, window_s=60)
+    assert row["points"][-1][1] == 5.0
+    tsdb.shutdown()
+
+
+def test_kill_switch(monkeypatch):
+    tsdb.shutdown()
+    monkeypatch.setenv("MISAKA_TSDB", "0")
+    assert tsdb.ensure_started() is None
+    assert tsdb.restore_bytes(b"{}") == 0
+    assert tsdb.index_payload()["running"] is False
+    monkeypatch.delenv("MISAKA_TSDB")
+
+
+def test_collector_thread_and_governor_liveness(monkeypatch):
+    tsdb.shutdown()
+    monkeypatch.setenv("MISAKA_TSDB_INTERVAL_S", "0.05")
+    monkeypatch.setenv("MISAKA_TSDB_BUDGET", "0.5")
+    db = tsdb.ensure_started()
+    g = metrics.gauge(_name("live"), "x")
+    g.set(1.0)
+    deadline = time.monotonic() + 10
+    while db._samples < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert db._samples >= 3, "collector thread never sampled"
+    # the governor stretches the period when a sample's cost would blow
+    # the duty-cycle budget
+    db._cost_ema = 1.0
+    assert db._current_period() == pytest.approx(1.0 / db.budget)
+    tsdb.shutdown()
+
+
+# --- the watchdog over it ---------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog():
+    yield
+    watchdog.shutdown()
+    tsdb.shutdown()
+
+
+def test_watchdog_spec_parse():
+    [r] = watchdog.parse_spec(
+        "p99=foo_seconds:p99{route=/x}>2x@1h for 5m ->page"
+    )
+    assert (r.name, r.series) == ("p99", "foo_seconds:p99")
+    assert r.labels == {"route": "/x"}
+    assert r.factor == 2.0 and r.baseline_s == 3600.0
+    assert r.sustain_s == 300.0 and r.severity == "page"
+    [r] = watchdog.parse_spec("bar<1")
+    assert r.threshold == 1.0 and r.op == "<" and r.severity == "warning"
+    assert r.sustain_s == 0.0
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense", "foo>>1", "foo>1 ->fatal", "foo>0x@1h",
+])
+def test_watchdog_spec_rejects(bad):
+    with pytest.raises(watchdog.WatchdogSpecError):
+        watchdog.parse_spec(bad)
+
+
+def test_watchdog_absolute_rule_fires_sustains_and_clears():
+    db, reg = _private_db(interval_s=0.05)
+    g = metrics.gauge(_name("wd"), "x", registry=reg)
+    w = watchdog.Watchdog(
+        watchdog.parse_spec(f"hot={g.name}>2 for 0.15s ->page"),
+        recent_s=0.2,
+    )
+    g.set(5.0)
+    db.sample_once()
+    w.evaluate(db)
+    assert w.overall_state() == "ok"    # bad, but not sustained yet
+    deadline = time.monotonic() + 5
+    while w.overall_state() == "ok" and time.monotonic() < deadline:
+        time.sleep(0.06)
+        db.sample_once()
+        w.evaluate(db)
+    assert w.overall_state() == "page"
+    [rp] = w.payload()["rules"]
+    assert rp["state"] == "page" and rp["value"] == pytest.approx(5.0)
+    assert rp["since_unix"] > 0
+    # recovery must ALSO sustain before clearing (no alert strobe)
+    g.set(0.0)
+    deadline = time.monotonic() + 5
+    while w.overall_state() == "page" and time.monotonic() < deadline:
+        time.sleep(0.06)
+        db.sample_once()
+        w.evaluate(db)
+    assert w.overall_state() == "ok"
+
+
+def test_watchdog_ratio_rule_needs_baseline_then_catches_drift():
+    db, reg = _private_db(interval_s=0.05)
+    g = metrics.gauge(_name("drift"), "x", registry=reg)
+    # baseline 30s: stage 0 at the test interval spans 0.05 x 720 = 36 s,
+    # so the baseline query stays on the fine stage (a 60s baseline would
+    # fall to the 60s-wide tier = one slot — exactly the production
+    # contract, where the default 5s interval gives stage 0 a 1h span
+    # matching the default 1h baseline)
+    w = watchdog.Watchdog(
+        watchdog.parse_spec(f"d={g.name}>3x@30s for 0s"),
+        recent_s=0.1, min_points=3,
+    )
+    g.set(1.0)
+    db.sample_once()
+    w.evaluate(db)
+    assert w.overall_state() == "ok"    # no baseline yet: silent
+    assert w.payload()["rules"][0].get("baseline") is None
+    for _ in range(8):                  # build the trailing baseline ~1.0
+        time.sleep(0.06)
+        db.sample_once()
+    g.set(10.0)                         # 10x the 1.0 median
+    deadline = time.monotonic() + 5
+    while w.overall_state() == "ok" and time.monotonic() < deadline:
+        time.sleep(0.06)
+        db.sample_once()
+        w.evaluate(db)
+    assert w.overall_state() == "warning"
+    rp = w.payload()["rules"][0]
+    assert rp["baseline"] == pytest.approx(1.0, abs=0.2)
+    assert rp["threshold"] == pytest.approx(3.0, abs=0.6)
+
+
+def test_watchdog_no_data_holds_state():
+    db, _ = _private_db(interval_s=0.05)
+    w = watchdog.Watchdog(
+        watchdog.parse_spec("ghost=misaka_never_exists<1 for 0s ->page"),
+        recent_s=0.2,
+    )
+    w.evaluate(db)
+    assert w.overall_state() == "ok"    # absent series: no verdict
+
+
+def test_watchdog_defaults_and_env(monkeypatch):
+    rules = watchdog.default_rules(5.0)
+    assert {r.name for r in rules} == {
+        "canary-full", "p99-drift", "replica-restarts",
+    }
+    # env spec replaces the defaults; a malformed one is LOUD and falls
+    # back to them
+    tsdb.shutdown()
+    watchdog.shutdown()
+    monkeypatch.setenv("MISAKA_TSDB_INTERVAL_S", "1.0")
+    monkeypatch.setenv("MISAKA_WATCHDOG", "one=foo>1 for 1s")
+    w = watchdog.ensure_started()
+    assert [r.name for r in w.rules] == ["one"]
+    watchdog.shutdown()
+    monkeypatch.setenv("MISAKA_WATCHDOG", "][broken")
+    w = watchdog.ensure_started()
+    assert {r.name for r in w.rules} == {
+        "canary-full", "p99-drift", "replica-restarts",
+    }
+    assert "spec_error" in watchdog.debug_payload()
+    watchdog.shutdown()
+    monkeypatch.setenv("MISAKA_WATCHDOG", "0")
+    assert watchdog.ensure_started() is None
+    assert watchdog.overall_state() is None

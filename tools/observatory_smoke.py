@@ -1,0 +1,271 @@
+"""`make observatory-smoke`: the ISSUE 11 observatory proven end-to-end
+against a REAL subprocess server (~30s).
+
+Boots `python -m misaka_tpu.runtime.app` with the registry + canary +
+TSDB at test cadence, drives traffic, then asserts through the public
+HTTP surface:
+
+  1. the embedded TSDB collected >= 3 intervals and GET /debug/series
+     answers well-formed shapes (index catalog; a counter-as-rate query
+     with [t, avg, max] points; retention stages; the documented
+     bytes-per-series bound);
+  2. GET /debug/dashboard serves the self-contained HTML with populated
+     sparklines (baked DATA panels carrying points; zero external
+     assets);
+  3. the synthetic canary's misaka_canary_success series is present and
+     green (full-stack probes through edge -> batcher -> engine);
+  4. the regression watchdog FIRES on an injected serve_delay fault
+     (armed over the production POST /debug/faults route), surfaces on
+     /debug/alerts with exemplar trace IDs and flips /healthz degraded
+     — then CLEARS after the fault is removed.
+
+Exit 0 on success, 1 with a reason.  The same assertions run inside
+tier-1 (tests/test_observatory.py, tests/test_tsdb.py); this is the
+standalone tripwire against the real process boundary.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def post(base, path, data=None, raw=None, timeout=60):
+    body = raw if raw is not None else urllib.parse.urlencode(data or {}).encode()
+    req = urllib.request.Request(base + path, data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def get(base, path, timeout=30):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def fail(msg):
+    print(f"# observatory-smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    import socket
+
+    import numpy as np
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    tmp = tempfile.mkdtemp(prefix="misaka-obs-smoke-")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MISAKA_PORT": str(port),
+        "MISAKA_BATCH": "8",
+        "MISAKA_AUTORUN": "1",
+        "MISAKA_IN_CAP": "32",
+        "MISAKA_OUT_CAP": "32",
+        "MISAKA_STACK_CAP": "16",
+        "MISAKA_PROGRAMS_DIR": os.path.join(tmp, "programs"),
+        # observatory at smoke cadence (production default: 5s / 1%)
+        "MISAKA_TSDB_INTERVAL_S": "0.5",
+        "MISAKA_TSDB_BUDGET": "0.5",
+        "MISAKA_CANARY_INTERVAL_S": "0.5",
+        "MISAKA_WATCHDOG_RECENT_S": "2",
+        "MISAKA_WATCHDOG": (
+            "p99hot=misaka_http_request_duration_seconds:p99"
+            "{route=/compute_raw}>0.05 for 1s ->page"
+        ),
+        "NODE_INFO": json.dumps({"main": {"type": "program"}}),
+        "MISAKA_PROGRAMS": json.dumps({"main": "IN ACC\nADD 2\nOUT ACC\n"}),
+    }
+    proc = subprocess.Popen([sys.executable, "-m", "misaka_tpu.runtime.app"],
+                            env=env)
+    base = f"http://127.0.0.1:{port}"
+    stop = threading.Event()
+    errors = []
+
+    def pump():
+        vals = np.arange(8, dtype=np.int32)
+        try:
+            while not stop.is_set():
+                st, out = post(base, "/compute_raw?spread=1",
+                               raw=vals.astype("<i4").tobytes())
+                if st != 200 or not np.array_equal(
+                    np.frombuffer(out, "<i4"), vals + 2
+                ):
+                    raise RuntimeError(f"traffic error: {st} {out[:80]!r}")
+                time.sleep(0.02)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            try:
+                if get(base, "/healthz", timeout=2)[0] == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.25)
+        else:
+            fail("server did not come up")
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+
+        # --- 1. >= 3 collected intervals + /debug/series shapes ----------
+        deadline = time.monotonic() + 60
+        idx = None
+        while time.monotonic() < deadline:
+            st, body = get(base, "/debug/series")
+            if st != 200:
+                fail(f"/debug/series index: {st}")
+            idx = json.loads(body)
+            if idx.get("samples", 0) >= 3:
+                break
+            time.sleep(0.5)
+        else:
+            fail(f"TSDB never reached 3 samples: {idx}")
+        if not idx["running"] or idx["series_count"] <= 0:
+            fail(f"index unhealthy: {idx}")
+        if idx["bytes_per_series"] != 28 * sum(
+            s["slots"] for s in idx["stages"]
+        ):
+            fail(f"memory bound mismatch: {idx}")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st, body = get(
+                base, "/debug/series?name=misaka_compute_values_total"
+                      "&window=5m",
+            )
+            q = json.loads(body)
+            if st == 200 and q["series"] and q["series"][0]["points"]:
+                break
+            time.sleep(0.5)
+        else:
+            fail(f"no rate points for misaka_compute_values_total: {q}")
+        row = q["series"][0]
+        if row["kind"] != "rate":
+            fail(f"counter not stored as rate: {row['kind']}")
+        for t_, avg, mx in row["points"]:
+            if not (t_ > 0 and avg >= 0 and mx >= avg):
+                fail(f"malformed point: {[t_, avg, mx]}")
+
+        # --- 2. the dashboard with populated sparklines ------------------
+        st, body = get(base, "/debug/dashboard?window=5m")
+        if st != 200:
+            fail(f"/debug/dashboard: {st}")
+        page = body.decode()
+        if "misaka observatory" not in page or "<script>" not in page:
+            fail("dashboard page shape")
+        if re.search(r'src\s*=\s*"http', page):
+            fail("dashboard references external assets")
+        m = re.search(r"const DATA = (.*);\n", page)
+        if not m:
+            fail("no baked DATA in the dashboard")
+        data = json.loads(m.group(1))
+        populated = [
+            p["title"] for p in data["panels"]
+            if any(r["points"] for r in p["series"])
+        ]
+        if not populated:
+            fail("no dashboard panel has points")
+
+        # --- 3. canary series present and green --------------------------
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st, body = get(
+                base, "/debug/series?name=misaka_canary_success&window=5m"
+            )
+            q = json.loads(body)
+            full = [
+                r for r in q["series"]
+                if r["labels"].get("tier") == "full" and r["points"]
+            ]
+            if full:
+                break
+            time.sleep(0.5)
+        else:
+            fail(f"no canary full-stack series: {q}")
+        if full[0]["points"][-1][1] < 1.0:
+            fail(f"canary not green: {full[0]['points'][-3:]}")
+        st, body = get(base, "/healthz")
+        health = json.loads(body)
+        if health.get("canary", {}).get("failing_tier") is not None:
+            fail(f"canary failing at boot: {health['canary']}")
+
+        # --- 4. watchdog fires on an injected fault, then clears ---------
+        st, body = post(base, "/debug/faults",
+                        {"spec": "serve_delay=0.15"})
+        if st != 200:
+            fail(f"arming the fault: {st} {body!r}")
+        deadline = time.monotonic() + 90
+        wd = None
+        while time.monotonic() < deadline:
+            wd = json.loads(get(base, "/debug/alerts")[1])["watchdog"]
+            if wd["state"] == "page":
+                break
+            time.sleep(0.5)
+        else:
+            fail(f"watchdog never fired under serve_delay: {wd}")
+        fired = [r for r in wd["rules"] if r["state"] == "page"]
+        if not fired or not fired[0].get("exemplars"):
+            fail(f"firing rule carries no exemplars: {fired}")
+        ex = fired[0]["exemplars"][0]
+        st, body = get(base, ex["href"])
+        if st != 200:
+            fail(f"exemplar {ex['href']} not resolvable: {st}")
+        health = json.loads(get(base, "/healthz")[1])
+        if health.get("degraded") is not True:
+            fail(f"page did not flip /healthz degraded: {health}")
+        st, body = post(base, "/debug/faults", {"spec": ""})
+        if st != 200:
+            fail(f"clearing the fault: {st} {body!r}")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            wd = json.loads(get(base, "/debug/alerts")[1])["watchdog"]
+            health = json.loads(get(base, "/healthz")[1])
+            if wd["state"] == "ok" and health.get("degraded") is not True:
+                break
+            time.sleep(0.5)
+        else:
+            fail(f"watchdog never cleared: {wd} {health}")
+
+        if errors:
+            fail(f"traffic errors: {errors[0]}")
+        print(json.dumps({
+            "observatory_smoke": "ok",
+            "tsdb_samples": idx["samples"],
+            "series_count": idx["series_count"],
+            "dashboard_populated_panels": len(populated),
+            "canary_last": full[0]["points"][-1][1],
+            "watchdog_fired_and_cleared": True,
+        }))
+        return 0
+    finally:
+        stop.set()
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
