@@ -7,7 +7,9 @@ CXX ?= g++
 # scrolling past.  utils/nativelib.py's on-demand rebuild keeps plain
 # flags — a stricter future compiler must not brick runtime rebuilds.
 WARNFLAGS ?= -Wall -Wextra -Werror
-CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC -pthread $(WARNFLAGS)
+# -fopenmp-simd: honor the interpreter's `#pragma omp simd` loop
+# annotations (pure compiler directive — no OpenMP runtime is linked).
+CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC -pthread -fopenmp-simd $(WARNFLAGS)
 
 native: native/libmisaka_assembler.so native/libmisaka_interp.so native/libmisaka_textcodec.so
 
@@ -30,7 +32,7 @@ native/libmisaka_textcodec.so: native/textcodec.cpp
 # serve/close/counter-read scenario — the PR 7 TOCTOU-UAF shape — under
 # each instrument.  docs/STATIC_ANALYSIS.md "Sanitizer lanes".
 SAN_CXXFLAGS = -O1 -g -fno-omit-frame-pointer -std=c++17 -shared -fPIC \
-	-pthread $(WARNFLAGS)
+	-pthread -fopenmp-simd $(WARNFLAGS)
 
 native-asan: native/libmisaka_interp.asan.so
 native/libmisaka_interp.asan.so: native/interpreter.cpp

@@ -128,7 +128,8 @@ class MisakaClient:
                  timeout: float = 30.0, pool_size: int = 4,
                  retry_stale: bool = True, connect_retries: int = 3,
                  program: str | None = None, api_key: str | None = None,
-                 ca: str | None = None, tls_insecure: bool = False):
+                 ca: str | None = None, tls_insecure: bool = False,
+                 wire: str | None = None):
         """`retry_stale` (default True) replays a request ONCE when a
         POOLED connection proves dead at send time or before any
         response byte arrives — the stale-keep-alive case.  This is
@@ -163,7 +164,15 @@ class MisakaClient:
         KEY): `ca` pins a CA bundle path (the `make cert` ca.cert, or
         the self-signed service cert itself); `tls_insecure=True` skips
         verification (lab use).  Default with neither: the system trust
-        store."""
+        store.
+
+        `wire` selects the bulk-lane encoding: "auto" (default) speaks
+        the headered binary protocol (utils/wire.py — raw little-endian
+        int32 + a 12-byte header, negotiated via Content-Type/Accept)
+        when the server advertises `wire_binary` on /healthz, decimal
+        text otherwise; "binary" forces it (no probe); "text" keeps the
+        legacy decimal lanes.  MISAKA_CLIENT_WIRE overrides the
+        default."""
         import os as _os
 
         self.base_url = base_url.rstrip("/")
@@ -198,6 +207,18 @@ class MisakaClient:
         self._pool_lock = threading.Lock()
         self._pool_size = max(0, int(pool_size))
         self.program = program
+        wire_mode = wire or _os.environ.get("MISAKA_CLIENT_WIRE") or "auto"
+        if wire_mode not in ("auto", "binary", "text"):
+            raise ValueError(
+                f"wire must be auto|binary|text, got {wire_mode!r}"
+            )
+        # None = auto (probe /healthz wire_binary once, lazily): the
+        # headered binary form must never reach a server that would
+        # compute on the header bytes as payload
+        self._wire_binary: bool | None = (
+            True if wire_mode == "binary"
+            else False if wire_mode == "text" else None
+        )
 
     def _compute_path(self, suffix: str) -> str:
         """`/compute*` or the program-addressed `/programs/<name>/compute*`
@@ -250,11 +271,12 @@ class MisakaClient:
         return self._request_full(path, data, method)[0]
 
     def _request_full(
-        self, path: str, data: bytes | None, method: str
+        self, path: str, data: bytes | None, method: str,
+        extra_headers: dict[str, str] | None = None,
     ) -> tuple[bytes, dict[str, str]]:
         """Like _request, but also returns the response headers the
         tracing surface rides (X-Misaka-Trace, Server-Timing)."""
-        headers = {}
+        headers = dict(extra_headers) if extra_headers else {}
         if data is not None:
             # the server's bulk lanes answer 411 without a length;
             # http.client sets it for bytes bodies, but be explicit
@@ -385,12 +407,32 @@ class MisakaClient:
 
     # --- bulk compute lanes -------------------------------------------------
 
+    def _use_binary_wire(self) -> bool:
+        """Lazy capability probe for wire="auto": one GET /healthz per
+        client session decides whether the server speaks the headered
+        binary protocol.  Fail-safe: any probe failure (old server, no
+        route, network hiccup) latches text — the headered form must
+        never reach a server that would compute on the header bytes."""
+        cached = self._wire_binary
+        if cached is None:
+            try:
+                cached = bool(self.healthz().get("wire_binary"))
+            except Exception:
+                cached = False
+            self._wire_binary = cached
+        return cached
+
     def compute_batch(self, values, spread: bool = True):
-        """A value stream in ONE round trip (decimal text wire format).
+        """A value stream in ONE round trip.  Speaks the binary wire by
+        default (the headered /compute_raw protocol — utils/wire.py) when
+        the server supports it; the decimal text /compute_batch form is
+        the fallback (and forced by wire="text" / MISAKA_CLIENT_WIRE).
         Returns an int32 numpy array (numpy imported here, not at module
         scope — the scalar/lifecycle surface stays stdlib-only)."""
         import numpy as np
 
+        if self._use_binary_wire():
+            return self.compute_raw(values, spread=spread)
         vals = np.ascontiguousarray(values, dtype=np.int32)
         body = b"values=" + b"+".join(b"%d" % v for v in vals.tolist())
         if spread:
@@ -403,13 +445,29 @@ class MisakaClient:
         )
 
     def compute_raw(self, values, spread: bool = True):
-        """The wire-efficient lane: raw little-endian int32 both ways.
-        Returns an int32 numpy array."""
+        """The wire-efficient lane: raw little-endian int32 both ways —
+        headered binary protocol (framing-validated, utils/wire.py) when
+        negotiated, the legacy headerless raw form otherwise.  Returns an
+        int32 numpy array."""
         import numpy as np
 
         vals = np.ascontiguousarray(values, dtype="<i4")
         path = self._compute_path("/compute_raw") \
             + "?spread=" + ("1" if spread else "0")
+        if self._use_binary_wire():
+            from misaka_tpu.utils import wire as _wire
+
+            raw, headers = self._request_full(
+                path, _wire.pack(vals.tobytes()), "POST",
+                extra_headers={
+                    "Content-Type": _wire.CONTENT_TYPE,
+                    "Accept": _wire.CONTENT_TYPE,
+                },
+            )
+            payload = _wire.unpack(raw)
+            return _traced_array(
+                np.frombuffer(payload, dtype="<i4").copy(), headers
+            )
         raw, headers = self._request_full(path, vals.tobytes(), "POST")
         return _traced_array(np.frombuffer(raw, dtype="<i4").copy(), headers)
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import sys
 import threading
 
 import numpy as np
@@ -85,6 +86,10 @@ def _configure(lib: ctypes.CDLL) -> None:
         _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P,
         _I32P, _I32P, ctypes.c_int, _I32P, ctypes.c_int, _I32P,
     ]
+    lib.misaka_pool_simd_info.restype = None
+    lib.misaka_pool_simd_info.argtypes = [ctypes.c_void_p, _I32P]
+    lib.misaka_spec_key.restype = ctypes.c_char_p
+    lib.misaka_spec_key.argtypes = []
 
 
 _NATIVE = NativeLib(
@@ -101,6 +106,30 @@ def _load() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return _NATIVE.available()
+
+
+# Per-program specialized builds (core/specialize.py): each cached .so is
+# the full interpreter ABI compiled with one network's tables baked in.
+# dlopen caches by path, but ctypes.CDLL re-runs symbol setup per call —
+# keep one configured handle per path (never evicted: a handle must
+# outlive every pool created from it, and the set is bounded by the
+# registry's activation cache).
+_SPEC_LIBS: dict[str, ctypes.CDLL] = {}
+_SPEC_LIBS_LOCK = threading.Lock()
+
+
+def load_specialized(path: str) -> ctypes.CDLL:
+    """Load + configure a specialized interpreter .so.  Raises on any
+    load/symbol failure — callers fall back to the generic library."""
+    with _SPEC_LIBS_LOCK:
+        lib = _SPEC_LIBS.get(path)
+        if lib is None:
+            lib = ctypes.CDLL(path)
+            _configure(lib)
+            if not lib.misaka_spec_key():  # a generic build is NOT a spec
+                raise ValueError(f"{path} carries no specialization key")
+            _SPEC_LIBS[path] = lib
+        return lib
 
 
 def _as_i32p(arr: np.ndarray):
@@ -360,8 +389,12 @@ class NativePool:
     """
 
     def __init__(self, code, prog_len, num_stacks, stack_cap, in_cap, out_cap,
-                 replicas, threads: int | None = None):
-        lib = _load()
+                 replicas, threads: int | None = None,
+                 lib: ctypes.CDLL | None = None):
+        # `lib` overrides the shared generic library with a per-program
+        # specialized build (load_specialized) — same ABI, baked tables
+        if lib is None:
+            lib = _load()
         if lib is None:
             raise RuntimeError("native interpreter unavailable (no g++?)")
         self._lib = lib
@@ -413,6 +446,17 @@ class NativePool:
 
     def __del__(self):
         try:
+            # NEVER destroy the C++ pool during interpreter finalization:
+            # a daemon device-loop thread may be frozen inside a
+            # GIL-released serve call (CPython parks daemon threads at
+            # their next GIL acquisition, so the C++ side keeps waiting on
+            # cv_done) — destroying the condition variable under that
+            # waiter is UB and aborts the whole process ("terminate called
+            # without an active exception").  The OS reclaims the threads
+            # and memory at exit anyway; explicit close() keeps the
+            # quiesced-by-construction contract for normal lifecycles.
+            if sys.is_finalizing():
+                return
             self.close()
         except Exception:
             pass
@@ -421,6 +465,19 @@ class NativePool:
         if not self._h:
             raise RuntimeError("pool is closed")
         return self._h
+
+    def simd_info(self) -> dict:
+        """The pool's execution mode: {"width": replicas per SIMD group
+        (0 = scalar per-replica path), "avx2": AVX2 instantiation selected,
+        "specialized": per-program baked tick functions engaged}."""
+        out = np.zeros((3,), np.int32)
+        with self._ctr_lock:
+            self._lib.misaka_pool_simd_info(self._handle(), _as_i32p(out))
+        return {
+            "width": int(out[0]),
+            "avx2": bool(out[1]),
+            "specialized": bool(out[2]),
+        }
 
     def counters(self) -> dict:
         """Pool busy/idle nanosecond counters (the usage-accounting plane):

@@ -33,6 +33,7 @@ when no TPU is attached.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import weakref
@@ -40,6 +41,7 @@ import weakref
 import numpy as np
 
 from misaka_tpu.core import cinterp
+from misaka_tpu.core import specialize
 from misaka_tpu.core.state import NetworkState
 from misaka_tpu.runtime import usage
 from misaka_tpu.utils import metrics
@@ -126,6 +128,47 @@ _G_POOL_REPLICAS.set_function(
     lambda: sum(p._replicas for p in _live_pools())
 )
 _G_POOL_FILL.set_function(_fill_ratio)
+
+# SIMD / specialization observability (ISSUE 12): lane width is the
+# replica-group width of the widest live pool (8 = the AVX2 group path, 0
+# = scalar per-replica ticks — MISAKA_SIMD=0 or no pool), specialized
+# counts pools executing per-program baked tick functions.  The
+# specialize-outcome counter lives in core/specialize.py.
+_G_SIMD_WIDTH = metrics.gauge(
+    "misaka_native_simd_lane_width",
+    "Replicas stepped per SIMD group by the widest live native pool "
+    "(0 = scalar per-replica path)",
+)
+_G_SPECIALIZED = metrics.gauge(
+    "misaka_native_specialized_active",
+    "Live native pools executing per-program specialized tick functions",
+)
+
+
+def _simd_width() -> float:
+    width = 0
+    for p in _live_pools():
+        try:
+            info = p.simd_info()
+        except Exception:
+            continue
+        width = max(width, info["width"])
+    return float(width)
+
+
+def _specialized_active() -> float:
+    count = 0
+    for p in _live_pools():
+        try:
+            if p.simd_info()["specialized"]:
+                count += 1
+        except Exception:
+            continue
+    return float(count)
+
+
+_G_SIMD_WIDTH.set_function(_simd_width)
+_G_SPECIALIZED.set_function(_specialized_active)
 
 _G_POOL_BUSY = metrics.gauge(
     "misaka_native_pool_busy_fraction",
@@ -300,15 +343,37 @@ class NativeServePool:
 
     is_native = True
 
-    def __init__(self, net, chunk_steps: int = 128, threads: int | None = None):
+    def __init__(self, net, chunk_steps: int = 128, threads: int | None = None,
+                 specialized: str | None = None):
         if net.batch is None:
             raise ValueError("NativeServePool serves a batched network "
                              "(use NativeServe for batch=None)")
+        # `specialized` names a per-program interpreter .so built by
+        # core/specialize.py.  The fallback ladder is total: a load
+        # failure, a pool whose baked tables don't engage (C++-side
+        # mismatch), or ANY other error serves on the generic library —
+        # specialization may only ever add speed, never an outage.
+        lib = None
+        if specialized is not None:
+            try:
+                lib = cinterp.load_specialized(specialized)
+            except Exception as e:
+                specialize.M_SPECIALIZE.labels(status="fallback").inc()
+                logging.getLogger("misaka.specialize").warning(
+                    "specialized build %s failed to load (%s); "
+                    "serving generic", specialized, e,
+                )
+                lib = None
         self._pool = cinterp.NativePool(
             np.asarray(net.code), np.asarray(net.prog_len),
             net.num_stacks, net.stack_cap, net.in_cap, net.out_cap,
-            replicas=net.batch, threads=threads,
+            replicas=net.batch, threads=threads, lib=lib,
         )
+        if lib is not None and not self._pool.simd_info()["specialized"]:
+            # the .so loaded but its baked tables did not engage (key'd
+            # wrong, SIMD off, or batch below the group width): count it
+            # so a silent always-generic fleet is visible on /metrics
+            specialize.M_SPECIALIZE.labels(status="fallback").inc()
         self.threads = self._pool.threads
         self._chunk = int(chunk_steps)
         self._replicas = net.batch
@@ -337,6 +402,10 @@ class NativeServePool:
     def close(self) -> None:
         self._closed = True
         self._pool.close()
+
+    def simd_info(self) -> dict:
+        """The pool's execution mode (cinterp.NativePool.simd_info)."""
+        return self._pool.simd_info()
 
     def take_busy_ns(self) -> int:
         """Busy-ns accumulated since the last take (worker + serial-path

@@ -80,6 +80,34 @@ so the master's env surface is what survives:
                    interactive-latency tier, MISAKA_BATCH=B = B replicas
                    sharded over OS threads [MISAKA_NATIVE_THREADS], the
                    host throughput tier; single-chip, needs g++)
+  MISAKA_SIMD      native-tier execution ladder (r16): "auto"/unset = SIMD
+                   struct-of-arrays group ticks, 8 replicas per AVX2 lane
+                   when the CPU has AVX2; "generic" = the same group engine
+                   without AVX2 codegen (the forced feature-detection
+                   fallback); "0"/"off" = the shipped scalar per-replica
+                   interpreter.  Every rung is bit-identical
+                   (tests/test_simd.py); /status.native shows the live rung
+  MISAKA_SPECIALIZE  "0" disables per-program specialized native ticks
+                   (core/specialize.py: registry activation — and the boot
+                   engine — compile the program's tables into a cached
+                   per-program interpreter .so; any failure falls back to
+                   the generic engine with
+                   misaka_native_specialize_total{status} counting why)
+  MISAKA_SPEC_CACHE  specialization compile-cache dir for the boot engine
+                   (default: a per-user tmp dir; the registry caches next
+                   to its version store instead)
+  MISAKA_PLANE_SHM "1" = zero-copy compute plane: frontend workers ship
+                   frame payloads through one shared-memory segment per
+                   plane connection instead of unix-socket copies (frame
+                   headers, metadata, secret handshake, drain/probe/hedge
+                   semantics stay on the socket; a pre-shm engine or a
+                   box without /dev/shm silently keeps socket payloads —
+                   misaka_plane_shm_frames_total proves engagement).
+                   Default off
+  MISAKA_CLIENT_WIRE  client-side: "text" forces MisakaClient's bulk lanes
+                   back to the decimal forms ("auto" default probes
+                   /healthz wire_binary and speaks the headered binary
+                   protocol, utils/wire.py)
   MISAKA_DATA_PARALLEL   shard the batch axis over N chips (requires
                    MISAKA_BATCH divisible by N); MISAKA_MODEL_PARALLEL
                    shards program-node lanes over M chips via the ICI-
@@ -473,6 +501,18 @@ def _serve_http(
             plane.close()
 
 
+def _specialize_dir(environ=os.environ) -> str | None:
+    """The boot master's native specialization cache dir, or None when the
+    layer is killed (MISAKA_SPECIALIZE=0) — MasterNode only compiles
+    specialized ticks when a cache dir is named."""
+    if environ.get("MISAKA_SPECIALIZE", "1") in ("0", "off"):
+        return None
+    from misaka_tpu.core import specialize
+
+    # default_cache_dir() owns the MISAKA_SPEC_CACHE lookup
+    return specialize.default_cache_dir()
+
+
 def main() -> None:
     if os.environ.get("MISAKA_LOG_JSON") == "1":
         # structured logs for container pipelines: one JSON object per
@@ -585,6 +625,10 @@ def main() -> None:
             # intStack.go:9-45 is unbounded; capacity auto-grows on wedge
             # unless disabled (MISAKA_STACK_AUTOGROW=0)
             stack_autogrow=environ.get("MISAKA_STACK_AUTOGROW", "1") != "0",
+            # per-program specialized native ticks for the boot engine
+            # (core/specialize.py; MISAKA_SPECIALIZE=0 kills, content-keyed
+            # compile cache shared per user — a restart reuses the .so)
+            native_spec_dir=_specialize_dir(environ),
         )
         install_guards(master.pause, environ, start_ppid=_PPID_AT_START)
         log_ = logging.getLogger("misaka_tpu.app")

@@ -56,6 +56,7 @@ from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
 from misaka_tpu.utils import slo
 from misaka_tpu.utils import tracespan
+from misaka_tpu.utils import wire
 from misaka_tpu.utils.backoff import Backoff
 from misaka_tpu.utils.httpfast import fast_parse_request
 
@@ -86,6 +87,12 @@ M_PLANE_DRAIN_REROUTES = metrics.counter(
     "misaka_plane_drain_reroutes_total",
     "Compute-plane frames answered with the drain reroute status "
     "(the fleet router re-dispatches them to a sibling)",
+)
+M_PLANE_SHM_FRAMES = metrics.counter(
+    "misaka_plane_shm_frames_total",
+    "Compute-plane frames whose payload rode a shared-memory segment "
+    "instead of the socket (MISAKA_PLANE_SHM=1) — zero here with the "
+    "flag set means the zero-copy plane silently fell back to sockets",
 )
 
 # Compute-plane wire format (unix SOCK_STREAM, one frame in flight per
@@ -145,9 +152,46 @@ _RESP_HDR = struct.Struct("<iI")
 # aggregated fleet /metrics).
 PLANE_DRAINING = 599
 
+# Plane-private ack for a shared-memory arming frame (MISAKA_PLANE_SHM=1,
+# the zero-copy plane): deliberately NOT 200, so a client talking to a
+# pre-shm engine (which would treat the arming frame as an empty compute
+# and answer 200) keeps shipping payload bytes on the socket instead of
+# writing into a segment nobody reads.
+PLANE_SHM_OK = 298
+
 # One frame's value budget.  Big enough that a frontend's whole in-hand
 # backlog ships at once; small enough to bound engine-side buffering.
 MAX_FRAME_VALUES = 1 << 20
+
+
+def plane_shm_enabled() -> bool:
+    """MISAKA_PLANE_SHM=1 swaps per-frame unix-socket payload copies for
+    one shared-memory segment per plane connection (the frame header and
+    metadata stay on the socket — handshake, drain, probe, and hedge
+    semantics are transport-independent).  Default off: the shipped
+    socket plane."""
+    return os.environ.get("MISAKA_PLANE_SHM", "0") == "1"
+
+
+def _attach_shm(name: str, size: int):
+    """Engine-side attach to a frontend-owned segment.  The resource
+    tracker is told to forget it immediately: the FRONTEND owns the
+    segment's lifetime, and Python 3.10's tracker would otherwise unlink
+    it (and warn) when THIS process exits (bpo-39959)."""
+    from multiprocessing import resource_tracker, shared_memory
+
+    seg = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass  # tracker internals shifted: worst case is an exit warning
+    if seg.size < 2 * size:
+        seg.close()
+        raise ValueError(
+            f"segment {name} is {seg.size} bytes; arming promised "
+            f"{2 * size}"
+        )
+    return seg
 
 # Program-addressed compute (the registry surface, runtime/registry.py):
 # /programs/<name>/<op> — the frontend accelerates the same ops it does on
@@ -306,9 +350,17 @@ class ComputePlane:
         registry = self._registry
 
         def parse_meta(blob: bytes) -> tuple[str | None, str | None, int,
-                                             list, list, bool, int, list]:
-            """(program, key, reqs, traces, edge, probe, hedged, shed)
-            from the frame's JSON metadata.
+                                             list, list, bool, int, list,
+                                             dict | None, int | None]:
+            """(program, key, reqs, traces, edge, probe, hedged, shed,
+            shm_arm, shm_vals) from the frame's JSON metadata.
+
+            `shm_arm` ({name, size}) is a shared-memory arming request
+            (MISAKA_PLANE_SHM, see _PlaneShm below); `shm_vals` marks a
+            frame whose payload lives in the connection's armed segment
+            instead of on the socket.  Both are FATAL when malformed,
+            like the program address: guessing would compute on the
+            wrong bytes.
 
             The program address must decode even with tracing killed; an
             UNDECODABLE blob raises _BadMeta and fails the frame (it may
@@ -329,7 +381,7 @@ class ComputePlane:
             "no key" would turn an authentication failure into the
             anonymous tenant's quota."""
             if not blob:
-                return None, None, 1, [], [], False, 0, []
+                return None, None, 1, [], [], False, 0, [], None, None
             import json as _json
 
             probe = False
@@ -337,6 +389,8 @@ class ComputePlane:
             key = None
             reqs = 1
             shed: list = []
+            shm_arm = None
+            shm_vals = None
             try:
                 obj = _json.loads(blob.decode())
                 if isinstance(obj, dict):
@@ -348,6 +402,18 @@ class ComputePlane:
                     hedged = int(obj.get("hedged") or 0)
                     reqs = max(1, int(obj.get("reqs") or 1))
                     shed = obj.get("shed") or []
+                    if obj.get("shm") is not None:
+                        shm_arm = obj["shm"]
+                        if not (isinstance(shm_arm, dict)
+                                and isinstance(shm_arm.get("name"), str)
+                                and isinstance(shm_arm.get("size"), int)
+                                and shm_arm["size"] > 0):
+                            raise ValueError("shm arming must carry "
+                                             "{name: str, size: int > 0}")
+                    if obj.get("shm_vals") is not None:
+                        shm_vals = int(obj["shm_vals"])
+                        if shm_vals < 0:
+                            raise ValueError("shm_vals must be >= 0")
                 elif isinstance(obj, list):
                     # the pre-registry traces-only list form
                     program, segs, edge_raw = None, obj, ()
@@ -357,7 +423,7 @@ class ComputePlane:
                     raise ValueError("program must be a string")
                 if key is not None and not isinstance(key, str):
                     raise ValueError("key must be a string")
-            except (ValueError, TypeError, UnicodeDecodeError) as e:
+            except (ValueError, TypeError, UnicodeDecodeError, KeyError) as e:
                 raise _BadMeta(str(e)) from e
             traces = []
             if tracespan.enabled():
@@ -382,7 +448,8 @@ class ComputePlane:
                     edge = [float(t0) for t0 in edge_raw]
                 except (ValueError, TypeError):
                     log.debug("dropping malformed plane edge metadata")
-            return program, key, reqs, traces, edge, probe, hedged, shed
+            return (program, key, reqs, traces, edge, probe, hedged, shed,
+                    shm_arm, shm_vals)
 
         def slo_record(program, edge, t_recv, error: bool) -> None:
             """Feed the frame's outcome into the per-program SLO windows:
@@ -404,6 +471,13 @@ class ComputePlane:
             else:
                 slo.observe(label, now - t_recv, error=error)
 
+        # shared-memory plane state for THIS connection (MISAKA_PLANE_SHM):
+        # the frontend owns + unlinks the segment; we attach on the arming
+        # frame and only ever map it (bound before the try: the finally
+        # must see it even when the handshake bails)
+        shm_seg = None
+        shm_size = 0
+        values = None  # previous frame's zero-copy view (released per frame)
         try:
             if self._secret is not None:
                 # shared-secret handshake BEFORE any frame: a peer that
@@ -421,6 +495,10 @@ class ComputePlane:
                     )
                     return
             while not self._closed:
+                # release the PREVIOUS frame's payload view before blocking:
+                # an np.frombuffer over the shm segment pins the mapping
+                # (BufferError at close) for as long as any view survives
+                values = None  # noqa: F841 — lifetime management
                 n, n_meta = _REQ_HDR.unpack(_recv_exact(conn, 8))
                 if n > MAX_FRAME_VALUES:
                     body = b"frame exceeds MAX_FRAME_VALUES"
@@ -430,11 +508,41 @@ class ComputePlane:
                 meta = _recv_exact(conn, n_meta) if n_meta else b""
                 try:
                     (program, key, reqs, traces, edge, probe,
-                     hedged, shed) = parse_meta(meta)
+                     hedged, shed, shm_arm, shm_vals) = parse_meta(meta)
                 except _BadMeta as e:
                     body = f"malformed plane metadata: {e}".encode()
                     conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
                     continue
+                if shm_arm is not None:
+                    # zero-copy plane arming: map the client's segment.
+                    # PLANE_SHM_OK is deliberately NOT 200 — a pre-shm
+                    # engine would answer this frame 200 (an empty
+                    # compute), and the client must be able to tell the
+                    # difference before it stops shipping payload bytes.
+                    old, shm_seg, shm_size = shm_seg, None, 0
+                    if old is not None:
+                        old.close()
+                    try:
+                        shm_seg = _attach_shm(shm_arm["name"],
+                                              shm_arm["size"])
+                        shm_size = int(shm_arm["size"])
+                        conn.sendall(_RESP_HDR.pack(PLANE_SHM_OK, 0))
+                    except Exception as e:
+                        body = f"shm attach failed: {e}".encode()
+                        conn.sendall(
+                            _RESP_HDR.pack(400, len(body)) + body
+                        )
+                    continue
+                if shm_vals is not None:
+                    # payload lives in [0, size) of the armed segment
+                    if shm_seg is None or shm_vals * 4 > shm_size \
+                            or shm_vals > MAX_FRAME_VALUES:
+                        body = b"shm frame without a valid armed segment"
+                        conn.sendall(
+                            _RESP_HDR.pack(400, len(body)) + body
+                        )
+                        return  # transport misuse: unrecoverable
+                    n = shm_vals  # the edge chain + metrics see real counts
                 if probe:
                     # router health probe: liveness + drain state only,
                     # zero engine work
@@ -476,6 +584,8 @@ class ComputePlane:
                         )
                         time.sleep(max(0.0, bh))
                     M_PLANE_FRAMES.inc()
+                    if shm_vals is not None:
+                        M_PLANE_SHM_FRAMES.inc()
                     if hedged:
                         M_PLANE_HEDGED.inc(hedged)
                     if shed:
@@ -526,7 +636,18 @@ class ComputePlane:
                     t_recv = time.monotonic()
                     import numpy as np
 
-                    values = np.frombuffer(raw, dtype="<i4")
+                    if shm_vals is not None:
+                        # zero-copy read straight off the mapped segment:
+                        # the client writes the next frame's payload only
+                        # after this frame's response, and the serve
+                        # scheduler consumes values into its feed buffers
+                        # before completing the entries, so the view is
+                        # never read after we answer
+                        values = np.frombuffer(
+                            shm_seg.buf, dtype="<i4", count=shm_vals
+                        )
+                    else:
+                        values = np.frombuffer(raw, dtype="<i4")
                     # Lease resolution FIRST, in its own try: only this
                     # step may answer 404 (ProgramNotFound is a KeyError
                     # subclass — this module stays registry-import-free).
@@ -600,9 +721,16 @@ class ComputePlane:
                         if lease_ctx is not None:
                             lease_ctx.__exit__(None, None, None)
                     payload = out.astype("<i4").tobytes()
-                    conn.sendall(
-                        _RESP_HDR.pack(200, len(payload) // 4) + payload
-                    )
+                    if shm_vals is not None:
+                        # response payload rides the segment's second
+                        # half; the socket carries only the 8-byte header
+                        shm_seg.buf[shm_size:shm_size + len(payload)] = \
+                            payload
+                        conn.sendall(_RESP_HDR.pack(200, len(payload) // 4))
+                    else:
+                        conn.sendall(
+                            _RESP_HDR.pack(200, len(payload) // 4) + payload
+                        )
                     slo_record(program, edge, t_recv, error=False)
                     dur = time.monotonic() - t_recv
                     for tr in traces:
@@ -620,6 +748,15 @@ class ComputePlane:
         except Exception:  # pragma: no cover — must not die silently
             log.exception("compute-plane connection handler crashed")
         finally:
+            values = None
+            if shm_seg is not None:
+                try:
+                    shm_seg.close()  # unmap only; the frontend owns unlink
+                except (OSError, BufferError):
+                    # a surviving numpy view (e.g. a timed-out entry still
+                    # holding its slice) pins the mapping — it is unmapped
+                    # when the last view is collected instead
+                    pass
             with self._conns_lock:
                 self._conns.discard(conn)
             try:
@@ -681,6 +818,9 @@ class PlaneClient:
         # cached once, like ComputePlane: MISAKA_PLANE_SECRET_FILE must
         # not be re-read from disk on every reconnect
         self._secret = edge_mod.plane_secret()
+        # captured HERE, not in the dispatcher thread: the decision must
+        # be fixed at construction (tests toggle the env around it)
+        self._shm_enabled = plane_shm_enabled()
         self.replica = replica  # fleet slot index (None = single engine)
         self._cond = threading.Condition()
         self._pending: deque[_PlaneRequest] = deque()
@@ -761,8 +901,73 @@ class PlaneClient:
             sock.sendall(edge_mod.plane_handshake(self._secret))
         return sock
 
+    def _arm_shm(self, sock: socket.socket, seg, seg_size: int) -> bool:
+        """Offer this dispatcher's shared-memory segment to the engine
+        over a fresh connection.  True only on the PLANE_SHM_OK ack — a
+        pre-shm engine answers the frame as an empty compute (200), and
+        we keep shipping payload on the socket."""
+        import json as _json
+
+        meta = _json.dumps(
+            {"shm": {"name": seg.name, "size": seg_size}}
+        ).encode()
+        sock.sendall(_REQ_HDR.pack(0, len(meta)) + meta)
+        status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
+        if length:
+            # drain whatever rode along (error text, or a legacy empty
+            # compute's payload) so the connection stays frame-aligned
+            _recv_exact(sock, length * 4 if status == 200 else length)
+        return status == PLANE_SHM_OK
+
     def _dispatch_loop(self) -> None:
+        # Zero-copy plane (MISAKA_PLANE_SHM=1): one shared-memory segment
+        # per CONNECTION, offered to the engine on every fresh socket.
+        # Layout: [0, seg_size) carries request payloads,
+        # [seg_size, 2*seg_size) responses; the strict one-frame-in-flight
+        # discipline of this loop makes the double buffer race-free for
+        # the connection's lifetime — and a RECONNECT allocates a FRESH
+        # segment (never reuses the old one): a stale engine handler from
+        # a timed-out previous connection may still be mapped, and its
+        # late read/write would corrupt the new connection's frames.
+        # Creation failure (no /dev/shm) costs that connection the shm
+        # path, nothing else.
+        seg_box: list = [None]
+        try:
+            self._dispatch_loop_inner(seg_box)
+        finally:
+            self._drop_seg(seg_box)
+
+    @staticmethod
+    def _drop_seg(seg_box: list) -> None:
+        seg, seg_box[0] = seg_box[0], None
+        if seg is not None:
+            try:
+                seg.close()
+                seg.unlink()
+            except (OSError, BufferError):
+                pass
+
+    def _fresh_seg(self, seg_box: list, seg_size: int):
+        """Replace the dispatcher's segment for a new connection,
+        unlinking the old one — a stale mapping keeps ITS copy alive
+        until its holder dies, touching nothing of ours."""
+        self._drop_seg(seg_box)
+        try:
+            from multiprocessing import shared_memory
+
+            seg_box[0] = shared_memory.SharedMemory(
+                create=True, size=2 * seg_size
+            )
+        except Exception as e:
+            log.warning("plane shm unavailable (%s); socket payloads", e)
+            seg_box[0] = None
+        return seg_box[0]
+
+    def _dispatch_loop_inner(self, seg_box: list) -> None:
         sock: socket.socket | None = None
+        armed = False  # shm offered + acked on the CURRENT socket
+        seg = None
+        seg_size = MAX_FRAME_VALUES * 4 if self._shm_enabled else 0
         while True:
             with self._cond:
                 while not self._pending and not self._closed:
@@ -884,25 +1089,51 @@ class PlaneClient:
                     ]
                 meta = _json.dumps(obj).encode()
             t_ship = now
-            frame = (
-                _REQ_HDR.pack(total // 4, len(meta))
-                + b"".join(r.body for r in batch) + meta
-            )
+            payload_out = b"".join(r.body for r in batch)
             # One stale-socket replay, the client-pool discipline
             # (client.py retry_stale) one level down: a REUSED plane
             # connection that fails is most often a replica that
             # restarted between frames — retry once on a fresh dial
             # before failing the batch (which in fleet mode would mark
-            # the whole replica down and hedge for nothing).
+            # the whole replica down and hedge for nothing).  The frame
+            # is rebuilt per attempt: a fresh socket needs the shm
+            # re-offered before payloads may ride the segment.
             for attempt in (0, 1):
                 reused = sock is not None
                 try:
                     if sock is None:
                         sock = self._connect()
+                        armed = False
+                        if self._shm_enabled:
+                            seg = self._fresh_seg(seg_box, seg_size)
+                    if seg is not None and not armed:
+                        armed = self._arm_shm(sock, seg, seg_size)
+                    use_shm = armed and total <= seg_size
+                    if use_shm:
+                        # payload into the segment; header + metadata
+                        # (which must then exist, to carry the count)
+                        # stay on the socket
+                        import json as _json
+
+                        seg.buf[0:total] = payload_out
+                        shm_meta = _json.dumps(
+                            {"program": program, "shm_vals": total // 4}
+                        ).encode() if not meta else (
+                            meta[:-1] + b',"shm_vals":%d}' % (total // 4)
+                        )
+                        frame = _REQ_HDR.pack(0, len(shm_meta)) + shm_meta
+                    else:
+                        frame = (
+                            _REQ_HDR.pack(total // 4, len(meta))
+                            + payload_out + meta
+                        )
                     sock.sendall(frame)
                     status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
                     if status == 200:
-                        payload = _recv_exact(sock, length * 4)
+                        payload = (
+                            bytes(seg.buf[seg_size:seg_size + length * 4])
+                            if use_shm else _recv_exact(sock, length * 4)
+                        )
                         off = 0
                         for r in batch:
                             r.out = payload[off:off + len(r.body)]
@@ -1587,6 +1818,16 @@ def make_frontend_server(
                 body = self._read_body()
                 if body is None:
                     return
+                if wire.is_binary(self.headers.get("Content-Type")):
+                    # headered binary protocol (utils/wire.py): the
+                    # worker validates framing at the edge and ships the
+                    # bare payload over the plane, exactly like the
+                    # legacy raw form
+                    try:
+                        body = wire.unpack(body)
+                    except wire.WireError as e:
+                        self._text(400, f"bad binary body: {e}")
+                        return
                 if len(body) % 4:
                     self._text(400, "body must be raw int32 values")
                     return
@@ -1595,7 +1836,11 @@ def make_frontend_server(
                 except PlaneError as e:
                     self._plane_error(e, shed_key)
                     return
-                self._reply(200, out, "application/octet-stream")
+                if wire.accepts_binary(self.headers.get("Accept")):
+                    self._reply(200, wire.header(len(out) // 4) + out,
+                                wire.CONTENT_TYPE)
+                else:
+                    self._reply(200, out, "application/octet-stream")
                 return
             if route == "/compute":
                 if self._shed_cached(shed_key) or not self._edge_guard():

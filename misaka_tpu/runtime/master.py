@@ -46,6 +46,7 @@ from misaka_tpu.utils import slo
 from misaka_tpu.utils import tracespan
 from misaka_tpu.utils import tsdb as tsdb_mod
 from misaka_tpu.utils import watchdog as watchdog_mod
+from misaka_tpu.utils import wire
 from misaka_tpu.utils.httpfast import fast_parse_request as _fast_parse_request
 from misaka_tpu.utils.textcodec import dec_to_ints, ints_to_dec
 
@@ -942,6 +943,7 @@ class MasterNode:
         stripe: int | None = None,
         stack_autogrow: bool = True,
         stack_grow_max_bytes: int = 256 * 1024 * 1024,
+        native_spec_dir: str | None = None,
     ):
         """batch=None serves one network instance (every /compute strictly
         serialized — the correlated fix for quirk #2).  batch=B runs B
@@ -1023,6 +1025,12 @@ class MasterNode:
         self._chunk = chunk_steps
         self._batch = batch
         self._engine = engine
+        # Per-program native specialization (core/specialize.py): armed by
+        # naming a compile cache dir — the registry passes one next to its
+        # version store, app.py passes the shared per-user cache.  Direct
+        # constructions (tests, library use) stay un-specialized so a
+        # MasterNode never surprises its caller with a 2s g++ run.
+        self._native_spec_dir = native_spec_dir
         # Stack auto-grow (reference parity: intStack.go:9-45 grows without
         # limit, while XLA shapes are static): when a full stack wedges the
         # network mid-request, the device loop doubles stack capacity —
@@ -1256,12 +1264,25 @@ class MasterNode:
             # __init__ already rejected trace/mesh combinations; the serve
             # loop dispatches on the returned object's .serve_chunk
             # (unbatched) or the (serve, idle) twin pair (batched pool)
+            from misaka_tpu.core import specialize
             from misaka_tpu.core.native_serve import NativeServe, NativeServePool
 
-            runner = (
-                NativeServe(net) if self._batch is None
-                else NativeServePool(net, chunk_steps=self._chunk)
-            )
+            if self._batch is None:
+                runner = NativeServe(net)
+            else:
+                # Per-program specialized tick functions: compile-once per
+                # content hash (cached on disk), graceful fallback to the
+                # generic interpreter on ANY failure.  Only worth it when
+                # at least one full SIMD group exists (kGroupW = 8).
+                spec_so = None
+                if (self._native_spec_dir is not None
+                        and self._batch >= 8 and specialize.enabled()):
+                    spec_so = specialize.build(
+                        net, cache_dir=self._native_spec_dir
+                    )
+                runner = NativeServePool(
+                    net, chunk_steps=self._chunk, specialized=spec_so
+                )
             # usage attribution: the runner bills its measured native time
             # to THIS master's program.  Read through a weakref at call
             # time — the registry names engines (program_label) after
@@ -1851,6 +1872,25 @@ class MasterNode:
             status["batch"] = self._batch
         if self._mesh is not None:
             status["mesh"] = {"data": self._dp, "model": self._mp}
+        runner = self._runner
+        if getattr(runner, "is_native", False) and hasattr(runner, "simd_info"):
+            # the native execution ladder (ISSUE 12): group width / AVX2 /
+            # per-program specialization, plus the process-wide
+            # specialization-cache outcome counters — "is this box actually
+            # running the fast paths" answered without a /metrics parse
+            try:
+                from misaka_tpu.core.specialize import M_SPECIALIZE
+
+                status["native"] = {
+                    **runner.simd_info(),
+                    "specialize_cache": {
+                        s: int(M_SPECIALIZE.labels(status=s).value)
+                        for s in ("hit", "built", "error", "fallback",
+                                  "disabled")
+                    },
+                }
+            except Exception:  # status must never 500 on telemetry
+                pass
         return status
 
     def trace(self, last: int | None = None) -> list[dict]:
@@ -3090,6 +3130,11 @@ def make_http_server(
                         "uptime_seconds": round(
                             time.monotonic() - boot_mono, 3
                         ),
+                        # capability flag for the client's wire
+                        # auto-negotiation (utils/wire.py): a client must
+                        # never send the headered binary form to a server
+                        # that would compute on the header as payload
+                        "wire_binary": True,
                     }
                     # The frontend supervisor (runtime/frontends.py, armed
                     # by app.py via server.misaka_supervisor): a shrunk or
@@ -3557,6 +3602,14 @@ def make_http_server(
                     raw = self.rfile.read(length)
                     # post-body checks (body consumed: keep-alive stays
                     # synchronized through these early returns)
+                    if wire.is_binary(self.headers.get("Content-Type")):
+                        # the headered binary protocol (utils/wire.py):
+                        # validated framing, same zero-copy payload
+                        try:
+                            raw = wire.unpack(raw)
+                        except wire.WireError as e:
+                            self._text(400, f"bad binary body: {e}")
+                            return
                     if len(raw) % 4:
                         self._text(400, "body must be raw int32 values")
                         return
@@ -3600,7 +3653,12 @@ def make_http_server(
                     except PeerUnavailable as e:
                         self._text(503, str(e))
                         return
-                    self._bytes(result.astype("<i4").tobytes())
+                    payload = result.astype("<i4").tobytes()
+                    if wire.accepts_binary(self.headers.get("Accept")):
+                        self._send(wire.header(len(payload) // 4) + payload,
+                                   wire.CONTENT_TYPE)
+                    else:
+                        self._bytes(payload)  # legacy headerless raw
                 elif path == "/programs":
                     # the registry upload surface: publish one program
                     # version (TIS source, topology JSON, or compose YAML)

@@ -769,6 +769,11 @@ class ProgramRegistry:
         master = MasterNode(
             topo, chunk_steps=self._chunk, batch=self._batch,
             engine=self._engine,
+            # per-program specialized native ticks (core/specialize.py),
+            # cached next to the version store: a reactivation (or a
+            # restart) reuses the content-keyed .so instead of recompiling;
+            # hot-swap to a new version keys a new entry automatically
+            native_spec_dir=os.path.join(self._name_dir(name), "native"),
         )
         master.program_label = name
         ckpt = self._state_path(name, version)

@@ -38,6 +38,9 @@ PANELS = (
      "max", ""),
     ("Native pool busy fraction", "misaka_native_pool_busy_fraction",
      "max", ""),
+    ("SIMD lane width", "misaka_native_simd_lane_width", "max", ""),
+    ("Specialized engines", "misaka_native_specialized_active", "max", ""),
+    ("Plane shm frames (/s)", "misaka_plane_shm_frames_total", "sum", "/s"),
     ("Replicas alive", "misaka_fleet_replicas_alive", "min", ""),
     ("Canary success", "misaka_canary_success", "min", ""),
     ("Canary p99", "misaka_canary_latency_seconds:p99", "max", "s"),

@@ -106,6 +106,7 @@ POINTS = frozenset({
     "replica_blackhole",
     "overload",
     "quota_exhaust",
+    "specialize_fail",
 })
 
 # Points that accept a ":<qualifier>" suffix scoping the fault to one

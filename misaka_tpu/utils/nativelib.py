@@ -79,8 +79,11 @@ class NativeLib:
             subprocess.run(
                 [
                     # -pthread: the interpreter's serving pool runs
-                    # std::thread workers; harmless for the other components
+                    # std::thread workers; -fopenmp-simd honors the SIMD
+                    # loop pragmas (no OpenMP runtime) — both harmless for
+                    # the other components
                     cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                    "-fopenmp-simd",
                     f'-DMISAKA_SRC_HASH="{self._src_hash()}"',
                     self._src, "-o", tmp,
                 ],

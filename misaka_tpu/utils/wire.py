@@ -1,0 +1,85 @@
+"""Binary client wire protocol v1 for /compute_raw (ISSUE 12 layer 3).
+
+The text lanes pay decimal encode/parse per value; the legacy raw lane is
+already little-endian int32 both ways but headerless, so the server can
+only trust Content-Length framing and the client cannot negotiate.  This
+module defines the headered binary protocol both sides speak by default:
+
+    request:  POST /compute_raw
+              Content-Type: application/x-misaka-i32
+              body = 12-byte header + count * int32 (little-endian)
+    response: negotiated by Accept: application/x-misaka-i32 —
+              same header framing + raw int32 outputs
+
+    header:   <IHHI  magic 0x314B534D ("MSK1"), version, flags, count
+
+Negotiation is strictly additive: a body without the Content-Type is the
+legacy headerless raw lane (byte-identical to the shipped behavior), and a
+request without the Accept gets the legacy raw response.  The header buys
+framing validation (count vs Content-Length — a truncated proxy body is a
+typed 400, not silently-computed garbage) and a place for future flags;
+the payload stays the zero-copy np.frombuffer shape on both sides.
+
+Stdlib-only: the jax-free frontend workers and the pure-stdlib client both
+import this.
+"""
+
+from __future__ import annotations
+
+import struct
+
+MAGIC = 0x314B534D  # b"MSK1" read as little-endian uint32
+VERSION = 1
+CONTENT_TYPE = "application/x-misaka-i32"
+_HDR = struct.Struct("<IHHI")  # magic, version, flags, count
+HEADER_LEN = _HDR.size  # 12
+
+
+class WireError(ValueError):
+    """Malformed binary-protocol body (bad magic/version/count)."""
+
+
+def header(count: int, flags: int = 0) -> bytes:
+    return _HDR.pack(MAGIC, VERSION, flags, count)
+
+
+def pack(payload: bytes, flags: int = 0) -> bytes:
+    """Frame one raw little-endian int32 payload."""
+    if len(payload) % 4:
+        raise WireError("payload must be whole int32 values")
+    return _HDR.pack(MAGIC, VERSION, flags, len(payload) // 4) + payload
+
+
+def unpack(body: bytes) -> bytes:
+    """Validate the header and return the raw int32 payload bytes.
+
+    Raises WireError on anything malformed — the server answers a typed
+    400 instead of computing on garbage."""
+    if len(body) < HEADER_LEN:
+        raise WireError(
+            f"body of {len(body)} bytes is shorter than the "
+            f"{HEADER_LEN}-byte header"
+        )
+    magic, version, _flags, count = _HDR.unpack_from(body)
+    if magic != MAGIC:
+        raise WireError(f"bad magic 0x{magic:08x} (expected 0x{MAGIC:08x})")
+    if version != VERSION:
+        raise WireError(f"unsupported protocol version {version}")
+    payload = body[HEADER_LEN:]
+    if len(payload) != count * 4:
+        raise WireError(
+            f"header promises {count} values but body carries "
+            f"{len(payload)} payload bytes"
+        )
+    return payload
+
+
+def is_binary(content_type: str | None) -> bool:
+    """Does this Content-Type select the headered binary request form?"""
+    return bool(content_type) and content_type.split(";", 1)[0].strip() \
+        == CONTENT_TYPE
+
+
+def accepts_binary(accept: str | None) -> bool:
+    """Does this Accept header negotiate the headered binary response?"""
+    return bool(accept) and CONTENT_TYPE in accept

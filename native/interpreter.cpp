@@ -23,11 +23,24 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <thread>
 #include <utility>
 #include <vector>
+
+// Per-program specialization (core/specialize.py): the build injects
+// -DMISAKA_SPEC_HEADER pointing at a generated header that bakes ONE
+// network's tables (code/prog_len) and dimensions as constexpr data in
+// namespace spec, and defines MISAKA_SPEC.  The same source then compiles
+// into a .so whose group tick paths constant-fold every dimension and read
+// the program straight from .rodata; misaka_pool_create falls back to the
+// generic paths when the runtime tables don't match the baked ones, so a
+// stale cache entry degrades, never corrupts.
+#ifdef MISAKA_SPEC_HEADER
+#include MISAKA_SPEC_HEADER
+#endif
 
 namespace {
 
@@ -462,6 +475,672 @@ void read_state(Interp* it, int32_t* acc, int32_t* bak, int32_t* pc,
   counters[4] = it->tick_count;
 }
 
+// --- SIMD struct-of-arrays group engine ------------------------------------
+//
+// The throughput rewrite of the tick loop (ROADMAP "raw speed"): one worker
+// thread steps kGroupW replicas at once, with every per-lane scalar of the
+// Interp above widened into a contiguous [*, kGroupW] plane — struct of
+// arrays across REPLICAS, the batch axis, not across a network's lanes.
+// The superstep discipline makes replicas fully independent within a tick
+// (instances never share ports, stacks, or rings), so the replica axis is
+// embarrassingly data-parallel: the per-lane loops run their replica
+// dimension innermost over contiguous memory, the clean ones annotated
+// `#pragma omp simd` (compiled with -fopenmp-simd — no OpenMP runtime),
+// and the instruction fetch is hoisted out of the lane loops into per-field
+// SoA planes once per tick.
+//
+// The whole serve body is instantiated from ONE template into two
+// functions: inside an `__attribute__((target("avx2")))` wrapper (AVX2
+// codegen, 8 int32 per vector = kGroupW) and with default codegen (the
+// scalar fallback).  Runtime CPU detection (__builtin_cpu_supports) picks
+// the variant at pool creation; both execute the same statements in the
+// same order on the same integer types, so outputs are bit-identical to
+// each other AND to the scalar Interp, which remains the oracle and the
+// MISAKA_SIMD=0 kill-switch path (the differential suites pin all three).
+//
+//   MISAKA_SIMD=0|off     pool runs the shipped scalar per-replica path
+//   MISAKA_SIMD=generic   group path, default codegen (the no-AVX2 ladder
+//                         rung, forceable for tests on any box)
+//   MISAKA_SIMD=1|auto    group path, AVX2 when the CPU has it (default)
+
+constexpr int kGroupW = 8;  // replicas per group: one AVX2 int32 vector
+
+enum SimdMode { SIMD_OFF = 0, SIMD_GENERIC = 1, SIMD_AVX2 = 2 };
+
+SimdMode simd_mode_from_env() {
+  const char* e = std::getenv("MISAKA_SIMD");
+  if (e != nullptr && (std::strcmp(e, "0") == 0 || std::strcmp(e, "off") == 0))
+    return SIMD_OFF;
+  const bool force_generic = e != nullptr && std::strcmp(e, "generic") == 0;
+#if defined(__x86_64__) || defined(__i386__)
+  if (!force_generic && __builtin_cpu_supports("avx2")) return SIMD_AVX2;
+#else
+  (void)force_generic;
+#endif
+  return SIMD_GENERIC;
+}
+
+// One pool serve/idle job (batch-major state arrays, see misaka_pool_serve).
+struct Job {
+  int32_t *acc, *bak, *pc, *port_val;
+  uint8_t* port_full;
+  int32_t* hold_val;
+  uint8_t* holding;
+  int32_t *stack_mem, *stack_top, *in_buf, *out_buf, *counters, *retired;
+  int32_t *acc_hi, *bak_hi;
+  const int32_t* feed_vals;    // [B, in_cap], null when idle
+  const int32_t* feed_counts;  // [B], null when idle
+  int ticks = 0;
+  bool feeding = false;
+  int32_t* packed = nullptr;  // [B, 4+out_cap] serve / [B, 4] idle
+  // Partial-fill fast path: when non-null, ONLY these replica indices
+  // (strictly increasing, validated at the entry point) are imported,
+  // fed, run, and exported — an underfilled serve pass pays for the
+  // replicas actually working, not the whole batch.  The Python caller
+  // prefills skipped replicas' packed rows from their current counters.
+  const int32_t* active = nullptr;
+  int n_active = 0;
+};
+
+// SoA scratch for one group of kGroupW replicas.  Pure scratch: state lives
+// in the caller's batch-major arrays between calls (the pool is stateless),
+// so ONE Group per worker thread serves every group unit that thread picks
+// up.  Planes are indexed [x * kGroupW + r].
+struct Group {
+  int n_lanes, max_len, num_stacks, stack_cap, in_cap, out_cap;
+  const int32_t* code;      // borrowed from the owning pool (shared program)
+  const int32_t* prog_len;
+
+  std::vector<int64_t> acc, bak;               // [n][W]
+  std::vector<int32_t> pc, hold_val, retired;  // [n][W]
+  std::vector<uint8_t> holding;                // [n][W]
+  std::vector<int32_t> port_val;               // [n][kPorts][W]
+  std::vector<uint8_t> port_full;              // [n][kPorts][W]
+  // Rings and stack memory stay REPLICA-major ([W][...], the job-array
+  // layout): inside a tick they are only ever touched scalar per replica
+  // (per-replica ring cursors / stack tops index them), so the SoA
+  // transpose would buy nothing — while replica-major makes their
+  // import/export a straight memcpy, which dominates the per-call floor
+  // at serving batch sizes.
+  std::vector<int32_t> stack_mem;              // [W][S][cap]
+  std::vector<int32_t> stack_top;              // [S][W]
+  std::vector<int32_t> in_buf;                 // [W][in_cap]
+  std::vector<int32_t> out_buf;                // [W][out_cap]
+  int32_t in_rd[kGroupW], in_wr[kGroupW], out_rd[kGroupW], out_wr[kGroupW];
+  int32_t tick_count[kGroupW];
+
+  // per-tick scratch: cached instruction pointers + decoded op plane
+  // (fetch hoists the pc chase out of the phase loops; the remaining
+  // fields read through f_ptr, L1-hot) plus the widened arbitration
+  // state of Interp::tick
+  std::vector<const int32_t*> f_ptr;                     // [n][W]
+  std::vector<int32_t> s_op;                             // [n][W]
+  std::vector<int64_t> s_src_val;                        // [n][W]
+  std::vector<uint8_t> s_src_ok;                         // [n][W]
+  std::vector<uint8_t> s_deliv_full;                     // [n][kPorts][W]
+  std::vector<int32_t> s_deliv_val;                      // [n][kPorts][W]
+  std::vector<int32_t> s_begin_top;                      // [S][W]
+  std::vector<uint8_t> s_stack_taken, s_pushed;          // [S][W]
+  std::vector<int32_t> s_push_val;                       // [S][W]
+
+  Group(const int32_t* code_, const int32_t* prog_len_, int n_lanes_,
+        int max_len_, int num_stacks_, int stack_cap_, int in_cap_,
+        int out_cap_)
+      : n_lanes(n_lanes_), max_len(max_len_), num_stacks(num_stacks_),
+        stack_cap(stack_cap_), in_cap(in_cap_), out_cap(out_cap_),
+        code(code_), prog_len(prog_len_) {
+    const size_t nW = (size_t)n_lanes * kGroupW;
+    const size_t pW = (size_t)n_lanes * kPorts * kGroupW;
+    const size_t sW = (size_t)num_stacks * kGroupW;
+    acc.assign(nW, 0); bak.assign(nW, 0);
+    pc.assign(nW, 0); hold_val.assign(nW, 0); retired.assign(nW, 0);
+    holding.assign(nW, 0);
+    port_val.assign(pW, 0); port_full.assign(pW, 0);
+    stack_mem.assign((size_t)num_stacks * stack_cap * kGroupW, 0);
+    stack_top.assign(sW, 0);
+    in_buf.assign((size_t)in_cap * kGroupW, 0);
+    out_buf.assign((size_t)out_cap * kGroupW, 0);
+    f_ptr.assign(nW, nullptr);
+    s_op.assign(nW, 0);
+    s_src_val.assign(nW, 0);
+    s_src_ok.assign(nW, 0);
+    s_deliv_full.assign(pW, 0); s_deliv_val.assign(pW, 0);
+    s_begin_top.assign(sW, 0);
+    s_stack_taken.assign(sW, 0); s_pushed.assign(sW, 0);
+    s_push_val.assign(sW, 0);
+    std::memset(in_rd, 0, sizeof(in_rd));
+    std::memset(in_wr, 0, sizeof(in_wr));
+    std::memset(out_rd, 0, sizeof(out_rd));
+    std::memset(out_wr, 0, sizeof(out_wr));
+    std::memset(tick_count, 0, sizeof(tick_count));
+  }
+};
+
+// Dimension/table traits: the group serve template reads every dimension
+// and the program tables through one of these, so the SAME statements
+// compile once against runtime fields (DynSpec) and once against the baked
+// constexpr data of a specialized build (SpecSpec) — constant loop bounds
+// unroll, the program reads from .rodata, and the two stay semantically
+// identical by construction.
+struct DynSpec {
+  static constexpr bool is_spec = false;
+  static inline int n_lanes(const Group& g) { return g.n_lanes; }
+  static inline int max_len(const Group& g) { return g.max_len; }
+  static inline int num_stacks(const Group& g) { return g.num_stacks; }
+  static inline int stack_cap(const Group& g) { return g.stack_cap; }
+  static inline int in_cap(const Group& g) { return g.in_cap; }
+  static inline int out_cap(const Group& g) { return g.out_cap; }
+  static inline const int32_t* code(const Group& g) { return g.code; }
+  static inline const int32_t* prog_len(const Group& g) { return g.prog_len; }
+};
+
+#ifdef MISAKA_SPEC
+struct SpecSpec {
+  static constexpr bool is_spec = true;
+  static inline constexpr int n_lanes(const Group&) { return spec::n_lanes; }
+  static inline constexpr int max_len(const Group&) { return spec::max_len; }
+  static inline constexpr int num_stacks(const Group&) {
+    return spec::num_stacks;
+  }
+  static inline constexpr int stack_cap(const Group&) {
+    return spec::stack_cap;
+  }
+  static inline constexpr int in_cap(const Group&) { return spec::in_cap; }
+  static inline constexpr int out_cap(const Group&) { return spec::out_cap; }
+  static inline const int32_t* code(const Group&) { return spec::code; }
+  static inline const int32_t* prog_len(const Group&) {
+    return spec::prog_len;
+  }
+};
+#endif
+
+#define MISAKA_AI inline __attribute__((always_inline))
+
+// One group tick: Interp::tick with the replica axis widened to kGroupW.
+// Returns whether ANY replica progressed — a no-progress replica's tick is
+// an identity step (determinism: it can never wake without external input),
+// so lockstep over the group preserves per-replica bit-identity with the
+// scalar engine's individual early exit.
+template <class S>
+MISAKA_AI bool group_tick(Group& g) {
+  constexpr int W = kGroupW;
+  const int n = S::n_lanes(g);
+  const int ml = S::max_len(g);
+  const int ns = S::num_stacks(g);
+  const int scap = S::stack_cap(g);
+  const int icap = S::in_cap(g);
+  const int ocap = S::out_cap(g);
+  const int32_t* code = S::code(g);
+  const int32_t* plen = S::prog_len(g);
+
+  uint8_t moved[W];
+  std::memset(moved, 0, sizeof(moved));
+  constexpr uint32_t kReads =
+      (1u << OP_MOV_LOCAL) | (1u << OP_MOV_NET) | (1u << OP_ADD) |
+      (1u << OP_SUB) | (1u << OP_JRO) | (1u << OP_PUSH) | (1u << OP_OUT);
+
+  // pass 1 — fetch + phase A + source resolution, fused per (lane,
+  // replica): all three touch only the lane's OWN latch/registers, so
+  // they need no cross-lane ordering.  The instruction pointer is cached
+  // for pass 2 (pc is stable until commit).
+  for (int l = 0; l < n; ++l) {
+    const int32_t* base = code + (size_t)l * ml * NFIELDS;
+    for (int r = 0; r < W; ++r) {
+      const int i = l * W + r;
+      const int32_t* f = base + (size_t)g.pc[i] * NFIELDS;
+      g.f_ptr[i] = f;
+      const int op = f[F_OP], src = f[F_SRC];
+      g.s_op[i] = op;
+      const bool reads = (kReads >> op) & 1u;
+      // phase A: consume a ready port source into the hold latch
+      if (reads && src >= SRC_R0 && !g.holding[i]) {
+        const size_t pi = (size_t)(l * kPorts + (src - SRC_R0)) * W + r;
+        if (g.port_full[pi]) {
+          g.hold_val[i] = g.port_val[pi];
+          g.holding[i] = 1;
+          g.port_full[pi] = 0;
+          moved[r] = 1;
+        }
+      }
+      // source resolution (post-consume holding, like the scalar engine)
+      const int64_t v = (src == SRC_IMM) ? (int64_t)f[F_IMM]
+                      : (src == SRC_ACC) ? g.acc[i]
+                      : (src == SRC_NIL) ? (int64_t)0
+                                         : (int64_t)g.hold_val[i];
+      g.s_src_val[i] = reads ? v : 0;
+      g.s_src_ok[i] =
+          (uint8_t)(!reads || src < SRC_R0 || g.holding[i] != 0);
+    }
+  }
+
+  // pass 2 — arbitration + commit, fused: lowest lane index wins each
+  // per-replica resource, and since later lanes' grants can never change
+  // an earlier lane's, the commit (register/pc effects reading
+  // begin-of-tick acc/bak — each lane reads only its OWN, held in locals
+  // before the update) runs in the same iteration.  Port/stack/ring
+  // EFFECTS still wait for pass 3: sends must see post-consume,
+  // pre-delivery occupancy, stack feasibility keys on begin-of-tick tops,
+  // and IN reads the ring at the begin-of-tick read cursor.
+  std::memset(g.s_deliv_full.data(), 0, (size_t)n * kPorts * W);
+  std::memcpy(g.s_begin_top.data(), g.stack_top.data(),
+              (size_t)ns * W * sizeof(int32_t));
+  std::memset(g.s_stack_taken.data(), 0, (size_t)ns * W);
+  std::memset(g.s_pushed.data(), 0, (size_t)ns * W);
+  uint8_t in_avail[W], out_free[W], in_taken[W], out_taken[W];
+  int32_t in_win[W], out_value[W];
+#pragma omp simd
+  for (int r = 0; r < W; ++r) {
+    in_avail[r] = (uint8_t)(g.in_wr[r] - g.in_rd[r] > 0);
+    out_free[r] = (uint8_t)(g.out_wr[r] - g.out_rd[r] < ocap);
+    in_taken[r] = out_taken[r] = 0;
+    in_win[r] = -1;
+    out_value[r] = 0;
+  }
+  for (int l = 0; l < n; ++l) {
+    const int32_t ln = plen[l];
+    for (int r = 0; r < W; ++r) {
+      const int i = l * W + r;
+      const int op = g.s_op[i];
+      const int32_t* f = g.f_ptr[i];
+      bool commit;
+      int32_t pop_val = 0;
+      switch (op) {
+        case OP_MOV_NET: {
+          commit = false;
+          if (!g.s_src_ok[i]) break;
+          const size_t pi = (size_t)(f[F_TGT] * kPorts + f[F_PORT]) * W + r;
+          if (!g.port_full[pi] && !g.s_deliv_full[pi]) {
+            g.s_deliv_full[pi] = 1;
+            g.s_deliv_val[pi] = i32(g.s_src_val[i]);  // wire: sint32
+            commit = true;
+          }
+          break;
+        }
+        case OP_PUSH: {
+          commit = false;
+          if (!g.s_src_ok[i]) break;
+          const size_t si = (size_t)f[F_TGT] * W + r;
+          if (!g.s_stack_taken[si] && g.s_begin_top[si] < scap) {
+            g.s_stack_taken[si] = 1;
+            g.s_pushed[si] = 1;
+            g.s_push_val[si] = i32(g.s_src_val[i]);  // wire: sint32
+            commit = true;
+          }
+          break;
+        }
+        case OP_POP: {
+          commit = false;
+          const int s = f[F_TGT];
+          const size_t si = (size_t)s * W + r;
+          if (!g.s_stack_taken[si] && g.s_begin_top[si] > 0) {
+            g.s_stack_taken[si] = 1;
+            pop_val = g.stack_mem[((size_t)r * ns + s) * scap +
+                                  g.s_begin_top[si] - 1];
+            commit = true;
+          }
+          break;
+        }
+        case OP_IN:
+          commit = false;
+          if (in_avail[r] && !in_taken[r]) {
+            in_taken[r] = 1;
+            in_win[r] = l;
+            commit = true;
+          }
+          break;
+        case OP_OUT:
+          commit = false;
+          if (g.s_src_ok[i] && out_free[r] && !out_taken[r]) {
+            out_taken[r] = 1;
+            out_value[r] = i32(g.s_src_val[i]);
+            commit = true;
+          }
+          break;
+        default:
+          commit = g.s_src_ok[i] != 0;
+          break;
+      }
+      if (!commit) continue;
+      moved[r] = 1;
+      const int64_t oa = g.acc[i], ob = g.bak[i];  // begin-of-tick values
+      switch (op) {
+        case OP_MOV_LOCAL:
+          if (f[F_DST] == DST_ACC) g.acc[i] = g.s_src_val[i];
+          break;
+        case OP_ADD:
+          g.acc[i] = (int64_t)((uint64_t)oa + (uint64_t)g.s_src_val[i]);
+          break;
+        case OP_SUB:
+          g.acc[i] = (int64_t)((uint64_t)oa - (uint64_t)g.s_src_val[i]);
+          break;
+        case OP_NEG: g.acc[i] = (int64_t)(0 - (uint64_t)oa); break;
+        case OP_SWP: g.acc[i] = ob; g.bak[i] = oa; break;
+        case OP_SAV: g.bak[i] = oa; break;
+        case OP_POP:
+          if (f[F_DST] == DST_ACC) g.acc[i] = pop_val;
+          break;
+        case OP_IN:
+          if (f[F_DST] == DST_ACC)
+            g.acc[i] = g.in_buf[(size_t)r * icap + g.in_rd[r] % icap];
+          break;
+        default: break;
+      }
+      const bool taken = op == OP_JMP || (op == OP_JEZ && oa == 0) ||
+                         (op == OP_JNZ && oa != 0) ||
+                         (op == OP_JGZ && oa > 0) || (op == OP_JLZ && oa < 0);
+      if (taken) {
+        g.pc[i] = f[F_JMP];
+      } else if (op == OP_JRO) {
+        // 64-bit offset: saturate by sign past int32 (mirrors Interp)
+        const int64_t v = g.s_src_val[i];
+        const int64_t t = (v >= INT32_MIN && v <= INT32_MAX)
+                              ? (int64_t)g.pc[i] + v
+                              : (v < 0 ? 0 : (int64_t)ln - 1);
+        g.pc[i] = (int32_t)(t < 0 ? 0 : (t > ln - 1 ? ln - 1 : t));
+      } else {
+        g.pc[i] = (g.pc[i] + 1) % ln;
+      }
+      g.holding[i] = 0;
+      g.retired[i] = i32((int64_t)g.retired[i] + 1);  // wrap-safe
+    }
+  }
+
+  // pass 3 — apply resource effects (contiguous over the replica axis)
+  {
+    const size_t np = (size_t)n * kPorts * W;
+#pragma omp simd
+    for (size_t pi = 0; pi < np; ++pi) {
+      if (g.s_deliv_full[pi]) {
+        g.port_full[pi] = 1;
+        g.port_val[pi] = g.s_deliv_val[pi];
+      }
+    }
+  }
+  for (int s = 0; s < ns; ++s) {
+    for (int r = 0; r < W; ++r) {
+      const size_t si = (size_t)s * W + r;
+      if (g.s_pushed[si]) {
+        g.stack_mem[((size_t)r * ns + s) * scap + g.s_begin_top[si]] =
+            g.s_push_val[si];
+        g.stack_top[si] = g.s_begin_top[si] + 1;
+      } else if (g.s_stack_taken[si]) {
+        g.stack_top[si] = g.s_begin_top[si] - 1;  // a granted POP
+      }
+    }
+  }
+  bool any = false;
+  for (int r = 0; r < W; ++r) {
+    if (in_win[r] >= 0) g.in_rd[r] += 1;
+    if (out_taken[r]) {
+      g.out_buf[(size_t)r * ocap + g.out_wr[r] % ocap] = out_value[r];
+      g.out_wr[r] += 1;
+    }
+    g.tick_count[r] = i32((int64_t)g.tick_count[r] + 1);  // wrap-safe
+    any |= moved[r] != 0;
+  }
+  return any;
+}
+
+// interp_run widened to the group: early exit when NO replica progresses
+// (per-replica quiescence is monotone, so identity steps before the group
+// quiesces preserve bit-identity), tick counters topped up to exactly
+// +ticks, ring counters rebased below the int32 wrap per replica.
+template <class S>
+MISAKA_AI void group_run(Group& g, int ticks) {
+  constexpr int W = kGroupW;
+  const int icap = S::in_cap(g);
+  const int ocap = S::out_cap(g);
+  int executed = 0;
+  for (; executed < ticks;) {
+    ++executed;
+    if (!group_tick<S>(g)) break;
+  }
+  const int remaining = ticks - executed;
+  const int32_t kThreshold = 1 << 30;
+  for (int r = 0; r < W; ++r) {
+    if (remaining)
+      g.tick_count[r] = i32((int64_t)g.tick_count[r] + remaining);
+    if (g.in_rd[r] > kThreshold) {
+      const int32_t base = (g.in_rd[r] / icap) * icap;
+      g.in_rd[r] -= base;
+      g.in_wr[r] -= base;
+    }
+    if (g.out_rd[r] > kThreshold) {
+      const int32_t base = (g.out_rd[r] / ocap) * ocap;
+      g.out_rd[r] -= base;
+      g.out_wr[r] -= base;
+    }
+  }
+}
+
+// One full group serve/idle: validate -> import (transpose batch-major
+// slices into the SoA planes) -> feed -> run -> pack/drain -> export.
+// Mirrors Pool::serve_replica exactly.  Returns 0 on success; any
+// validation or feed-capacity violation returns nonzero BEFORE touching
+// the job arrays, and the caller reruns the whole group down the scalar
+// per-replica path so error codes and partial-failure state semantics
+// stay byte-identical to the shipped engine.
+template <class S>
+MISAKA_AI int group_serve(Group& g, const Job& j, int rep0) {
+  constexpr int W = kGroupW;
+  const int n = S::n_lanes(g);
+  const int ns = S::num_stacks(g);
+  const int scap = S::stack_cap(g);
+  const int icap = S::in_cap(g);
+  const int ocap = S::out_cap(g);
+  const int32_t* plen = S::prog_len(g);
+
+  for (int r = 0; r < W; ++r) {
+    const int rep = rep0 + r;
+    const int32_t* pc = j.pc + (size_t)rep * n;
+    for (int l = 0; l < n; ++l)
+      if (pc[l] < 0 || pc[l] >= plen[l]) return 1;
+    const int32_t* top = j.stack_top + (size_t)rep * ns;
+    for (int s = 0; s < ns; ++s)
+      if (top[s] < 0 || top[s] > scap) return 1;
+    const int32_t* c = j.counters + (size_t)rep * 5;
+    if (c[0] < 0 || c[1] < c[0] || c[1] - c[0] > icap || c[2] < 0 ||
+        c[3] < c[2] || c[3] - c[2] > ocap)
+      return 1;
+    if (j.feeding) {
+      const int count = j.feed_counts[rep];
+      if (count > icap - (c[1] - c[0])) return 1;  // scalar path reports -2
+    }
+  }
+
+  for (int r = 0; r < W; ++r) {
+    const int rep = rep0 + r;
+    const int32_t* a = j.acc + (size_t)rep * n;
+    const int32_t* ah = j.acc_hi + (size_t)rep * n;
+    const int32_t* b = j.bak + (size_t)rep * n;
+    const int32_t* bh = j.bak_hi + (size_t)rep * n;
+    const int32_t* pc = j.pc + (size_t)rep * n;
+    const int32_t* hv = j.hold_val + (size_t)rep * n;
+    const uint8_t* ho = j.holding + (size_t)rep * n;
+    const int32_t* rt = j.retired + (size_t)rep * n;
+    for (int l = 0; l < n; ++l) {
+      const int i = l * W + r;
+      g.acc[i] =
+          (int64_t)(((uint64_t)(uint32_t)ah[l] << 32) | (uint32_t)a[l]);
+      g.bak[i] =
+          (int64_t)(((uint64_t)(uint32_t)bh[l] << 32) | (uint32_t)b[l]);
+      g.pc[i] = pc[l];
+      g.hold_val[i] = hv[l];
+      g.holding[i] = ho[l] ? 1 : 0;
+      g.retired[i] = rt[l];
+    }
+    const int32_t* pv = j.port_val + (size_t)rep * n * kPorts;
+    const uint8_t* pf = j.port_full + (size_t)rep * n * kPorts;
+    for (int x = 0; x < n * kPorts; ++x) {
+      g.port_val[(size_t)x * W + r] = pv[x];
+      g.port_full[(size_t)x * W + r] = pf[x] ? 1 : 0;
+    }
+    const int32_t* st = j.stack_top + (size_t)rep * ns;
+    for (int s = 0; s < ns; ++s) g.stack_top[(size_t)s * W + r] = st[s];
+    // replica-major planes: straight memcpys (above-top stack residue is
+    // never read — pushes land AT the top, pops read below it)
+    std::memcpy(&g.stack_mem[(size_t)r * ns * scap],
+                j.stack_mem + (size_t)rep * ns * scap,
+                (size_t)ns * scap * 4);
+    std::memcpy(&g.in_buf[(size_t)r * icap],
+                j.in_buf + (size_t)rep * icap, (size_t)icap * 4);
+    std::memcpy(&g.out_buf[(size_t)r * ocap],
+                j.out_buf + (size_t)rep * ocap, (size_t)ocap * 4);
+    const int32_t* c = j.counters + (size_t)rep * 5;
+    g.in_rd[r] = c[0];
+    g.in_wr[r] = c[1];
+    g.out_rd[r] = c[2];
+    g.out_wr[r] = c[3];
+    g.tick_count[r] = c[4];
+  }
+
+  if (j.feeding) {
+    for (int r = 0; r < W; ++r) {
+      const int rep = rep0 + r;
+      const int count = j.feed_counts[rep];
+      const int32_t* vals = j.feed_vals + (size_t)rep * icap;
+      for (int k = 0; k < count; ++k) {
+        g.in_buf[(size_t)r * icap + g.in_wr[r] % icap] = vals[k];
+        g.in_wr[r] += 1;
+      }
+    }
+  }
+
+  group_run<S>(g, j.ticks);
+
+  if (j.feeding) {
+    for (int r = 0; r < W; ++r) {
+      int32_t* row = j.packed + (size_t)(rep0 + r) * (4 + ocap);
+      row[0] = g.in_rd[r];
+      row[1] = g.in_wr[r];
+      row[2] = g.out_rd[r];
+      row[3] = g.out_wr[r];
+      std::memcpy(row + 4, &g.out_buf[(size_t)r * ocap],
+                  (size_t)ocap * 4);
+      g.out_rd[r] = g.out_wr[r];  // drain AFTER the snapshot (device parity)
+    }
+  } else {
+    for (int r = 0; r < W; ++r) {
+      int32_t* row = j.packed + (size_t)(rep0 + r) * 4;
+      row[0] = g.in_rd[r];
+      row[1] = g.in_wr[r];
+      row[2] = g.out_rd[r];
+      row[3] = g.out_wr[r];  // idle: counters only, ring untouched
+    }
+  }
+
+  for (int r = 0; r < W; ++r) {
+    const int rep = rep0 + r;
+    int32_t* a = j.acc + (size_t)rep * n;
+    int32_t* ah = j.acc_hi + (size_t)rep * n;
+    int32_t* b = j.bak + (size_t)rep * n;
+    int32_t* bh = j.bak_hi + (size_t)rep * n;
+    int32_t* pc = j.pc + (size_t)rep * n;
+    int32_t* hv = j.hold_val + (size_t)rep * n;
+    uint8_t* ho = j.holding + (size_t)rep * n;
+    int32_t* rt = j.retired + (size_t)rep * n;
+    for (int l = 0; l < n; ++l) {
+      const int i = l * W + r;
+      a[l] = i32(g.acc[i]);
+      ah[l] = (int32_t)(g.acc[i] >> 32);
+      b[l] = i32(g.bak[i]);
+      bh[l] = (int32_t)(g.bak[i] >> 32);
+      pc[l] = g.pc[i];
+      hv[l] = g.hold_val[i];
+      ho[l] = g.holding[i];
+      rt[l] = g.retired[i];
+    }
+    int32_t* pv = j.port_val + (size_t)rep * n * kPorts;
+    uint8_t* pf = j.port_full + (size_t)rep * n * kPorts;
+    for (int x = 0; x < n * kPorts; ++x) {
+      pv[x] = g.port_val[(size_t)x * W + r];
+      pf[x] = g.port_full[(size_t)x * W + r];
+    }
+    int32_t* sm = j.stack_mem + (size_t)rep * ns * scap;
+    int32_t* st = j.stack_top + (size_t)rep * ns;
+    for (int s = 0; s < ns; ++s) {
+      const int32_t top = g.stack_top[(size_t)s * W + r];
+      st[s] = top;
+      // live slots + explicit zero pad above the top (read_state parity)
+      std::memcpy(sm + (size_t)s * scap,
+                  &g.stack_mem[((size_t)r * ns + s) * scap], (size_t)top * 4);
+      std::memset(sm + (size_t)s * scap + top, 0, (size_t)(scap - top) * 4);
+    }
+    std::memcpy(j.in_buf + (size_t)rep * icap,
+                &g.in_buf[(size_t)r * icap], (size_t)icap * 4);
+    std::memcpy(j.out_buf + (size_t)rep * ocap,
+                &g.out_buf[(size_t)r * ocap], (size_t)ocap * 4);
+    int32_t* c = j.counters + (size_t)rep * 5;
+    c[0] = g.in_rd[r];
+    c[1] = g.in_wr[r];
+    c[2] = g.out_rd[r];
+    c[3] = g.out_wr[r];
+    c[4] = g.tick_count[r];
+  }
+  return 0;
+}
+
+// The template instantiated through target wrappers: the avx2 variants get
+// AVX2 codegen for the always-inlined body (runtime-selected), the plain
+// ones are the scalar fallback from the SAME template.
+using GroupServeFn = int (*)(Group&, const Job&, int);
+
+int group_serve_dyn_plain(Group& g, const Job& j, int rep0) {
+  return group_serve<DynSpec>(g, j, rep0);
+}
+#if defined(__x86_64__) || defined(__i386__)
+__attribute__((target("avx2"))) int group_serve_dyn_avx2(Group& g,
+                                                         const Job& j,
+                                                         int rep0) {
+  return group_serve<DynSpec>(g, j, rep0);
+}
+#endif
+#ifdef MISAKA_SPEC
+int group_serve_spec_plain(Group& g, const Job& j, int rep0) {
+  return group_serve<SpecSpec>(g, j, rep0);
+}
+#if defined(__x86_64__) || defined(__i386__)
+__attribute__((target("avx2"))) int group_serve_spec_avx2(Group& g,
+                                                          const Job& j,
+                                                          int rep0) {
+  return group_serve<SpecSpec>(g, j, rep0);
+}
+#endif
+#endif
+
+GroupServeFn pick_group_fn(SimdMode mode, bool specialized) {
+  (void)specialized;
+#ifdef MISAKA_SPEC
+  if (specialized) {
+#if defined(__x86_64__) || defined(__i386__)
+    if (mode == SIMD_AVX2) return group_serve_spec_avx2;
+#endif
+    return group_serve_spec_plain;
+  }
+#endif
+#if defined(__x86_64__) || defined(__i386__)
+  if (mode == SIMD_AVX2) return group_serve_dyn_avx2;
+#endif
+  return group_serve_dyn_plain;
+}
+
+#ifdef MISAKA_SPEC
+// Does the runtime network match the baked one?  A mismatch silently
+// degrades to the generic paths: a stale or mis-keyed cache entry must
+// never execute another program's baked tables.
+bool spec_matches(const int32_t* code, const int32_t* prog_len, int n_lanes,
+                  int max_len, int num_stacks, int stack_cap, int in_cap,
+                  int out_cap) {
+  if (n_lanes != spec::n_lanes || max_len != spec::max_len ||
+      num_stacks != spec::num_stacks || stack_cap != spec::stack_cap ||
+      in_cap != spec::in_cap || out_cap != spec::out_cap)
+    return false;
+  return std::memcmp(code, spec::code,
+                     (size_t)n_lanes * max_len * NFIELDS * 4) == 0 &&
+         std::memcmp(prog_len, spec::prog_len, (size_t)n_lanes * 4) == 0;
+}
+#endif
+
 // --- multi-threaded replica pool: the host THROUGHPUT tier -----------------
 //
 // B independent network replicas (the host analog of the engine's vmap batch
@@ -469,9 +1148,12 @@ void read_state(Interp* it, int32_t* acc, int32_t* bak, int32_t* pc,
 // embarrassingly parallel — the TIS network is deterministic per instance and
 // instances never share ports, stacks, or rings — so one pool_serve call
 // shards the replica range across threads via an atomic index dispenser and
-// barriers before returning.  Each replica's serve iteration mirrors the
-// device batched twins (core/engine.py make_batched_serve), keeping the
-// master's canonical state the NetworkState pytree:
+// barriers before returning.  The dispensed unit is a GROUP of kGroupW
+// replicas on the SIMD path (full groups only — partial groups, the batch
+// remainder, and the whole pool under MISAKA_SIMD=0 go per-replica through
+// the scalar Interp).  Each replica's serve iteration mirrors the device
+// batched twins (core/engine.py make_batched_serve), keeping the master's
+// canonical state the NetworkState pytree:
 //
 //   serve: import slice -> feed -> run ticks -> packed row
 //          [in_rd, in_wr, out_rd, out_wr, out_buf...] -> drain -> export
@@ -487,26 +1169,7 @@ inline int64_t now_ns() {
 }
 
 struct Pool {
-  struct Job {
-    int32_t *acc, *bak, *pc, *port_val;
-    uint8_t* port_full;
-    int32_t* hold_val;
-    uint8_t* holding;
-    int32_t *stack_mem, *stack_top, *in_buf, *out_buf, *counters, *retired;
-    int32_t *acc_hi, *bak_hi;
-    const int32_t* feed_vals;    // [B, in_cap], null when idle
-    const int32_t* feed_counts;  // [B], null when idle
-    int ticks = 0;
-    bool feeding = false;
-    int32_t* packed = nullptr;  // [B, 4+out_cap] serve / [B, 4] idle
-    // Partial-fill fast path: when non-null, ONLY these replica indices
-    // (strictly increasing, validated at the entry point) are imported,
-    // fed, run, and exported — an underfilled serve pass pays for the
-    // replicas actually working, not the whole batch.  The Python caller
-    // prefills skipped replicas' packed rows from their current counters.
-    const int32_t* active = nullptr;
-    int n_active = 0;
-  };
+  using Job = ::Job;
 
   std::vector<Interp*> replicas;
   std::vector<std::thread> workers;
@@ -516,6 +1179,18 @@ struct Pool {
   long job_id = 0;
   int done_threads = 0;
   std::atomic<int> next{0};
+  // SIMD group path (see the group engine above): mode decided once at
+  // creation from MISAKA_SIMD + CPU detection; scratch_groups holds ONE
+  // SoA scratch per worker thread (the pool is stateless between calls,
+  // so a group is pure scratch); units is the per-job work list the
+  // dispenser hands out — group units for full kGroupW-aligned active
+  // blocks, per-replica scalar units for everything else.
+  struct Unit { int32_t kind; int32_t idx; };  // kind: 0 replica, 1 group
+  SimdMode simd_mode = SIMD_OFF;
+  bool specialized = false;
+  GroupServeFn group_fn = nullptr;
+  std::vector<Group*> scratch_groups;
+  std::vector<Unit> units;
   // Per-replica result codes (each slot written by exactly one worker):
   // run_job reports the LOWEST-INDEX failure, so a mixed-failure batch
   // raises the same Python exception on every run instead of whichever
@@ -541,6 +1216,23 @@ struct Pool {
     cv_work.notify_all();
     for (auto& w : workers) w.join();
     for (auto* it : replicas) delete it;
+    for (auto* g : scratch_groups) delete g;
+  }
+
+  void serve_unit(const Unit& u, int tid) {
+    if (u.kind == 0) {
+      rep_rc[u.idx] = serve_replica(u.idx);
+      return;
+    }
+    const int rep0 = u.idx * kGroupW;
+    if (group_fn(*scratch_groups[tid], job, rep0) != 0) {
+      // validation/feed-capacity violation: rerun the whole group down
+      // the scalar path so per-replica error codes and untouched-state
+      // semantics match the shipped engine exactly (the group path
+      // bailed before writing anything back)
+      for (int r = 0; r < kGroupW; ++r)
+        rep_rc[rep0 + r] = serve_replica(rep0 + r);
+    }
   }
 
   void worker_main(int tid) {
@@ -556,11 +1248,9 @@ struct Pool {
         seen = job_id;
       }
       const int64_t t_work = now_ns();
-      const int n = job.active ? job.n_active : (int)replicas.size();
-      for (int r; (r = next.fetch_add(1)) < n;) {
-        const int rep = job.active ? job.active[r] : r;
-        rep_rc[rep] = serve_replica(rep);
-      }
+      const int n = (int)units.size();
+      for (int u; (u = next.fetch_add(1)) < n;)
+        serve_unit(units[u], tid);
       busy_ns[tid].fetch_add(now_ns() - t_work, std::memory_order_relaxed);
       {
         std::lock_guard<std::mutex> lk(mu);
@@ -621,6 +1311,37 @@ struct Pool {
     return 0;
   }
 
+  // Build the per-job work list: full kGroupW-aligned blocks of active
+  // replicas become group units when the SIMD path is armed; everything
+  // else (batch remainder, partial groups under partial fill, the whole
+  // pool under MISAKA_SIMD=0) goes per-replica through the scalar Interp.
+  void build_units() {
+    units.clear();
+    const int B = (int)replicas.size();
+    const bool grouped = group_fn != nullptr;
+    if (job.active == nullptr) {
+      const int ng = grouped ? B / kGroupW : 0;
+      for (int g = 0; g < ng; ++g) units.push_back({1, g});
+      for (int r = ng * kGroupW; r < B; ++r) units.push_back({0, r});
+      return;
+    }
+    int i = 0;
+    while (i < job.n_active) {
+      const int r = job.active[i];
+      const int g = r / kGroupW;
+      // strictly-increasing active + matching endpoints == the whole
+      // aligned block is present
+      if (grouped && r == g * kGroupW && i + kGroupW <= job.n_active &&
+          job.active[i + kGroupW - 1] == g * kGroupW + kGroupW - 1) {
+        units.push_back({1, g});
+        i += kGroupW;
+      } else {
+        units.push_back({0, r});
+        ++i;
+      }
+    }
+  }
+
   int run_job() {
     const int n = job.active ? job.n_active : (int)replicas.size();
     // Serial fast path: a small pass (the partial-fill serving case — a
@@ -628,6 +1349,7 @@ struct Pool {
     // The parallel path costs a notify_all + done-barrier round trip
     // across every worker (~0.3-0.5ms of futex churn on a 24-thread
     // pool), which dwarfs the work itself below a handful of replicas.
+    // (n <= 4 < kGroupW, so this path never sees a group unit.)
     if (n <= 4) {
       const int64_t t_work = now_ns();
       int rc = 0;
@@ -639,6 +1361,7 @@ struct Pool {
       serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
       return rc;
     }
+    build_units();
     {
       std::lock_guard<std::mutex> lk(mu);
       next.store(0);
@@ -776,10 +1499,53 @@ void* misaka_pool_create(const int32_t* code, const int32_t* prog_len,
   if (n_threads > n_replicas) n_threads = n_replicas;
   p->busy_ns = std::vector<std::atomic<int64_t>>(n_threads);
   p->idle_ns = std::vector<std::atomic<int64_t>>(n_threads);
+  // SIMD group path: armed when the kill switch allows it and the batch
+  // has at least one full group; specialized tick functions additionally
+  // require the runtime tables to MATCH the baked ones (a mismatched
+  // specialized .so degrades to the generic group path, never corrupts).
+  p->simd_mode = simd_mode_from_env();
+  if (p->simd_mode != SIMD_OFF && n_replicas >= kGroupW) {
+#ifdef MISAKA_SPEC
+    p->specialized = spec_matches(code, prog_len, n_lanes, max_len,
+                                  p->replicas[0]->num_stacks, stack_cap,
+                                  in_cap, out_cap);
+#endif
+    p->group_fn = pick_group_fn(p->simd_mode, p->specialized);
+    p->scratch_groups.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t)
+      p->scratch_groups.push_back(new Group(
+          p->replicas[0]->code.data(), p->replicas[0]->prog_len.data(),
+          n_lanes, max_len, p->replicas[0]->num_stacks, stack_cap, in_cap,
+          out_cap));
+  } else {
+    p->simd_mode = SIMD_OFF;
+  }
   p->workers.reserve(n_threads);
   for (int t = 0; t < n_threads; ++t)
     p->workers.emplace_back([p, t] { p->worker_main(t); });
   return p;
+}
+
+// SIMD/specialization introspection for the metrics plane: out[0] = group
+// width (kGroupW when the group path is armed, 0 when the pool runs the
+// scalar per-replica path), out[1] = 1 when the AVX2 instantiation is
+// selected (0 = the generic fallback from the same template), out[2] = 1
+// when the pool executes per-program specialized tick functions.
+void misaka_pool_simd_info(void* h, int32_t* out /*[3]*/) {
+  auto* p = (Pool*)h;
+  out[0] = p->simd_mode == SIMD_OFF ? 0 : kGroupW;
+  out[1] = p->simd_mode == SIMD_AVX2 ? 1 : 0;
+  out[2] = p->specialized ? 1 : 0;
+}
+
+// The specialization content key baked into this build ("" = the generic
+// shipped library).  core/specialize.py keys its on-disk cache on this.
+const char* misaka_spec_key(void) {
+#ifdef MISAKA_SPEC
+  return spec::key;
+#else
+  return "";
+#endif
 }
 
 void misaka_pool_destroy(void* h) { delete (Pool*)h; }

@@ -972,6 +972,13 @@ def test_fleet_kill9_and_roll_under_load_zero_errors(tmp_path):
                 after = {k: z[k].copy() for k in z.files}
             assert set(after) == set(before[i])
             for k in after:
+                if k == "__tsdb__":
+                    # the retained metric history (utils/tsdb.py, r15)
+                    # rides checkpoints so /debug/series SURVIVES the
+                    # roll — and it keeps accumulating samples across it
+                    # by design.  Only the NETWORK state is bit-pinned;
+                    # the history's presence is the contract here.
+                    continue
                 assert np.array_equal(after[k], before[i][k]), (
                     f"replica {i} array {k!r} changed across the roll"
                 )

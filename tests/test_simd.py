@@ -1,0 +1,418 @@
+"""SIMD struct-of-arrays interpreter + per-program specialization (ISSUE 12).
+
+The differential corpus for the group engine (native/interpreter.cpp): the
+pool's three execution ladders — AVX2 group ticks, the generic group
+fallback (`MISAKA_SIMD=generic`, the forced no-AVX2 rung), and the shipped
+scalar per-replica path (`MISAKA_SIMD=0`) — must be BIT-IDENTICAL to each
+other and to the XLA batched serve twins, including tick counts,
+partial-fill active lists, and checkpoint/restore round trips through a
+specialized engine.  Per-program specialization (core/specialize.py) must
+engage when armed, fall back gracefully on compile failure (the
+`specialize_fail` chaos point), and never change results.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.core import native_serve, specialize
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.runtime.master import MasterNode
+from misaka_tpu.runtime.registry import ProgramRegistry
+from misaka_tpu.runtime.topology import Topology
+from misaka_tpu.utils import faults
+
+pytestmark = pytest.mark.skipif(
+    not native_serve.available(), reason="native interpreter unavailable (no g++)"
+)
+
+SMALL = dict(stack_cap=8, in_cap=16, out_cap=16)
+
+# Control-flow DIVERGENCE across replicas in one SIMD group: the branch a
+# replica takes depends on its input's sign, so the 8 lanes of a group run
+# different instructions at the same tick — the exact shape a masked/SoA
+# rewrite gets wrong if arbitration or commit leaks across the replica axis.
+DIVERGE = Topology(
+    node_info={"p": "program"},
+    programs={
+        "p": (
+            "IN ACC\n"
+            "JGZ pos\n"
+            "JLZ neg\n"
+            "OUT 0\n"
+            "JMP end\n"
+            "pos: ADD 100\n"
+            "OUT ACC\n"
+            "JMP end\n"
+            "neg: NEG\n"
+            "OUT ACC\n"
+            "end: NOP"
+        )
+    },
+    **SMALL,
+)
+
+
+def topologies():
+    return {
+        "add2": networks.add2(**SMALL),
+        "acc_loop": networks.acc_loop(**SMALL),
+        "ring4": networks.ring(4, **SMALL),
+        "diverge": DIVERGE,
+    }
+
+
+def state_dict(state: NetworkState) -> dict:
+    return {f: np.asarray(getattr(state, f)) for f in NetworkState._fields}
+
+
+def assert_state_equal(a: dict, b: dict, msg: str = ""):
+    for f, av in a.items():
+        np.testing.assert_array_equal(av, b[f], err_msg=f"{msg}: field {f}")
+
+
+def run_schedule(net, mode: str | None, rounds: int = 8, spec: str | None = None,
+                 threads: int = 6, seed: int = 3, active_fn=None):
+    """One deterministic feed schedule through a NativeServePool under the
+    given MISAKA_SIMD mode; returns (final state dict, [packed/ctr rows]).
+    The schedule's randomness depends only on the seed, and ring headroom
+    depends only on prior state — identical across modes by induction."""
+    B = net.batch
+    prev = os.environ.get("MISAKA_SIMD")
+    if mode is None:
+        os.environ.pop("MISAKA_SIMD", None)
+    else:
+        os.environ["MISAKA_SIMD"] = mode
+    try:
+        pool = native_serve.NativeServePool(
+            net, chunk_steps=64, threads=threads, specialized=spec
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("MISAKA_SIMD", None)
+        else:
+            os.environ["MISAKA_SIMD"] = prev
+    rng = np.random.default_rng(seed)
+    state = net.init_state()
+    rows = []
+    try:
+        for it in range(rounds):
+            if it % 4 == 3:
+                state, ctrs = pool.idle(state, 32)
+                rows.append(np.asarray(ctrs).copy())
+                continue
+            free = net.in_cap - (
+                np.asarray(state.in_wr) - np.asarray(state.in_rd)
+            )
+            counts = np.minimum(
+                rng.integers(0, net.in_cap + 1, size=B), free
+            ).astype(np.int32)
+            vals = rng.integers(
+                np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+                size=(B, net.in_cap), dtype=np.int64,
+            ).astype(np.int32)  # full int32 range: wrap arithmetic included
+            active = active_fn(it, counts) if active_fn else None
+            if active is not None:
+                mask = np.zeros((B,), bool)
+                mask[active] = True
+                counts[~mask] = 0
+            state, packed = pool.serve(state, vals, counts, active=active)
+            packed = np.asarray(packed).copy()
+            if active is not None:
+                # skipped rows carry ONLY their counters (columns 4+ are
+                # np.empty garbage by contract) — blank them for comparison
+                skipped = np.ones((B,), bool)
+                skipped[active] = False
+                packed[skipped, 4:] = 0
+            rows.append(packed)
+        return state_dict(state), rows
+    finally:
+        pool.close()
+
+
+@pytest.mark.parametrize("name", sorted(topologies()))
+def test_simd_generic_scalar_bit_identity(name):
+    """AVX2 group path vs generic group fallback vs scalar per-replica
+    path: full-state bit-identity (tick counts included) over a mixed
+    serve/idle schedule on a batch with both full groups and a scalar
+    remainder (B=19 -> 2 group units + 3 stragglers)."""
+    net = topologies()[name].compile(batch=19)
+    d_auto, rows_auto = run_schedule(net, None)
+    d_gen, rows_gen = run_schedule(net, "generic")
+    d_off, rows_off = run_schedule(net, "0")
+    assert_state_equal(d_auto, d_gen, f"{name}: avx2 vs generic")
+    assert_state_equal(d_auto, d_off, f"{name}: simd vs scalar")
+    for i, (ra, rb, rc) in enumerate(zip(rows_auto, rows_gen, rows_off)):
+        np.testing.assert_array_equal(ra, rb, err_msg=f"{name} row {i}")
+        np.testing.assert_array_equal(ra, rc, err_msg=f"{name} row {i}")
+
+
+def test_partial_fill_active_lists_parity():
+    """Active lists covering full groups, partial groups, and stragglers:
+    the unit builder must route each correctly (group vs scalar) with
+    results identical to the all-scalar path."""
+    net = topologies()["add2"].compile(batch=24)
+
+    def actives(it, counts):
+        return [
+            None,                                   # full batch
+            list(range(0, 8)),                      # exactly one group
+            list(range(0, 12)),                     # group + partial
+            [1, 3, 8, 9, 10, 11, 12, 13, 14, 15, 23],  # stragglers + group
+            [17],                                   # serial fast path
+            list(range(8, 24)),                     # two aligned groups
+        ][it % 6]
+
+    d_simd, rows_simd = run_schedule(net, None, rounds=12, active_fn=actives)
+    d_off, rows_off = run_schedule(net, "0", rounds=12, active_fn=actives)
+    assert_state_equal(d_simd, d_off, "partial fill")
+    for i, (ra, rb) in enumerate(zip(rows_simd, rows_off)):
+        np.testing.assert_array_equal(ra, rb, err_msg=f"row {i}")
+
+
+def test_forced_fallback_reports_and_matches():
+    """Feature detection forced off (MISAKA_SIMD=generic): the pool must
+    report the scalar-codegen group path (width 8, avx2 False) and produce
+    the same outputs — the no-AVX2 ladder rung exercised on any box."""
+    net = topologies()["acc_loop"].compile(batch=16)
+    prev = os.environ.get("MISAKA_SIMD")
+    os.environ["MISAKA_SIMD"] = "generic"
+    try:
+        pool = native_serve.NativeServePool(net, chunk_steps=32)
+        info = pool.simd_info()
+        pool.close()
+    finally:
+        if prev is None:
+            os.environ.pop("MISAKA_SIMD", None)
+        else:
+            os.environ["MISAKA_SIMD"] = prev
+    assert info == {"width": 8, "avx2": False, "specialized": False}
+    # and the kill switch reports the scalar path
+    os.environ["MISAKA_SIMD"] = "0"
+    try:
+        pool = native_serve.NativeServePool(net, chunk_steps=32)
+        assert pool.simd_info()["width"] == 0
+        pool.close()
+    finally:
+        if prev is None:
+            os.environ.pop("MISAKA_SIMD", None)
+        else:
+            os.environ["MISAKA_SIMD"] = prev
+
+
+def masked_stack(arr, top):
+    col = np.arange(arr.shape[-1])
+    return np.where(col[None, None, :] < top[:, :, None], arr, 0)
+
+
+def test_simd_vs_xla_batched_twins():
+    """Three-way: the SIMD group path vs the jitted XLA batched serve
+    twins, at a batch wide enough for full groups (the pre-existing
+    pool-vs-XLA pin runs B=4, all-scalar units).  Tick counts included."""
+    B = 16
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile(batch=B)
+    serve_fn, idle_fn = net.make_batched_serve(None, 16)
+    pool = native_serve.NativeServePool(net, chunk_steps=16, threads=6)
+    assert pool.simd_info()["width"] == 8  # the group path is live
+    s_dev, s_nat = net.init_state(), net.init_state()
+    rng = np.random.default_rng(11)
+    try:
+        for it in range(10):
+            if it % 4 == 3:
+                s_dev, c_dev = idle_fn(s_dev)
+                s_nat, c_nat = pool.idle(s_nat)
+                np.testing.assert_array_equal(np.asarray(c_dev), c_nat)
+            else:
+                free = net.in_cap - (
+                    np.asarray(s_nat.in_wr) - np.asarray(s_nat.in_rd)
+                )
+                counts = np.minimum(
+                    rng.integers(0, 6, size=B), free
+                ).astype(np.int32)
+                vals = np.zeros((B, net.in_cap), np.int32)
+                for b in range(B):
+                    vals[b, : counts[b]] = rng.integers(
+                        -1000, 1000, size=counts[b]
+                    )
+                s_dev, p_dev = serve_fn(s_dev, vals, counts)
+                s_nat, p_nat = pool.serve(s_nat, vals, counts)
+                np.testing.assert_array_equal(
+                    np.asarray(p_dev), p_nat, err_msg=f"iter {it}"
+                )
+            a, b = state_dict(s_dev), state_dict(s_nat)
+            for f in NetworkState._fields:
+                if f == "stack_mem":
+                    np.testing.assert_array_equal(
+                        masked_stack(a[f], a["stack_top"]),
+                        masked_stack(b[f], b["stack_top"]),
+                        err_msg=f"iter {it}: stack_mem",
+                    )
+                else:
+                    np.testing.assert_array_equal(
+                        a[f], b[f], err_msg=f"iter {it}: {f}"
+                    )
+    finally:
+        pool.close()
+
+
+# --- per-program specialization ---------------------------------------------
+
+
+def test_specialized_engages_and_matches(tmp_path):
+    """A specialized build must engage (simd_info) and stay bit-identical
+    to the generic group path and the scalar path; the second build of the
+    same content is a cache hit."""
+    net = topologies()["add2"].compile(batch=16)
+    so = specialize.build(net, cache_dir=str(tmp_path))
+    assert so is not None and os.path.exists(so)
+    built = specialize.M_SPECIALIZE.labels(status="built").value
+    hits = specialize.M_SPECIALIZE.labels(status="hit").value
+    assert specialize.build(net, cache_dir=str(tmp_path)) == so
+    assert specialize.M_SPECIALIZE.labels(status="built").value == built
+    assert specialize.M_SPECIALIZE.labels(status="hit").value == hits + 1
+
+    prev = os.environ.get("MISAKA_SIMD")
+    os.environ.pop("MISAKA_SIMD", None)
+    try:
+        pool = native_serve.NativeServePool(net, chunk_steps=32, specialized=so)
+        info = pool.simd_info()
+        pool.close()
+    finally:
+        if prev is not None:
+            os.environ["MISAKA_SIMD"] = prev
+    assert info["specialized"] and info["width"] == 8
+
+    d_spec, rows_spec = run_schedule(net, None, spec=so)
+    d_gen, rows_gen = run_schedule(net, None)
+    d_off, rows_off = run_schedule(net, "0")
+    assert_state_equal(d_spec, d_gen, "spec vs generic")
+    assert_state_equal(d_spec, d_off, "spec vs scalar")
+    for i, (ra, rb, rc) in enumerate(zip(rows_spec, rows_gen, rows_off)):
+        np.testing.assert_array_equal(ra, rb, err_msg=f"row {i}")
+        np.testing.assert_array_equal(ra, rc, err_msg=f"row {i}")
+
+
+def test_mismatched_specialization_degrades(tmp_path):
+    """A spec .so keyed for ANOTHER program must load but NOT engage (the
+    C++ side memcmps the baked tables) — and still compute correctly via
+    the generic group path."""
+    net_a = topologies()["add2"].compile(batch=16)
+    net_b = topologies()["acc_loop"].compile(batch=16)
+    so_a = specialize.build(net_a, cache_dir=str(tmp_path))
+    assert so_a is not None
+    fallback = specialize.M_SPECIALIZE.labels(status="fallback").value
+    pool = native_serve.NativeServePool(net_b, chunk_steps=32, specialized=so_a)
+    try:
+        assert not pool.simd_info()["specialized"]
+        assert specialize.M_SPECIALIZE.labels(
+            status="fallback"
+        ).value == fallback + 1
+    finally:
+        pool.close()
+    d_mis, _ = run_schedule(net_b, None, spec=so_a, seed=9)
+    d_ok, _ = run_schedule(net_b, "0", seed=9)
+    assert_state_equal(d_mis, d_ok, "mismatched spec")
+
+
+def test_specialized_checkpoint_roundtrip(tmp_path):
+    """Checkpoint/restore through a SPECIALIZED engine: state saved from a
+    specialized master restores bit-identically into a fresh specialized
+    master AND into a scalar-path master, and the continuation stream
+    matches (the delay-line shape: outputs prove the restored state)."""
+    topo = Topology(
+        node_info={"p": "program"},
+        programs={"p": "IN ACC\nSWP\nOUT ACC\nSWP\nSAV\n"},  # delay line
+        **SMALL,
+    )
+    spec_dir = str(tmp_path / "spec")
+    masters = {}
+
+    def build_master(spec: bool):
+        prev = os.environ.get("MISAKA_SIMD")
+        if not spec:
+            os.environ["MISAKA_SIMD"] = "0"
+        try:
+            m = MasterNode(
+                topo, chunk_steps=32, batch=16, engine="native",
+                native_spec_dir=spec_dir if spec else None,
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("MISAKA_SIMD", None)
+            else:
+                os.environ["MISAKA_SIMD"] = prev
+        return m
+
+    m_spec = build_master(spec=True)
+    assert m_spec._runner.simd_info()["specialized"]
+    # the /status observability block: execution ladder + cache outcomes
+    native = m_spec.status()["native"]
+    assert native["specialized"] and native["width"] == 8
+    assert set(native["specialize_cache"]) == {
+        "hit", "built", "error", "fallback", "disabled"
+    }
+    masters["spec"] = m_spec
+    try:
+        m_spec.run()
+        first = m_spec.compute_many(list(range(1, 33)))
+        ckpt = str(tmp_path / "spec.npz")
+        m_spec.pause()
+        m_spec.save_checkpoint(ckpt)
+
+        for label, spec in (("spec2", True), ("scalar", False)):
+            m2 = build_master(spec=spec)
+            masters[label] = m2
+            m2.load_checkpoint(ckpt)
+            # restored state is bit-identical to the checkpointed master's
+            assert_state_equal(
+                state_dict(m2._state), state_dict(m_spec._state),
+                f"restore into {label}",
+            )
+            m2.run()
+            cont = m2.compute_many([100, 200, 300])
+            m2.pause()
+            # the delay line's continuation proves live state: the first
+            # restored output is the LAST pre-checkpoint input
+            assert list(cont) == [32, 100, 200], (label, list(cont))
+        assert list(first) == [0] + list(range(1, 32))
+    finally:
+        for m in masters.values():
+            m.close()
+
+
+def test_specialize_fail_chaos_graceful_fallback(tmp_path):
+    """The specialize_fail fault at the compile site: registry activation
+    must SUCCEED on the generic interpreter, the failure must count on
+    misaka_native_specialize_total{status="error"}, and clients see zero
+    errors."""
+    errors = specialize.M_SPECIALIZE.labels(status="error").value
+    faults.configure("specialize_fail")
+    try:
+        reg = ProgramRegistry(
+            str(tmp_path), batch=16, engine="native", chunk_steps=32,
+            caps=SMALL,
+        )
+        try:
+            reg.publish("victim", tis="IN ACC\nADD 7\nOUT ACC\n")
+            with reg.lease("victim", values=3) as m:
+                out = m.compute_many([1, 2, 3])
+                assert list(out) == [8, 9, 10]
+                assert not m._runner.simd_info()["specialized"]
+        finally:
+            reg.close()
+    finally:
+        faults.configure(None)
+    assert specialize.M_SPECIALIZE.labels(status="error").value > errors
+    # disarmed again: the same store now specializes on reactivation
+    reg = ProgramRegistry(
+        str(tmp_path), batch=16, engine="native", chunk_steps=32, caps=SMALL,
+    )
+    try:
+        with reg.lease("victim", values=3) as m:
+            out = m.compute_many([4, 5, 6])
+            assert list(out) == [11, 12, 13]
+            assert m._runner.simd_info()["specialized"]
+    finally:
+        reg.close()

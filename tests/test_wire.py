@@ -1,0 +1,330 @@
+"""The zero-copy wire (ISSUE 12 layer 3): the headered binary client
+protocol on /compute_raw (utils/wire.py, negotiated via
+Content-Type/Accept, the client default) and the shared-memory compute
+plane (MISAKA_PLANE_SHM=1 — payloads ride a per-connection segment, the
+socket keeps the frame headers, handshake, drain, and probe semantics).
+"""
+
+import http.client
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.client import MisakaClient
+from misaka_tpu.runtime import frontends
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.utils import wire
+
+SMALL = dict(stack_cap=16, in_cap=16, out_cap=16)
+
+
+# --- the protocol itself ----------------------------------------------------
+
+
+def test_pack_unpack_roundtrip():
+    payload = np.arange(-8, 8, dtype="<i4").tobytes()
+    framed = wire.pack(payload)
+    assert len(framed) == wire.HEADER_LEN + len(payload)
+    assert wire.unpack(framed) == payload
+    assert wire.unpack(wire.pack(b"")) == b""
+
+
+@pytest.mark.parametrize("body,msg", [
+    (b"", "shorter than"),
+    (b"\x00" * 12, "bad magic"),
+    (wire.header(5) + b"\x00" * 8, "promises 5 values"),
+    (b"MSK1" + b"\x63\x00\x00\x00" + b"\x00\x00\x00\x00", "version"),
+    (wire.pack(np.arange(3, dtype="<i4").tobytes())[:-1], "payload bytes"),
+])
+def test_unpack_rejects_malformed(body, msg):
+    with pytest.raises(wire.WireError, match=msg):
+        wire.unpack(body)
+
+
+def test_pack_rejects_ragged_payload():
+    with pytest.raises(wire.WireError):
+        wire.pack(b"\x01\x02\x03")
+
+
+def test_negotiation_helpers():
+    assert wire.is_binary(wire.CONTENT_TYPE)
+    assert wire.is_binary(wire.CONTENT_TYPE + "; charset=binary")
+    assert not wire.is_binary("application/octet-stream")
+    assert not wire.is_binary(None)
+    assert wire.accepts_binary(f"text/plain, {wire.CONTENT_TYPE}")
+    assert not wire.accepts_binary("*/*")
+    assert not wire.accepts_binary(None)
+
+
+# --- the HTTP surface -------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    top = networks.add2(**SMALL)
+    master = MasterNode(top, chunk_steps=32, batch=2, engine="scan")
+    httpd = make_http_server(master, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    master.run()
+    try:
+        yield master, httpd.server_address[1]
+    finally:
+        master.pause()
+        httpd.shutdown()
+        master.close()
+
+
+def _post(port, body, headers=None, path="/compute_raw?spread=1"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", path, body, headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.getheader("Content-Type"), resp.read()
+    finally:
+        conn.close()
+
+
+def test_binary_request_and_response(server):
+    _, port = server
+    vals = np.arange(-5, 6, dtype="<i4")
+    status, ctype, raw = _post(
+        port, wire.pack(vals.tobytes()),
+        {"Content-Type": wire.CONTENT_TYPE, "Accept": wire.CONTENT_TYPE},
+    )
+    assert status == 200 and ctype == wire.CONTENT_TYPE
+    out = np.frombuffer(wire.unpack(raw), "<i4")
+    np.testing.assert_array_equal(out, vals + 2)
+
+
+def test_binary_request_legacy_response(server):
+    # Content-Type negotiates the request form; without the Accept the
+    # response stays the legacy headerless raw bytes
+    _, port = server
+    vals = np.arange(4, dtype="<i4")
+    status, ctype, raw = _post(
+        port, wire.pack(vals.tobytes()), {"Content-Type": wire.CONTENT_TYPE}
+    )
+    assert status == 200 and ctype == "application/octet-stream"
+    np.testing.assert_array_equal(np.frombuffer(raw, "<i4"), vals + 2)
+
+
+def test_legacy_raw_unchanged(server):
+    _, port = server
+    vals = np.arange(4, dtype="<i4")
+    status, ctype, raw = _post(port, vals.tobytes())
+    assert status == 200 and ctype == "application/octet-stream"
+    np.testing.assert_array_equal(np.frombuffer(raw, "<i4"), vals + 2)
+
+
+def test_malformed_binary_body_is_typed_400(server):
+    _, port = server
+    status, _, body = _post(
+        port, wire.header(99) + b"\x00" * 4,
+        {"Content-Type": wire.CONTENT_TYPE},
+    )
+    assert status == 400 and b"bad binary body" in body
+    # and the server keeps serving (the error consumed the body)
+    vals = np.arange(3, dtype="<i4")
+    status, _, raw = _post(port, vals.tobytes())
+    assert status == 200
+    np.testing.assert_array_equal(np.frombuffer(raw, "<i4"), vals + 2)
+
+
+def test_client_negotiates_binary_by_default(server):
+    _, port = server
+    c = MisakaClient(f"http://127.0.0.1:{port}", timeout=30)
+    try:
+        assert c.healthz()["wire_binary"] is True
+        vals = np.arange(-20, 20, dtype=np.int32)
+        out = c.compute_batch(vals)  # rides the binary /compute_raw lane
+        np.testing.assert_array_equal(np.asarray(out), vals + 2)
+        assert c._wire_binary is True  # the probe latched binary
+        out = c.compute_raw(vals[:7])
+        np.testing.assert_array_equal(np.asarray(out), vals[:7] + 2)
+    finally:
+        c.close()
+
+
+def test_client_text_mode_keeps_legacy_lane(server):
+    _, port = server
+    c = MisakaClient(f"http://127.0.0.1:{port}", timeout=30, wire="text")
+    try:
+        vals = np.arange(5, dtype=np.int32)
+        out = c.compute_batch(vals)
+        np.testing.assert_array_equal(np.asarray(out), vals + 2)
+        assert c._wire_binary is False
+    finally:
+        c.close()
+
+
+def test_client_probe_failure_latches_text():
+    # no server at all: the capability probe must fail SAFE (text), never
+    # raise out of the probe itself
+    c = MisakaClient("http://127.0.0.1:1", timeout=0.2, connect_retries=0)
+    assert c._use_binary_wire() is False
+
+
+# --- the shared-memory plane ------------------------------------------------
+
+
+@pytest.fixture()
+def shm_plane(tmp_path):
+    top = networks.add2(**SMALL)
+    master = MasterNode(top, chunk_steps=32, batch=4, engine="scan")
+    plane = frontends.start_compute_plane(master, str(tmp_path / "p.sock"))
+    master.run()
+    try:
+        yield master, plane
+    finally:
+        plane.close()
+        master.pause()
+        master.close()
+
+
+def _with_shm_env(value):
+    prev = os.environ.get("MISAKA_PLANE_SHM")
+    if value is None:
+        os.environ.pop("MISAKA_PLANE_SHM", None)
+    else:
+        os.environ["MISAKA_PLANE_SHM"] = value
+
+    def restore():
+        if prev is None:
+            os.environ.pop("MISAKA_PLANE_SHM", None)
+        else:
+            os.environ["MISAKA_PLANE_SHM"] = prev
+
+    return restore
+
+
+def test_shm_plane_serves_and_counts(shm_plane):
+    master, plane = shm_plane
+    restore = _with_shm_env("1")
+    try:
+        before = frontends.M_PLANE_SHM_FRAMES.value
+        client = frontends.PlaneClient(plane.path, conns=1)
+        try:
+            for k in range(5):
+                vals = (np.arange(12, dtype=np.int32) + 100 * k)
+                out = client.compute_raw(
+                    np.ascontiguousarray(vals, "<i4").tobytes()
+                )
+                np.testing.assert_array_equal(
+                    np.frombuffer(out, "<i4"), vals + 2
+                )
+        finally:
+            client.close()
+        assert frontends.M_PLANE_SHM_FRAMES.value >= before + 5
+    finally:
+        restore()
+
+
+def test_shm_plane_default_off(shm_plane):
+    master, plane = shm_plane
+    restore = _with_shm_env(None)
+    try:
+        before = frontends.M_PLANE_SHM_FRAMES.value
+        client = frontends.PlaneClient(plane.path, conns=1)
+        try:
+            vals = np.arange(8, dtype=np.int32)
+            out = client.compute_raw(
+                np.ascontiguousarray(vals, "<i4").tobytes()
+            )
+            np.testing.assert_array_equal(np.frombuffer(out, "<i4"), vals + 2)
+        finally:
+            client.close()
+        # shipped behavior: zero shm frames without the flag
+        assert frontends.M_PLANE_SHM_FRAMES.value == before
+    finally:
+        restore()
+
+
+def test_shm_plane_preserves_drain_semantics(shm_plane):
+    master, plane = shm_plane
+    restore = _with_shm_env("1")
+    try:
+        client = frontends.PlaneClient(plane.path, conns=1)
+        try:
+            vals = np.arange(6, dtype=np.int32)
+            body = np.ascontiguousarray(vals, "<i4").tobytes()
+            out = client.compute_raw(body)  # arm the shm path first
+            np.testing.assert_array_equal(np.frombuffer(out, "<i4"), vals + 2)
+            plane.set_draining(True)
+            # a single-engine PlaneClient maps the drain status to 503
+            with pytest.raises(frontends.PlaneError) as e:
+                client.compute_raw(body)
+            assert e.value.status == 503
+            plane.set_draining(False)
+            out = client.compute_raw(body)
+            np.testing.assert_array_equal(np.frombuffer(out, "<i4"), vals + 2)
+        finally:
+            client.close()
+    finally:
+        restore()
+
+
+def test_shm_rearms_with_fresh_segment_after_restart(tmp_path):
+    """A replica restart between frames: the stale-socket replay must
+    re-arm on the NEW connection with a FRESH segment (never reusing the
+    old one — a stale engine handler may still map it) and the request
+    succeeds with zero client-visible errors."""
+    top = networks.add2(**SMALL)
+    path = str(tmp_path / "p.sock")
+    m1 = MasterNode(top, chunk_steps=32, batch=4, engine="scan")
+    p1 = frontends.start_compute_plane(m1, path)
+    m1.run()
+    restore = _with_shm_env("1")
+    try:
+        client = frontends.PlaneClient(path, conns=1)
+        try:
+            vals = np.arange(10, dtype=np.int32)
+            body = np.ascontiguousarray(vals, "<i4").tobytes()
+            out = client.compute_raw(body)
+            np.testing.assert_array_equal(np.frombuffer(out, "<i4"), vals + 2)
+            # "restart": sever the plane, bring a twin up on the same path
+            p1.close()
+            m1.pause()
+            m2 = MasterNode(top, chunk_steps=32, batch=4, engine="scan")
+            p2 = frontends.start_compute_plane(m2, path)
+            m2.run()
+            try:
+                before = frontends.M_PLANE_SHM_FRAMES.value
+                out = client.compute_raw(body)  # replay + re-arm
+                np.testing.assert_array_equal(
+                    np.frombuffer(out, "<i4"), vals + 2
+                )
+                assert frontends.M_PLANE_SHM_FRAMES.value >= before + 1
+            finally:
+                p2.close()
+                m2.pause()
+                m2.close()
+        finally:
+            client.close()
+    finally:
+        restore()
+        m1.close()
+
+
+def test_shm_armed_engine_still_accepts_socket_frames(shm_plane):
+    # the transports can mix on one plane: a second, shm-less client
+    # keeps socket payloads while the first rides the segment
+    master, plane = shm_plane
+    restore = _with_shm_env("1")
+    try:
+        shm_client = frontends.PlaneClient(plane.path, conns=1)
+    finally:
+        restore()
+    plain_client = frontends.PlaneClient(plane.path, conns=1)
+    try:
+        for client in (shm_client, plain_client, shm_client):
+            vals = np.arange(9, dtype=np.int32)
+            out = client.compute_raw(
+                np.ascontiguousarray(vals, "<i4").tobytes()
+            )
+            np.testing.assert_array_equal(np.frombuffer(out, "<i4"), vals + 2)
+    finally:
+        shm_client.close()
+        plain_client.close()

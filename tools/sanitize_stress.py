@@ -78,8 +78,45 @@ def build_sanitized_so(kind: str) -> str:
     return so
 
 
+def build_sanitized_spec_so(kind: str) -> str | None:
+    """An INSTRUMENTED per-program specialized build of the scenario's
+    network (core/specialize.py with the sanitizer's flags via
+    MISAKA_SPEC_CXXFLAGS): the specialized tick paths get the same
+    sanitizer coverage as the generic ones.  Built in the parent so the
+    child never runs g++ under the sanitizer's LD_PRELOAD."""
+    import types
+
+    flag, _, suffix, _, _ = _SAN[kind]
+    code, prog_len = _tables()
+    net = types.SimpleNamespace(
+        code=code, prog_len=prog_len, num_stacks=1, stack_cap=16,
+        in_cap=16, out_cap=16,
+    )
+    from misaka_tpu.core import specialize
+
+    prev = os.environ.get("MISAKA_SPEC_CXXFLAGS")
+    os.environ["MISAKA_SPEC_CXXFLAGS"] = (
+        f"{flag} -O1 -g -fno-omit-frame-pointer"
+    )
+    try:
+        so = specialize.build(
+            net,
+            cache_dir=os.path.join(REPO, "native", f".spec-{suffix}-cache"),
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("MISAKA_SPEC_CXXFLAGS", None)
+        else:
+            os.environ["MISAKA_SPEC_CXXFLAGS"] = prev
+    if so is None:
+        print("sanitize: WARNING — instrumented specialized build failed; "
+              "the lane runs without the specialized path", file=sys.stderr)
+    return so
+
+
 def reexec_under_sanitizer(kind: str, args) -> int:
     so = build_sanitized_so(kind)
+    spec_so = build_sanitized_spec_so(kind)
     _, runtime, _, env_var, env_val = _SAN[kind]
     cxx = os.environ.get("CXX", "g++")
     lib = subprocess.run(
@@ -96,6 +133,7 @@ def reexec_under_sanitizer(kind: str, args) -> int:
         env_var: env_val + ":" + env.get(env_var, ""),
         "MISAKA_INTERP_SO": so,
         "MISAKA_SANITIZE_CHILD": kind,
+        **({"MISAKA_SANITIZE_SPEC_SO": spec_so} if spec_so else {}),
         # never touch (or wedge on) a TPU relay from a sanitizer lane
         "JAX_PLATFORMS": "cpu",
         "PALLAS_AXON_POOL_IPS": "",
@@ -178,13 +216,46 @@ def run_scenario(args) -> int:
     stats = {"passes": 0, "values": 0, "reads": 0, "closed_reads": 0,
              "cycles": 0}
 
-    def new_pool():
-        return cinterp.NativePool(
-            code, prog_len, 1, 16, in_cap, in_cap,
-            replicas=B, threads=args.pool_threads,
-        )
+    # Pool variants rotated across close/recreate cycles so every ladder
+    # rung runs the concurrent serve/close/counter-read race under the
+    # sanitizer: the AVX2 group path, the generic group fallback, the
+    # scalar per-replica path (MISAKA_SIMD=0), and — when the parent
+    # built one — the instrumented SPECIALIZED build's baked tick paths.
+    spec_lib = None
+    spec_path = os.environ.get("MISAKA_SANITIZE_SPEC_SO")
+    if spec_path:
+        spec_lib = cinterp.load_specialized(spec_path)
+    variants = [(None, None), ("generic", None), ("0", None)]
+    # the group/specialized paths only arm with at least one full SIMD
+    # group of replicas (kGroupW = 8); below that every variant runs the
+    # scalar engine and expecting `specialized` to engage would abort a
+    # lane that is correctly degrading
+    group_capable = B >= 8
+    if spec_lib is not None and group_capable:
+        variants.append((None, spec_lib))
+    stats["spec_pools"] = 0
 
-    box = {"pool": new_pool()}
+    def new_pool(variant: int):
+        mode, lib = variants[variant % len(variants)]
+        prev = os.environ.pop("MISAKA_SIMD", None)
+        if mode is not None:
+            os.environ["MISAKA_SIMD"] = mode
+        try:
+            pool = cinterp.NativePool(
+                code, prog_len, 1, 16, in_cap, in_cap,
+                replicas=B, threads=args.pool_threads, lib=lib,
+            )
+        finally:
+            os.environ.pop("MISAKA_SIMD", None)
+            if prev is not None:
+                os.environ["MISAKA_SIMD"] = prev
+        if lib is not None:
+            assert pool.simd_info()["specialized"], \
+                "specialized build did not engage"
+            stats["spec_pools"] += 1
+        return pool
+
+    box = {"pool": new_pool(0)}
     rng = np.random.default_rng(7)
 
     def serve_loop():
@@ -274,7 +345,7 @@ def run_scenario(args) -> int:
                 errors.append(RuntimeError("serve thread never quiesced"))
                 break
             old = box["pool"]
-            box["pool"] = new_pool()
+            box["pool"] = new_pool(stats["cycles"] + 1)
             old.close()  # readers may hold `old` RIGHT NOW — the race
             stats["cycles"] += 1
             serve_gate.set()
@@ -295,7 +366,8 @@ def run_scenario(args) -> int:
           f"{stats['passes']} serve passes / {stats['values']} values, "
           f"{stats['reads']} counter reads "
           f"({stats['closed_reads']} typed closed-pool losses), "
-          f"{stats['cycles']} close/recreate cycles", file=sys.stderr)
+          f"{stats['cycles']} close/recreate cycles "
+          f"({stats['spec_pools']} specialized pools)", file=sys.stderr)
     return 0
 
 
