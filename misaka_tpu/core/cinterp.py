@@ -90,6 +90,26 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.misaka_pool_simd_info.argtypes = [ctypes.c_void_p, _I32P]
     lib.misaka_spec_key.restype = ctypes.c_char_p
     lib.misaka_spec_key.argtypes = []
+    # resident-state serving (r17)
+    lib.misaka_interp_pack.restype = None
+    lib.misaka_interp_pack.argtypes = [ctypes.c_void_p, _I32P, ctypes.c_int]
+    _STATE15 = [
+        _I32P, _I32P, _I32P, _I32P, _U8P, _I32P, _U8P,
+        _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P, _I32P,
+    ]
+    lib.misaka_pool_import.restype = ctypes.c_int
+    lib.misaka_pool_import.argtypes = [ctypes.c_void_p] + _STATE15
+    lib.misaka_pool_export.restype = ctypes.c_int
+    lib.misaka_pool_export.argtypes = [ctypes.c_void_p] + _STATE15
+    lib.misaka_pool_discard.restype = None
+    lib.misaka_pool_discard.argtypes = [ctypes.c_void_p]
+    lib.misaka_pool_is_resident.restype = ctypes.c_int
+    lib.misaka_pool_is_resident.argtypes = [ctypes.c_void_p]
+    lib.misaka_pool_serve_resident.restype = ctypes.c_int
+    lib.misaka_pool_serve_resident.argtypes = [
+        ctypes.c_void_p, _I32P, _I32P, ctypes.c_int, _I32P, ctypes.c_int,
+        _I32P, _U8P,
+    ]
 
 
 _NATIVE = NativeLib(
@@ -302,6 +322,19 @@ class NativeInterpreter:
         )
         return d
 
+    def pack(self, drain: bool = True) -> np.ndarray:
+        """The serve_chunk packed row [in_rd, in_wr, out_rd, out_wr,
+        out_buf...] straight off the interpreter, draining the output ring
+        AFTER the snapshot when `drain` (the resident-state serve path:
+        the counters + ring are the only per-chunk reads, so the full
+        state export stays on the lifecycle paths).  With drain=False only
+        the four counters are filled."""
+        row = np.empty((4 + self.out_cap,), np.int32)
+        self._lib.misaka_interp_pack(
+            self._handle(), _as_i32p(row), 1 if drain else 0
+        )
+        return row
+
     def state_arrays(self) -> dict:
         """Mirror tests/oracle.py state_arrays for differential comparison."""
         d = self._read_raw()
@@ -511,6 +544,166 @@ class NativePool:
                 idle.ctypes.data_as(i64p), self.threads,
             )
         return busy, idle
+
+    # --- resident-state serving (r17) ----------------------------------
+
+    def _state_ptrs(self, d: dict):
+        """The 15 state-array pointers for the import/export ABI (import
+        passes _checked arrays — the C++ side copies, no donation; export
+        passes freshly-allocated buffers)."""
+        return [
+            _as_i32p(d["acc"]), _as_i32p(d["bak"]), _as_i32p(d["pc"]),
+            _as_i32p(d["port_val"]), d["port_full"].ctypes.data_as(_U8P),
+            _as_i32p(d["hold_val"]), d["holding"].ctypes.data_as(_U8P),
+            _as_i32p(d["stack_mem"]), _as_i32p(d["stack_top"]),
+            _as_i32p(d["in_buf"]), _as_i32p(d["out_buf"]),
+            _as_i32p(d["_counters5"]), _as_i32p(d["retired"]),
+            _as_i32p(d["acc_hi"]), _as_i32p(d["bak_hi"]),
+        ]
+
+    def import_state(self, d: dict) -> bool:
+        """Arm C++ residency from a state dict (export_arrays keys, each
+        with a leading [B] axis).  The arrays are validated exactly like a
+        stateless serve (lossy casts raise ValueError) and COPIED into the
+        resident store — no donation.  False when the C side rejects the
+        state (pc/stack_top/ring violations): residency stays disarmed and
+        the caller's arrays stay authoritative."""
+        B, n, s = self.replicas, self.n_lanes, self.num_stacks
+        c = {
+            "acc": _checked_i32("acc", d["acc"], (B, n)),
+            "bak": _checked_i32("bak", d["bak"], (B, n)),
+            "acc_hi": _checked_i32("acc_hi", d["acc_hi"], (B, n)),
+            "bak_hi": _checked_i32("bak_hi", d["bak_hi"], (B, n)),
+            "pc": _checked_i32("pc", d["pc"], (B, n)),
+            "port_val": _checked_i32(
+                "port_val", d["port_val"], (B, n, isa.NUM_PORTS)
+            ),
+            "port_full": _checked_u8(
+                "port_full", d["port_full"], (B, n, isa.NUM_PORTS)
+            ),
+            "hold_val": _checked_i32("hold_val", d["hold_val"], (B, n)),
+            "holding": _checked_u8("holding", d["holding"], (B, n)),
+            "stack_mem": _checked_i32(
+                "stack_mem", d["stack_mem"], (B, s, self.stack_cap)
+            ),
+            "stack_top": _checked_i32("stack_top", d["stack_top"], (B, s)),
+            "in_buf": _checked_i32("in_buf", d["in_buf"], (B, self.in_cap)),
+            "out_buf": _checked_i32(
+                "out_buf", d["out_buf"], (B, self.out_cap)
+            ),
+            "retired": _checked_i32("retired", d["retired"], (B, n)),
+        }
+        counters = np.empty((B, 5), np.int32)
+        for i, k in enumerate(("in_rd", "in_wr", "out_rd", "out_wr", "tick")):
+            counters[:, i] = _checked_i32(k, d[k], (B,))
+        c["_counters5"] = counters
+        rc = self._lib.misaka_pool_import(
+            self._handle(), *self._state_ptrs(c)
+        )
+        return rc == 0
+
+    def export_state(self) -> dict | None:
+        """Non-destructive export of the resident state into fresh
+        batch-major arrays (residency stays armed; None when it is not).
+        The returned dict has the same key set serve() returns, so the
+        caller can feed it straight back through the trusted stateless
+        path or build a NetworkState from it."""
+        B, n, s = self.replicas, self.n_lanes, self.num_stacks
+        d = {
+            "acc": np.empty((B, n), np.int32),
+            "bak": np.empty((B, n), np.int32),
+            "acc_hi": np.empty((B, n), np.int32),
+            "bak_hi": np.empty((B, n), np.int32),
+            "pc": np.empty((B, n), np.int32),
+            "port_val": np.empty((B, n, isa.NUM_PORTS), np.int32),
+            "port_full": np.empty((B, n, isa.NUM_PORTS), np.uint8),
+            "hold_val": np.empty((B, n), np.int32),
+            "holding": np.empty((B, n), np.uint8),
+            "stack_mem": np.empty((B, s, self.stack_cap), np.int32),
+            "stack_top": np.empty((B, s), np.int32),
+            "in_buf": np.empty((B, self.in_cap), np.int32),
+            "out_buf": np.empty((B, self.out_cap), np.int32),
+            "retired": np.empty((B, n), np.int32),
+            "_counters5": np.empty((B, 5), np.int32),
+        }
+        rc = self._lib.misaka_pool_export(
+            self._handle(), *self._state_ptrs(d)
+        )
+        if rc != 0:
+            return None
+        counters = d["_counters5"]
+        d["in_rd"] = counters[:, 0].copy()
+        d["in_wr"] = counters[:, 1].copy()
+        d["out_rd"] = counters[:, 2].copy()
+        d["out_wr"] = counters[:, 3].copy()
+        d["tick"] = counters[:, 4].copy()
+        return d
+
+    def discard_resident(self) -> None:
+        """Disarm residency WITHOUT exporting — the caller replaced the
+        state wholesale (load/restore) and the resident copy is
+        superseded."""
+        with self._ctr_lock:
+            if self._h:
+                self._lib.misaka_pool_discard(self._h)
+
+    def is_resident(self) -> bool:
+        with self._ctr_lock:
+            if not self._h:
+                return False
+            return bool(self._lib.misaka_pool_is_resident(self._h))
+
+    def serve_resident(self, values, counts, ticks: int, active=None):
+        """One serve (counts given) or idle (counts None) pass on the
+        RESIDENT state: no import, no export, no Python-side state dict at
+        all.  Returns (packed, progress) — packed has EVERY row filled
+        (skipped rows carry their current counters plus the
+        drained-on-serve contract), progress[b]=1 when replica b retired
+        an instruction this call (the device loop's hot-set signal)."""
+        B = self.replicas
+        feeding = counts is not None
+        if feeding:
+            values = _checked_i32("values", values, (B, self.in_cap))
+            counts = _checked_i32("counts", counts, (B,))
+            packed = np.empty((B, 4 + self.out_cap), np.int32)
+            vp, cp = _as_i32p(values), _as_i32p(counts)
+        else:
+            packed = np.empty((B, 4), np.int32)
+            vp = cp = None
+        ap, n_active = None, 0
+        if active is not None:
+            active = np.ascontiguousarray(active, dtype=np.int32)
+            if active.ndim != 1:
+                raise ValueError("active must be a flat replica index list")
+            if active.size and (
+                int(active[0]) < 0 or int(active[-1]) >= B
+                or (np.diff(active) <= 0).any()
+            ):
+                raise ValueError(
+                    "active must be strictly increasing replica indices "
+                    f"in [0, {B})"
+                )
+            if feeding:
+                skip = np.ones((B,), bool)
+                skip[active] = False
+                if (counts[skip] > 0).any():
+                    raise ValueError(
+                        "active must cover every replica with counts > 0 "
+                        "(a skipped feed would silently drop values)"
+                    )
+            ap, n_active = _as_i32p(active), int(active.size)
+        progress = np.empty((B,), np.uint8)
+        rc = self._lib.misaka_pool_serve_resident(
+            self._handle(), vp, cp, int(ticks), ap, n_active,
+            _as_i32p(packed), progress.ctypes.data_as(_U8P),
+        )
+        if rc == -2:
+            raise RuntimeError("native pool feed exceeded ring free space")
+        if rc == -3:  # pragma: no cover — Python validated above
+            raise ValueError("invalid active replica list")
+        if rc == -4:
+            raise RuntimeError("pool residency is not armed")
+        return packed, progress
 
     def serve(self, d: dict, values, counts, ticks: int, active=None,
               trusted: bool = False):

@@ -34,6 +34,7 @@ when no TPU is attached.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import weakref
@@ -44,6 +45,7 @@ from misaka_tpu.core import cinterp
 from misaka_tpu.core import specialize
 from misaka_tpu.core.state import NetworkState
 from misaka_tpu.runtime import usage
+from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
 from misaka_tpu.utils import tracespan
 
@@ -221,6 +223,95 @@ class _BusyWindow:
 _G_POOL_BUSY.set_function(_BusyWindow().read)
 
 
+# --- resident-state serving (r17) ------------------------------------------
+#
+# The native engines keep their state IN C++ between serve calls on the
+# trusted-identity path: the device loop passes back the exact state
+# object the engine returned last call, so as long as that identity holds
+# nothing else touched the state and the per-call import/export round
+# trip (~200us/call at B=256) is pure waste.  Lifecycle paths —
+# checkpoint, /load, /restore, autogrow, registry eviction/hot-swap,
+# /status — export lazily through export_resident() (MasterNode calls it
+# before reading self._state's content).  MISAKA_NATIVE_RESIDENT=0 kills
+# the layer (the exact r16 stateless behavior); the `resident_fallback`
+# chaos point forces the stateless path per-call with a coherent export
+# first.
+
+def resident_enabled() -> bool:
+    return os.environ.get("MISAKA_NATIVE_RESIDENT", "1") not in ("0", "off")
+
+
+_C_RESIDENT = metrics.counter(
+    "misaka_native_resident_total",
+    "Resident-state serve events: hit = served on in-C++ state, miss = "
+    "state replaced, re-imported + armed, export = a lifecycle path "
+    "materialized the state, fallback = stateless serve while armed "
+    "(kill switch / resident_fallback chaos) after a coherent export",
+    ("event",),
+)
+_C_RES_HIT = _C_RESIDENT.labels(event="hit")
+_C_RES_MISS = _C_RESIDENT.labels(event="miss")
+_C_RES_EXPORT = _C_RESIDENT.labels(event="export")
+_C_RES_FALLBACK = _C_RESIDENT.labels(event="fallback")
+
+# module-level mirrors of hit/miss for the windowed ratio gauge (reading
+# our own counter objects back is not part of the metrics API)
+_res_events = {"hit": 0, "miss": 0}
+
+_G_RES_ACTIVE = metrics.gauge(
+    "misaka_native_resident_active",
+    "Live native pools currently serving on in-C++ resident state",
+)
+_G_RES_RATIO = metrics.gauge(
+    "misaka_native_resident_hit_ratio",
+    "Resident-state hit ratio (hits / serve calls) over the last ~1s "
+    "window — the dashboard's residency signal; 0 with residency "
+    "disabled or the pool cold",
+)
+
+
+def _resident_active() -> float:
+    count = 0
+    for p in _live_pools():
+        try:
+            if p._pool.is_resident():
+                count += 1
+        except Exception:
+            continue
+    return float(count)
+
+
+_G_RES_ACTIVE.set_function(_resident_active)
+
+
+class _HitWindow:
+    """Windowed hit ratio from the cumulative event mirrors (the
+    _BusyWindow discipline: delta over >= 1 s, coherent within it)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._prev: tuple[float, int, int] | None = None
+        self._value = 0.0
+
+    def read(self) -> float:
+        hit, miss = _res_events["hit"], _res_events["miss"]
+        now = time.monotonic()
+        with self._lock:
+            prev = self._prev
+            if prev is None:
+                self._prev = (now, hit, miss)
+                return 0.0
+            if now - prev[0] >= 1.0:
+                dh = hit - prev[1]
+                dm = miss - prev[2]
+                self._value = dh / (dh + dm) if dh + dm > 0 else 0.0
+                self._prev = (now, hit, miss)
+            return self._value
+
+
+_G_RES_RATIO.set_function(_HitWindow().read)
+
+
 def pool_counters() -> dict | None:
     """Busy/idle nanosecond counters across every live native pool (None
     when no pool is serving): process-wide aggregate + a per-pool block
@@ -281,6 +372,12 @@ class NativeServe:
             net.num_stacks, net.stack_cap, net.in_cap, net.out_cap,
         )
         self._out_cap = net.out_cap
+        self._resident = resident_enabled()
+        # Residency anchor: while set, the interpreter ITSELF holds the
+        # authoritative state and `_last_state`'s array contents are
+        # stale — only its identity matters.  None = the interpreter's
+        # content mirrors whatever the last export produced (stateless).
+        self._last_state: NetworkState | None = None
         # usage attribution: the unbatched interpreter runs synchronously
         # on the calling thread, so the call wall IS its busy time (the
         # pooled tier uses the C++ busy-ns counters instead)
@@ -292,33 +389,74 @@ class NativeServe:
     def validate_state(self, state: NetworkState) -> None:
         """Raise ValueError on a state this engine cannot execute (pc beyond
         the program, stack_top beyond capacity, broken ring counters).
-        Importing IS the validation — the interpreter is stateless between
-        serve calls, so the imported content is simply overwritten next."""
+        Importing IS the validation — all-or-nothing on the C side, so a
+        rejected state leaves the interpreter (and an armed residency
+        anchor) untouched; a SUCCESSFUL import replaces the resident
+        content, so the anchor is cleared (the next serve re-imports its
+        own state)."""
         self._interp.import_arrays({
             f: np.asarray(getattr(state, f)) for f in NetworkState._fields
         })
+        self._last_state = None
+
+    def export_resident(self, anchor=None) -> NetworkState | None:
+        """Materialize the resident state (None when not armed — the
+        caller's state object is already authoritative).  Residency stays
+        armed, re-anchored on the returned object.  `anchor` (the caller's
+        current state object) gates the export: when given and NOT this
+        engine's identity anchor, the resident copy is superseded (a
+        lifecycle path replaced the state) and None is returned."""
+        if self._last_state is None:
+            return None
+        if anchor is not None and anchor is not self._last_state:
+            return None
+        d = self._interp.export_arrays()
+        st = NetworkState(**{f: d[f] for f in NetworkState._fields})
+        _C_RES_EXPORT.inc()
+        self._last_state = st
+        return st
 
     def serve_chunk(self, state: NetworkState, values, count, num_steps: int):
-        """See core/engine.py serve_chunk — same contract, host execution."""
+        """See core/engine.py serve_chunk — same contract, host execution.
+
+        Resident fast path (r17): when the caller hands back the exact
+        state object this engine returned last chunk, the import is
+        skipped (the interpreter already holds that state) and the export
+        collapses to the packed row — the returned state is the SAME
+        object, with lifecycle reads going through export_resident."""
         t0 = time.perf_counter()
         it = self._interp
-        it.import_arrays({
-            f: np.asarray(getattr(state, f)) for f in NetworkState._fields
-        })
+        anchored = (
+            self._last_state is not None and state is self._last_state
+        )
+        track = self._resident and faults.fire("resident_fallback") is None
+        if not anchored:
+            it.import_arrays({
+                f: np.asarray(getattr(state, f))
+                for f in NetworkState._fields
+            })
+        if track:
+            (_C_RES_HIT if anchored else _C_RES_MISS).inc()
+            _res_events["hit" if anchored else "miss"] += 1
         count = int(count)
         if count:
             fed = it.feed(np.asarray(values[:count], np.int32))
             if fed != count:  # caller cut to free space; a miss is a bug
                 raise RuntimeError(f"native feed accepted {fed}/{count}")
         it.run(int(num_steps))
-        d = it.export_arrays()
-        packed = np.concatenate([
-            np.array([d["in_rd"], d["in_wr"], d["out_rd"], d["out_wr"]],
-                     np.int32),
-            d["out_buf"],
-        ])
-        d["out_rd"] = d["out_wr"]  # the returned state's ring is drained
-        out = NetworkState(**{f: d[f] for f in NetworkState._fields}), packed
+        # snapshot + INTERNAL drain: the interpreter's ring state stays
+        # coherent whether or not the next call skips the import
+        packed = it.pack(drain=True)
+        if track:
+            self._last_state = state
+            out = state, packed
+        else:
+            if anchored:
+                _C_RES_FALLBACK.inc()  # chaos/kill switch: export fresh
+            d = it.export_arrays()  # rings already drained above
+            self._last_state = None
+            out = NetworkState(**{f: d[f] for f in NetworkState._fields}), \
+                packed
         _C_CALLS_CHUNK.inc()
         dur = time.perf_counter() - t0
         usage.add_native(self.usage_label(), dur)
@@ -388,6 +526,14 @@ class NativeServePool:
         # the cache and takes the validated path.
         self._last_state = None
         self._last_dict = None
+        # Resident-state mode (r17): when armed, the identity cache proves
+        # MORE — the batch state lives in C++ between calls and `state` is
+        # just the anchor object, so serve/idle skip the import/export
+        # round trip entirely.  _progress carries the last resident call's
+        # per-replica hot flags for the device loop (the stateless path
+        # leaves it None and the loop derives hotness from `retired`).
+        self._resident = resident_enabled()
+        self._progress = None
         # Usage attribution (runtime/usage.py): which program this pool's
         # busy time bills to.  MasterNode rebinds this to its live
         # program_label (through a weakref — the registry names engines
@@ -439,8 +585,81 @@ class NativeServePool:
     def validate_state(self, state: NetworkState) -> None:
         """Raise ValueError on a state this engine cannot execute (pc beyond
         the program, stack_top beyond capacity, broken ring counters) —
-        a zero-tick idle round trip; importing IS the validation."""
+        a zero-tick idle round trip; importing IS the validation.  Runs on
+        the pool's stateless scratch interpreters, so an armed resident
+        state is never touched (a restore whose validation fails must
+        leave the live network serving its current state)."""
         self._pool.idle(self._to_dict(state), 0)
+
+    def export_resident(self, anchor=None) -> NetworkState | None:
+        """Materialize the in-C++ resident state into a fresh NetworkState
+        and re-anchor the identity cache on it (residency stays armed, so
+        the next serve with the returned state is still a resident hit).
+        None when residency is not armed — the caller's state object is
+        already authoritative.  `anchor` (the caller's current state
+        object) gates the export: when given and NOT the identity anchor,
+        the resident copy is superseded by a lifecycle replacement and
+        None is returned (exporting would clobber the fresh state).
+        MasterNode calls this before any path that READS state content:
+        checkpoint, snapshot/restore, autogrow, /status, the loop's boot
+        counters."""
+        if anchor is not None and anchor is not self._last_state:
+            return None
+        d = self._pool.export_state()
+        if d is None:
+            return None
+        _C_RES_EXPORT.inc()
+        st = self._to_state(d)
+        self._last_state, self._last_dict = st, d
+        return st
+
+    def consume_progress(self):
+        """Per-replica progress flags ([B] uint8) from the last resident
+        serve/idle — the device loop's hot-set signal; None when the last
+        call went down the stateless path (the loop falls back to
+        exported retired deltas)."""
+        return self._progress
+
+    def _serve_resident(self, state, values, counts, ticks, active):
+        """The resident fast path: serve on the in-C++ state with no
+        import/export.  Returns (packed, progress), or None when this
+        call cannot be served resident (import validation refused the
+        state) — the caller falls back to the stateless ladder."""
+        pool = self._pool
+        if state is self._last_state and pool.is_resident():
+            _C_RES_HIT.inc()
+            _res_events["hit"] += 1
+        else:
+            # a lifecycle path replaced the state: the resident copy (if
+            # any) is superseded — discard and re-arm from the new state
+            pool.discard_resident()
+            if not pool.import_state(self._to_dict(state)):
+                return None
+            _C_RES_MISS.inc()
+            _res_events["miss"] += 1
+        return pool.serve_resident(values, counts, ticks, active=active)
+
+    def _stateless_input(self, state):
+        """(trusted, d_in) for the stateless ladder.  If residency is
+        armed on this state's identity, the state object's arrays are
+        STALE — export the authoritative copy first and serve trusted on
+        it (the resident_fallback chaos point and the kill switch land
+        here)."""
+        pool = self._pool
+        if pool.is_resident():
+            if state is self._last_state:
+                d = pool.export_state()
+                if d is not None:
+                    _C_RES_FALLBACK.inc()
+                    pool.discard_resident()
+                    self._last_dict = d
+                    return True, d
+            pool.discard_resident()
+        trusted = state is self._last_state and self._last_dict is not None
+        return trusted, (self._last_dict if trusted else self._to_dict(state))
+
+    def _resident_ok(self) -> bool:
+        return self._resident and faults.fire("resident_fallback") is None
 
     def serve(self, state: NetworkState, values, counts,
               num_steps: int | None = None, active=None):
@@ -451,17 +670,29 @@ class NativeServePool:
         `active` (optional, strictly increasing replica indices covering
         every fed replica) is the partial-fill fast path: only those
         replicas tick — an underfilled pass pays for the replicas doing
-        work, not the whole batch (cinterp.NativePool.serve)."""
+        work, not the whole batch (cinterp.NativePool.serve).
+
+        Resident fast path (r17): on the trusted-identity path the state
+        stays in C++ — the returned state is the SAME object handed in
+        (its array contents are stale; export_resident materializes them
+        for lifecycle reads) and the packed rows carry everything the
+        device loop consumes per chunk."""
         t0 = time.perf_counter()
-        trusted = state is self._last_state
-        d_in = self._last_dict if trusted else self._to_dict(state)
-        d, packed = self._pool.serve(
-            d_in, values, counts,
-            self._chunk if num_steps is None else num_steps,
-            active=active, trusted=trusted,
-        )
-        new_state = self._to_state(d)
-        self._last_state, self._last_dict = new_state, d
+        ticks = self._chunk if num_steps is None else num_steps
+        res = self._serve_resident(state, values, counts, ticks, active) \
+            if self._resident_ok() else None
+        if res is not None:
+            packed, self._progress = res
+            new_state = state
+            self._last_state = state
+        else:
+            trusted, d_in = self._stateless_input(state)
+            d, packed = self._pool.serve(
+                d_in, values, counts, ticks, active=active, trusted=trusted,
+            )
+            new_state = self._to_state(d)
+            self._last_state, self._last_dict = new_state, d
+            self._progress = None
         out = new_state, packed
         self._account_native()
         _C_CALLS_POOL.inc()
@@ -484,17 +715,24 @@ class NativeServePool:
              active=None):
         """idle_fn twin: advance the chunk with no feed, return
         (state, ctrs [B, 4]); output rings left undrained.  `active`
-        restricts the pass to the given replica indices (partial fill)."""
+        restricts the pass to the given replica indices (partial fill).
+        Same resident fast path as serve()."""
         t0 = time.perf_counter()
-        trusted = state is self._last_state
-        d_in = self._last_dict if trusted else self._to_dict(state)
-        d, ctrs = self._pool.idle(
-            d_in,
-            self._chunk if num_steps is None else num_steps,
-            active=active, trusted=trusted,
-        )
-        new_state = self._to_state(d)
-        self._last_state, self._last_dict = new_state, d
+        ticks = self._chunk if num_steps is None else num_steps
+        res = self._serve_resident(state, None, None, ticks, active) \
+            if self._resident_ok() else None
+        if res is not None:
+            ctrs, self._progress = res
+            new_state = state
+            self._last_state = state
+        else:
+            trusted, d_in = self._stateless_input(state)
+            d, ctrs = self._pool.idle(
+                d_in, ticks, active=active, trusted=trusted,
+            )
+            new_state = self._to_state(d)
+            self._last_state, self._last_dict = new_state, d
+            self._progress = None
         out = new_state, ctrs
         self._account_native()
         _C_CALLS_IDLE.inc()

@@ -96,6 +96,25 @@ so the master's env surface is what survives:
   MISAKA_SPEC_CACHE  specialization compile-cache dir for the boot engine
                    (default: a per-user tmp dir; the registry caches next
                    to its version store instead)
+  MISAKA_SPEC_CACHE_MAX_MB / MISAKA_SPEC_CACHE_MAX_ENTRIES
+                   size/entry LRU bound on the specialization disk cache
+                   (defaults 256 MiB / 64 entries; evictions count on
+                   misaka_specialize_cache_evictions_total — r17)
+  MISAKA_SPEC_SWITCH_MAX  total-instruction budget for the generated
+                   switch-threaded specialized tick (default 4096; over
+                   budget keeps the table-baked generic tick, 0 disables
+                   the switch layer — r17)
+  MISAKA_NATIVE_RESIDENT  "0" disables resident-state native serving
+                   (r17): every serve call then pays the full state
+                   import/export round trip like r16.  Default on; the
+                   resident_fallback chaos point forces per-call
+                   fallback; misaka_native_resident_total counts
+                   hit/miss/export/fallback
+  MISAKA_POOL_SPIN_US  native pool dispenser spin budget in microseconds
+                   before a worker parks on the futex (default 50 — r17)
+  MISAKA_PLANE_PIPELINE  max in-flight frames per compute-plane
+                   connection, BOTH ends (default 4; 1 restores the r16
+                   ping-pong; the shm plane always runs depth 1 — r17)
   MISAKA_PLANE_SHM "1" = zero-copy compute plane: frontend workers ship
                    frame payloads through one shared-memory segment per
                    plane connection instead of unix-socket copies (frame

@@ -88,6 +88,12 @@ M_PLANE_DRAIN_REROUTES = metrics.counter(
     "Compute-plane frames answered with the drain reroute status "
     "(the fleet router re-dispatches them to a sibling)",
 )
+M_PLANE_PIPELINED = metrics.counter(
+    "misaka_plane_pipelined_frames_total",
+    "Compute frames accepted while an earlier frame from the same plane "
+    "connection was still in flight (MISAKA_PLANE_PIPELINE > 1) — zero "
+    "under load means the plane is running single-outstanding-frame",
+)
 M_PLANE_SHM_FRAMES = metrics.counter(
     "misaka_plane_shm_frames_total",
     "Compute-plane frames whose payload rode a shared-memory segment "
@@ -459,10 +465,13 @@ class ComputePlane:
             here — they are the client's, not the service's."""
             if not slo.armed():
                 return
+            # getattr: duck-typed registries (tests) may not carry a
+            # default name — label None = the default program's windows.
+            # Load-bearing under pipelining: an exception here would kill
+            # the whole connection's in-flight frames, not just one.
             label = (
                 program.partition("@")[0] if program
-                else registry.default_name if registry is not None
-                else None
+                else getattr(registry, "default_name", None)
             )
             now = time.monotonic()
             if edge:
@@ -471,13 +480,241 @@ class ComputePlane:
             else:
                 slo.observe(label, now - t_recv, error=error)
 
+        # Per-request pipelining (r17): up to MISAKA_PLANE_PIPELINE frames
+        # from ONE connection may be in flight through the serve scheduler
+        # at once — the reader keeps reading while earlier frames compute,
+        # so a connection stops being single-outstanding-frame
+        # queueing-bound (the 64-client p50's measured wall, BENCH_HISTORY
+        # r16).  Responses ship in FRAME ORDER via a done-event chain (the
+        # wire carries no frame ids — FIFO pairing is the protocol), and
+        # anything order- or state-sensitive (probes, shm arming, frames
+        # whose payload rides the shm double buffer, error replies from
+        # the reader) first drains the pipeline by waiting on the chain
+        # tail.  MISAKA_PLANE_PIPELINE=1 restores the r16 ping-pong.
+        pipe_depth = max(
+            1, int(os.environ.get("MISAKA_PLANE_PIPELINE", "") or 4)
+        )
+        send_lock = threading.Lock()
+        conn_dead = [False]
+        pipe_sem = threading.Semaphore(pipe_depth)
+        executor = [None]  # lazy ThreadPoolExecutor, pipelined frames only
+        tail = [None]      # done event of the most recently accepted frame
+
+        def send_ordered(prev, data: bytes) -> None:
+            if prev is not None:
+                prev.wait()
+            if conn_dead[0]:
+                raise ConnectionError("plane connection is closed")
+            with send_lock:
+                conn.sendall(data)
+
+        def drain_pipeline() -> None:
+            t = tail[0]
+            if t is not None:
+                t.wait()
+
+        def process_frame(n, parsed, get_values, reply) -> None:
+            """Everything past metadata parsing for one compute frame:
+            drain check, chaos, edge chain, lease resolution, the
+            scheduler submission, and the ordered response via `reply`.
+            Runs inline (reader thread) or on the pipeline executor; the
+            in-flight count was taken by the caller and is released
+            here."""
+            (program, key, reqs, traces, edge, probe, hedged, shed,
+             _shm_arm, shm_vals) = parsed
+            try:
+                if self._draining:
+                    # rolling restart: hand this frame back to the
+                    # router, which re-dispatches it onto a healthy
+                    # sibling — the client never sees an error
+                    M_PLANE_DRAIN_REROUTES.inc()
+                    body = b"replica draining; reroute"
+                    reply(_RESP_HDR.pack(PLANE_DRAINING, len(body)) + body)
+                    for tr in traces:
+                        tracespan.end(tr, status=PLANE_DRAINING)
+                    return
+                bh = faults.fire("replica_blackhole")
+                if bh is None and self._replica_label is not None:
+                    bh = faults.fire(
+                        f"replica_blackhole:{self._replica_label}"
+                    )
+                if bh is not None:
+                    # chaos (utils/faults.py): hold the frame unanswered —
+                    # the router's frame deadline must fire and hedge the
+                    # requests onto a sibling
+                    log.warning(
+                        "replica_blackhole fault: holding frame %.1fs", bh
+                    )
+                    time.sleep(max(0.0, bh))
+                M_PLANE_FRAMES.inc()
+                if shm_vals is not None:
+                    M_PLANE_SHM_FRAMES.inc()
+                if hedged:
+                    M_PLANE_HEDGED.inc(hedged)
+                if shed:
+                    # worker-local shed-cache hits since the last frame:
+                    # book them here so the headline rejected counter
+                    # covers the whole door, not just the decisions this
+                    # process made (lenient: malformed rows cost the
+                    # count, never the frame)
+                    try:
+                        for t, r, cnt in shed:
+                            edge_mod.count_shed(
+                                t if isinstance(t, str) else None,
+                                str(r), int(cnt),
+                            )
+                    except (ValueError, TypeError):
+                        log.debug("dropping malformed shed metadata")
+                # The edge chain, per frame (runtime/edge.py): the
+                # frontend workers terminate TLS and ship the API key
+                # along; auth + per-tenant quota + admission run HERE,
+                # where the state is global — one frame is one
+                # (program, tenant), so a frame-level decision is a
+                # tenant-level decision.  Rejections ship the typed
+                # status with a JSON body the worker unpacks back into
+                # Retry-After.
+                chain = edge_mod.current()
+                if chain.armed:
+                    decision = chain.check(
+                        "/compute_raw", "POST", key=key,
+                        program=program or getattr(
+                            registry, "default_name", None
+                        ),
+                        values=int(n), requests=reqs,
+                    )
+                    if decision.reject is not None:
+                        rej = decision.reject
+                        # the worker's shed cache reports under this
+                        # tenant when it honors the Retry-After
+                        rej.tenant = decision.tenant
+                        body = rej.to_wire()
+                        reply(_RESP_HDR.pack(rej.status, len(body)) + body)
+                        for tr in traces:
+                            tracespan.end(tr, status=rej.status)
+                        return
+                t_recv = time.monotonic()
+                values = get_values()
+                # Lease resolution FIRST, in its own try: only this step
+                # may answer 404 (ProgramNotFound is a KeyError subclass —
+                # this module stays registry-import-free).  A KeyError
+                # escaping the compute itself must stay a 500:
+                # classifying an engine bug as "program not found" would
+                # hide it from 5xx alerting.
+                lease_ctx = None
+                try:
+                    if registry is not None:
+                        # the registry lease: resolves the program (the
+                        # seeded default for None), activates cold
+                        # engines, parks through hot-swaps, and counts
+                        # the per-program metric series
+                        lease_ctx = registry.lease(
+                            program, values=int(values.size)
+                        )
+                        m = lease_ctx.__enter__()
+                    elif program:
+                        raise KeyError(
+                            f"program registry disabled; cannot "
+                            f"route to program {program!r}"
+                        )
+                    else:
+                        m = master
+                except KeyError as e:
+                    # args[0] dodges KeyError's repr-quoting of its
+                    # message
+                    msg = e.args[0] if e.args and isinstance(
+                        e.args[0], str
+                    ) else str(e)
+                    body = msg.encode()
+                    reply(_RESP_HDR.pack(404, len(body)) + body)
+                    for tr in traces:
+                        tracespan.end(tr, status=404)
+                    return
+                except Exception as e:
+                    # activation failure (RegistryError, compile error...)
+                    body = str(e).encode()
+                    reply(_RESP_HDR.pack(500, len(body)) + body)
+                    slo_record(program, edge, t_recv, error=True)
+                    for tr in traces:
+                        tracespan.end(tr, status=500)
+                    return
+                try:
+                    if not m.is_running:
+                        raise _NotRunning()
+                    out = m.compute_coalesced(
+                        values, timeout=self._timeout,
+                        return_array=True, traces=tuple(traces),
+                    )
+                except _NotRunning:
+                    # the route's 400 body
+                    body = b"network is not running"
+                    reply(_RESP_HDR.pack(400, len(body)) + body)
+                    for tr in traces:
+                        tracespan.end(tr, status=400)
+                    return
+                except Exception as e:
+                    body = str(e).encode()
+                    reply(_RESP_HDR.pack(500, len(body)) + body)
+                    slo_record(program, edge, t_recv, error=True)
+                    for tr in traces:
+                        tracespan.add_span(
+                            tr, "plane.recv", t_recv,
+                            time.monotonic() - t_recv,
+                        )
+                        tracespan.end(tr, status=500)
+                    return
+                finally:
+                    if lease_ctx is not None:
+                        lease_ctx.__exit__(None, None, None)
+                payload = out.astype("<i4").tobytes()
+                if shm_vals is not None:
+                    # response payload rides the segment's second half;
+                    # the socket carries only the 8-byte header (shm
+                    # frames run INLINE with the pipeline drained, so the
+                    # double buffer is never shared)
+                    shm_state[0].buf[
+                        shm_state[1]:shm_state[1] + len(payload)
+                    ] = payload
+                    reply(_RESP_HDR.pack(200, len(payload) // 4))
+                else:
+                    reply(
+                        _RESP_HDR.pack(200, len(payload) // 4) + payload
+                    )
+                slo_record(program, edge, t_recv, error=False)
+                dur = time.monotonic() - t_recv
+                for tr in traces:
+                    tracespan.add_span(
+                        tr, "plane.recv", t_recv, dur,
+                        {"frame_values": int(n)},
+                    )
+                    tracespan.end(tr, status=200)
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+
+        def run_pipelined(n, parsed, raw, prev, done) -> None:
+            import numpy as np
+
+            try:
+                process_frame(
+                    n, parsed, lambda: np.frombuffer(raw, dtype="<i4"),
+                    lambda data: send_ordered(prev, data),
+                )
+            except (ConnectionError, OSError) as e:
+                conn_dead[0] = True
+                log.debug("pipelined plane frame send failed: %r", e)
+            except Exception:  # pragma: no cover — must not die silently
+                conn_dead[0] = True
+                log.exception("pipelined compute-plane frame crashed")
+            finally:
+                done.set()
+                pipe_sem.release()
+
         # shared-memory plane state for THIS connection (MISAKA_PLANE_SHM):
         # the frontend owns + unlinks the segment; we attach on the arming
         # frame and only ever map it (bound before the try: the finally
-        # must see it even when the handshake bails)
-        shm_seg = None
-        shm_size = 0
-        values = None  # previous frame's zero-copy view (released per frame)
+        # must see it even when the handshake bails).  shm_state is
+        # [segment, size], readable from process_frame.
+        shm_state = [None, 0]
         try:
             if self._secret is not None:
                 # shared-secret handshake BEFORE any frame: a peer that
@@ -495,37 +732,37 @@ class ComputePlane:
                     )
                     return
             while not self._closed:
-                # release the PREVIOUS frame's payload view before blocking:
-                # an np.frombuffer over the shm segment pins the mapping
-                # (BufferError at close) for as long as any view survives
-                values = None  # noqa: F841 — lifetime management
                 n, n_meta = _REQ_HDR.unpack(_recv_exact(conn, 8))
                 if n > MAX_FRAME_VALUES:
+                    drain_pipeline()
                     body = b"frame exceeds MAX_FRAME_VALUES"
                     conn.sendall(_RESP_HDR.pack(413, len(body)) + body)
                     return  # protocol state is unrecoverable past this
                 raw = _recv_exact(conn, n * 4)
                 meta = _recv_exact(conn, n_meta) if n_meta else b""
                 try:
-                    (program, key, reqs, traces, edge, probe,
-                     hedged, shed, shm_arm, shm_vals) = parse_meta(meta)
+                    parsed = parse_meta(meta)
                 except _BadMeta as e:
+                    drain_pipeline()  # error replies respect frame order
                     body = f"malformed plane metadata: {e}".encode()
                     conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
                     continue
+                (_program, _key, _reqs, _traces, _edge, probe,
+                 _hedged, _shed, shm_arm, shm_vals) = parsed
                 if shm_arm is not None:
                     # zero-copy plane arming: map the client's segment.
                     # PLANE_SHM_OK is deliberately NOT 200 — a pre-shm
                     # engine would answer this frame 200 (an empty
                     # compute), and the client must be able to tell the
                     # difference before it stops shipping payload bytes.
-                    old, shm_seg, shm_size = shm_seg, None, 0
+                    drain_pipeline()  # nobody may still read the old seg
+                    old, shm_state[0], shm_state[1] = shm_state[0], None, 0
                     if old is not None:
                         old.close()
                     try:
-                        shm_seg = _attach_shm(shm_arm["name"],
-                                              shm_arm["size"])
-                        shm_size = int(shm_arm["size"])
+                        shm_state[0] = _attach_shm(shm_arm["name"],
+                                                   shm_arm["size"])
+                        shm_state[1] = int(shm_arm["size"])
                         conn.sendall(_RESP_HDR.pack(PLANE_SHM_OK, 0))
                     except Exception as e:
                         body = f"shm attach failed: {e}".encode()
@@ -535,8 +772,9 @@ class ComputePlane:
                     continue
                 if shm_vals is not None:
                     # payload lives in [0, size) of the armed segment
-                    if shm_seg is None or shm_vals * 4 > shm_size \
+                    if shm_state[0] is None or shm_vals * 4 > shm_state[1] \
                             or shm_vals > MAX_FRAME_VALUES:
+                        drain_pipeline()
                         body = b"shm frame without a valid armed segment"
                         conn.sendall(
                             _RESP_HDR.pack(400, len(body)) + body
@@ -545,7 +783,8 @@ class ComputePlane:
                     n = shm_vals  # the edge chain + metrics see real counts
                 if probe:
                     # router health probe: liveness + drain state only,
-                    # zero engine work
+                    # zero engine work (ordered behind in-flight frames)
+                    drain_pipeline()
                     status = PLANE_DRAINING if self._draining else 200
                     conn.sendall(_RESP_HDR.pack(status, 0))
                     continue
@@ -553,205 +792,70 @@ class ComputePlane:
                 # roll polls `inflight` after arming the drain, and a
                 # frame that passed the check un-counted could be missed
                 # by the quiescence wait.  Counted-then-drained frames
-                # just reroute (the finally decrements on `continue`).
+                # just reroute (process_frame decrements on every path).
+                if pipe_depth > 1 and shm_vals is None:
+                    # pipelined dispatch: bounded by pipe_sem, responses
+                    # ordered by the done-event chain
+                    pipe_sem.acquire()
+                    if conn_dead[0]:
+                        pipe_sem.release()
+                        return
+                    done = threading.Event()
+                    prev, tail[0] = tail[0], done
+                    if prev is not None and not prev.is_set():
+                        M_PLANE_PIPELINED.inc()
+                    if executor[0] is None:
+                        from concurrent.futures import ThreadPoolExecutor
+
+                        executor[0] = ThreadPoolExecutor(
+                            max_workers=pipe_depth,
+                            thread_name_prefix="misaka-plane-pipe",
+                        )
+                    with self._inflight_lock:
+                        self._inflight += 1
+                    executor[0].submit(
+                        run_pipelined, n, parsed, raw, prev, done
+                    )
+                    continue
+                # inline dispatch: shm frames always land here (the
+                # double buffer requires the one-frame-in-flight
+                # discipline) as does MISAKA_PLANE_PIPELINE=1
+                drain_pipeline()
+                import numpy as np
+
+                if shm_vals is not None:
+                    # zero-copy read straight off the mapped segment: the
+                    # client writes the next frame's payload only after
+                    # this frame's response, and the serve scheduler
+                    # consumes values into its feed buffers before
+                    # completing the entries, so the view is never read
+                    # after we answer (released when process_frame
+                    # returns, before the next blocking read)
+                    def get_values(_seg=shm_state[0], _count=shm_vals):
+                        return np.frombuffer(
+                            _seg.buf, dtype="<i4", count=_count
+                        )
+                else:
+                    def get_values(_raw=raw):
+                        return np.frombuffer(_raw, dtype="<i4")
                 with self._inflight_lock:
                     self._inflight += 1
-                try:
-                    if self._draining:
-                        # rolling restart: hand this frame back to the
-                        # router, which re-dispatches it onto a healthy
-                        # sibling — the client never sees an error
-                        M_PLANE_DRAIN_REROUTES.inc()
-                        body = b"replica draining; reroute"
-                        conn.sendall(
-                            _RESP_HDR.pack(PLANE_DRAINING, len(body)) + body
-                        )
-                        for tr in traces:
-                            tracespan.end(tr, status=PLANE_DRAINING)
-                        continue
-                    bh = faults.fire("replica_blackhole")
-                    if bh is None and self._replica_label is not None:
-                        bh = faults.fire(
-                            f"replica_blackhole:{self._replica_label}"
-                        )
-                    if bh is not None:
-                        # chaos (utils/faults.py): hold the frame
-                        # unanswered — the router's frame deadline must
-                        # fire and hedge the requests onto a sibling
-                        log.warning(
-                            "replica_blackhole fault: holding frame %.1fs",
-                            bh,
-                        )
-                        time.sleep(max(0.0, bh))
-                    M_PLANE_FRAMES.inc()
-                    if shm_vals is not None:
-                        M_PLANE_SHM_FRAMES.inc()
-                    if hedged:
-                        M_PLANE_HEDGED.inc(hedged)
-                    if shed:
-                        # worker-local shed-cache hits since the last
-                        # frame: book them here so the headline
-                        # rejected counter covers the whole door, not
-                        # just the decisions this process made (lenient:
-                        # malformed rows cost the count, never the frame)
-                        try:
-                            for t, r, n in shed:
-                                edge_mod.count_shed(
-                                    t if isinstance(t, str) else None,
-                                    str(r), int(n),
-                                )
-                        except (ValueError, TypeError):
-                            log.debug("dropping malformed shed metadata")
-                    # The edge chain, per frame (runtime/edge.py): the
-                    # frontend workers terminate TLS and ship the API
-                    # key along; auth + per-tenant quota + admission run
-                    # HERE, where the state is global — one frame is one
-                    # (program, tenant), so a frame-level decision is a
-                    # tenant-level decision.  Rejections ship the typed
-                    # status with a JSON body the worker unpacks back
-                    # into Retry-After.
-                    chain = edge_mod.current()
-                    if chain.armed:
-                        decision = chain.check(
-                            "/compute_raw", "POST", key=key,
-                            program=program or (
-                                registry.default_name
-                                if registry is not None else None
-                            ),
-                            values=int(n), requests=reqs,
-                        )
-                        if decision.reject is not None:
-                            rej = decision.reject
-                            # the worker's shed cache reports under this
-                            # tenant when it honors the Retry-After
-                            rej.tenant = decision.tenant
-                            body = rej.to_wire()
-                            conn.sendall(
-                                _RESP_HDR.pack(rej.status, len(body))
-                                + body
-                            )
-                            for tr in traces:
-                                tracespan.end(tr, status=rej.status)
-                            continue
-                    t_recv = time.monotonic()
-                    import numpy as np
-
-                    if shm_vals is not None:
-                        # zero-copy read straight off the mapped segment:
-                        # the client writes the next frame's payload only
-                        # after this frame's response, and the serve
-                        # scheduler consumes values into its feed buffers
-                        # before completing the entries, so the view is
-                        # never read after we answer
-                        values = np.frombuffer(
-                            shm_seg.buf, dtype="<i4", count=shm_vals
-                        )
-                    else:
-                        values = np.frombuffer(raw, dtype="<i4")
-                    # Lease resolution FIRST, in its own try: only this
-                    # step may answer 404 (ProgramNotFound is a KeyError
-                    # subclass — this module stays registry-import-free).
-                    # A KeyError escaping the compute itself must stay a
-                    # 500: classifying an engine bug as "program not
-                    # found" would hide it from 5xx alerting.
-                    lease_ctx = None
-                    try:
-                        if registry is not None:
-                            # the registry lease: resolves the program
-                            # (the seeded default for None), activates
-                            # cold engines, parks through hot-swaps, and
-                            # counts the per-program metric series
-                            lease_ctx = registry.lease(
-                                program, values=int(values.size)
-                            )
-                            m = lease_ctx.__enter__()
-                        elif program:
-                            raise KeyError(
-                                f"program registry disabled; cannot "
-                                f"route to program {program!r}"
-                            )
-                        else:
-                            m = master
-                    except KeyError as e:
-                        # args[0] dodges KeyError's repr-quoting of its
-                        # message
-                        msg = e.args[0] if e.args and isinstance(
-                            e.args[0], str
-                        ) else str(e)
-                        body = msg.encode()
-                        conn.sendall(_RESP_HDR.pack(404, len(body)) + body)
-                        for tr in traces:
-                            tracespan.end(tr, status=404)
-                        continue
-                    except Exception as e:
-                        # activation failure (RegistryError, compile
-                        # error...)
-                        body = str(e).encode()
-                        conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
-                        slo_record(program, edge, t_recv, error=True)
-                        for tr in traces:
-                            tracespan.end(tr, status=500)
-                        continue
-                    try:
-                        if not m.is_running:
-                            raise _NotRunning()
-                        out = m.compute_coalesced(
-                            values, timeout=self._timeout,
-                            return_array=True, traces=tuple(traces),
-                        )
-                    except _NotRunning:
-                        # the route's 400 body
-                        body = b"network is not running"
-                        conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
-                        for tr in traces:
-                            tracespan.end(tr, status=400)
-                        continue
-                    except Exception as e:
-                        body = str(e).encode()
-                        conn.sendall(_RESP_HDR.pack(500, len(body)) + body)
-                        slo_record(program, edge, t_recv, error=True)
-                        for tr in traces:
-                            tracespan.add_span(
-                                tr, "plane.recv", t_recv,
-                                time.monotonic() - t_recv,
-                            )
-                            tracespan.end(tr, status=500)
-                        continue
-                    finally:
-                        if lease_ctx is not None:
-                            lease_ctx.__exit__(None, None, None)
-                    payload = out.astype("<i4").tobytes()
-                    if shm_vals is not None:
-                        # response payload rides the segment's second
-                        # half; the socket carries only the 8-byte header
-                        shm_seg.buf[shm_size:shm_size + len(payload)] = \
-                            payload
-                        conn.sendall(_RESP_HDR.pack(200, len(payload) // 4))
-                    else:
-                        conn.sendall(
-                            _RESP_HDR.pack(200, len(payload) // 4) + payload
-                        )
-                    slo_record(program, edge, t_recv, error=False)
-                    dur = time.monotonic() - t_recv
-                    for tr in traces:
-                        tracespan.add_span(
-                            tr, "plane.recv", t_recv, dur,
-                            {"frame_values": int(n)},
-                        )
-                        tracespan.end(tr, status=200)
-                finally:
-                    with self._inflight_lock:
-                        self._inflight -= 1
+                process_frame(
+                    n, parsed, get_values,
+                    lambda data: send_ordered(None, data),
+                )
         except (ConnectionError, OSError) as e:
             # frontend went away; its requests fail on their side
             log.debug("compute-plane connection closed: %r", e)
         except Exception:  # pragma: no cover — must not die silently
             log.exception("compute-plane connection handler crashed")
         finally:
-            values = None
-            if shm_seg is not None:
+            conn_dead[0] = True
+            if executor[0] is not None:
+                executor[0].shutdown(wait=False)
+            if shm_state[0] is not None:
                 try:
-                    shm_seg.close()  # unmap only; the frontend owns unlink
+                    shm_state[0].close()  # unmap; the frontend owns unlink
                 except (OSError, BufferError):
                     # a surviving numpy view (e.g. a timed-out entry still
                     # holding its slice) pins the mapping — it is unmapped
@@ -786,7 +890,7 @@ class PlaneError(RuntimeError):
 
 class _PlaneRequest:
     __slots__ = ("body", "out", "error", "event", "cancelled", "trace",
-                 "enqueued", "program", "key", "hedged")
+                 "enqueued", "program", "key", "hedged", "replayed")
 
     def __init__(self, body: bytes, trace=None, program=None, key=None,
                  hedged: bool = False):
@@ -800,6 +904,23 @@ class _PlaneRequest:
         self.program = program    # registry address (None = default program)
         self.key = key            # API key (frames pack per (program, key))
         self.hedged = hedged      # re-routed here after a sibling failed
+        self.replayed = False     # one stale-socket requeue per request
+
+
+class _Shipment:
+    """One in-flight frame on a pipelined plane connection: everything the
+    receiver needs to complete (or the failure path to replay) it."""
+
+    __slots__ = ("batch", "traced", "t_ship", "use_shm", "shed",
+                 "replay_ok")
+
+    def __init__(self, batch, traced, t_ship, use_shm, shed, replay_ok):
+        self.batch = batch
+        self.traced = traced
+        self.t_ship = t_ship
+        self.use_shm = use_shm
+        self.shed = shed          # worker-local shed counts riding this frame
+        self.replay_ok = replay_ok  # NOT the first frame on a fresh dial
 
 
 class PlaneClient:
@@ -964,10 +1085,188 @@ class PlaneClient:
         return seg_box[0]
 
     def _dispatch_loop_inner(self, seg_box: list) -> None:
-        sock: socket.socket | None = None
-        armed = False  # shm offered + acked on the CURRENT socket
-        seg = None
+        # Pipelined dispatcher (r17): frames ship as soon as they are
+        # built, up to MISAKA_PLANE_PIPELINE outstanding on this
+        # connection (1 while the shm plane is armed — the double buffer
+        # requires the one-frame discipline); a per-socket receiver
+        # thread completes shipments in FIFO order (the wire carries no
+        # frame ids — order IS the pairing).  Failure discipline mirrors
+        # the r13 one-shot stale-socket replay, generalized: when a
+        # socket dies before ANY response arrived on it, outstanding
+        # requests are requeued (once each — _PlaneRequest.replayed) at
+        # the FRONT of the pending deque and rebuilt on a fresh dial; the
+        # first frame on a socket dialed FOR it never replays (a fresh
+        # dial that fails is a real error, not a stale socket), and a
+        # TIMEOUT never replays (the replica is slow or silent, not
+        # stale).  Lock order: `cond` (connection state) before
+        # self._cond (queue state), never the reverse.
+        depth = max(1, int(os.environ.get("MISAKA_PLANE_PIPELINE", "") or 4))
         seg_size = MAX_FRAME_VALUES * 4 if self._shm_enabled else 0
+        cond = threading.Condition()
+        gen: dict = {
+            "id": 0, "sock": None, "armed": False, "seg": None,
+            "outstanding": deque(), "responded": 0, "dead": True,
+            "inherited": False,  # a frame has shipped on this socket
+        }
+
+        def fail_requests(reqs, text: bytes, status: int = 502) -> None:
+            err = PlaneError(status, text)
+            for r in reqs:
+                r.error = err
+                r.event.set()
+
+        def remerge_shed(shed) -> None:
+            # the frame carrying these shed counts never arrived: put
+            # them back for the next frame — losing them silently
+            # under-reports the rejected counter during exactly the
+            # floods it exists to measure
+            if not shed:
+                return
+            with self._cond:
+                for sk, cnt in shed.items():
+                    self._shed[sk] = self._shed.get(sk, 0) + cnt
+
+        def conn_failed(gen_id: int, exc: BaseException) -> None:
+            """Tear down one socket generation (from the receiver or the
+            dispatcher's send path): fail or requeue its outstanding
+            shipments under the replay discipline above."""
+            with cond:
+                if gen["id"] != gen_id or gen["dead"]:
+                    return
+                gen["dead"] = True
+                outstanding = list(gen["outstanding"])
+                gen["outstanding"].clear()
+                responded = gen["responded"]
+                sock = gen["sock"]
+                gen["sock"] = None
+                cond.notify_all()
+                if outstanding:
+                    with self._cond:
+                        self._inflight -= len(outstanding)
+            try:
+                if sock is not None:
+                    sock.close()
+            except OSError:
+                pass
+            replay = responded == 0 and not isinstance(exc, TimeoutError)
+            requeue: list = []
+            failed: list = []
+            for shp in outstanding:
+                ok = replay and shp.replay_ok
+                for r in shp.batch:
+                    if ok and not r.replayed and not r.cancelled:
+                        r.replayed = True
+                        requeue.append(r)
+                    else:
+                        failed.append(r)
+                remerge_shed(shp.shed)
+            fail_requests(failed, f"compute plane error: {exc}".encode())
+            if requeue:
+                with self._cond:
+                    for r in reversed(requeue):
+                        self._pending.appendleft(r)
+                    self._cond.notify_all()
+
+        def receiver(sock: socket.socket, gen_id: int, seg) -> None:
+            try:
+                while True:
+                    # An IDLE connection parks here indefinitely: the
+                    # socket's own timeout fires with nothing outstanding
+                    # (the engine owes us nothing) and must not tear down
+                    # a healthy generation.  With frames outstanding, a
+                    # shipment gets its own full timeout budget from its
+                    # ship time — only a genuinely silent replica fails.
+                    while True:
+                        try:
+                            hdr = _recv_exact(sock, 8)
+                            break
+                        except TimeoutError:
+                            with cond:
+                                if gen["id"] != gen_id:
+                                    return
+                                oldest = (
+                                    gen["outstanding"][0].t_ship
+                                    if gen["outstanding"] else None
+                                )
+                            if (oldest is not None
+                                    and time.monotonic() - oldest
+                                    >= self._timeout):
+                                raise  # silent replica mid-frame
+                            continue
+                    status, length = _RESP_HDR.unpack(hdr)
+                    with cond:
+                        if gen["id"] != gen_id:
+                            return  # superseded generation
+                        if not gen["outstanding"]:
+                            raise struct.error(
+                                "response without an outstanding frame"
+                            )
+                        shp = gen["outstanding"][0]
+                    if status == 200:
+                        payload = (
+                            bytes(seg.buf[seg_size:seg_size + length * 4])
+                            if shp.use_shm
+                            else _recv_exact(sock, length * 4)
+                        )
+                        off = 0
+                        for r in shp.batch:
+                            r.out = payload[off:off + len(r.body)]
+                            off += len(r.body)
+                    else:
+                        err = PlaneError(status, _recv_exact(sock, length))
+                        if status == PLANE_DRAINING and self.replica is None:
+                            # plane-private status: a single-engine client
+                            # has no sibling to reroute to — surface as a
+                            # retryable 503 (the fleet router intercepts
+                            # the raw status before this mapping matters)
+                            err = PlaneError(503, err.body)
+                        for r in shp.batch:
+                            r.error = err
+                    dur = time.monotonic() - shp.t_ship
+                    ship_attrs = (
+                        {"replica": self.replica}
+                        if self.replica is not None else None
+                    )
+                    for r in shp.traced:
+                        tracespan.add_span(r.trace, "plane.ship",
+                                           shp.t_ship, dur, ship_attrs)
+                    with cond:
+                        if gen["id"] != gen_id:
+                            return
+                        gen["outstanding"].popleft()
+                        gen["responded"] += 1
+                        cond.notify_all()
+                    with self._cond:
+                        self._inflight -= 1
+                        self._cond.notify()  # a window-waiting dispatcher
+                    for r in shp.batch:
+                        r.event.set()
+            except (ConnectionError, OSError, struct.error) as e:
+                conn_failed(gen_id, e)
+
+        try:
+            self._dispatch_pipelined(seg_box, seg_size, depth, cond, gen,
+                                     fail_requests, remerge_shed,
+                                     conn_failed, receiver)
+        finally:
+            # pop the receiver out of its blocking recv: a closed client
+            # must not leak a thread parked on a live engine socket for
+            # the life of the process (the ComputePlane accept-leak
+            # lesson, one layer out)
+            with cond:
+                sock = gen["sock"]
+                gen["sock"] = None
+                gen["dead"] = True
+                cond.notify_all()
+            try:
+                if sock is not None:
+                    sock.close()
+            except OSError:
+                pass
+
+    def _dispatch_pipelined(self, seg_box, seg_size, depth, cond, gen,
+                            fail_requests, remerge_shed, conn_failed,
+                            receiver) -> None:
         while True:
             with self._cond:
                 while not self._pending and not self._closed:
@@ -1088,34 +1387,81 @@ class PlaneClient:
                         [t, r, n] for (t, r), n in shed_report.items()
                     ]
                 meta = _json.dumps(obj).encode()
-            t_ship = now
             payload_out = b"".join(r.body for r in batch)
-            # One stale-socket replay, the client-pool discipline
-            # (client.py retry_stale) one level down: a REUSED plane
-            # connection that fails is most often a replica that
-            # restarted between frames — retry once on a fresh dial
-            # before failing the batch (which in fleet mode would mark
-            # the whole replica down and hedge for nothing).  The frame
-            # is rebuilt per attempt: a fresh socket needs the shm
-            # re-offered before payloads may ride the segment.
-            for attempt in (0, 1):
-                reused = sock is not None
-                try:
-                    if sock is None:
+
+            # --- ship on the live socket generation, dialing as needed ---
+            dials = 0
+            while True:
+                with cond:
+                    need_dial = gen["dead"] or gen["sock"] is None
+                    gen_id = gen["id"]
+                if need_dial:
+                    dials += 1
+                    if dials > 2:
+                        with self._cond:
+                            self._inflight -= 1
+                            self._cond.notify()
+                        fail_requests(
+                            batch, b"compute plane error: dial failed"
+                        )
+                        remerge_shed(shed_report)
+                        break
+                    try:
                         sock = self._connect()
-                        armed = False
-                        if self._shm_enabled:
-                            seg = self._fresh_seg(seg_box, seg_size)
-                    if seg is not None and not armed:
-                        armed = self._arm_shm(sock, seg, seg_size)
-                    use_shm = armed and total <= seg_size
+                    except OSError as e:
+                        with self._cond:
+                            self._inflight -= 1
+                            self._cond.notify()
+                        fail_requests(
+                            batch, f"compute plane error: {e}".encode()
+                        )
+                        remerge_shed(shed_report)
+                        break
+                    armed = False
+                    seg = None
+                    if self._shm_enabled:
+                        seg = self._fresh_seg(seg_box, seg_size)
+                    if seg is not None:
+                        try:
+                            armed = self._arm_shm(sock, seg, seg_size)
+                        except (ConnectionError, OSError, struct.error):
+                            try:
+                                sock.close()
+                            except OSError:
+                                pass
+                            continue  # one more dial, then give up
+                    with cond:
+                        gen["id"] += 1
+                        gen_id = gen["id"]
+                        gen.update(sock=sock, seg=seg, armed=armed,
+                                   dead=False, responded=0,
+                                   inherited=False)
+                        gen["outstanding"].clear()
+                    threading.Thread(
+                        target=receiver, daemon=True,
+                        args=(sock, gen_id, seg if armed else None),
+                        name="misaka-plane-recv",
+                    ).start()
+                with cond:
+                    if gen["id"] != gen_id or gen["dead"]:
+                        continue
+                    # pipeline backpressure: shm's double buffer needs
+                    # strict one-in-flight; sockets take `depth`
+                    eff = 1 if gen["armed"] else depth
+                    while (not gen["dead"] and gen["sock"] is not None
+                           and len(gen["outstanding"]) >= eff):
+                        cond.wait(0.2)
+                    if gen["dead"] or gen["sock"] is None:
+                        continue  # the generation died while we waited
+                    use_shm = gen["armed"] and total <= seg_size
                     if use_shm:
-                        # payload into the segment; header + metadata
-                        # (which must then exist, to carry the count)
-                        # stay on the socket
+                        # payload into the segment (safe: zero frames
+                        # outstanding on an armed connection); header +
+                        # metadata (which must then exist, to carry the
+                        # count) stay on the socket
                         import json as _json
 
-                        seg.buf[0:total] = payload_out
+                        gen["seg"].buf[0:total] = payload_out
                         shm_meta = _json.dumps(
                             {"program": program, "shm_vals": total // 4}
                         ).encode() if not meta else (
@@ -1127,70 +1473,26 @@ class PlaneClient:
                             _REQ_HDR.pack(total // 4, len(meta))
                             + payload_out + meta
                         )
-                    sock.sendall(frame)
-                    status, length = _RESP_HDR.unpack(_recv_exact(sock, 8))
-                    if status == 200:
-                        payload = (
-                            bytes(seg.buf[seg_size:seg_size + length * 4])
-                            if use_shm else _recv_exact(sock, length * 4)
-                        )
-                        off = 0
-                        for r in batch:
-                            r.out = payload[off:off + len(r.body)]
-                            off += len(r.body)
-                    else:
-                        err = PlaneError(status, _recv_exact(sock, length))
-                        if status == PLANE_DRAINING and self.replica is None:
-                            # plane-private status: a single-engine client
-                            # has no sibling to reroute to — surface as a
-                            # retryable 503 (the fleet router intercepts
-                            # the raw status before this mapping matters)
-                            err = PlaneError(503, err.body)
-                        for r in batch:
-                            r.error = err
-                    dur = time.monotonic() - t_ship
-                    ship_attrs = (
-                        {"replica": self.replica}
-                        if self.replica is not None else None
+                    shp = _Shipment(
+                        batch, traced, time.monotonic(), use_shm,
+                        shed_report, replay_ok=gen["inherited"],
                     )
-                    for r in traced:
-                        tracespan.add_span(r.trace, "plane.ship", t_ship,
-                                           dur, ship_attrs)
-                except (ConnectionError, OSError, struct.error) as e:
-                    try:
-                        if sock is not None:
-                            sock.close()
-                    except OSError:
-                        pass
-                    sock = None  # reconnect on the next frame
-                    if (
-                        reused and attempt == 0
-                        and not isinstance(e, TimeoutError)
-                    ):
-                        # (a TIMEOUT is not a stale socket — the replica
-                        # is slow or silent; replaying would double the
-                        # stall while the waiter has already hedged)
-                        continue
-                    err = PlaneError(
-                        502, f"compute plane error: {e}".encode()
-                    )
-                    for r in batch:
-                        r.error = err
-                    if shed_report:
-                        # the frame carrying these shed counts never
-                        # arrived: put them back for the next frame —
-                        # losing them silently under-reports the
-                        # rejected counter during exactly the floods it
-                        # exists to measure
-                        with self._cond:
-                            for sk, n in shed_report.items():
-                                self._shed[sk] = self._shed.get(sk, 0) + n
+                    # enqueue BEFORE sending (a response cannot arrive
+                    # before its frame's bytes do), so the send itself
+                    # runs OUTSIDE the lock: a blocking sendall holding
+                    # `cond` would stall the receiver's completion path —
+                    # with full socket buffers both directions that is a
+                    # four-way wedge only the timeout could break
+                    sock_now = gen["sock"]
+                    gen["outstanding"].append(shp)
+                    gen["inherited"] = True
+                try:
+                    sock_now.sendall(frame)
+                except (ConnectionError, OSError) as send_exc:
+                    # conn_failed sees this batch among the outstanding
+                    # shipments and applies the replay discipline to it
+                    conn_failed(gen_id, send_exc)
                 break
-            with self._cond:
-                self._inflight -= 1
-                self._cond.notify()  # a window-waiting dispatcher can go
-            for r in batch:
-                r.event.set()
 
 
 class _RouterReplica:

@@ -1802,6 +1802,27 @@ class MasterNode:
     def is_running(self) -> bool:
         return self._running
 
+    def _sync_native_state(self) -> None:
+        """Materialize resident native-engine state into self._state (r17).
+
+        The native engines keep batch state IN C++ between serve calls,
+        returning their identity anchor with stale array contents — so
+        every path that READS self._state's content (checkpoint, snapshot,
+        autogrow, /status, the loop's boot counters, the idle-path ring
+        drain) must export first.  No-op for non-native engines and when
+        residency is not armed.  Caller holds _state_lock (export and the
+        serve path are thereby serialized — the pool has one caller)."""
+        export = getattr(self._runner, "export_resident", None)
+        if export is None:
+            return
+        # anchor-gated: if a lifecycle path (reset/load/restore) already
+        # REPLACED self._state, the resident copy is superseded and the
+        # export must not clobber the fresh state — the engine exports
+        # only when self._state IS its identity anchor
+        st = export(self._state)
+        if st is not None:
+            self._state = st
+
     def status(self) -> dict:
         """Live metrics (additive vs the reference, which has none —
         SURVEY.md §5: stdlib log lines were its only observability).
@@ -1811,6 +1832,7 @@ class MasterNode:
         outside the lock races with invalidation on TPU.
         """
         with self._state_lock:
+            self._sync_native_state()
             state = self._state
             topo = self._topology
             # Batched states carry a leading [B] axis; report totals across
@@ -1947,6 +1969,7 @@ class MasterNode:
 
         t0 = time.perf_counter()
         with self._state_lock:
+            self._sync_native_state()
             state = self._state
             topo = self._topology
             arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
@@ -2119,6 +2142,7 @@ class MasterNode:
         import jax
 
         with self._state_lock:
+            self._sync_native_state()
             return jax.tree.map(lambda x: x.copy(), self._state)
 
     def restore(self, state) -> None:
@@ -2257,6 +2281,7 @@ class MasterNode:
         import jax.numpy as jnp
 
         with self._state_lock:
+            self._sync_native_state()
             net = self._net
             tops = np.asarray(self._state.stack_top)
             if not (tops >= net.stack_cap).any():
@@ -2297,6 +2322,7 @@ class MasterNode:
             if self._net is not net:  # lifecycle swapped the network under us
                 self._close_runner(new_runner)
                 return
+            self._sync_native_state()  # the pad below reads state content
             pad = [(0, 0)] * (self._state.stack_mem.ndim - 1) \
                 + [(0, new_cap - net.stack_cap)]
             old_runner = self._runner
@@ -2476,11 +2502,30 @@ class MasterNode:
         return None if active.size >= self._n_slots else active
 
     def _native_note_progress(self, state, active) -> None:
-        """Refresh the hot set from per-replica retired deltas after a
-        native chunk: a replica that retired nothing across a whole chunk
-        is blocked awaiting input and safe to skip until fed again."""
+        """Refresh the hot set from per-replica progress after a native
+        chunk: a replica that retired nothing across a whole chunk is
+        blocked awaiting input and safe to skip until fed again.
+
+        The resident pool (r17) reports MEASURED per-replica progress
+        flags from the C++ side — state.retired is stale while the state
+        lives in C++ — and the flags are this chunk's deltas already, so
+        no baseline pass is needed.  The stateless path keeps deriving
+        the signal from exported retired deltas."""
+        prog_fn = getattr(self._runner, "consume_progress", None)
+        prog = prog_fn() if prog_fn is not None else None
+        if prog is not None:
+            if active is None:
+                self._native_hot = prog.astype(bool)
+            else:
+                self._native_hot[:] = False
+                self._native_hot[active] = prog[active].astype(bool)
+            self._retired_prev = True  # flags mode: baseline is implicit
+            return
         ret = np.asarray(state.retired).sum(axis=1)
-        prev = self._retired_prev
+        # a mode switch (resident -> stateless fallback) leaves the True
+        # sentinel here, which is "baseline exists" but not an array
+        prev = self._retired_prev \
+            if isinstance(self._retired_prev, np.ndarray) else None
         if prev is None or active is None:
             # no baseline: keep everyone hot one pass so real deltas form
             self._native_hot = (
@@ -2497,7 +2542,11 @@ class MasterNode:
         # next iteration's feed decisions: between chunks nothing on the
         # device moves, so post-run counters are exact — and on a relayed
         # device every extra read is a round trip on the serve path.
-        ctrs = self._net.counters(self._state)  # [4] or [4, B]
+        # Resident native state is materialized first: this boot read is
+        # the one per-run() place the loop consumes state CONTENT.
+        with self._state_lock:
+            self._sync_native_state()
+            ctrs = self._net.counters(self._state)  # [4] or [4, B]
         while self._running:
             busy = False
             t_iter = time.perf_counter()
@@ -2595,6 +2644,22 @@ class MasterNode:
                             p = np.asarray(packed)  # [B, 4]: counters only
                             ctrs = p.T
                             if (p[:, 3] > p[:, 2]).any():
+                                if native:
+                                    # resident pools: materialize before
+                                    # the host-side ring drain (the state
+                                    # object's out_buf is stale while the
+                                    # state lives in C++); the rebuilt
+                                    # drained state misses the identity
+                                    # cache once — this path only fires
+                                    # when an UNFED chunk emitted values
+                                    exp = getattr(
+                                        self._runner, "export_resident",
+                                        None,
+                                    )
+                                    st2 = exp(state) if exp is not None \
+                                        else None
+                                    if st2 is not None:
+                                        state = st2
                                 state, per_slot = self._net.drain_batched(
                                     state, rd=p[:, 2], wr=p[:, 3]
                                 )

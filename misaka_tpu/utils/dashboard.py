@@ -38,6 +38,10 @@ PANELS = (
      "max", ""),
     ("Native pool busy fraction", "misaka_native_pool_busy_fraction",
      "max", ""),
+    ("Residency hit ratio", "misaka_native_resident_hit_ratio",
+     "max", ""),
+    ("Pipelined plane frames (/s)", "misaka_plane_pipelined_frames_total",
+     "sum", "/s"),
     ("SIMD lane width", "misaka_native_simd_lane_width", "max", ""),
     ("Specialized engines", "misaka_native_specialized_active", "max", ""),
     ("Plane shm frames (/s)", "misaka_plane_shm_frames_total", "sum", "/s"),

@@ -107,6 +107,7 @@ POINTS = frozenset({
     "overload",
     "quota_exhaust",
     "specialize_fail",
+    "resident_fallback",
 })
 
 # Points that accept a ":<qualifier>" suffix scoping the fault to one

@@ -21,14 +21,19 @@
 
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
+#include <climits>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
-#include <mutex>
 #include <thread>
 #include <utility>
 #include <vector>
+
+#if defined(__linux__)
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+#endif
 
 // Per-program specialization (core/specialize.py): the build injects
 // -DMISAKA_SPEC_HEADER pointing at a generated header that bakes ONE
@@ -57,6 +62,52 @@ enum Field { F_OP = 0, F_SRC, F_IMM, F_DST, F_TGT, F_PORT, F_JMP, NFIELDS };
 constexpr int kPorts = 4;
 
 inline int32_t i32(int64_t v) { return (int32_t)(uint32_t)(uint64_t)v; }
+
+// --- flat futex/spin dispenser primitives (r17) ----------------------------
+//
+// The serving pool's per-call wake used to be a condition-variable
+// broadcast plus a mutexed done barrier: ~180us/call of futex churn and
+// lock convoys at 24 threads (BENCH_HISTORY r16).  The pool below runs the
+// same one-caller/many-workers discipline on flat atomics instead: the
+// caller publishes a job by bumping `job_seq` (workers spin briefly — the
+// inter-call gap under load is shorter than a context switch — then park
+// on a futex), the existing atomic unit dispenser hands out work, and the
+// last worker to finish stores `done_seq` and wakes the caller, which
+// spins-then-parks symmetrically.  Happens-before rides the atomics (the
+// seq_cst bump of job_seq publishes the job arrays; the acq_rel countdown
+// of active_workers chains every worker's writes into the release store
+// of done_seq), so no mutex is needed anywhere on the round trip.  On
+// non-Linux the futex calls degrade to yield — every wait loop re-checks
+// its predicate.
+
+inline void cpu_pause() {
+#if defined(__x86_64__) || defined(__i386__)
+  __builtin_ia32_pause();
+#else
+  std::this_thread::yield();
+#endif
+}
+
+inline void futex_wait_u32(std::atomic<uint32_t>* addr, uint32_t expect) {
+#if defined(__linux__)
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAIT_PRIVATE,
+          expect, nullptr, nullptr, 0);
+#else
+  (void)addr;
+  (void)expect;
+  std::this_thread::yield();
+#endif
+}
+
+inline void futex_wake_u32(std::atomic<uint32_t>* addr, int n) {
+#if defined(__linux__)
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAKE_PRIVATE,
+          n, nullptr, nullptr, 0);
+#else
+  (void)addr;
+  (void)n;
+#endif
+}
 
 inline bool reads_src(int op) {
   switch (op) {
@@ -522,14 +573,15 @@ SimdMode simd_mode_from_env() {
 
 // One pool serve/idle job (batch-major state arrays, see misaka_pool_serve).
 struct Job {
-  int32_t *acc, *bak, *pc, *port_val;
-  uint8_t* port_full;
-  int32_t* hold_val;
-  uint8_t* holding;
-  int32_t *stack_mem, *stack_top, *in_buf, *out_buf, *counters, *retired;
-  int32_t *acc_hi, *bak_hi;
-  const int32_t* feed_vals;    // [B, in_cap], null when idle
-  const int32_t* feed_counts;  // [B], null when idle
+  int32_t *acc = nullptr, *bak = nullptr, *pc = nullptr, *port_val = nullptr;
+  uint8_t* port_full = nullptr;
+  int32_t* hold_val = nullptr;
+  uint8_t* holding = nullptr;
+  int32_t *stack_mem = nullptr, *stack_top = nullptr, *in_buf = nullptr,
+          *out_buf = nullptr, *counters = nullptr, *retired = nullptr;
+  int32_t *acc_hi = nullptr, *bak_hi = nullptr;
+  const int32_t* feed_vals = nullptr;    // [B, in_cap], null when idle
+  const int32_t* feed_counts = nullptr;  // [B], null when idle
   int ticks = 0;
   bool feeding = false;
   int32_t* packed = nullptr;  // [B, 4+out_cap] serve / [B, 4] idle
@@ -537,9 +589,14 @@ struct Job {
   // (strictly increasing, validated at the entry point) are imported,
   // fed, run, and exported — an underfilled serve pass pays for the
   // replicas actually working, not the whole batch.  The Python caller
-  // prefills skipped replicas' packed rows from their current counters.
+  // prefills skipped replicas' packed rows from their current counters
+  // (on the RESIDENT path the C++ side fills every row itself).
   const int32_t* active = nullptr;
   int n_active = 0;
+  // Resident-path extras: progress[rep] = 1 when the replica retired an
+  // instruction during the call — the device loop's hot-set signal, which
+  // the stateless path derives from the exported `retired` plane.
+  uint8_t* progress = nullptr;
 };
 
 // SoA scratch for one group of kGroupW replicas.  Pure scratch: state lives
@@ -656,20 +713,104 @@ struct SpecSpec {
 
 #define MISAKA_AI inline __attribute__((always_inline))
 
-// One group tick: Interp::tick with the replica axis widened to kGroupW.
-// Returns whether ANY replica progressed — a no-progress replica's tick is
-// an identity step (determinism: it can never wake without external input),
-// so lockstep over the group preserves per-replica bit-identity with the
-// scalar engine's individual early exit.
+// Per-tick ring/IO arbitration state shared between the tick passes: what
+// pass 2 discovers (per-replica IN/OUT winners) and pass 3 applies.  A
+// plain aggregate so the generated switch-threaded tick (specialize.py
+// part 2) shares the exact prologue/epilogue code with the generic tick.
+struct TickIO {
+  uint8_t in_avail[kGroupW], out_free[kGroupW];
+  uint8_t in_taken[kGroupW], out_taken[kGroupW];
+  int32_t in_win[kGroupW], out_value[kGroupW];
+};
+
+// Scratch reset + begin-of-tick snapshots: runs after pass 1 (phase A),
+// before arbitration.  Shared single-source with the specialized tick.
 template <class S>
-MISAKA_AI bool group_tick(Group& g) {
+MISAKA_AI void tick_prologue(Group& g, TickIO& io) {
+  constexpr int W = kGroupW;
+  const int n = S::n_lanes(g);
+  const int ns = S::num_stacks(g);
+  const int ocap = S::out_cap(g);
+  std::memset(g.s_deliv_full.data(), 0, (size_t)n * kPorts * W);
+  std::memcpy(g.s_begin_top.data(), g.stack_top.data(),
+              (size_t)ns * W * sizeof(int32_t));
+  std::memset(g.s_stack_taken.data(), 0, (size_t)ns * W);
+  std::memset(g.s_pushed.data(), 0, (size_t)ns * W);
+#pragma omp simd
+  for (int r = 0; r < W; ++r) {
+    io.in_avail[r] = (uint8_t)(g.in_wr[r] - g.in_rd[r] > 0);
+    io.out_free[r] = (uint8_t)(g.out_wr[r] - g.out_rd[r] < ocap);
+    io.in_taken[r] = io.out_taken[r] = 0;
+    io.in_win[r] = -1;
+    io.out_value[r] = 0;
+  }
+}
+
+// pass 3 — apply resource effects (contiguous over the replica axis).
+// Masked-out replicas never wrote arbitration scratch, so the port and
+// stack loops are naturally no-ops for them; only the per-replica ring
+// winners and the tick-count advance need the explicit gate.
+template <class S, bool kMasked>
+MISAKA_AI bool tick_epilogue(Group& g, TickIO& io, const uint8_t* moved,
+                             const uint8_t* mask) {
+  constexpr int W = kGroupW;
+  const int n = S::n_lanes(g);
+  const int ns = S::num_stacks(g);
+  const int scap = S::stack_cap(g);
+  const int ocap = S::out_cap(g);
+  {
+    const size_t np = (size_t)n * kPorts * W;
+#pragma omp simd
+    for (size_t pi = 0; pi < np; ++pi) {
+      if (g.s_deliv_full[pi]) {
+        g.port_full[pi] = 1;
+        g.port_val[pi] = g.s_deliv_val[pi];
+      }
+    }
+  }
+  for (int s = 0; s < ns; ++s) {
+    for (int r = 0; r < W; ++r) {
+      const size_t si = (size_t)s * W + r;
+      if (g.s_pushed[si]) {
+        g.stack_mem[((size_t)r * ns + s) * scap + g.s_begin_top[si]] =
+            g.s_push_val[si];
+        g.stack_top[si] = g.s_begin_top[si] + 1;
+      } else if (g.s_stack_taken[si]) {
+        g.stack_top[si] = g.s_begin_top[si] - 1;  // a granted POP
+      }
+    }
+  }
+  bool any = false;
+  for (int r = 0; r < W; ++r) {
+    if (kMasked && !mask[r]) continue;
+    if (io.in_win[r] >= 0) g.in_rd[r] += 1;
+    if (io.out_taken[r]) {
+      g.out_buf[(size_t)r * ocap + g.out_wr[r] % ocap] = io.out_value[r];
+      g.out_wr[r] += 1;
+    }
+    g.tick_count[r] = i32((int64_t)g.tick_count[r] + 1);  // wrap-safe
+    any |= moved[r] != 0;
+  }
+  return any;
+}
+
+// One group tick: Interp::tick with the replica axis widened to kGroupW.
+// Returns whether ANY masked-in replica progressed — a no-progress
+// replica's tick is an identity step (determinism: it can never wake
+// without external input), so lockstep over the group preserves
+// per-replica bit-identity with the scalar engine's individual early
+// exit.  kMasked gates replicas OUT of the tick entirely (partial fill on
+// the resident path): a masked-out replica's state — registers, latches,
+// ports, rings, tick count — is bit-untouched, exactly as if it had been
+// left off a stateless call's active list.
+template <class S, bool kMasked>
+MISAKA_AI bool group_tick(Group& g, const uint8_t* mask) {
   constexpr int W = kGroupW;
   const int n = S::n_lanes(g);
   const int ml = S::max_len(g);
   const int ns = S::num_stacks(g);
   const int scap = S::stack_cap(g);
   const int icap = S::in_cap(g);
-  const int ocap = S::out_cap(g);
   const int32_t* code = S::code(g);
   const int32_t* plen = S::prog_len(g);
 
@@ -682,7 +823,9 @@ MISAKA_AI bool group_tick(Group& g) {
   // pass 1 — fetch + phase A + source resolution, fused per (lane,
   // replica): all three touch only the lane's OWN latch/registers, so
   // they need no cross-lane ordering.  The instruction pointer is cached
-  // for pass 2 (pc is stable until commit).
+  // for pass 2 (pc is stable until commit).  Masked-out replicas still
+  // resolve sources into scratch (harmless — pass 2 skips them) but must
+  // never consume a port: that is a state change.
   for (int l = 0; l < n; ++l) {
     const int32_t* base = code + (size_t)l * ml * NFIELDS;
     for (int r = 0; r < W; ++r) {
@@ -693,7 +836,8 @@ MISAKA_AI bool group_tick(Group& g) {
       g.s_op[i] = op;
       const bool reads = (kReads >> op) & 1u;
       // phase A: consume a ready port source into the hold latch
-      if (reads && src >= SRC_R0 && !g.holding[i]) {
+      if (reads && src >= SRC_R0 && !g.holding[i] &&
+          (!kMasked || mask[r])) {
         const size_t pi = (size_t)(l * kPorts + (src - SRC_R0)) * W + r;
         if (g.port_full[pi]) {
           g.hold_val[i] = g.port_val[pi];
@@ -713,6 +857,9 @@ MISAKA_AI bool group_tick(Group& g) {
     }
   }
 
+  TickIO io;
+  tick_prologue<S>(g, io);
+
   // pass 2 — arbitration + commit, fused: lowest lane index wins each
   // per-replica resource, and since later lanes' grants can never change
   // an earlier lane's, the commit (register/pc effects reading
@@ -721,24 +868,10 @@ MISAKA_AI bool group_tick(Group& g) {
   // EFFECTS still wait for pass 3: sends must see post-consume,
   // pre-delivery occupancy, stack feasibility keys on begin-of-tick tops,
   // and IN reads the ring at the begin-of-tick read cursor.
-  std::memset(g.s_deliv_full.data(), 0, (size_t)n * kPorts * W);
-  std::memcpy(g.s_begin_top.data(), g.stack_top.data(),
-              (size_t)ns * W * sizeof(int32_t));
-  std::memset(g.s_stack_taken.data(), 0, (size_t)ns * W);
-  std::memset(g.s_pushed.data(), 0, (size_t)ns * W);
-  uint8_t in_avail[W], out_free[W], in_taken[W], out_taken[W];
-  int32_t in_win[W], out_value[W];
-#pragma omp simd
-  for (int r = 0; r < W; ++r) {
-    in_avail[r] = (uint8_t)(g.in_wr[r] - g.in_rd[r] > 0);
-    out_free[r] = (uint8_t)(g.out_wr[r] - g.out_rd[r] < ocap);
-    in_taken[r] = out_taken[r] = 0;
-    in_win[r] = -1;
-    out_value[r] = 0;
-  }
   for (int l = 0; l < n; ++l) {
     const int32_t ln = plen[l];
     for (int r = 0; r < W; ++r) {
+      if (kMasked && !mask[r]) continue;
       const int i = l * W + r;
       const int op = g.s_op[i];
       const int32_t* f = g.f_ptr[i];
@@ -782,17 +915,17 @@ MISAKA_AI bool group_tick(Group& g) {
         }
         case OP_IN:
           commit = false;
-          if (in_avail[r] && !in_taken[r]) {
-            in_taken[r] = 1;
-            in_win[r] = l;
+          if (io.in_avail[r] && !io.in_taken[r]) {
+            io.in_taken[r] = 1;
+            io.in_win[r] = l;
             commit = true;
           }
           break;
         case OP_OUT:
           commit = false;
-          if (g.s_src_ok[i] && out_free[r] && !out_taken[r]) {
-            out_taken[r] = 1;
-            out_value[r] = i32(g.s_src_val[i]);
+          if (g.s_src_ok[i] && io.out_free[r] && !io.out_taken[r]) {
+            io.out_taken[r] = 1;
+            io.out_value[r] = i32(g.s_src_val[i]);
             commit = true;
           }
           break;
@@ -845,59 +978,53 @@ MISAKA_AI bool group_tick(Group& g) {
     }
   }
 
-  // pass 3 — apply resource effects (contiguous over the replica axis)
-  {
-    const size_t np = (size_t)n * kPorts * W;
-#pragma omp simd
-    for (size_t pi = 0; pi < np; ++pi) {
-      if (g.s_deliv_full[pi]) {
-        g.port_full[pi] = 1;
-        g.port_val[pi] = g.s_deliv_val[pi];
-      }
-    }
-  }
-  for (int s = 0; s < ns; ++s) {
-    for (int r = 0; r < W; ++r) {
-      const size_t si = (size_t)s * W + r;
-      if (g.s_pushed[si]) {
-        g.stack_mem[((size_t)r * ns + s) * scap + g.s_begin_top[si]] =
-            g.s_push_val[si];
-        g.stack_top[si] = g.s_begin_top[si] + 1;
-      } else if (g.s_stack_taken[si]) {
-        g.stack_top[si] = g.s_begin_top[si] - 1;  // a granted POP
-      }
-    }
-  }
-  bool any = false;
-  for (int r = 0; r < W; ++r) {
-    if (in_win[r] >= 0) g.in_rd[r] += 1;
-    if (out_taken[r]) {
-      g.out_buf[(size_t)r * ocap + g.out_wr[r] % ocap] = out_value[r];
-      g.out_wr[r] += 1;
-    }
-    g.tick_count[r] = i32((int64_t)g.tick_count[r] + 1);  // wrap-safe
-    any |= moved[r] != 0;
-  }
-  return any;
+  return tick_epilogue<S, kMasked>(g, io, moved, mask);
 }
 
-// interp_run widened to the group: early exit when NO replica progresses
-// (per-replica quiescence is monotone, so identity steps before the group
-// quiesces preserve bit-identity), tick counters topped up to exactly
-// +ticks, ring counters rebased below the int32 wrap per replica.
-template <class S>
-MISAKA_AI void group_run(Group& g, int ticks) {
+// Switch-threaded specialized tick (core/specialize.py, header part 2):
+// the generated second section of the spec header defines
+// misaka_spec_tick<kMasked>(Group&, const uint8_t*) — the SAME three-pass
+// tick with every (lane, pc) instruction dispatched through a switch
+// whose cases carry the instruction fields AND the pc successors as
+// literals, so instruction fetch stops chasing per-replica pc through
+// gathers entirely (the modulo pc advance folds to a constant too).  It
+// is included HERE, after Group/TickIO/the pass helpers it calls, and
+// shares tick_prologue/tick_epilogue so the resource-effect semantics
+// stay single-source.  An r16-era cached header without part 2 simply
+// never defines MISAKA_SPEC_SWITCH and keeps the generic template tick.
+#if defined(MISAKA_SPEC) && defined(MISAKA_SPEC_SWITCH)
+#define MISAKA_SPEC_PART2 1
+#include MISAKA_SPEC_HEADER
+#undef MISAKA_SPEC_PART2
+#endif
+
+template <class S, bool kMasked>
+MISAKA_AI bool group_tick_for(Group& g, const uint8_t* mask) {
+#if defined(MISAKA_SPEC) && defined(MISAKA_SPEC_SWITCH)
+  if constexpr (S::is_spec) return misaka_spec_tick<kMasked>(g, mask);
+#endif
+  return group_tick<S, kMasked>(g, mask);
+}
+
+// interp_run widened to the group: early exit when NO masked-in replica
+// progresses (per-replica quiescence is monotone, so identity steps
+// before the group quiesces preserve bit-identity), tick counters topped
+// up to exactly +ticks, ring counters rebased below the int32 wrap per
+// replica.  Masked-out replicas are untouched throughout.
+template <class S, bool kMasked>
+MISAKA_AI void group_run(Group& g, int ticks, const uint8_t* mask) {
   constexpr int W = kGroupW;
   const int icap = S::in_cap(g);
   const int ocap = S::out_cap(g);
   int executed = 0;
   for (; executed < ticks;) {
     ++executed;
-    if (!group_tick<S>(g)) break;
+    if (!group_tick_for<S, kMasked>(g, mask)) break;
   }
   const int remaining = ticks - executed;
   const int32_t kThreshold = 1 << 30;
   for (int r = 0; r < W; ++r) {
+    if (kMasked && !mask[r]) continue;
     if (remaining)
       g.tick_count[r] = i32((int64_t)g.tick_count[r] + remaining);
     if (g.in_rd[r] > kThreshold) {
@@ -913,15 +1040,12 @@ MISAKA_AI void group_run(Group& g, int ticks) {
   }
 }
 
-// One full group serve/idle: validate -> import (transpose batch-major
-// slices into the SoA planes) -> feed -> run -> pack/drain -> export.
-// Mirrors Pool::serve_replica exactly.  Returns 0 on success; any
-// validation or feed-capacity violation returns nonzero BEFORE touching
-// the job arrays, and the caller reruns the whole group down the scalar
-// per-replica path so error codes and partial-failure state semantics
-// stay byte-identical to the shipped engine.
+// Validate one group's batch-major state slices — the exact checks
+// write_state performs — plus (feeding) the ring-headroom check, WITHOUT
+// touching the group.  Nonzero tells the caller to refuse an import or
+// rerun the group down the scalar path.
 template <class S>
-MISAKA_AI int group_serve(Group& g, const Job& j, int rep0) {
+MISAKA_AI int group_validate(const Group& g, const Job& j, int rep0) {
   constexpr int W = kGroupW;
   const int n = S::n_lanes(g);
   const int ns = S::num_stacks(g);
@@ -929,7 +1053,6 @@ MISAKA_AI int group_serve(Group& g, const Job& j, int rep0) {
   const int icap = S::in_cap(g);
   const int ocap = S::out_cap(g);
   const int32_t* plen = S::prog_len(g);
-
   for (int r = 0; r < W; ++r) {
     const int rep = rep0 + r;
     const int32_t* pc = j.pc + (size_t)rep * n;
@@ -947,7 +1070,19 @@ MISAKA_AI int group_serve(Group& g, const Job& j, int rep0) {
       if (count > icap - (c[1] - c[0])) return 1;  // scalar path reports -2
     }
   }
+  return 0;
+}
 
+// Import: transpose batch-major slices into the SoA planes (the caller
+// validated first).
+template <class S>
+MISAKA_AI void group_import(Group& g, const Job& j, int rep0) {
+  constexpr int W = kGroupW;
+  const int n = S::n_lanes(g);
+  const int ns = S::num_stacks(g);
+  const int scap = S::stack_cap(g);
+  const int icap = S::in_cap(g);
+  const int ocap = S::out_cap(g);
   for (int r = 0; r < W; ++r) {
     const int rep = rep0 + r;
     const int32_t* a = j.acc + (size_t)rep * n;
@@ -993,21 +1128,33 @@ MISAKA_AI int group_serve(Group& g, const Job& j, int rep0) {
     g.out_wr[r] = c[3];
     g.tick_count[r] = c[4];
   }
+}
 
-  if (j.feeding) {
-    for (int r = 0; r < W; ++r) {
-      const int rep = rep0 + r;
-      const int count = j.feed_counts[rep];
-      const int32_t* vals = j.feed_vals + (size_t)rep * icap;
-      for (int k = 0; k < count; ++k) {
-        g.in_buf[(size_t)r * icap + g.in_wr[r] % icap] = vals[k];
-        g.in_wr[r] += 1;
-      }
+// Feed masked-in replicas' pending values into their input rings (the
+// caller checked headroom).
+template <class S, bool kMasked>
+MISAKA_AI void group_feed(Group& g, const Job& j, int rep0,
+                          const uint8_t* mask) {
+  constexpr int W = kGroupW;
+  const int icap = S::in_cap(g);
+  for (int r = 0; r < W; ++r) {
+    if (kMasked && !mask[r]) continue;
+    const int rep = rep0 + r;
+    const int count = j.feed_counts[rep];
+    const int32_t* vals = j.feed_vals + (size_t)rep * icap;
+    for (int k = 0; k < count; ++k) {
+      g.in_buf[(size_t)r * icap + g.in_wr[r] % icap] = vals[k];
+      g.in_wr[r] += 1;
     }
   }
+}
 
-  group_run<S>(g, j.ticks);
-
+// Pack the post-run snapshot rows (serve: counters + ring, then drain;
+// idle: counters only, ring untouched).
+template <class S>
+MISAKA_AI void group_pack(Group& g, const Job& j, int rep0) {
+  constexpr int W = kGroupW;
+  const int ocap = S::out_cap(g);
   if (j.feeding) {
     for (int r = 0; r < W; ++r) {
       int32_t* row = j.packed + (size_t)(rep0 + r) * (4 + ocap);
@@ -1028,7 +1175,17 @@ MISAKA_AI int group_serve(Group& g, const Job& j, int rep0) {
       row[3] = g.out_wr[r];  // idle: counters only, ring untouched
     }
   }
+}
 
+// Export: transpose the SoA planes back into the batch-major slices.
+template <class S>
+MISAKA_AI void group_export(Group& g, const Job& j, int rep0) {
+  constexpr int W = kGroupW;
+  const int n = S::n_lanes(g);
+  const int ns = S::num_stacks(g);
+  const int scap = S::stack_cap(g);
+  const int icap = S::in_cap(g);
+  const int ocap = S::out_cap(g);
   for (int r = 0; r < W; ++r) {
     const int rep = rep0 + r;
     int32_t* a = j.acc + (size_t)rep * n;
@@ -1077,16 +1234,105 @@ MISAKA_AI int group_serve(Group& g, const Job& j, int rep0) {
     c[3] = g.out_wr[r];
     c[4] = g.tick_count[r];
   }
+}
+
+// One full STATELESS group serve/idle: validate -> import -> feed -> run
+// -> pack/drain -> export.  Mirrors Pool::serve_replica exactly.  Returns
+// 0 on success; any validation or feed-capacity violation returns nonzero
+// BEFORE touching the job arrays, and the caller reruns the whole group
+// down the scalar per-replica path so error codes and partial-failure
+// state semantics stay byte-identical to the shipped engine.
+template <class S>
+MISAKA_AI int group_serve(Group& g, const Job& j, int rep0) {
+  if (group_validate<S>(g, j, rep0)) return 1;
+  group_import<S>(g, j, rep0);
+  if (j.feeding) group_feed<S, false>(g, j, rep0, nullptr);
+  group_run<S, false>(g, j.ticks, nullptr);
+  group_pack<S>(g, j, rep0);
+  group_export<S>(g, j, rep0);
   return 0;
 }
 
-// The template instantiated through target wrappers: the avx2 variants get
-// AVX2 codegen for the always-inlined body (runtime-selected), the plain
-// ones are the scalar fallback from the SAME template.
+// One RESIDENT group serve/idle (r17): state lives in `g` between calls —
+// no import, no export, no transpose.  `mask` (null = every replica)
+// gates which replicas tick; a masked-out row keeps its state untouched
+// but still gets its packed row filled — current counters, plus the
+// drained-on-serve contract for an undrained ring on a feeding pass
+// (exactly what the Python caller used to prefill from its own copy of
+// the counters, which residency no longer has).  Returns 0, or -2 when a
+// feed exceeds a ring's free space — checked for the WHOLE group before
+// any effect, so a failed call leaves the resident state bit-untouched.
+template <class S>
+MISAKA_AI int group_serve_resident(Group& g, const Job& j, int rep0,
+                                   const uint8_t* mask) {
+  constexpr int W = kGroupW;
+  const int n = S::n_lanes(g);
+  const int icap = S::in_cap(g);
+  const int ocap = S::out_cap(g);
+  if (j.feeding) {
+    for (int r = 0; r < W; ++r) {
+      if (mask != nullptr && !mask[r]) continue;
+      if (j.feed_counts[rep0 + r] > icap - (g.in_wr[r] - g.in_rd[r]))
+        return -2;
+    }
+  }
+  int64_t retired0[W];
+  if (j.progress != nullptr) {
+    for (int r = 0; r < W; ++r) {
+      int64_t s = 0;
+      for (int l = 0; l < n; ++l) s += g.retired[(size_t)l * W + r];
+      retired0[r] = s;
+    }
+  }
+  if (mask != nullptr) {
+    if (j.feeding) group_feed<S, true>(g, j, rep0, mask);
+    group_run<S, true>(g, j.ticks, mask);
+  } else {
+    if (j.feeding) group_feed<S, false>(g, j, rep0, nullptr);
+    group_run<S, false>(g, j.ticks, nullptr);
+  }
+  for (int r = 0; r < W; ++r) {
+    const int rep = rep0 + r;
+    const bool on = mask == nullptr || mask[r] != 0;
+    if (j.feeding) {
+      int32_t* row = j.packed + (size_t)rep * (4 + ocap);
+      row[0] = g.in_rd[r];
+      row[1] = g.in_wr[r];
+      row[2] = g.out_rd[r];
+      row[3] = g.out_wr[r];
+      if (on || g.out_wr[r] > g.out_rd[r]) {
+        std::memcpy(row + 4, &g.out_buf[(size_t)r * ocap],
+                    (size_t)ocap * 4);
+        g.out_rd[r] = g.out_wr[r];  // drain AFTER the snapshot
+      }
+    } else {
+      int32_t* row = j.packed + (size_t)rep * 4;
+      row[0] = g.in_rd[r];
+      row[1] = g.in_wr[r];
+      row[2] = g.out_rd[r];
+      row[3] = g.out_wr[r];
+    }
+    if (j.progress != nullptr) {
+      int64_t s = 0;
+      for (int l = 0; l < n; ++l) s += g.retired[(size_t)l * W + r];
+      j.progress[rep] = (uint8_t)(on && s != retired0[r]);
+    }
+  }
+  return 0;
+}
+
+// The templates instantiated through target wrappers: the avx2 variants
+// get AVX2 codegen for the always-inlined bodies (runtime-selected), the
+// plain ones are the scalar fallback from the SAME templates.
 using GroupServeFn = int (*)(Group&, const Job&, int);
+using GroupResidentFn = int (*)(Group&, const Job&, int, const uint8_t*);
 
 int group_serve_dyn_plain(Group& g, const Job& j, int rep0) {
   return group_serve<DynSpec>(g, j, rep0);
+}
+int group_resident_dyn_plain(Group& g, const Job& j, int rep0,
+                             const uint8_t* mask) {
+  return group_serve_resident<DynSpec>(g, j, rep0, mask);
 }
 #if defined(__x86_64__) || defined(__i386__)
 __attribute__((target("avx2"))) int group_serve_dyn_avx2(Group& g,
@@ -1094,16 +1340,28 @@ __attribute__((target("avx2"))) int group_serve_dyn_avx2(Group& g,
                                                          int rep0) {
   return group_serve<DynSpec>(g, j, rep0);
 }
+__attribute__((target("avx2"))) int group_resident_dyn_avx2(
+    Group& g, const Job& j, int rep0, const uint8_t* mask) {
+  return group_serve_resident<DynSpec>(g, j, rep0, mask);
+}
 #endif
 #ifdef MISAKA_SPEC
 int group_serve_spec_plain(Group& g, const Job& j, int rep0) {
   return group_serve<SpecSpec>(g, j, rep0);
+}
+int group_resident_spec_plain(Group& g, const Job& j, int rep0,
+                              const uint8_t* mask) {
+  return group_serve_resident<SpecSpec>(g, j, rep0, mask);
 }
 #if defined(__x86_64__) || defined(__i386__)
 __attribute__((target("avx2"))) int group_serve_spec_avx2(Group& g,
                                                           const Job& j,
                                                           int rep0) {
   return group_serve<SpecSpec>(g, j, rep0);
+}
+__attribute__((target("avx2"))) int group_resident_spec_avx2(
+    Group& g, const Job& j, int rep0, const uint8_t* mask) {
+  return group_serve_resident<SpecSpec>(g, j, rep0, mask);
 }
 #endif
 #endif
@@ -1122,6 +1380,33 @@ GroupServeFn pick_group_fn(SimdMode mode, bool specialized) {
   if (mode == SIMD_AVX2) return group_serve_dyn_avx2;
 #endif
   return group_serve_dyn_plain;
+}
+
+GroupResidentFn pick_resident_fn(SimdMode mode, bool specialized) {
+  (void)specialized;
+#ifdef MISAKA_SPEC
+  if (specialized) {
+#if defined(__x86_64__) || defined(__i386__)
+    if (mode == SIMD_AVX2) return group_resident_spec_avx2;
+#endif
+    return group_resident_spec_plain;
+  }
+#endif
+#if defined(__x86_64__) || defined(__i386__)
+  if (mode == SIMD_AVX2) return group_resident_dyn_avx2;
+#endif
+  return group_resident_dyn_plain;
+}
+
+// Plain-codegen instantiations for the lifecycle paths (import/export are
+// transpose memcpys — rare, never hot, no avx2 wrapper needed).
+int group_import_checked(Group& g, const Job& j, int rep0) {
+  if (group_validate<DynSpec>(g, j, rep0)) return -1;
+  group_import<DynSpec>(g, j, rep0);
+  return 0;
+}
+void group_export_plain(Group& g, const Job& j, int rep0) {
+  group_export<DynSpec>(g, j, rep0);
 }
 
 #ifdef MISAKA_SPEC
@@ -1171,25 +1456,40 @@ inline int64_t now_ns() {
 struct Pool {
   using Job = ::Job;
 
+  // `replicas` holds the per-replica scalar interpreters.  On the
+  // STATELESS path they are never touched — scalar units run on
+  // per-thread scratch interpreters — because on the RESIDENT path (r17)
+  // they ARE the authoritative store for the replicas outside the
+  // group-aligned range (res_groups covers [0, group_cover)): a stateless
+  // call (validate_state, a fallback serve) arriving while residency is
+  // armed must leave the resident state bit-untouched.
   std::vector<Interp*> replicas;
+  std::vector<Interp*> scratch_interps;  // [threads + 1]: workers + caller
   std::vector<std::thread> workers;
-  std::mutex mu;
-  std::condition_variable cv_work, cv_done;
-  bool shutdown = false;
-  long job_id = 0;
-  int done_threads = 0;
+
+  // --- flat futex/spin dispenser (see the primitives above) ---
+  std::atomic<uint32_t> job_seq{0};
+  std::atomic<uint32_t> done_seq{0};
+  std::atomic<int> active_workers{0};
+  std::atomic<int> parked{0};
+  std::atomic<uint32_t> stop{0};
   std::atomic<int> next{0};
-  // SIMD group path (see the group engine above): mode decided once at
-  // creation from MISAKA_SIMD + CPU detection; scratch_groups holds ONE
-  // SoA scratch per worker thread (the pool is stateless between calls,
-  // so a group is pure scratch); units is the per-job work list the
-  // dispenser hands out — group units for full kGroupW-aligned active
-  // blocks, per-replica scalar units for everything else.
-  struct Unit { int32_t kind; int32_t idx; };  // kind: 0 replica, 1 group
+  int64_t spin_ns = 50 * 1000;  // MISAKA_POOL_SPIN_US overrides
+
+  // Work units: `count` consecutive replicas (U_SCALAR/U_RES_SCALAR) or
+  // groups (U_GROUP/U_RES_GROUP) per dispense.  build_units sizes the
+  // count adaptively — ~4 units per thread at full batch (bounds both
+  // dispenser traffic and the tail thread's wall: the last unit is
+  // ~1/(4T) of the job), collapsing to single groups under partial fill
+  // so the tail never holds more than one group over its siblings.
+  struct Unit { int32_t kind; int32_t idx; int32_t count; };
+  enum { U_SCALAR = 0, U_GROUP = 1, U_RES_GROUP = 2, U_RES_MASKED = 3,
+         U_RES_SCALAR = 4 };
   SimdMode simd_mode = SIMD_OFF;
   bool specialized = false;
   GroupServeFn group_fn = nullptr;
-  std::vector<Group*> scratch_groups;
+  GroupResidentFn resident_fn = nullptr;
+  std::vector<Group*> scratch_groups;  // [threads + 1], stateless scratch
   std::vector<Unit> units;
   // Per-replica result codes (each slot written by exactly one worker):
   // run_job reports the LOWEST-INDEX failure, so a mixed-failure batch
@@ -1197,70 +1497,162 @@ struct Pool {
   // worker's atomic store landed last.
   std::vector<int> rep_rc;
   Job job;
+
+  // --- resident state (r17) ---
+  // When armed, the authoritative batch state lives HERE between serve
+  // calls: res_groups owns the group-aligned replica range in SoA planes
+  // (so a resident serve pays zero import/export transposition), the
+  // `replicas` interpreters own the remainder, and serve calls run
+  // feed/tick/pack in place.  Lifecycle paths export on demand
+  // (misaka_pool_export) and state replacement discards
+  // (misaka_pool_discard).
+  bool resident = false;
+  int group_cover = 0;             // replicas resident in res_groups
+  std::vector<Group*> res_groups;  // built lazily at first import
+  std::vector<uint8_t> res_mask;   // [B] active-mask scratch
+  std::vector<int32_t> res_skipped;  // fully-skipped resident replicas
+
   // Per-thread busy/idle nanosecond counters (the usage-accounting plane,
   // misaka_tpu/runtime/usage.py): `busy` accumulates time a worker spends
-  // executing replica supersteps, `idle` the time it parks on cv_work —
-  // MEASURED native attribution, so "time in the C++ pool" is a counter
-  // read, not an inference from Python-side wall clocks.  serial_busy_ns
-  // covers the small-pass fast path, which runs on the CALLING thread
-  // (outside the worker set).  Atomics: readers (misaka_pool_counters)
-  // run concurrently with serving without taking the pool mutex.
+  // executing replica supersteps, `idle` the time it spins/parks awaiting
+  // work — MEASURED native attribution, so "time in the C++ pool" is a
+  // counter read, not an inference from Python-side wall clocks.
+  // serial_busy_ns covers work on the CALLING thread (the small-pass fast
+  // path, and the caller helping drain the unit list while it waits).
+  // Atomics: readers (misaka_pool_counters) run concurrently with serving
+  // without any pool lock.
   std::vector<std::atomic<int64_t>> busy_ns, idle_ns;
   std::atomic<int64_t> serial_busy_ns{0};
 
   ~Pool() {
-    {
-      std::lock_guard<std::mutex> lk(mu);
-      shutdown = true;
-    }
-    cv_work.notify_all();
+    stop.store(1, std::memory_order_seq_cst);
+    job_seq.fetch_add(1, std::memory_order_seq_cst);  // pop spinners
+    futex_wake_u32(&job_seq, INT_MAX);
     for (auto& w : workers) w.join();
     for (auto* it : replicas) delete it;
+    for (auto* it : scratch_interps) delete it;
     for (auto* g : scratch_groups) delete g;
+    for (auto* g : res_groups) delete g;
   }
 
-  void serve_unit(const Unit& u, int tid) {
-    if (u.kind == 0) {
-      rep_rc[u.idx] = serve_replica(u.idx);
-      return;
+  void serve_unit(const Unit& u, int slot) {
+    switch (u.kind) {
+      case U_SCALAR:
+        for (int k = 0; k < u.count; ++k)
+          rep_rc[u.idx + k] =
+              serve_replica(u.idx + k, scratch_interps[slot]);
+        break;
+      case U_GROUP:
+        for (int k = 0; k < u.count; ++k) {
+          const int rep0 = (u.idx + k) * kGroupW;
+          if (group_fn(*scratch_groups[slot], job, rep0) != 0) {
+            // validation/feed-capacity violation: rerun the whole group
+            // down the scalar path so per-replica error codes and
+            // untouched-state semantics match the shipped engine exactly
+            // (the group path bailed before writing anything back)
+            for (int r = 0; r < kGroupW; ++r)
+              rep_rc[rep0 + r] =
+                  serve_replica(rep0 + r, scratch_interps[slot]);
+          }
+        }
+        break;
+      case U_RES_GROUP:
+        for (int k = 0; k < u.count; ++k) {
+          const int gi = u.idx + k;
+          rep_rc[gi * kGroupW] =
+              resident_fn(*res_groups[gi], job, gi * kGroupW, nullptr);
+        }
+        break;
+      case U_RES_MASKED:
+        rep_rc[u.idx * kGroupW] =
+            resident_fn(*res_groups[u.idx], job, u.idx * kGroupW,
+                        res_mask.data() + (size_t)u.idx * kGroupW);
+        break;
+      case U_RES_SCALAR:
+        for (int k = 0; k < u.count; ++k)
+          rep_rc[u.idx + k] = serve_replica_resident(u.idx + k);
+        break;
     }
-    const int rep0 = u.idx * kGroupW;
-    if (group_fn(*scratch_groups[tid], job, rep0) != 0) {
-      // validation/feed-capacity violation: rerun the whole group down
-      // the scalar path so per-replica error codes and untouched-state
-      // semantics match the shipped engine exactly (the group path
-      // bailed before writing anything back)
-      for (int r = 0; r < kGroupW; ++r)
-        rep_rc[rep0 + r] = serve_replica(rep0 + r);
-    }
+  }
+
+  void run_units(int slot) {
+    const int nu = (int)units.size();
+    for (int u; (u = next.fetch_add(1, std::memory_order_relaxed)) < nu;)
+      serve_unit(units[u], slot);
   }
 
   void worker_main(int tid) {
-    long seen = 0;
+    uint32_t seen = 0;
     for (;;) {
-      {
-        const int64_t t_park = now_ns();
-        std::unique_lock<std::mutex> lk(mu);
-        cv_work.wait(lk, [&] { return shutdown || job_id != seen; });
-        idle_ns[tid].fetch_add(now_ns() - t_park,
-                               std::memory_order_relaxed);
-        if (shutdown) return;
-        seen = job_id;
+      const int64_t t_park = now_ns();
+      uint32_t cur;
+      while ((cur = job_seq.load(std::memory_order_acquire)) == seen) {
+        if (stop.load(std::memory_order_relaxed) != 0) return;
+        const int64_t waited = now_ns() - t_park;
+        if (waited < spin_ns) {
+          // pause-spin briefly (the inter-call gap under load), then
+          // YIELD-spin: on an oversubscribed/few-core box a pure pause
+          // spin starves the very thread it is waiting on
+          if (waited < 2000) cpu_pause();
+          else std::this_thread::yield();
+          continue;
+        }
+        // park: increment-recheck-wait pairs with the publisher's
+        // store-then-read of `parked`, so a wake is never lost
+        parked.fetch_add(1, std::memory_order_seq_cst);
+        if (job_seq.load(std::memory_order_seq_cst) == seen &&
+            stop.load(std::memory_order_seq_cst) == 0)
+          futex_wait_u32(&job_seq, seen);
+        parked.fetch_sub(1, std::memory_order_seq_cst);
       }
+      seen = cur;
+      if (stop.load(std::memory_order_relaxed) != 0) return;
+      idle_ns[tid].fetch_add(now_ns() - t_park, std::memory_order_relaxed);
       const int64_t t_work = now_ns();
-      const int n = (int)units.size();
-      for (int u; (u = next.fetch_add(1)) < n;)
-        serve_unit(units[u], tid);
+      run_units(tid);
       busy_ns[tid].fetch_add(now_ns() - t_work, std::memory_order_relaxed);
-      {
-        std::lock_guard<std::mutex> lk(mu);
-        if (++done_threads == (int)workers.size()) cv_done.notify_all();
+      if (active_workers.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        done_seq.store(seen, std::memory_order_release);
+        futex_wake_u32(&done_seq, 1);
       }
     }
   }
 
-  int serve_replica(int r) {
-    Interp* it = replicas[r];
+  // Publish the current job/units to the workers; the caller then helps
+  // drain the unit list itself (it would otherwise just spin) and waits
+  // on the done futex.
+  void publish_job() {
+    next.store(0, std::memory_order_relaxed);
+    active_workers.store((int)workers.size(), std::memory_order_relaxed);
+    job_seq.fetch_add(1, std::memory_order_seq_cst);
+    if (parked.load(std::memory_order_seq_cst) > 0)
+      futex_wake_u32(&job_seq, INT_MAX);
+  }
+
+  void wait_done() {
+    const uint32_t target = job_seq.load(std::memory_order_relaxed);
+    const int64_t t_spin = now_ns();
+    while (done_seq.load(std::memory_order_acquire) != target) {
+      const int64_t waited = now_ns() - t_spin;
+      if (waited < spin_ns) {
+        // pause-spin briefly, then yield-spin: the unit list is already
+        // drained when the caller gets here, so the tail worker needs
+        // the CPU more than this thread needs the lowest-latency wake
+        if (waited < 2000) cpu_pause();
+        else std::this_thread::yield();
+        continue;
+      }
+      futex_wait_u32(&done_seq, target - 1);
+    }
+  }
+
+  int lowest_rc() const {
+    for (int r : rep_rc)
+      if (r != 0) return r;  // lowest replica index wins (deterministic)
+    return 0;
+  }
+
+  int serve_replica(int r, Interp* it) {
     const Job& j = job;
     const int n = it->n_lanes, s = it->num_stacks;
     int32_t* acc = j.acc + (size_t)r * n;
@@ -1311,6 +1703,96 @@ struct Pool {
     return 0;
   }
 
+  // Resident scalar serve: replicas[r] IS the state — feed/run/pack with
+  // no state round trip (the Interp analog of group_serve_resident).
+  int serve_replica_resident(int r) {
+    Interp* it = replicas[r];
+    const Job& j = job;
+    if (j.feeding &&
+        j.feed_counts[r] > it->in_cap - (it->in_wr - it->in_rd))
+      return -2;
+    int64_t retired0 = 0;
+    if (j.progress != nullptr)
+      for (int32_t v : it->retired) retired0 += v;
+    if (j.feeding) {
+      const int count = j.feed_counts[r];
+      if (count > 0)
+        interp_feed(it, j.feed_vals + (size_t)r * it->in_cap, count);
+    }
+    interp_run(it, j.ticks);
+    if (j.feeding) {
+      int32_t* row = j.packed + (size_t)r * (4 + it->out_cap);
+      row[0] = it->in_rd;
+      row[1] = it->in_wr;
+      row[2] = it->out_rd;
+      row[3] = it->out_wr;
+      std::memcpy(row + 4, it->out_buf.data(), (size_t)it->out_cap * 4);
+      it->out_rd = it->out_wr;  // drain AFTER the snapshot
+    } else {
+      int32_t* row = j.packed + (size_t)r * 4;
+      row[0] = it->in_rd;
+      row[1] = it->in_wr;
+      row[2] = it->out_rd;
+      row[3] = it->out_wr;
+    }
+    if (j.progress != nullptr) {
+      int64_t s = 0;
+      for (int32_t v : it->retired) s += v;
+      j.progress[r] = (uint8_t)(s != retired0);
+    }
+    return 0;
+  }
+
+  // A resident replica OUTSIDE the active set: packed row only (current
+  // counters, plus the drained-on-serve contract for an undrained ring
+  // on a feeding pass) — state otherwise untouched, ticks not advanced.
+  void pack_skipped(int rep) {
+    const Job& j = job;
+    const int ocap = replicas[0]->out_cap;
+    int32_t c[4];
+    const int32_t* out_src = nullptr;
+    if (rep < group_cover) {
+      Group& g = *res_groups[rep / kGroupW];
+      const int r = rep % kGroupW;
+      c[0] = g.in_rd[r];
+      c[1] = g.in_wr[r];
+      c[2] = g.out_rd[r];
+      c[3] = g.out_wr[r];
+      if (j.feeding && c[3] > c[2]) {
+        out_src = &g.out_buf[(size_t)r * ocap];
+        g.out_rd[r] = c[3];
+      }
+    } else {
+      Interp* it = replicas[rep];
+      c[0] = it->in_rd;
+      c[1] = it->in_wr;
+      c[2] = it->out_rd;
+      c[3] = it->out_wr;
+      if (j.feeding && c[3] > c[2]) {
+        out_src = it->out_buf.data();
+        it->out_rd = c[3];
+      }
+    }
+    int32_t* row = j.packed + (size_t)rep * (j.feeding ? 4 + ocap : 4);
+    row[0] = c[0];
+    row[1] = c[1];
+    row[2] = c[2];
+    row[3] = c[3];
+    if (out_src != nullptr)
+      std::memcpy(row + 4, out_src, (size_t)ocap * 4);
+    if (j.progress != nullptr) j.progress[rep] = 0;
+  }
+
+  // Unit-size policy (the adaptive half of the dispenser): ~4 units per
+  // thread bounds dispenser traffic AND the tail thread's wall at full
+  // batch; small jobs degrade to count=1.
+  int unit_chunk(int n_units) const {
+    const int t = (int)workers.size();
+    if (t <= 1 || n_units <= t) return 1;
+    const int c = n_units / (t * 4);
+    return c < 1 ? 1 : c;
+  }
+
   // Build the per-job work list: full kGroupW-aligned blocks of active
   // replicas become group units when the SIMD path is armed; everything
   // else (batch remainder, partial groups under partial fill, the whole
@@ -1321,8 +1803,13 @@ struct Pool {
     const bool grouped = group_fn != nullptr;
     if (job.active == nullptr) {
       const int ng = grouped ? B / kGroupW : 0;
-      for (int g = 0; g < ng; ++g) units.push_back({1, g});
-      for (int r = ng * kGroupW; r < B; ++r) units.push_back({0, r});
+      const int gc = unit_chunk(ng);
+      for (int g = 0; g < ng; g += gc)
+        units.push_back({U_GROUP, g, ng - g < gc ? ng - g : gc});
+      const int r0 = ng * kGroupW;
+      const int rc = unit_chunk(B - r0);
+      for (int r = r0; r < B; r += rc)
+        units.push_back({U_SCALAR, r, B - r < rc ? B - r : rc});
       return;
     }
     int i = 0;
@@ -1333,48 +1820,182 @@ struct Pool {
       // aligned block is present
       if (grouped && r == g * kGroupW && i + kGroupW <= job.n_active &&
           job.active[i + kGroupW - 1] == g * kGroupW + kGroupW - 1) {
-        units.push_back({1, g});
+        units.push_back({U_GROUP, g, 1});
         i += kGroupW;
       } else {
-        units.push_back({0, r});
+        units.push_back({U_SCALAR, r, 1});
         ++i;
       }
+    }
+  }
+
+  // The resident work list: every resident group with at least one
+  // active replica becomes a unit (masked when partially active); fully
+  // skipped replicas go on res_skipped for the caller to pack while the
+  // workers tick.
+  void build_units_resident() {
+    units.clear();
+    res_skipped.clear();
+    const int B = (int)replicas.size();
+    const int ng = group_cover / kGroupW;
+    if (job.active == nullptr) {
+      const int gc = unit_chunk(ng);
+      for (int g = 0; g < ng; g += gc)
+        units.push_back({U_RES_GROUP, g, ng - g < gc ? ng - g : gc});
+      const int rc = unit_chunk(B - group_cover);
+      for (int r = group_cover; r < B; r += rc)
+        units.push_back({U_RES_SCALAR, r, B - r < rc ? B - r : rc});
+      return;
+    }
+    res_mask.assign(B, 0);
+    for (int i = 0; i < job.n_active; ++i) res_mask[job.active[i]] = 1;
+    for (int g = 0; g < ng; ++g) {
+      int cnt = 0;
+      for (int r = 0; r < kGroupW; ++r) cnt += res_mask[g * kGroupW + r];
+      if (cnt == kGroupW) {
+        units.push_back({U_RES_GROUP, g, 1});
+      } else if (cnt > 0) {
+        units.push_back({U_RES_MASKED, g, 1});
+      } else {
+        for (int r = 0; r < kGroupW; ++r)
+          res_skipped.push_back(g * kGroupW + r);
+      }
+    }
+    for (int r = group_cover; r < B; ++r) {
+      if (res_mask[r]) units.push_back({U_RES_SCALAR, r, 1});
+      else res_skipped.push_back(r);
     }
   }
 
   int run_job() {
     const int n = job.active ? job.n_active : (int)replicas.size();
     // Serial fast path: a small pass (the partial-fill serving case — a
-    // few coalesced slots out of thousands) runs on the CALLING thread.
-    // The parallel path costs a notify_all + done-barrier round trip
-    // across every worker (~0.3-0.5ms of futex churn on a 24-thread
-    // pool), which dwarfs the work itself below a handful of replicas.
-    // (n <= 4 < kGroupW, so this path never sees a group unit.)
+    // few coalesced slots out of thousands) runs on the CALLING thread;
+    // even the flat dispenser's wake round trip dwarfs the work itself
+    // below a handful of replicas.  (n <= 4 < kGroupW, so this path
+    // never sees a group unit.)
     if (n <= 4) {
       const int64_t t_work = now_ns();
       int rc = 0;
+      const int slot = (int)workers.size();  // the caller's scratch slot
       for (int i = 0; i < n; ++i) {
         const int rep = job.active ? job.active[i] : i;
-        const int r = serve_replica(rep);
+        const int r = serve_replica(rep, scratch_interps[slot]);
         if (r != 0 && rc == 0) rc = r;  // lowest index first by iteration
       }
       serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
       return rc;
     }
     build_units();
-    {
-      std::lock_guard<std::mutex> lk(mu);
-      next.store(0);
-      rep_rc.assign(replicas.size(), 0);
-      done_threads = 0;
-      ++job_id;
+    rep_rc.assign(replicas.size(), 0);
+    // A 1-worker pool gains nothing from the handoff (the caller IS an
+    // executor): run the whole list inline — zero dispenser cost, and on
+    // a 1-core box no spin contention against the lone worker.
+    if (workers.size() <= 1 || units.size() <= 1) {
+      const int64_t t_work = now_ns();
+      for (const Unit& u : units) serve_unit(u, (int)workers.size());
+      serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
+      return lowest_rc();
     }
-    cv_work.notify_all();
-    std::unique_lock<std::mutex> lk(mu);
-    cv_done.wait(lk, [&] { return done_threads == (int)workers.size(); });
-    for (int r : rep_rc)
-      if (r != 0) return r;  // lowest replica index wins (deterministic)
+    publish_job();
+    const int64_t t_help = now_ns();
+    run_units((int)workers.size());
+    serial_busy_ns.fetch_add(now_ns() - t_help, std::memory_order_relaxed);
+    wait_done();
+    return lowest_rc();
+  }
+
+  // The resident twin of run_job: no import/export anywhere — the units
+  // tick the resident store in place, the caller packs the skipped rows
+  // (work it would otherwise spend spinning on the done futex).
+  int run_resident_job() {
+    const int n = job.active ? job.n_active : (int)replicas.size();
+    build_units_resident();
+    rep_rc.assign(replicas.size(), 0);
+    const int caller = (int)workers.size();
+    if (n <= 4 || units.size() <= 1 || workers.size() <= 1) {
+      const int64_t t_work = now_ns();
+      for (const Unit& u : units) serve_unit(u, caller);
+      for (int rep : res_skipped) pack_skipped(rep);
+      serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
+      return lowest_rc();
+    }
+    publish_job();
+    const int64_t t_help = now_ns();
+    for (int rep : res_skipped) pack_skipped(rep);
+    run_units(caller);
+    serial_busy_ns.fetch_add(now_ns() - t_help, std::memory_order_relaxed);
+    wait_done();
+    return lowest_rc();
+  }
+
+  // Arm residency from the job's batch-major state arrays.  Per-group
+  // validate-then-load, per-remainder write_state (which validates before
+  // touching): `resident` flips true only after EVERY replica loaded, so
+  // a failed import leaves residency observably disarmed and the arrays
+  // authoritative (partially-loaded storage is dormant).
+  int import_state() {
+    const int B = (int)replicas.size();
+    resident = false;
+    if (resident_fn != nullptr && group_cover > 0 && res_groups.empty()) {
+      const int ng = group_cover / kGroupW;
+      res_groups.reserve(ng);
+      for (int g = 0; g < ng; ++g)
+        res_groups.push_back(new Group(
+            replicas[0]->code.data(), replicas[0]->prog_len.data(),
+            replicas[0]->n_lanes, replicas[0]->max_len,
+            replicas[0]->num_stacks, replicas[0]->stack_cap,
+            replicas[0]->in_cap, replicas[0]->out_cap));
+    }
+    for (int g = 0; g < group_cover / kGroupW; ++g)
+      if (group_import_checked(*res_groups[g], job, g * kGroupW) != 0)
+        return -1;
+    for (int r = group_cover; r < B; ++r)
+      if (write_replica(r) != 0) return -1;
+    resident = true;
     return 0;
+  }
+
+  // Export the resident state into the job's batch-major arrays —
+  // non-destructive (rings undrained, residency stays armed).
+  int export_state() {
+    if (!resident) return -1;
+    for (int g = 0; g < group_cover / kGroupW; ++g)
+      group_export_plain(*res_groups[g], job, g * kGroupW);
+    for (int r = group_cover; r < (int)replicas.size(); ++r)
+      read_replica(r);
+    return 0;
+  }
+
+  int write_replica(int r) {
+    Interp* it = replicas[r];
+    const Job& j = job;
+    const int n = it->n_lanes, s = it->num_stacks;
+    return write_state(
+        it, j.acc + (size_t)r * n, j.bak + (size_t)r * n,
+        j.pc + (size_t)r * n, j.port_val + (size_t)r * n * kPorts,
+        j.port_full + (size_t)r * n * kPorts, j.hold_val + (size_t)r * n,
+        j.holding + (size_t)r * n, j.stack_mem + (size_t)r * s * it->stack_cap,
+        j.stack_top + (size_t)r * s, j.in_buf + (size_t)r * it->in_cap,
+        j.out_buf + (size_t)r * it->out_cap, j.counters + (size_t)r * 5,
+        j.retired + (size_t)r * n, j.acc_hi + (size_t)r * n,
+        j.bak_hi + (size_t)r * n);
+  }
+
+  void read_replica(int r) {
+    Interp* it = replicas[r];
+    const Job& j = job;
+    const int n = it->n_lanes, s = it->num_stacks;
+    read_state(
+        it, j.acc + (size_t)r * n, j.bak + (size_t)r * n,
+        j.pc + (size_t)r * n, j.port_val + (size_t)r * n * kPorts,
+        j.port_full + (size_t)r * n * kPorts, j.hold_val + (size_t)r * n,
+        j.holding + (size_t)r * n, j.stack_mem + (size_t)r * s * it->stack_cap,
+        j.stack_top + (size_t)r * s, j.out_buf + (size_t)r * it->out_cap,
+        j.counters + (size_t)r * 5, j.retired + (size_t)r * n,
+        j.acc_hi + (size_t)r * n, j.bak_hi + (size_t)r * n);
+    std::memcpy(j.in_buf + (size_t)r * it->in_cap, it->in_buf.data(),
+                (size_t)it->in_cap * 4);
   }
 };
 
@@ -1442,6 +2063,23 @@ void misaka_interp_read_in(void* h, int32_t* in_buf) {
   std::memcpy(in_buf, it->in_buf.data(), (size_t)it->in_cap * 4);
 }
 
+// The serve_chunk packed row ([in_rd, in_wr, out_rd, out_wr, out_buf...])
+// straight off the interpreter, optionally draining the output ring after
+// the snapshot — the resident-state fast path of the unbatched serving
+// engine (core/native_serve.NativeServe), which no longer exports the
+// whole state per chunk just to read four counters and the ring.
+void misaka_interp_pack(void* h, int32_t* row, int drain) {
+  auto* it = (Interp*)h;
+  row[0] = it->in_rd;
+  row[1] = it->in_wr;
+  row[2] = it->out_rd;
+  row[3] = it->out_wr;
+  if (drain != 0) {
+    std::memcpy(row + 4, it->out_buf.data(), (size_t)it->out_cap * 4);
+    it->out_rd = it->out_wr;  // drain AFTER the snapshot (device parity)
+  }
+}
+
 // Bulk state write — the inverse of misaka_interp_read (+ in_buf), used by
 // the native serving engine to import a NetworkState pytree before a chunk
 // (runtime/master.py engine="native") and by checkpoint restore.  Validates
@@ -1499,6 +2137,22 @@ void* misaka_pool_create(const int32_t* code, const int32_t* prog_len,
   if (n_threads > n_replicas) n_threads = n_replicas;
   p->busy_ns = std::vector<std::atomic<int64_t>>(n_threads);
   p->idle_ns = std::vector<std::atomic<int64_t>>(n_threads);
+  const char* spin = std::getenv("MISAKA_POOL_SPIN_US");
+  if (spin != nullptr && *spin != '\0')
+    p->spin_ns = (int64_t)std::atol(spin) * 1000;
+  // Per-thread (+ caller) scratch interpreters for the stateless scalar
+  // path: the per-replica interpreters are the RESIDENT store, which a
+  // concurrent stateless call must never clobber.
+  p->scratch_interps.reserve(n_threads + 1);
+  for (int t = 0; t < n_threads + 1; ++t) {
+    Interp* it = create_interp(code, prog_len, n_lanes, max_len, num_stacks,
+                               stack_cap, in_cap, out_cap);
+    if (it == nullptr) {  // cannot happen (replicas validated) — be safe
+      delete p;
+      return nullptr;
+    }
+    p->scratch_interps.push_back(it);
+  }
   // SIMD group path: armed when the kill switch allows it and the batch
   // has at least one full group; specialized tick functions additionally
   // require the runtime tables to MATCH the baked ones (a mismatched
@@ -1511,15 +2165,21 @@ void* misaka_pool_create(const int32_t* code, const int32_t* prog_len,
                                   in_cap, out_cap);
 #endif
     p->group_fn = pick_group_fn(p->simd_mode, p->specialized);
-    p->scratch_groups.reserve(n_threads);
-    for (int t = 0; t < n_threads; ++t)
+    p->scratch_groups.reserve(n_threads + 1);
+    for (int t = 0; t < n_threads + 1; ++t)
       p->scratch_groups.push_back(new Group(
           p->replicas[0]->code.data(), p->replicas[0]->prog_len.data(),
           n_lanes, max_len, p->replicas[0]->num_stacks, stack_cap, in_cap,
           out_cap));
+    p->group_cover = (n_replicas / kGroupW) * kGroupW;
   } else {
     p->simd_mode = SIMD_OFF;
   }
+  // the resident tick variant (group range) — scalar-only pools keep
+  // resident state in the per-replica interpreters instead
+  p->resident_fn =
+      p->group_fn != nullptr ? pick_resident_fn(p->simd_mode, p->specialized)
+                             : nullptr;
   p->workers.reserve(n_threads);
   for (int t = 0; t < n_threads; ++t)
     p->workers.emplace_back([p, t] { p->worker_main(t); });
@@ -1632,7 +2292,116 @@ int misaka_pool_serve(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
   j.packed = packed;
   j.active = active;
   j.n_active = n_active;
+  j.progress = nullptr;
   return p->run_job();
+}
+
+// --- resident-state serving (r17) ------------------------------------------
+//
+// misaka_pool_import arms residency: the batch-major arrays are validated
+// and loaded into the pool's resident store (SoA groups + remainder
+// interpreters), after which misaka_pool_serve_resident runs serve/idle
+// passes with NO state round trip — the ~200us/call import/export floor
+// at B=256 is simply gone.  misaka_pool_export writes the resident state
+// back out (non-destructive; residency stays armed) for lifecycle paths
+// — checkpoint, /load, /restore, autogrow, registry eviction — and
+// misaka_pool_discard disarms without exporting (the caller replaced the
+// state wholesale).  The caller (core/native_serve.NativeServePool) only
+// takes the resident path while its Python-side identity cache proves
+// nothing else touched the state.
+
+int misaka_pool_import(void* h, const int32_t* acc, const int32_t* bak,
+                       const int32_t* pc, const int32_t* port_val,
+                       const uint8_t* port_full, const int32_t* hold_val,
+                       const uint8_t* holding, const int32_t* stack_mem,
+                       const int32_t* stack_top, const int32_t* in_buf,
+                       const int32_t* out_buf, const int32_t* counters,
+                       const int32_t* retired, const int32_t* acc_hi,
+                       const int32_t* bak_hi) {
+  auto* p = (Pool*)h;
+  Pool::Job& j = p->job;
+  j = Pool::Job{};
+  j.acc = (int32_t*)acc;
+  j.bak = (int32_t*)bak;
+  j.pc = (int32_t*)pc;
+  j.port_val = (int32_t*)port_val;
+  j.port_full = (uint8_t*)port_full;
+  j.hold_val = (int32_t*)hold_val;
+  j.holding = (uint8_t*)holding;
+  j.stack_mem = (int32_t*)stack_mem;
+  j.stack_top = (int32_t*)stack_top;
+  j.in_buf = (int32_t*)in_buf;
+  j.out_buf = (int32_t*)out_buf;
+  j.counters = (int32_t*)counters;
+  j.retired = (int32_t*)retired;
+  j.acc_hi = (int32_t*)acc_hi;
+  j.bak_hi = (int32_t*)bak_hi;
+  return p->import_state();
+}
+
+int misaka_pool_export(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
+                       int32_t* port_val, uint8_t* port_full,
+                       int32_t* hold_val, uint8_t* holding,
+                       int32_t* stack_mem, int32_t* stack_top,
+                       int32_t* in_buf, int32_t* out_buf, int32_t* counters,
+                       int32_t* retired, int32_t* acc_hi, int32_t* bak_hi) {
+  auto* p = (Pool*)h;
+  Pool::Job& j = p->job;
+  j = Pool::Job{};
+  j.acc = acc;
+  j.bak = bak;
+  j.pc = pc;
+  j.port_val = port_val;
+  j.port_full = port_full;
+  j.hold_val = hold_val;
+  j.holding = holding;
+  j.stack_mem = stack_mem;
+  j.stack_top = stack_top;
+  j.in_buf = in_buf;
+  j.out_buf = out_buf;
+  j.counters = counters;
+  j.retired = retired;
+  j.acc_hi = acc_hi;
+  j.bak_hi = bak_hi;
+  return p->export_state();
+}
+
+void misaka_pool_discard(void* h) { ((Pool*)h)->resident = false; }
+
+int misaka_pool_is_resident(void* h) {
+  return ((Pool*)h)->resident ? 1 : 0;
+}
+
+// One resident serve (feed_counts non-null) or idle (null) pass.  packed
+// gets EVERY row filled (active rows post-run, skipped rows their current
+// counters + the drained-on-serve contract); progress (may be null) gets
+// the per-replica retired-anything flags.  Returns 0, -2 (a feed exceeded
+// a ring's free space — resident state untouched), -3 (invalid active
+// list), or -4 (residency not armed: caller bug).
+int misaka_pool_serve_resident(void* h, const int32_t* feed_vals,
+                               const int32_t* feed_counts, int ticks,
+                               const int32_t* active, int n_active,
+                               int32_t* packed, uint8_t* progress) {
+  auto* p = (Pool*)h;
+  if (!p->resident) return -4;
+  if (active != nullptr) {
+    if (n_active < 0 || n_active > (int)p->replicas.size()) return -3;
+    for (int i = 0; i < n_active; ++i) {
+      if (active[i] < 0 || active[i] >= (int)p->replicas.size()) return -3;
+      if (i > 0 && active[i] <= active[i - 1]) return -3;
+    }
+  }
+  Pool::Job& j = p->job;
+  j = Pool::Job{};
+  j.feed_vals = feed_vals;
+  j.feed_counts = feed_counts;
+  j.ticks = ticks;
+  j.feeding = feed_counts != nullptr;
+  j.packed = packed;
+  j.active = active;
+  j.n_active = n_active;
+  j.progress = progress;
+  return p->run_resident_job();
 }
 
 }  // extern "C"

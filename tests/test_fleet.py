@@ -213,7 +213,13 @@ def test_router_failover_under_concurrent_load_zero_errors(tmp_path):
         ]
         for t in threads:
             t.start()
-        time.sleep(0.1)
+        # kill mid-load deterministically: the r17 pipelined plane moves
+        # this whole workload faster than a fixed sleep — wait until some
+        # (but nowhere near all) values are served, then pull the plug
+        deadline = time.monotonic() + 5
+        while (masters[0].values + masters[1].values) < 12 * 25 * 8 // 10 \
+                and time.monotonic() < deadline:
+            time.sleep(0.002)
         planes[1].close()  # the in-process kill -9
         for t in threads:
             t.join(timeout=30)

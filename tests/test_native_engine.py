@@ -33,6 +33,17 @@ def masked_stack(state):
     return np.where(col[None, :] < top[:, None], mem, 0)
 
 
+def materialize(engine, state):
+    """Resident-state native engines (r17) return their identity anchor
+    with stale array contents; export before reading state fields — the
+    exact step MasterNode._sync_native_state performs.  Residency stays
+    armed on the returned object, so the differential loops below keep
+    exercising the resident tick path AND the export coherence."""
+    exp = getattr(engine, "export_resident", None)
+    st = exp() if exp is not None else None
+    return st if st is not None else state
+
+
 def assert_states_equal(a, b):
     for f in type(a)._fields:
         if f == "stack_mem":
@@ -58,6 +69,7 @@ def test_serve_chunk_parity_add2():
         count = min(count, free)
         s_dev, p_dev = net.serve_chunk(s_dev, vals, count, 16)
         s_nat, p_nat = ns.serve_chunk(s_nat, vals, count, 16)
+        s_nat = materialize(ns, s_nat)
         np.testing.assert_array_equal(np.asarray(p_dev), p_nat, err_msg=f"iter {it}")
         assert_states_equal(s_dev, s_nat)
 
@@ -78,6 +90,7 @@ def test_serve_chunk_parity_stack_net():
         vals[0] = i + 1
         s_dev, p_dev = net.serve_chunk(s_dev, vals, 1, 24)
         s_nat, p_nat = ns.serve_chunk(s_nat, vals, 1, 24)
+        s_nat = materialize(ns, s_nat)
         np.testing.assert_array_equal(np.asarray(p_dev), p_nat)
         assert_states_equal(s_dev, s_nat)
 
@@ -263,6 +276,7 @@ def test_pool_matches_batched_scan_twins():
             if it % 4 == 3:  # idle iterations interleave with fed ones
                 s_dev, c_dev = idle_fn(s_dev)
                 s_nat, c_nat = pool.idle(s_nat)
+                s_nat = materialize(pool, s_nat)
                 np.testing.assert_array_equal(
                     np.asarray(c_dev), c_nat, err_msg=f"idle iter {it}"
                 )
@@ -280,6 +294,7 @@ def test_pool_matches_batched_scan_twins():
                     )
                 s_dev, p_dev = serve_fn(s_dev, vals, counts)
                 s_nat, p_nat = pool.serve(s_nat, vals, counts)
+                s_nat = materialize(pool, s_nat)
                 np.testing.assert_array_equal(
                     np.asarray(p_dev), p_nat, err_msg=f"iter {it}"
                 )

@@ -1,0 +1,272 @@
+"""Resident-state native serving (r17): residency vs lifecycle.
+
+The native engines keep batch state IN C++ between serve calls on the
+trusted-identity path; these tests pin the contract's two halves:
+
+  * bit-identity — the differential corpus replayed through a resident
+    pool matches the stateless (MISAKA_NATIVE_RESIDENT=0) pool
+    bit-for-bit, including under the resident_fallback chaos point
+    flapping mid-stream;
+  * lifecycle laziness — checkpoint, snapshot/restore, /load, reset,
+    autogrow-style status reads, and registry eviction each force a
+    lazy export whose content equals the eager path's, and a lifecycle
+    replacement is never clobbered by a superseded resident copy.
+
+(The fleet roll rides save_checkpoint/snapshot — the same export hook —
+and its bit-identity drill lives in tests/test_fleet.py's slow lane.)
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.core import native_serve
+from misaka_tpu.runtime.master import MasterNode
+from misaka_tpu.utils import faults
+
+pytestmark = pytest.mark.skipif(
+    not native_serve.available(), reason="native interpreter unavailable (no g++)"
+)
+
+
+def make_pool(net, resident: bool, **kw):
+    prev = os.environ.get("MISAKA_NATIVE_RESIDENT")
+    os.environ["MISAKA_NATIVE_RESIDENT"] = "1" if resident else "0"
+    try:
+        return native_serve.NativeServePool(net, **kw)
+    finally:
+        if prev is None:
+            os.environ.pop("MISAKA_NATIVE_RESIDENT", None)
+        else:
+            os.environ["MISAKA_NATIVE_RESIDENT"] = prev
+
+
+def state_dict(state):
+    return {f: np.asarray(getattr(state, f)) for f in state._fields}
+
+
+def run_schedule(net, resident: bool, rounds=10, seed=3, fallback_every=None):
+    """A randomized serve/idle schedule with partial-fill active lists;
+    returns (final state dict, [packed rows]).  `fallback_every` arms the
+    resident_fallback chaos point on every Nth round — the mid-stream
+    degrade whose outputs must stay bit-identical."""
+    B = net.batch
+    pool = make_pool(net, resident, chunk_steps=48)
+    rng = np.random.default_rng(seed)
+    state = net.init_state()
+    rows = []
+    try:
+        for it in range(rounds):
+            if fallback_every:
+                faults.configure(
+                    "resident_fallback" if it % fallback_every == 0 else ""
+                )
+            if it % 4 == 3:
+                state, ctrs = pool.idle(state, 24)
+                state = pool.export_resident(state) or state
+                rows.append(np.asarray(ctrs).copy())
+                continue
+            free = net.in_cap - (
+                np.asarray(state.in_wr) - np.asarray(state.in_rd)
+            )
+            counts = np.minimum(
+                rng.integers(0, net.in_cap + 1, size=B), free
+            ).astype(np.int32)
+            vals = rng.integers(
+                -10_000, 10_000, size=(B, net.in_cap)
+            ).astype(np.int32)
+            active = None
+            if it % 3 == 1:  # partial fill: half the replicas
+                active = np.flatnonzero(np.arange(B) % 2 == 0)
+                mask = np.zeros((B,), bool)
+                mask[active] = True
+                counts[~mask] = 0
+            state, packed = pool.serve(state, vals, counts, active=active)
+            state = pool.export_resident(state) or state
+            packed = np.asarray(packed).copy()
+            if active is not None:
+                skipped = np.ones((B,), bool)
+                skipped[active] = False
+                packed[skipped, 4:] = 0  # np.empty residue by contract
+            rows.append(packed)
+        return state_dict(state), rows
+    finally:
+        faults.configure("")
+        pool.close()
+
+
+@pytest.mark.parametrize("batch", [6, 24])  # scalar-resident and group paths
+def test_resident_bit_identical_to_stateless(batch):
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile(batch=batch)
+    d_off, rows_off = run_schedule(net, resident=False)
+    d_on, rows_on = run_schedule(net, resident=True)
+    assert len(rows_off) == len(rows_on)
+    for i, (a, b) in enumerate(zip(rows_off, rows_on)):
+        np.testing.assert_array_equal(a, b, err_msg=f"round {i}")
+    for f in d_off:
+        np.testing.assert_array_equal(d_off[f], d_on[f], err_msg=f)
+
+
+def test_resident_fallback_chaos_bit_identical():
+    """The resident_fallback chaos point flapping mid-stream: every
+    affected call exports coherently and serves stateless — outputs and
+    final state stay bit-identical to both pure modes."""
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile(batch=16)
+    d_ref, rows_ref = run_schedule(net, resident=False)
+    d_chaos, rows_chaos = run_schedule(net, resident=True, fallback_every=2)
+    for i, (a, b) in enumerate(zip(rows_ref, rows_chaos)):
+        np.testing.assert_array_equal(a, b, err_msg=f"round {i}")
+    for f in d_ref:
+        np.testing.assert_array_equal(d_ref[f], d_chaos[f], err_msg=f)
+
+
+def test_resident_counters_and_progress():
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile(batch=16)
+    pool = make_pool(net, True, chunk_steps=48)
+    try:
+        hit0 = native_serve._res_events["hit"]
+        miss0 = native_serve._res_events["miss"]
+        state = net.init_state()
+        counts = np.zeros((16,), np.int32)
+        counts[3] = 2
+        vals = np.zeros((16, 8), np.int32)
+        vals[3, :2] = 7
+        state, _ = pool.serve(state, vals, counts)  # miss: arms residency
+        assert native_serve._res_events["miss"] == miss0 + 1
+        prog = pool.consume_progress()
+        assert prog is not None and prog.shape == (16,)
+        assert prog[3] == 1  # the fed replica retired instructions
+        # a partial-fill resident pass: only the active replica ticks
+        active = np.array([3], np.int32)
+        state, _ = pool.serve(state, vals, counts, active=active)
+        assert native_serve._res_events["hit"] == hit0 + 1
+        prog = pool.consume_progress()
+        assert prog[3] == 1 and int(prog.sum()) == 1
+    finally:
+        pool.close()
+
+
+def test_master_lifecycle_forces_lazy_export(tmp_path):
+    """checkpoint / snapshot+restore / status through a RESIDENT native
+    master: every read sees the live (exported) state, a restore round
+    trip is bit-identical, and serving stays correct throughout."""
+    master = MasterNode(
+        networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+        chunk_steps=32, batch=8, engine="native",
+    )
+    try:
+        master.run()
+        for v in range(6):
+            assert master.compute(v, timeout=30) == v + 2
+        # /status reads state content (ticks, ring depths) — the export hook
+        st = master.status()
+        assert st["tick"] > 0
+        for v in (100, 101):
+            assert master.compute(v, timeout=30) == v + 2
+        # pause: a RUNNING network keeps ticking, so bit-level comparisons
+        # happen on a quiesced engine (the export path is the same)
+        master.pause()
+        snap = master.snapshot()  # forces the lazy export
+        assert int(np.asarray(snap.tick).flat[0]) > 0
+        master.restore(snap)
+        snap2 = master.snapshot()
+        for f in snap._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(snap, f)),
+                np.asarray(getattr(snap2, f)), err_msg=f,
+            )
+        # checkpoint rides the same export; its arrays ARE the live state
+        path = str(tmp_path / "resident.npz")
+        master.save_checkpoint(path)
+        arrays = dict(np.load(path))
+        for f in snap._fields:
+            np.testing.assert_array_equal(
+                arrays[f], np.asarray(getattr(snap, f)), err_msg=f,
+            )
+        master.load_checkpoint(path)
+        master.run()
+        for v in (7, 8, 9):
+            assert master.compute(v, timeout=30) == v + 2
+    finally:
+        master.close()
+
+
+def test_master_reset_and_load_supersede_resident(tmp_path):
+    """reset/load REPLACE the state: the superseded resident copy must
+    never leak back through a later export (the anchor gate)."""
+    master = MasterNode(
+        networks.add2(in_cap=8, out_cap=8, stack_cap=8),
+        chunk_steps=32, batch=8, engine="native",
+    )
+    try:
+        master.run()
+        for v in range(4):
+            assert master.compute(v, timeout=30) == v + 2
+        master.reset()
+        snap = master.snapshot()  # must be the RESET state, not resident
+        assert int(np.asarray(snap.tick).flat[0]) == 0
+        assert not bool(np.asarray(snap.port_full).any())
+        master.run()
+        assert master.compute(5, timeout=30) == 7
+    finally:
+        master.close()
+
+
+def test_registry_eviction_revives_resident_state():
+    """Eviction drains + checkpoints a RESIDENT native engine (the lazy
+    export under capacity pressure) and revival restores the state: the
+    delay line continues where it left off — fresh state would answer 0.
+    The checkpoint's arrays must equal the resident engine's live state
+    at drain time (the export, not a stale snapshot)."""
+    from misaka_tpu.runtime.master import verify_checkpoint
+    from misaka_tpu.runtime.registry import ProgramRegistry
+
+    caps = dict(stack_cap=16, in_cap=16, out_cap=16)
+    delay = "IN ACC\nSWP\nOUT ACC\nSWP\nSAV\n"
+    reg = ProgramRegistry(
+        None, batch=None, engine="native", chunk_steps=32, caps=caps,
+        max_active=4,
+    )
+    top = networks.add2(**caps)
+    master = MasterNode(top, chunk_steps=32, batch=None, engine="native")
+    reg.seed("default", master, top)
+    master.run()
+    try:
+        v = reg.publish("delay", tis=delay)["version"]
+        with reg.lease("delay") as m:
+            assert m.compute_coalesced([5]) == [0]
+            assert m.compute_coalesced([6]) == [5]
+        assert reg.deactivate("delay")
+        ckpt = reg._state_path("delay", v)
+        verify_checkpoint(ckpt)
+        with np.load(ckpt) as data:
+            # the resident engine's BAK (the remembered value) reached
+            # the checkpoint — the lazy export actually happened
+            assert 6 in np.asarray(data["bak"]).reshape(-1)
+        with reg.lease("delay") as m:
+            assert m.compute_coalesced([7]) == [6]
+    finally:
+        master.pause()
+        reg.close()
+
+
+def test_unbatched_native_serve_resident_counters():
+    """NativeServe (batch=None) rides the same identity discipline: the
+    second chunk on the returned anchor is a resident hit."""
+    net = networks.add2(in_cap=8, out_cap=8, stack_cap=8).compile()
+    ns = native_serve.NativeServe(net)
+    hit0 = native_serve._res_events["hit"]
+    state = net.init_state()
+    vals = np.zeros((net.in_cap,), np.int32)
+    vals[0] = 41
+    state, packed = ns.serve_chunk(state, vals, 1, 64)
+    rd, wr = int(packed[2]), int(packed[3])
+    assert wr - rd == 1 and int(packed[4:][rd % net.out_cap]) == 43
+    vals[0] = 1
+    state, packed = ns.serve_chunk(state, vals, 1, 64)
+    assert native_serve._res_events["hit"] == hit0 + 1
+    st = ns.export_resident(state)
+    assert st is not None and int(np.asarray(st.tick)) > 0
+    ns.close()

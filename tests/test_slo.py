@@ -463,12 +463,21 @@ def test_plane_edge_feeds_windows(monkeypatch, tmp_path):
         for _ in range(8):
             out = client.compute_raw(vals.astype("<i4").tobytes())
             assert (np.frombuffer(out, "<i4") == vals + 2).all()
-        # the engine-side record for the last frame lands just after its
-        # response bytes go out; give it a beat before reading
-        time.sleep(0.2)
+        # The engine-side record lands AFTER the response bytes go out —
+        # since r17 on the plane's pipeline executor thread, which a
+        # contended box (this 1-core container with suite-order
+        # neighbors) can deschedule for hundreds of ms.  POLL the longest
+        # window instead of sleeping a fixed beat: the pin is that
+        # plane-edge observations REACH the windows, not the recording
+        # thread's scheduling latency or the 0.5s window's knife-edge.
+        deadline = time.monotonic() + 3
         payload = slo.evaluate("default")
-        assert payload["windows"]["0.5s"]["requests"] >= 8
-        assert payload["windows"]["0.5s"]["p99_ms"] > 0
+        while (payload["windows"]["4s"]["requests"] < 8
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+            payload = slo.evaluate("default")
+        assert payload["windows"]["4s"]["requests"] >= 8
+        assert payload["windows"]["4s"]["p99_ms"] > 0
     finally:
         client.close()
         m.pause()

@@ -214,7 +214,7 @@ def run_scenario(args) -> int:
     serve_gate.set()
     errors: list[BaseException] = []
     stats = {"passes": 0, "values": 0, "reads": 0, "closed_reads": 0,
-             "cycles": 0}
+             "cycles": 0, "resident_passes": 0}
 
     # Pool variants rotated across close/recreate cycles so every ladder
     # rung runs the concurrent serve/close/counter-read race under the
@@ -282,14 +282,36 @@ def run_scenario(args) -> int:
                         [-2**31, -7, 0, 5, 2**31 - 1, 2**31 - 2],
                         size=counts[b],
                     ).astype(np.int32)
-                d, packed = pool.serve(d, vals, counts, ticks=64)
-                # partial-fill serial fast path (n<=4 runs on THIS
-                # thread): a second shape through the same superstep
+                # Alternate the r17 RESIDENT path with the stateless one:
+                # import/serve_resident/export race the same scrape
+                # readers (and drive the futex dispenser + masked group
+                # ticks), and the export-under-load is exactly the
+                # lifecycle path a checkpoint takes against a hot pool.
+                resident = stats["passes"] % 2 == 1
                 active = np.arange(min(2, B), dtype=np.int32)
-                d, _ = pool.serve(
-                    d, np.zeros((B, in_cap), np.int32),
-                    np.zeros((B,), np.int32), ticks=8, active=active,
-                )
+                if resident:
+                    if not pool.is_resident() and not pool.import_state(d):
+                        raise AssertionError("resident import refused")
+                    packed, progress = pool.serve_resident(vals, counts, 64)
+                    assert progress.shape == (B,)
+                    # masked partial-fill resident pass (group-mask path)
+                    pool.serve_resident(
+                        np.zeros((B, in_cap), np.int32),
+                        np.zeros((B,), np.int32), 8, active=active,
+                    )
+                    d = pool.export_state()  # the lifecycle export
+                    assert d is not None
+                    stats["resident_passes"] += 1
+                else:
+                    if pool.is_resident():
+                        pool.discard_resident()  # d carries the export
+                    d, packed = pool.serve(d, vals, counts, ticks=64)
+                    # partial-fill serial fast path (n<=4 runs on THIS
+                    # thread): a second shape through the same superstep
+                    d, _ = pool.serve(
+                        d, np.zeros((B, in_cap), np.int32),
+                        np.zeros((B,), np.int32), ticks=8, active=active,
+                    )
                 for b in range(B):
                     rd, wr = int(packed[b, 2]), int(packed[b, 3])
                     got = packed[b, 4:][(rd + np.arange(wr - rd)) % in_cap]
@@ -358,12 +380,14 @@ def run_scenario(args) -> int:
     if errors:
         print(f"sanitize: scenario error: {errors[0]!r}", file=sys.stderr)
         return 1
-    if not (stats["passes"] and stats["reads"] and stats["cycles"]):
+    if not (stats["passes"] and stats["reads"] and stats["cycles"]
+            and stats["resident_passes"]):
         print(f"sanitize: scenario did not exercise the race: {stats}",
               file=sys.stderr)
         return 1
     print(f"# sanitize[{os.environ.get('MISAKA_SANITIZE_CHILD')}] green: "
-          f"{stats['passes']} serve passes / {stats['values']} values, "
+          f"{stats['passes']} serve passes / {stats['values']} values "
+          f"({stats['resident_passes']} resident), "
           f"{stats['reads']} counter reads "
           f"({stats['closed_reads']} typed closed-pool losses), "
           f"{stats['cycles']} close/recreate cycles "

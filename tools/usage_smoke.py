@@ -178,7 +178,12 @@ def main() -> int:
         if flame.get("samples", 0) <= 0 or not flame.get("stacks"):
             fail(f"no CPython samples in the flamegraph: "
                  f"samples={flame.get('samples')}")
-        if "native_pool" not in flame or flame["native_pool"]["busy_ns"] <= 0:
+        # busy INCLUDES the serial/caller-thread path (the PR 7 busy-
+        # fraction semantics): on a 1-worker pool the r17 dispenser runs
+        # every unit inline on the caller, so worker busy_ns alone is
+        # legitimately 0 while serial_ns carries the whole load
+        np_flame = flame.get("native_pool") or {}
+        if np_flame.get("busy_ns", 0) + np_flame.get("serial_ns", 0) <= 0:
             fail("flamegraph lacks the measured native busy/idle split")
         if not any(";" in k for k in flame["stacks"]):
             fail("flamegraph folded stacks carry no frame chains")
