@@ -153,6 +153,18 @@ registry-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/registry_smoke.py
 
+# Native flight-recorder tripwire (~10s): a REAL subprocess server with
+# frontend workers — traced traffic carrying X-Misaka-Trace IDs, then
+# assert GET /debug/perfetto renders ONE unified timeline per ID spanning
+# >= 5 tiers (http/frontend/plane/serve + native worker-thread spans from
+# the in-C++ event rings) and GET /debug/native_trace carries rung-tagged
+# unit events with the same IDs attached.  The same assertions run inside
+# tier-1 (tests/test_native_trace.py); docs/OBSERVABILITY.md "Native
+# flight recorder".
+native-trace-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/native_trace_smoke.py
+
 # Usage/SLO/profiler tripwire (~15s): a REAL subprocess server — two
 # registry tenants under mixed native+Python load, then assert GET
 # /debug/usage attributes nonzero CPU-seconds per program summing to the
@@ -190,6 +202,7 @@ ci:
 	$(MAKE) sanitize-smoke
 	$(MAKE) metrics-smoke
 	$(MAKE) trace-smoke
+	$(MAKE) native-trace-smoke
 	$(MAKE) registry-smoke
 	$(MAKE) usage-smoke
 	$(MAKE) observatory-smoke
@@ -271,4 +284,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke registry-smoke usage-smoke observatory-smoke edge-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke usage-smoke observatory-smoke edge-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean

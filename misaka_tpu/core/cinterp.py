@@ -110,6 +110,17 @@ def _configure(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, _I32P, _I32P, ctypes.c_int, _I32P, ctypes.c_int,
         _I32P, _U8P,
     ]
+    # flight recorder (r18)
+    lib.misaka_pool_trace_info.restype = None
+    lib.misaka_pool_trace_info.argtypes = [ctypes.c_void_p, _I64P]
+    lib.misaka_pool_trace_read.restype = ctypes.c_int
+    lib.misaka_pool_trace_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, _I64P, ctypes.c_int, _I64P,
+    ]
+    lib.misaka_pool_trace_stats.restype = None
+    lib.misaka_pool_trace_stats.argtypes = [ctypes.c_void_p, _I64P]
+    lib.misaka_pool_trace_set.restype = ctypes.c_int
+    lib.misaka_pool_trace_set.argtypes = [ctypes.c_void_p, ctypes.c_int]
 
 
 _NATIVE = NativeLib(
@@ -530,6 +541,13 @@ class NativePool:
             "busy_ns": int(out[0]),
             "idle_ns": int(out[1]),
             "serial_ns": int(out[2]),
+            # the caller-inline lane, first-class (r18): serial_ns IS
+            # work booked on the calling thread (zero-handoff inline,
+            # caller help, the small-pass fast path) — surfaced under
+            # its own name, with work_ns the one total conservation
+            # checks read instead of re-deriving busy + serial
+            "caller_inline_ns": int(out[2]),
+            "work_ns": int(out[0]) + int(out[2]),
         }
 
     def thread_counters(self) -> tuple[np.ndarray, np.ndarray]:
@@ -544,6 +562,102 @@ class NativePool:
                 idle.ctypes.data_as(i64p), self.threads,
             )
         return busy, idle
+
+    # --- flight recorder (r18) -----------------------------------------
+
+    # Event kinds (native/interpreter.cpp TraceEv) and the rung/shape tag
+    # decode for TEV_UNIT args — shared by native_serve's exporters.
+    TRACE_EVENTS = {
+        1: "serve", 2: "unit", 3: "spin", 4: "yield", 5: "park",
+        6: "import", 7: "export", 8: "discard",
+    }
+    TRACE_RUNGS = {
+        0: "scalar", 1: "generic", 2: "avx2",
+        5: "spec-generic", 6: "spec-avx2",
+    }
+    TRACE_SHAPES = {0: "group", 1: "scalar", 2: "masked"}
+    _TRACE_STAT_KEYS = (
+        "spin_ns", "yield_ns", "park_ns", "wakes",
+        "dispatch_calls", "dispatch_wait_ns", "last_dispatch_wait_ns",
+        "last_unit_imbalance", "caller_units", "serve_calls",
+        "inline_calls", "dropped",
+    )
+
+    def trace_info(self) -> dict:
+        """Recorder shape: ring count (0 = MISAKA_NATIVE_TRACE=0 skipped
+        the build), records per ring, armed flag, and the cumulative
+        oldest-dropped (overwritten) record count across rings."""
+        out = np.zeros((4,), np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        with self._ctr_lock:
+            self._lib.misaka_pool_trace_info(
+                self._handle(), out.ctypes.data_as(i64p)
+            )
+        return {
+            "rings": int(out[0]), "capacity": int(out[1]),
+            "armed": bool(out[2]), "dropped": int(out[3]),
+        }
+
+    def trace_read(self, ring: int, max_records: int | None = None):
+        """Snapshot one per-thread event ring without stopping the pool:
+        (records [n, 4] int64 rows of [t0_ns, dur_ns, kind, arg] oldest
+        first, cursor, dropped).  Ring `threads` is the calling thread's
+        (serve lifecycle + caller-inline units + residency events).
+        Raises ValueError on a bad ring index or an unbuilt recorder."""
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        meta = np.zeros((2,), np.int64)
+        with self._ctr_lock:
+            info = np.zeros((4,), np.int64)
+            self._lib.misaka_pool_trace_info(
+                self._handle(), info.ctypes.data_as(i64p)
+            )
+            cap = int(info[1])
+            want = cap if max_records is None else min(cap, int(max_records))
+            buf = np.zeros((max(1, want), 4), np.int64)
+            n = self._lib.misaka_pool_trace_read(
+                self._handle(), int(ring), buf.ctypes.data_as(i64p),
+                want, meta.ctypes.data_as(i64p),
+            )
+        if n < 0:
+            raise ValueError(
+                f"bad trace ring {ring} (recorder built: {bool(info[0])})"
+            )
+        return buf[:n], int(meta[0]), int(meta[1])
+
+    def trace_stats(self) -> dict:
+        """Cumulative recorder aggregates (lock-free relaxed reads on the
+        C++ side): dispenser wait ns by phase, wake/dispatch/serve call
+        counters, last dispatch wait + unit imbalance, caller-inline
+        units, dropped records, and replicas ticked per (rung, shape)."""
+        out = np.zeros((12 + 32,), np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        with self._ctr_lock:
+            self._lib.misaka_pool_trace_stats(
+                self._handle(), out.ctypes.data_as(i64p)
+            )
+        d = {k: int(out[i]) for i, k in enumerate(self._TRACE_STAT_KEYS)}
+        reps = {}
+        for rung in range(8):
+            for shape in range(4):
+                v = int(out[12 + rung * 4 + shape])
+                if v:
+                    reps[(
+                        self.TRACE_RUNGS.get(rung, f"rung{rung}"),
+                        self.TRACE_SHAPES.get(shape, f"shape{shape}"),
+                    )] = v
+        d["reps"] = reps
+        return d
+
+    def trace_set(self, on: bool) -> bool:
+        """Arm/disarm a built recorder at runtime (the overhead A/B's
+        toggle).  False when MISAKA_NATIVE_TRACE=0 skipped the ring
+        allocation at pool creation — there is nothing to arm."""
+        with self._ctr_lock:
+            if not self._h:
+                return False
+            return self._lib.misaka_pool_trace_set(
+                self._h, 1 if on else 0
+            ) >= 0
 
     # --- resident-state serving (r17) ----------------------------------
 

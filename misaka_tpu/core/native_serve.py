@@ -33,6 +33,7 @@ when no TPU is attached.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import threading
@@ -199,7 +200,7 @@ class _BusyWindow:
                 c = p._pool.counters()
             except Exception:
                 continue
-            w = c["busy_ns"] + c["serial_ns"]
+            w = c["work_ns"]  # the one derivation site: cinterp counters()
             work += w
             total += w + c["idle_ns"]
         now = time.monotonic()
@@ -312,6 +313,299 @@ class _HitWindow:
 _G_RES_RATIO.set_function(_HitWindow().read)
 
 
+# --- native flight recorder (r18) ------------------------------------------
+#
+# The C++ pool journals steady-clock-stamped events into bounded
+# lock-free per-thread rings (native/interpreter.cpp, the r18 block):
+# serve-call lifecycle, dispenser wait phases, per-unit tick execution
+# tagged by engine rung, residency import/export.  This layer exports it
+# upward: derived metrics (dispenser wait, spin-vs-park, unit imbalance,
+# per-rung tick share) pulled into the registry at a throttled cadence
+# from the serve path, correlation of ring events with the request-trace
+# IDs active during each pool call (the per-call windows below), the raw
+# dump behind GET /debug/native_trace, and a tier source feeding native
+# worker-thread spans into the GET /debug/perfetto export.  Always-on
+# like the PR 7 sampler; MISAKA_NATIVE_TRACE=0 kills the whole plane
+# (C++ rings unallocated, every hook below a no-op) and set_trace()
+# flips a built recorder at runtime for the overhead A/B.
+
+def trace_enabled() -> bool:
+    return os.environ.get("MISAKA_NATIVE_TRACE", "1") not in ("0", "off")
+
+
+_TRACE_ON = trace_enabled()
+
+
+def set_trace(on: bool) -> bool:
+    """Arm/disarm the flight recorder at runtime: every live pool's C++
+    emit flag plus the Python-side correlation/pull plumbing (the
+    bench --native-trace-ab toggle).  False when some pool was created
+    under MISAKA_NATIVE_TRACE=0 and has no rings to arm."""
+    global _TRACE_ON
+    _TRACE_ON = bool(on)
+    ok = True
+    for p in _live_pools():
+        try:
+            ok = p._pool.trace_set(on) and ok
+        except Exception:
+            ok = False
+    return ok
+
+
+_H_DISP_WAIT = metrics.histogram(
+    "misaka_native_dispenser_wait_seconds",
+    "Caller-side dispenser wait per published pool call (time the "
+    "calling thread waited on the done futex AFTER helping drain the "
+    "unit list — the straggler tail the r17 flat dispenser replaced the "
+    "~180us barrier with).  Sampled from the recorder at the ~50ms pull "
+    "cadence: each observation is the mean wait of one pull window",
+)
+_H_UNIT_IMBALANCE = metrics.histogram(
+    "misaka_native_unit_imbalance",
+    "Units-drained spread (max - min) across worker threads on the last "
+    "published pool call per pull window — sustained nonzero at full "
+    "batch means one thread runs the tail while siblings wait",
+)
+_C_DISP_PHASE = metrics.counter(
+    "misaka_native_dispenser_seconds_total",
+    "Worker dispenser wait seconds by phase (spin = pause-spin, yield = "
+    "yield-spin, park = futex) — the spin-vs-park split the "
+    "MISAKA_POOL_SPIN_US budget trades on",
+    ("phase",),
+)
+_C_DISP_SPIN = _C_DISP_PHASE.labels(phase="spin")
+_C_DISP_YIELD = _C_DISP_PHASE.labels(phase="yield")
+_C_DISP_PARK = _C_DISP_PHASE.labels(phase="park")
+_C_UNITS = metrics.counter(
+    "misaka_native_units_replicas_total",
+    "Replicas ticked by dispensed pool units, by engine rung (scalar / "
+    "generic / avx2 / spec-*) and unit shape (group / scalar remainder "
+    "/ masked partial-fill) — the per-rung tick share",
+    ("rung", "shape"),
+)
+_C_CALLER_UNITS = metrics.counter(
+    "misaka_native_caller_inline_units_total",
+    "Units drained on the CALLING thread (the zero-handoff inline path "
+    "and the caller helping while workers tick) — the caller-inline "
+    "lane's unit count",
+)
+_C_TRACE_DROPPED = metrics.counter(
+    "misaka_native_trace_dropped_total",
+    "Flight-recorder records overwritten before any reader saw them "
+    "(bounded rings drop oldest-first; size with "
+    "MISAKA_NATIVE_TRACE_RING)",
+)
+_G_SPIN_RATIO = metrics.gauge(
+    "misaka_native_dispenser_spin_ratio",
+    "Fraction of worker dispenser wait spent spinning (pause + yield) "
+    "vs parked on the futex over the last ~1s window — ~1 under "
+    "saturation (calls arrive inside the spin budget), ~0 idle",
+)
+
+
+class _SpinWindow:
+    """Windowed spin-vs-park ratio from the cumulative phase counters
+    (the _BusyWindow discipline: delta over >= 1 s, coherent within)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._prev: tuple[float, int, int] | None = None
+        self._value = 0.0
+
+    def read(self) -> float:
+        spin = park = 0
+        for p in _live_pools():
+            try:
+                s = p._pool.trace_stats()
+            except Exception:
+                continue
+            spin += s["spin_ns"] + s["yield_ns"]
+            park += s["park_ns"]
+        now = time.monotonic()
+        with self._lock:
+            prev = self._prev
+            if prev is None:
+                self._prev = (now, spin, park)
+                return 0.0
+            if now - prev[0] >= 1.0:
+                ds, dp = spin - prev[1], park - prev[2]
+                self._value = ds / (ds + dp) if ds + dp > 0 else 0.0
+                self._prev = (now, spin, park)
+            return self._value
+
+
+_G_SPIN_RATIO.set_function(_SpinWindow().read)
+
+# decoded-event field extractors (arg layouts: interpreter.cpp TraceEv)
+_EV_NAMES = cinterp.NativePool.TRACE_EVENTS
+_RUNG_NAMES = cinterp.NativePool.TRACE_RUNGS
+_SHAPE_NAMES = cinterp.NativePool.TRACE_SHAPES
+
+
+def _decode_event(t0: int, dur: int, kind: int, arg: int) -> dict:
+    k = _EV_NAMES.get(kind, str(kind))
+    ev = {"t_ns": t0, "dur_ns": dur, "kind": k}
+    if k == "unit":
+        ev["replicas"] = arg & 0xFFFFFF
+        shape = (arg >> 24) & 0x7
+        rung = (arg >> 27) & 0x1F
+        ev["shape"] = _SHAPE_NAMES.get(shape, f"shape{shape}")
+        ev["rung"] = _RUNG_NAMES.get(rung, f"rung{rung}")
+        ev["idx"] = arg >> 32
+    elif k == "serve":
+        ev["active"] = arg & 0xFFFFFFFF
+        flags = arg >> 32
+        ev["feeding"] = bool(flags & 1)
+        ev["resident"] = bool(flags & 2)
+        ev["inline"] = bool(flags & 4)
+    elif k in ("import", "export", "discard"):
+        ev["replicas"] = arg & 0xFFFFFFFF
+        if k != "discard":
+            ev["failed"] = bool(arg >> 32)
+    return ev
+
+
+def _window_index(pool) -> list[tuple[float, float, tuple]]:
+    """The pool's recent (start, end, trace_ids) serve-call windows,
+    sorted by start — C++ steady_clock and time.monotonic share
+    CLOCK_MONOTONIC on Linux, so ring timestamps land inside them."""
+    return sorted(pool._call_windows)
+
+
+def _ids_for(windows, start_s: float, end_s: float) -> tuple:
+    """Trace IDs active during [start_s, end_s] (one serializing caller
+    per pool, so windows never overlap and a scan from bisect is
+    bounded).  EXACT containment — ring stamps are taken inside the
+    Python-measured call window on the same CLOCK_MONOTONIC, and any
+    slop here would cross-attribute IDs between adjacent calls at high
+    call rates (~50us apart on the r18 call-overhead shape)."""
+    import bisect
+
+    if not windows:
+        return ()
+    i = bisect.bisect_right(windows, (start_s, float("inf"), ())) - 1
+    out: list = []
+    for j in range(max(0, i), len(windows)):
+        w0, w1, ids = windows[j]
+        if w0 > end_s:
+            break
+        if w1 >= start_s and w0 <= end_s:
+            for tid in ids:
+                if tid not in out:
+                    out.append(tid)
+    return tuple(out)
+
+
+def _iter_flight_rings(max_records: int | None):
+    """The shared ring walk behind both exporters: yields one tuple per
+    readable ring — (pool, program label, ring index, role, cursor,
+    dropped, decoded events) — with serve/unit events already carrying
+    the request-trace IDs of the call windows they fell inside.  A pool
+    or ring that fails to read is skipped (debug surfaces answer), and
+    pools without rings (MISAKA_NATIVE_TRACE=0) yield nothing."""
+    for p in _live_pools():
+        try:
+            info = p._pool.trace_info()
+        except Exception:
+            continue
+        try:
+            label = p.usage_label()
+        except Exception:
+            label = usage.DEFAULT_LABEL
+        if not info["rings"]:
+            yield p, label, info, None, None, None, None
+            continue
+        windows = _window_index(p)
+        for ring in range(info["rings"]):
+            try:
+                recs, cursor, dropped = p._pool.trace_read(
+                    ring, max_records
+                )
+            except Exception:
+                continue
+            role = "caller" if ring == p.threads else f"worker{ring}"
+            events = []
+            for t0, dur, kind, arg in recs.tolist():
+                ev = _decode_event(t0, dur, kind, arg)
+                if ev["kind"] in ("serve", "unit"):
+                    ids = _ids_for(windows, t0 / 1e9, (t0 + dur) / 1e9)
+                    if ids:
+                        ev["trace_ids"] = list(ids)
+                events.append(ev)
+            yield p, label, info, ring, role, (cursor, dropped), events
+
+
+def flight_payload(max_records: int | None = None) -> dict:
+    """GET /debug/native_trace: the raw per-thread rings of every live
+    pool, decoded, with serve/unit events carrying the request-trace IDs
+    active during their pool call.  Reading also refreshes the derived
+    metrics (an idle pool's counters stay fresh on scrape)."""
+    entries: dict[int, dict] = {}
+    pulled: set[int] = set()
+    for p, label, info, ring, role, meta, events in \
+            _iter_flight_rings(max_records):
+        entry = entries.get(id(p))
+        if entry is None:
+            entry = entries[id(p)] = {
+                "program": label,
+                "threads": p.threads,
+                "capacity": info["capacity"],
+                "armed": info["armed"],
+                "dropped": info["dropped"],
+                "rings": [],
+            }
+        if ring is None:
+            continue
+        entry["rings"].append({
+            "ring": ring,
+            "role": role,
+            "cursor": meta[0],
+            "dropped": meta[1],
+            "events": events,
+        })
+        if id(p) not in pulled:
+            pulled.add(id(p))
+            try:
+                p._pull_trace_stats(force=True)
+            except Exception:
+                pass
+    return {"enabled": _TRACE_ON, "pools": list(entries.values())}
+
+
+def flight_spans(window_s: float = 15.0, max_per_ring: int = 512) -> list:
+    """Recent flight-recorder events as tracespan.Span objects for the
+    Perfetto export (registered as a tier source below): per-thread
+    native lanes (attrs['_lane']) plus request-trace correlation
+    (attrs['trace_ids']) so one trace ID reads as one timeline from
+    http.parse down to the worker-thread units that served it."""
+    spans: list = []
+    now = time.monotonic()
+    for _p, label, _info, ring, role, _meta, events in \
+            _iter_flight_rings(max_per_ring):
+        if ring is None:
+            continue
+        for ev in events:
+            ev = dict(ev)
+            t0 = ev.pop("t_ns")
+            dur = ev.pop("dur_ns")
+            start = t0 / 1e9
+            if now - start > window_s:
+                continue
+            k = ev.pop("kind")
+            ids = ev.pop("trace_ids", None)
+            attrs = {"_lane": f"{label}/{role}", "pool": label}
+            attrs.update(ev)
+            if ids:
+                attrs["trace_ids"] = ids
+            spans.append(tracespan.Span(
+                f"native.{k}", start, dur / 1e9, attrs
+            ))
+    return spans
+
+
+tracespan.register_tier_source(flight_spans)
+
+
 def pool_counters() -> dict | None:
     """Busy/idle nanosecond counters across every live native pool (None
     when no pool is serving): process-wide aggregate + a per-pool block
@@ -333,9 +627,14 @@ def pool_counters() -> dict | None:
         c["program"] = label
         c["busy_ns_per_thread"] = [int(v) for v in busy]
         c["idle_ns_per_thread"] = [int(v) for v in idle]
-        work = c["busy_ns"] + c["serial_ns"]
-        total = work + c["idle_ns"]
-        c["busy_fraction"] = round(work / total, 6) if total else 0.0
+        # The caller-inline lane, FIRST-CLASS (r18): work booked on the
+        # calling thread — the r17 zero-handoff path runs EVERY unit
+        # there on 1-worker pools, plus the caller-help and serial fast
+        # paths everywhere.  cinterp counters() is the ONE place the
+        # caller_inline_ns/work_ns fields are derived; this layer only
+        # aggregates them.
+        total = c["work_ns"] + c["idle_ns"]
+        c["busy_fraction"] = round(c["work_ns"] / total, 6) if total else 0.0
         pools.append(c)
     if not pools:
         return None
@@ -344,6 +643,7 @@ def pool_counters() -> dict | None:
         "busy_ns": sum(c["busy_ns"] for c in pools),
         "idle_ns": sum(c["idle_ns"] for c in pools),
         "serial_ns": sum(c["serial_ns"] for c in pools),
+        "caller_inline_ns": sum(c["caller_inline_ns"] for c in pools),
         "busy_ns_per_thread": [
             v for c in pools for v in c["busy_ns_per_thread"]
         ],
@@ -351,9 +651,9 @@ def pool_counters() -> dict | None:
             v for c in pools for v in c["idle_ns_per_thread"]
         ],
     }
-    work = out["busy_ns"] + out["serial_ns"]
-    total = work + out["idle_ns"]
-    out["busy_fraction"] = round(work / total, 6) if total else 0.0
+    out["work_ns"] = out["busy_ns"] + out["caller_inline_ns"]
+    total = out["work_ns"] + out["idle_ns"]
+    out["busy_fraction"] = round(out["work_ns"] / total, 6) if total else 0.0
     if len(pools) > 1:
         out["pools"] = pools  # the per-program split, one block per pool
     return out
@@ -542,6 +842,16 @@ class NativeServePool:
         # busy-ns watermark for take_busy_ns deltas (device-loop thread
         # only — one serializing caller per pool by construction)
         self._busy_mark = 0
+        # Flight-recorder plumbing (r18): per-call (start, end, trace_ids)
+        # windows correlate ring events with the request traces the pass
+        # served (MasterNode rebinds active_trace_ids like usage_label);
+        # the stats watermark feeds the derived metrics at a throttled
+        # cadence so the pull never taxes the per-call hot path.
+        self._call_windows: collections.deque = collections.deque(maxlen=512)
+        self.active_trace_ids = lambda: ()
+        self._trace_marks: dict | None = None
+        self._trace_last_pull = 0.0
+        self._trace_pull_lock = threading.Lock()
         with _pool_refs_lock:
             _pool_refs.append(weakref.ref(self))
 
@@ -558,8 +868,7 @@ class NativeServePool:
         time): the MEASURED native cost of the call(s) in between, which
         the device loop attributes to its program.  Device-loop thread
         only — one serializing caller per pool by construction."""
-        c = self._pool.counters()
-        busy = c["busy_ns"] + c["serial_ns"]
+        busy = self._pool.counters()["work_ns"]
         delta = busy - self._busy_mark
         self._busy_mark = busy
         return max(0, delta)
@@ -572,6 +881,71 @@ class NativeServePool:
         delta = self.take_busy_ns()
         if usage.enabled():
             usage.add_native(self.usage_label(), delta * 1e-9)
+
+    def _pull_trace_stats(self, force: bool = False) -> None:
+        """Drain the C++ recorder aggregates into the metrics registry:
+        counter deltas vs the per-pool watermark, one sampled histogram
+        observation per pull window.  Callers race (the device-loop
+        serve path vs scrape threads via flight_payload), and the
+        read-delta-inc sequence is NOT atomic under the GIL (trace_stats
+        releases it inside ctypes) — _trace_pull_lock serializes the
+        watermark; a contended caller just skips (the winner already
+        drained the same deltas)."""
+        if not force and not _TRACE_ON:
+            return
+        if not self._trace_pull_lock.acquire(blocking=False):
+            return
+        try:
+            self._pull_trace_stats_locked()
+        finally:
+            self._trace_pull_lock.release()
+
+    def _pull_trace_stats_locked(self) -> None:
+        try:
+            s = self._pool.trace_stats()
+        except Exception:
+            return
+        prev, self._trace_marks = self._trace_marks, s
+        if prev is None:
+            return
+        d_spin = s["spin_ns"] - prev["spin_ns"]
+        d_yield = s["yield_ns"] - prev["yield_ns"]
+        d_park = s["park_ns"] - prev["park_ns"]
+        if d_spin > 0:
+            _C_DISP_SPIN.inc(d_spin * 1e-9)
+        if d_yield > 0:
+            _C_DISP_YIELD.inc(d_yield * 1e-9)
+        if d_park > 0:
+            _C_DISP_PARK.inc(d_park * 1e-9)
+        d_caller = s["caller_units"] - prev["caller_units"]
+        if d_caller > 0:
+            _C_CALLER_UNITS.inc(d_caller)
+        d_drop = s["dropped"] - prev["dropped"]
+        if d_drop > 0:
+            _C_TRACE_DROPPED.inc(d_drop)
+        d_calls = s["dispatch_calls"] - prev["dispatch_calls"]
+        if d_calls > 0:
+            d_wait = s["dispatch_wait_ns"] - prev["dispatch_wait_ns"]
+            _H_DISP_WAIT.observe(max(0.0, d_wait / d_calls) * 1e-9)
+            _H_UNIT_IMBALANCE.observe(float(s["last_unit_imbalance"]))
+        for key, v in s["reps"].items():
+            dv = v - prev["reps"].get(key, 0)
+            if dv > 0:
+                rung, shape = key
+                _C_UNITS.labels(rung=rung, shape=shape).inc(dv)
+
+    def _note_trace_call(self, t0: float, t1: float) -> None:
+        """Per-serve-call recorder bookkeeping: the correlation window
+        (only when request traces are active — an untraced call costs
+        one lambda call) and the throttled stats pull."""
+        if not _TRACE_ON:
+            return
+        ids = self.active_trace_ids()
+        if ids:
+            self._call_windows.append((t0, t1, tuple(ids)))
+        if t1 - self._trace_last_pull >= 0.05:
+            self._trace_last_pull = t1
+            self._pull_trace_stats()
 
     def _to_dict(self, state: NetworkState) -> dict:
         return {f: np.asarray(getattr(state, f)) for f in NetworkState._fields}
@@ -698,6 +1072,7 @@ class NativeServePool:
         _C_CALLS_POOL.inc()
         dur = time.perf_counter() - t0
         _H_SERVE_POOL.observe(dur)
+        self._note_trace_call(t0, t0 + dur)
         # native-tier flight-recorder event (one deque append): the pool
         # call underlying a fused pass, visible in GET /debug/perfetto
         tracespan.note_tier(
@@ -736,5 +1111,7 @@ class NativeServePool:
         out = new_state, ctrs
         self._account_native()
         _C_CALLS_IDLE.inc()
-        _H_SERVE_IDLE.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        _H_SERVE_IDLE.observe(t1 - t0)
+        self._note_trace_call(t0, t1)
         return out

@@ -112,6 +112,21 @@ so the master's env surface is what survives:
                    hit/miss/export/fallback
   MISAKA_POOL_SPIN_US  native pool dispenser spin budget in microseconds
                    before a worker parks on the futex (default 50 — r17)
+  MISAKA_NATIVE_TRACE  "0" disables the native flight recorder (r18):
+                   bounded lock-free per-thread event rings inside the
+                   C++ pool journal serve-call lifecycle, dispenser
+                   phases (spin/yield/park), per-unit rung-tagged tick
+                   execution, and residency events — dumped raw at GET
+                   /debug/native_trace, unified with request traces in
+                   GET /debug/perfetto (worker-thread unit spans under
+                   the same X-Misaka-Trace ID), and derived into
+                   misaka_native_dispenser_* / misaka_native_units_* /
+                   misaka_native_caller_inline_units_total metrics.
+                   Default on (overhead A/B'd >= 0.95; docs/
+                   OBSERVABILITY.md "Native flight recorder")
+  MISAKA_NATIVE_TRACE_RING  records per per-thread ring (default 2048,
+                   32 B each = 64 KiB/thread; oldest dropped, counted on
+                   misaka_native_trace_dropped_total)
   MISAKA_PLANE_PIPELINE  max in-flight frames per compute-plane
                    connection, BOTH ends (default 4; 1 restores the r16
                    ping-pong; the shm plane always runs depth 1 — r17)

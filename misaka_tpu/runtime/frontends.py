@@ -100,6 +100,58 @@ M_PLANE_SHM_FRAMES = metrics.counter(
     "instead of the socket (MISAKA_PLANE_SHM=1) — zero here with the "
     "flag set means the zero-copy plane silently fell back to sockets",
 )
+# Pipeline DEPTH (r18): the engagement counter above says pipelining
+# happened; these say how deep the overlap actually runs — in-flight
+# frames on one plane connection at each dispatch (histogram) and the
+# deepest overlap seen in the last ~5s (windowed gauge, the dashboard's
+# sparkline).  Observed on pipelined connections only
+# (MISAKA_PLANE_PIPELINE > 1).
+M_PLANE_PIPE_DEPTH = metrics.histogram(
+    "misaka_plane_pipeline_depth",
+    "In-flight frames on one compute-plane connection at frame dispatch "
+    "(1 = no overlap; MISAKA_PLANE_PIPELINE bounds it)",
+)
+
+
+class _DepthWindow:
+    """Max pipeline depth over a rolling ~5s window: two rotating
+    buckets so the reported max covers the last 5-10s — a depth spike is
+    visible to every scraper inside the window instead of only the one
+    that races it."""
+
+    def __init__(self, window_s: float = 5.0):
+        self._lock = threading.Lock()
+        self._window_s = window_s
+        self._t0 = 0.0
+        self._cur = 0
+        self._prev = 0
+
+    def note(self, depth: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._t0 >= self._window_s:
+                self._prev, self._cur = self._cur, 0
+                self._t0 = now
+            if depth > self._cur:
+                self._cur = depth
+
+    def read(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._t0 >= 2 * self._window_s:
+                return 0.0
+            if now - self._t0 >= self._window_s:
+                return float(self._cur)
+            return float(max(self._cur, self._prev))
+
+
+_PIPE_DEPTH_WINDOW = _DepthWindow()
+G_PLANE_PIPE_DEPTH = metrics.gauge(
+    "misaka_plane_pipeline_depth_max",
+    "Deepest per-connection frame overlap observed on the compute plane "
+    "in the last ~5s (0 = no pipelined traffic)",
+)
+G_PLANE_PIPE_DEPTH.set_function(_PIPE_DEPTH_WINDOW.read)
 
 # Compute-plane wire format (unix SOCK_STREAM, one frame in flight per
 # connection — pipelining comes from running several connections):
@@ -496,6 +548,7 @@ class ComputePlane:
         )
         send_lock = threading.Lock()
         conn_dead = [False]
+        conn_depth = [0]  # in-flight pipelined frames on THIS connection
         pipe_sem = threading.Semaphore(pipe_depth)
         executor = [None]  # lazy ThreadPoolExecutor, pipelined frames only
         tail = [None]      # done event of the most recently accepted frame
@@ -706,6 +759,8 @@ class ComputePlane:
                 conn_dead[0] = True
                 log.exception("pipelined compute-plane frame crashed")
             finally:
+                with self._inflight_lock:
+                    conn_depth[0] -= 1
                 done.set()
                 pipe_sem.release()
 
@@ -813,6 +868,10 @@ class ComputePlane:
                         )
                     with self._inflight_lock:
                         self._inflight += 1
+                        conn_depth[0] += 1
+                        depth = conn_depth[0]
+                    M_PLANE_PIPE_DEPTH.observe(depth)
+                    _PIPE_DEPTH_WINDOW.note(depth)
                     executor[0].submit(
                         run_pipelined, n, parsed, raw, prev, done
                     )

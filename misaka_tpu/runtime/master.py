@@ -192,6 +192,7 @@ _METRIC_ROUTES = frozenset({
     "/debug/requests", "/debug/perfetto", "/debug/isa_trace",
     "/debug/usage", "/debug/alerts", "/debug/flamegraph",
     "/debug/series", "/debug/dashboard", "/debug/faults",
+    "/debug/native_trace",
 })
 
 # The routes whose latency/error outcomes feed the per-program SLO windows
@@ -672,6 +673,14 @@ class ServeBatcher:
                 for tr in e.traces:
                     tracespan.add_span(tr, "serve.pass", t_pass, dur, attrs)
 
+        # the pass's request-trace IDs, visible to the native pool call
+        # that serves it (r18 flight recorder: the pool serve runs on the
+        # device-loop thread, where no request contextvar exists)
+        trace_token = master._trace_ids_enter(
+            dict.fromkeys(
+                tr.trace_id for e, _, _ in segs for tr in e.traces
+            )
+        )
         try:
             with master._epoch_lock:
                 epoch = master._epoch
@@ -730,6 +739,7 @@ class ServeBatcher:
             for e in failed:
                 e.event.set()
         finally:
+            master._trace_ids_exit(trace_token)
             with master._waiters_lock:
                 master._waiters -= 1
             for s in used:
@@ -1171,6 +1181,18 @@ class MasterNode:
         self._restore_flush = False
         self._flush_iters = 0
         self._flush_quiet = 0
+        # Active pass-trace registry (r18 native flight recorder): the
+        # request-trace IDs of every submit->collect window currently in
+        # flight on this master.  The serve scheduler and the direct
+        # compute lanes register their traced requests here; the native
+        # pool (whose serve call runs on the DEVICE-LOOP thread — the
+        # caller's contextvar never reaches it) reads the union per pool
+        # call and stamps it onto its flight-recorder correlation window,
+        # which is what lets /debug/perfetto hang worker-thread unit
+        # spans under the same trace ID as http.parse.
+        self._pass_traces_lock = threading.Lock()
+        self._pass_traces: dict[int, tuple] = {}
+        self._pass_trace_next = 0
         # The serve scheduler (cross-request micro-batching): concurrent
         # compute/compute_raw/compute_batch callers coalesce into fused
         # engine passes instead of each claiming an instance slot.
@@ -1293,6 +1315,14 @@ class MasterNode:
                 (m.program_label or usage.DEFAULT_LABEL)
                 if (m := mref()) is not None else usage.DEFAULT_LABEL
             )
+            if hasattr(runner, "active_trace_ids"):
+                # flight-recorder correlation (r18): the pool reads the
+                # trace IDs of this master's in-flight passes per serve
+                # call — same weakref discipline as usage_label
+                runner.active_trace_ids = lambda: (
+                    m.active_pass_trace_ids()
+                    if (m := mref()) is not None else ()
+                )
             return runner
         if self._mp > 1:
             # Lane-sharded serving: the statically-routed two-collective
@@ -1588,15 +1618,21 @@ class MasterNode:
             if self.program_label is not None:
                 pass_attrs["program"] = self.program_label
             t_pass = time.monotonic()
-            with tracespan.span("serve.pass", trace=tr, **pass_attrs):
-                with self._epoch_lock:
-                    epoch = self._epoch
-                    self._submit_q.put([(slot, arr)])
-                self._work_event.set()
-                deadline = time.monotonic() + timeout
-                parts = self._collect_slot(
-                    slot, arr.size, deadline, epoch, timeout
-                )
+            trace_token = self._trace_ids_enter(
+                (tr.trace_id,) if tr is not None else ()
+            )
+            try:
+                with tracespan.span("serve.pass", trace=tr, **pass_attrs):
+                    with self._epoch_lock:
+                        epoch = self._epoch
+                        self._submit_q.put([(slot, arr)])
+                    self._work_event.set()
+                    deadline = time.monotonic() + timeout
+                    parts = self._collect_slot(
+                        slot, arr.size, deadline, epoch, timeout
+                    )
+            finally:
+                self._trace_ids_exit(trace_token)
             # the direct lane's completed submit+collect window IS its
             # pass (one request, whole share) — same conservation-anchor
             # discipline as the scheduler's fused passes.  Success-only:
@@ -1758,6 +1794,10 @@ class MasterNode:
             pass_attrs = {"values": int(arr.size), "slots": len(owned)}
             if self.program_label is not None:
                 pass_attrs["program"] = self.program_label
+            _tr = tracespan.current()
+            trace_token = self._trace_ids_enter(
+                (_tr.trace_id,) if _tr is not None else ()
+            )
             with tracespan.span("serve.pass", **pass_attrs):
                 stripes = np.array_split(arr, len(owned))
                 with self._epoch_lock:
@@ -1793,6 +1833,7 @@ class MasterNode:
             usage.add_cpu(self.program_label, dur)
             return out if return_array else out.tolist()
         finally:
+            self._trace_ids_exit(trace_token)
             with self._waiters_lock:
                 self._waiters -= 1
             for s in owned:
@@ -2536,6 +2577,38 @@ class MasterNode:
             self._native_hot[:] = False
             self._native_hot[active] = ret[active] > prev[active]
         self._retired_prev = ret
+
+    def _trace_ids_enter(self, ids) -> int | None:
+        """Register a traced submit->collect window's request-trace IDs
+        (None when there is nothing to register); pair with
+        _trace_ids_exit in a finally."""
+        ids = tuple(ids)
+        if not ids:
+            return None
+        with self._pass_traces_lock:
+            token = self._pass_trace_next
+            self._pass_trace_next += 1
+            self._pass_traces[token] = ids
+        return token
+
+    def _trace_ids_exit(self, token: int | None) -> None:
+        if token is None:
+            return
+        with self._pass_traces_lock:
+            self._pass_traces.pop(token, None)
+
+    def active_pass_trace_ids(self) -> tuple:
+        """The union of trace IDs across in-flight passes (native pool
+        correlation read, once per pool call)."""
+        with self._pass_traces_lock:
+            if not self._pass_traces:
+                return ()
+            out: list = []
+            for ids in self._pass_traces.values():
+                for tid in ids:
+                    if tid not in out:
+                        out.append(tid)
+            return tuple(out)
 
     def _device_loop_inner(self) -> None:
         # One device counter read per iteration (post-run), reused for the
@@ -3410,6 +3483,25 @@ def make_http_server(
                     # Chrome trace-event JSON of the recorder contents —
                     # load in https://ui.perfetto.dev or chrome://tracing
                     self._json(tracespan.perfetto())
+                    return
+                if parsed.path == "/debug/native_trace":
+                    # the native flight recorder's raw per-thread rings
+                    # (core/native_serve.flight_payload): serve lifecycle,
+                    # dispenser phases, per-unit rung-tagged tick spans,
+                    # residency events — ?n=100 caps records per ring
+                    try:
+                        from misaka_tpu.core import native_serve
+                    except Exception:
+                        self._json({"enabled": False, "pools": []})
+                        return
+                    q = {
+                        k: v[0] for k, v in parse_qs(parsed.query).items()
+                    }
+                    try:
+                        max_records = int(q["n"]) if "n" in q else None
+                    except ValueError:
+                        max_records = None
+                    self._json(native_serve.flight_payload(max_records))
                     return
                 if parsed.path in ("/trace", "/debug/isa_trace"):
                     # the INSTRUCTION-history listing (core/trace.py),

@@ -42,6 +42,12 @@ PANELS = (
      "max", ""),
     ("Pipelined plane frames (/s)", "misaka_plane_pipelined_frames_total",
      "sum", "/s"),
+    ("Plane pipeline depth (max)", "misaka_plane_pipeline_depth_max",
+     "max", ""),
+    ("Dispenser wait p99", "misaka_native_dispenser_wait_seconds:p99",
+     "max", "s"),
+    ("Dispenser spin ratio", "misaka_native_dispenser_spin_ratio",
+     "max", ""),
     ("SIMD lane width", "misaka_native_simd_lane_width", "max", ""),
     ("Specialized engines", "misaka_native_specialized_active", "max", ""),
     ("Plane shm frames (/s)", "misaka_plane_shm_frames_total", "sum", "/s"),

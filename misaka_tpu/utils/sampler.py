@@ -392,9 +392,11 @@ meta.textContent = `rate ${DATA.rate_hz} Hz | samples ${DATA.samples} | ` +
 const np = DATA.native_pool;
 if (np) {
   const frac = np.busy_fraction;
+  const inline = np.caller_inline_ns || 0;
   document.getElementById('native').textContent =
-    `native C++ pool: ${np.threads} threads, busy ` +
-    `${(np.busy_ns/1e9).toFixed(2)}s vs idle ${(np.idle_ns/1e9).toFixed(2)}s ` +
+    `native C++ pool: ${np.threads} threads, worker busy ` +
+    `${(np.busy_ns/1e9).toFixed(2)}s + caller-inline ` +
+    `${(inline/1e9).toFixed(2)}s vs idle ${(np.idle_ns/1e9).toFixed(2)}s ` +
     `(${(frac*100).toFixed(1)}%% busy)`;
   const fill = document.createElement('div');
   fill.style.width = (frac*100).toFixed(2) + '%%';

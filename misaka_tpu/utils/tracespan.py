@@ -405,6 +405,28 @@ def tier_events() -> list[Span]:
     return list(_TIER_EVENTS)
 
 
+# Pluggable tier sources: subsystems with their OWN event storage (the
+# native flight recorder's in-C++ per-thread rings, core/native_serve)
+# contribute spans to the Perfetto export at read time instead of
+# double-buffering into _TIER_EVENTS.  A source returns a list of Span
+# objects; two attrs are interpreted by the exporter: ``_lane`` names a
+# per-source timeline lane (one Perfetto thread per distinct lane —
+# worker threads read as parallel tracks), and ``trace_ids`` lists the
+# request-trace IDs the span served — the span is then ALSO emitted on
+# each of those traces' own timelines, which is what makes one trace ID
+# read as a single story from http.parse down to the worker-thread unit
+# that ticked it.  Registered sources must never raise usefully: the
+# exporter swallows per-source failures (a debug surface answers).
+_TIER_SOURCES: list = []
+
+
+def register_tier_source(fn) -> None:
+    """Register a callable returning a list of Spans for the Perfetto
+    export (idempotent per callable)."""
+    if fn not in _TIER_SOURCES:
+        _TIER_SOURCES.append(fn)
+
+
 def clear() -> None:
     """Tests: wipe the recorder and tier events."""
     RECORDER.clear()
@@ -536,4 +558,51 @@ def perfetto() -> dict:
         if s.attrs:
             ev["args"] = dict(s.attrs)
         events.append(ev)
+    # pluggable tier sources (register_tier_source): per-lane timelines
+    # plus duplication onto the request traces each span served
+    lane_tids: dict[str, int] = {}
+    for fn in list(_TIER_SOURCES):
+        try:
+            spans = fn()
+        except Exception:
+            continue
+        for s in spans:
+            attrs = dict(s.attrs) if s.attrs else {}
+            lane = attrs.pop("_lane", None)
+            trace_ids = attrs.pop("trace_ids", None)
+            pid = TIER_PIDS[tier_of(s.name)]
+            tid = 0
+            if lane is not None:
+                tid = lane_tids.get(lane)
+                if tid is None:
+                    tid = 10001 + len(lane_tids)
+                    lane_tids[lane] = tid
+                    events.append({
+                        "ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": tid, "args": {"name": lane},
+                    })
+            ev = {
+                "ph": "X",
+                "name": s.name,
+                "pid": pid,
+                "tid": tid,
+                "ts": round(s.start * 1e6, 1),
+                "dur": round(s.dur * 1e6, 1),
+            }
+            if trace_ids:
+                attrs["trace_id"] = ",".join(trace_ids)
+            if attrs:
+                ev["args"] = attrs
+            events.append(ev)
+            # the same span on each served trace's own timeline: the
+            # unified per-trace story (only for traces the export knows)
+            for trace_id in trace_ids or ():
+                tr_tid = tids.get(trace_id)
+                if tr_tid is None:
+                    continue
+                ev2 = dict(ev)
+                ev2["tid"] = tr_tid
+                ev2["args"] = dict(attrs)
+                ev2["args"]["trace_id"] = trace_id
+                events.append(ev2)
     return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -19,6 +19,7 @@
 // and a zero-JAX host executor for tiny control-plane runs.  C ABI for
 // ctypes (misaka_tpu/core/cinterp.py).  Build: make native.
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <climits>
@@ -1453,6 +1454,51 @@ inline int64_t now_ns() {
       .count();
 }
 
+// --- native flight recorder (r18) ------------------------------------------
+//
+// Bounded lock-free per-thread event rings: every worker (plus the
+// calling thread, slot == threads) journals steady-clock-stamped
+// fixed-size records of what the serving hot path actually did —
+// serve-call lifecycle, dispenser wait phases (spin / yield / park),
+// per-unit tick execution tagged by engine rung, residency
+// import/export.  A writer owns its ring exclusively: it fills the
+// record with relaxed atomic stores (plain movs on x86 — no rmw, no
+// lock) and publishes with ONE release store of the ring cursor; readers
+// (misaka_pool_trace_read, called from Python scrape threads) acquire
+// the cursor, copy, and re-read it — any record the writer lapped during
+// the copy is discarded as torn, so a snapshot never stops the pool and
+// never observes a half-written record.  MISAKA_NATIVE_TRACE=0 skips
+// ring allocation entirely and every emit site reduces to one relaxed
+// flag load + branch (misaka_pool_trace_set can flip a BUILT recorder
+// at runtime for overhead A/Bs).  Memory bound: (threads + 1) rings x
+// MISAKA_NATIVE_TRACE_RING (default 2048) x 32 B = 64 KiB per thread.
+
+enum TraceEv {
+  TEV_SERVE = 1,    // one pool serve/idle call (caller ring); arg =
+                    // active replicas | flags<<32 (1 feeding, 2 resident,
+                    // 4 inline — never published to workers)
+  TEV_UNIT = 2,     // one dispensed unit executed; arg = replicas |
+                    // shape<<24 (0 group, 1 scalar/remainder, 2 masked) |
+                    // rung<<27 (0 scalar, 1 generic, 2 avx2, 4 = +spec) |
+                    // first replica/group index<<32
+  TEV_SPIN = 3,     // dispenser wait phases between jobs (worker rings):
+  TEV_YIELD = 4,    //   pause-spin, yield-spin, futex park — the ladder
+  TEV_PARK = 5,     //   split of one inter-job wait
+  TEV_IMPORT = 6,   // residency armed from batch-major arrays; arg = B |
+                    // (nonzero rc)<<32
+  TEV_EXPORT = 7,   // resident state materialized (lifecycle read)
+  TEV_DISCARD = 8,  // residency disarmed without export (state replaced)
+};
+
+constexpr int kTraceRecWords = 4;  // [t0_ns, dur_ns, kind, arg]
+
+// per-unit rung/shape tags (TEV_UNIT arg + the tr_reps aggregate index)
+enum { TSHAPE_GROUP = 0, TSHAPE_SCALAR = 1, TSHAPE_MASKED = 2 };
+enum { TRUNG_SCALAR = 0, TRUNG_GENERIC = 1, TRUNG_AVX2 = 2,
+       TRUNG_SPEC_BIT = 4 };
+constexpr int kTraceRungs = 8;   // rung in [0, 8): bit 2 = specialized
+constexpr int kTraceShapes = 4;  // shape in [0, 3], one spare
+
 struct Pool {
   using Job = ::Job;
 
@@ -1524,6 +1570,62 @@ struct Pool {
   std::vector<std::atomic<int64_t>> busy_ns, idle_ns;
   std::atomic<int64_t> serial_busy_ns{0};
 
+  // --- flight recorder (see the r18 block above) ---
+  bool trace_built = false;           // rings allocated at create
+  std::atomic<uint32_t> trace_armed{0};
+  int trace_cap = 0;                  // records per ring
+  std::vector<std::atomic<int64_t>> trace_buf;   // [(T+1) * cap * 4]
+  std::vector<std::atomic<uint64_t>> trace_cur;  // [T+1] ring cursors
+  // aggregate stats for the metrics plane (relaxed atomics, scrape-read):
+  std::atomic<int64_t> tr_spin_ns{0}, tr_yield_ns{0}, tr_park_ns{0};
+  std::atomic<int64_t> tr_wakes{0};
+  std::atomic<int64_t> tr_dispatch_calls{0}, tr_dispatch_wait_ns{0};
+  std::atomic<int64_t> tr_last_wait_ns{0}, tr_last_imbalance{0};
+  std::atomic<int64_t> tr_caller_units{0};
+  std::atomic<int64_t> tr_serve_calls{0}, tr_inline_calls{0};
+  std::atomic<int64_t> tr_reps[kTraceRungs * kTraceShapes]{};
+  // units each slot drained this published job (slot-exclusive plain
+  // writes; the caller reads them after the done_seq acquire, so the
+  // dispenser's own handshake is the fence)
+  std::vector<int32_t> units_call;
+
+  bool tracing() const {
+    return trace_armed.load(std::memory_order_relaxed) != 0;
+  }
+
+  void tr_emit(int slot, int64_t t0, int64_t dur, int64_t kind,
+               int64_t arg) {
+    std::atomic<uint64_t>& cur = trace_cur[slot];
+    const uint64_t c = cur.load(std::memory_order_relaxed);
+    std::atomic<int64_t>* r = &trace_buf[
+        ((size_t)slot * trace_cap + (size_t)(c % (uint64_t)trace_cap)) *
+        kTraceRecWords];
+    r[0].store(t0, std::memory_order_relaxed);
+    r[1].store(dur, std::memory_order_relaxed);
+    r[2].store(kind, std::memory_order_relaxed);
+    r[3].store(arg, std::memory_order_relaxed);
+    cur.store(c + 1, std::memory_order_release);
+  }
+
+  int group_rung() const {
+    int rung = simd_mode == SIMD_AVX2 ? TRUNG_AVX2 : TRUNG_GENERIC;
+    if (specialized) rung |= TRUNG_SPEC_BIT;
+    return rung;
+  }
+
+  // One serve-call lifecycle record + counters; rc passes through so the
+  // run_job exits stay one-line returns.  flags: 1 feeding, 2 resident,
+  // 4 inline (the call never published to workers).
+  int finish_serve(int rc, int64_t t_call, int n, int64_t flags) {
+    if (t_call != 0) {
+      tr_serve_calls.fetch_add(1, std::memory_order_relaxed);
+      if (flags & 4) tr_inline_calls.fetch_add(1, std::memory_order_relaxed);
+      tr_emit((int)workers.size(), t_call, now_ns() - t_call, TEV_SERVE,
+              (int64_t)(uint32_t)n | (flags << 32));
+    }
+    return rc;
+  }
+
   ~Pool() {
     stop.store(1, std::memory_order_seq_cst);
     job_seq.fetch_add(1, std::memory_order_seq_cst);  // pop spinners
@@ -1536,6 +1638,47 @@ struct Pool {
   }
 
   void serve_unit(const Unit& u, int slot) {
+    if (!tracing()) {
+      serve_unit_body(u, slot);
+      return;
+    }
+    const int64_t t0 = now_ns();
+    serve_unit_body(u, slot);
+    const int64_t dur = now_ns() - t0;
+    int rung = TRUNG_SCALAR, shape = TSHAPE_SCALAR;
+    int64_t reps = u.count;
+    switch (u.kind) {
+      case U_GROUP:
+      case U_RES_GROUP:
+        rung = group_rung();
+        shape = TSHAPE_GROUP;
+        reps = (int64_t)u.count * kGroupW;
+        break;
+      case U_RES_MASKED: {
+        rung = group_rung();
+        shape = TSHAPE_MASKED;
+        int cnt = 0;
+        for (int r = 0; r < kGroupW; ++r)
+          cnt += res_mask[(size_t)u.idx * kGroupW + r] != 0;
+        reps = cnt;
+        break;
+      }
+      default:
+        break;  // U_SCALAR / U_RES_SCALAR: scalar rung, remainder shape
+    }
+    tr_reps[rung * kTraceShapes + shape].fetch_add(
+        reps, std::memory_order_relaxed);
+    // per-job unit counts feed the imbalance read, which spans WORKER
+    // slots only — the caller slot is tracked on tr_caller_units (and a
+    // units_call entry the inline paths never reset would overflow)
+    if (slot < (int)workers.size()) units_call[slot] += 1;
+    else tr_caller_units.fetch_add(1, std::memory_order_relaxed);
+    tr_emit(slot, t0, dur, TEV_UNIT,
+            (reps & 0xffffff) | ((int64_t)shape << 24) |
+                ((int64_t)rung << 27) | ((int64_t)(uint32_t)u.idx << 32));
+  }
+
+  void serve_unit_body(const Unit& u, int slot) {
     switch (u.kind) {
       case U_SCALAR:
         for (int k = 0; k < u.count; ++k)
@@ -1607,8 +1750,26 @@ struct Pool {
       }
       seen = cur;
       if (stop.load(std::memory_order_relaxed) != 0) return;
-      idle_ns[tid].fetch_add(now_ns() - t_park, std::memory_order_relaxed);
       const int64_t t_work = now_ns();
+      const int64_t waited = t_work - t_park;
+      idle_ns[tid].fetch_add(waited, std::memory_order_relaxed);
+      if (tracing()) {
+        // split the wait along the ladder worker_main actually ran:
+        // pause-spin to 2us, yield-spin to spin_ns, futex park beyond —
+        // no extra clock reads (both endpoints already existed)
+        const int64_t spin_end = spin_ns < 2000 ? spin_ns : 2000;
+        const int64_t spin = waited < spin_end ? waited : spin_end;
+        const int64_t capped = waited < spin_ns ? waited : spin_ns;
+        const int64_t yield = capped > spin_end ? capped - spin_end : 0;
+        const int64_t park = waited > spin_ns ? waited - spin_ns : 0;
+        tr_spin_ns.fetch_add(spin, std::memory_order_relaxed);
+        tr_yield_ns.fetch_add(yield, std::memory_order_relaxed);
+        tr_park_ns.fetch_add(park, std::memory_order_relaxed);
+        tr_wakes.fetch_add(1, std::memory_order_relaxed);
+        tr_emit(tid, t_park, spin, TEV_SPIN, 0);
+        if (yield > 0) tr_emit(tid, t_park + spin_end, yield, TEV_YIELD, 0);
+        if (park > 0) tr_emit(tid, t_park + spin_ns, park, TEV_PARK, 0);
+      }
       run_units(tid);
       busy_ns[tid].fetch_add(now_ns() - t_work, std::memory_order_relaxed);
       if (active_workers.fetch_sub(1, std::memory_order_acq_rel) == 1) {
@@ -1623,6 +1784,8 @@ struct Pool {
   // on the done futex.
   void publish_job() {
     next.store(0, std::memory_order_relaxed);
+    if (tracing())  // per-slot unit counts for the imbalance read below
+      std::fill(units_call.begin(), units_call.end(), 0);
     active_workers.store((int)workers.size(), std::memory_order_relaxed);
     job_seq.fetch_add(1, std::memory_order_seq_cst);
     if (parked.load(std::memory_order_seq_cst) > 0)
@@ -1788,7 +1951,12 @@ struct Pool {
   // batch; small jobs degrade to count=1.
   int unit_chunk(int n_units) const {
     const int t = (int)workers.size();
-    if (t <= 1 || n_units <= t) return 1;
+    // 1-worker pools run every unit inline on the caller, so dispense
+    // granularity buys nothing — one maximal unit (fewer loop + flight-
+    // recorder emits per call; the r18 A/B measured per-unit emit cost
+    // on exactly this path)
+    if (t <= 1) return n_units < 1 ? 1 : n_units;
+    if (n_units <= t) return 1;
     const int c = n_units / (t * 4);
     return c < 1 ? 1 : c;
   }
@@ -1867,8 +2035,31 @@ struct Pool {
     }
   }
 
+  // Dispenser-wait accounting around wait_done (publish paths only): the
+  // caller has already helped drain the unit list, so this wait IS the
+  // straggler tail — the figure the r17 "no ~180us barrier" claim needs
+  // measured, not inferred.  The per-slot unit counts it reads were
+  // written before each worker's acq_rel countdown, so the done_seq
+  // acquire in wait_done orders them.
+  void wait_done_traced() {
+    const int64_t t_wait = now_ns();
+    wait_done();
+    const int64_t w = now_ns() - t_wait;
+    tr_dispatch_calls.fetch_add(1, std::memory_order_relaxed);
+    tr_dispatch_wait_ns.fetch_add(w, std::memory_order_relaxed);
+    tr_last_wait_ns.store(w, std::memory_order_relaxed);
+    int32_t mx = 0, mn = INT32_MAX;
+    for (size_t t = 0; t < workers.size(); ++t) {
+      mx = units_call[t] > mx ? units_call[t] : mx;
+      mn = units_call[t] < mn ? units_call[t] : mn;
+    }
+    tr_last_imbalance.store(mx - mn, std::memory_order_relaxed);
+  }
+
   int run_job() {
     const int n = job.active ? job.n_active : (int)replicas.size();
+    const int64_t t_call = tracing() ? now_ns() : 0;
+    const int64_t fflag = job.feeding ? 1 : 0;
     // Serial fast path: a small pass (the partial-fill serving case — a
     // few coalesced slots out of thousands) runs on the CALLING thread;
     // even the flat dispenser's wake round trip dwarfs the work itself
@@ -1884,7 +2075,7 @@ struct Pool {
         if (r != 0 && rc == 0) rc = r;  // lowest index first by iteration
       }
       serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
-      return rc;
+      return finish_serve(rc, t_call, n, fflag | 4);
     }
     build_units();
     rep_rc.assign(replicas.size(), 0);
@@ -1895,14 +2086,15 @@ struct Pool {
       const int64_t t_work = now_ns();
       for (const Unit& u : units) serve_unit(u, (int)workers.size());
       serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
-      return lowest_rc();
+      return finish_serve(lowest_rc(), t_call, n, fflag | 4);
     }
     publish_job();
     const int64_t t_help = now_ns();
     run_units((int)workers.size());
     serial_busy_ns.fetch_add(now_ns() - t_help, std::memory_order_relaxed);
-    wait_done();
-    return lowest_rc();
+    if (t_call != 0) wait_done_traced();
+    else wait_done();
+    return finish_serve(lowest_rc(), t_call, n, fflag);
   }
 
   // The resident twin of run_job: no import/export anywhere — the units
@@ -1910,6 +2102,8 @@ struct Pool {
   // (work it would otherwise spend spinning on the done futex).
   int run_resident_job() {
     const int n = job.active ? job.n_active : (int)replicas.size();
+    const int64_t t_call = tracing() ? now_ns() : 0;
+    const int64_t fflag = (job.feeding ? 1 : 0) | 2;  // resident
     build_units_resident();
     rep_rc.assign(replicas.size(), 0);
     const int caller = (int)workers.size();
@@ -1918,15 +2112,16 @@ struct Pool {
       for (const Unit& u : units) serve_unit(u, caller);
       for (int rep : res_skipped) pack_skipped(rep);
       serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
-      return lowest_rc();
+      return finish_serve(lowest_rc(), t_call, n, fflag | 4);
     }
     publish_job();
     const int64_t t_help = now_ns();
     for (int rep : res_skipped) pack_skipped(rep);
     run_units(caller);
     serial_busy_ns.fetch_add(now_ns() - t_help, std::memory_order_relaxed);
-    wait_done();
-    return lowest_rc();
+    if (t_call != 0) wait_done_traced();
+    else wait_done();
+    return finish_serve(lowest_rc(), t_call, n, fflag);
   }
 
   // Arm residency from the job's batch-major state arrays.  Per-group
@@ -2180,6 +2375,25 @@ void* misaka_pool_create(const int32_t* code, const int32_t* prog_len,
   p->resident_fn =
       p->group_fn != nullptr ? pick_resident_fn(p->simd_mode, p->specialized)
                              : nullptr;
+  // Flight recorder (r18): rings allocated BEFORE the workers exist so a
+  // worker never observes a half-built recorder.  MISAKA_NATIVE_TRACE=0
+  // skips the allocation entirely (trace_set then has nothing to arm).
+  p->units_call.assign(n_threads + 1, 0);
+  const char* te = std::getenv("MISAKA_NATIVE_TRACE");
+  if (te == nullptr ||
+      (std::strcmp(te, "0") != 0 && std::strcmp(te, "off") != 0)) {
+    int cap = 2048;
+    const char* tc = std::getenv("MISAKA_NATIVE_TRACE_RING");
+    if (tc != nullptr && *tc != '\0') cap = std::atoi(tc);
+    if (cap < 64) cap = 64;
+    if (cap > 65536) cap = 65536;
+    p->trace_cap = cap;
+    p->trace_buf = std::vector<std::atomic<int64_t>>(
+        (size_t)(n_threads + 1) * cap * kTraceRecWords);
+    p->trace_cur = std::vector<std::atomic<uint64_t>>(n_threads + 1);
+    p->trace_built = true;
+    p->trace_armed.store(1, std::memory_order_relaxed);
+  }
   p->workers.reserve(n_threads);
   for (int t = 0; t < n_threads; ++t)
     p->workers.emplace_back([p, t] { p->worker_main(t); });
@@ -2240,6 +2454,121 @@ int misaka_pool_thread_counters(void* h, int64_t* busy, int64_t* idle,
     idle[t] = p->idle_ns[t].load(std::memory_order_relaxed);
   }
   return n;
+}
+
+// --- flight-recorder read API (r18) ----------------------------------------
+
+// Recorder shape: out[0] = ring count (threads + 1; 0 = recorder not
+// built), out[1] = records per ring, out[2] = armed, out[3] = total
+// records dropped (overwritten before any reader saw them) across rings.
+void misaka_pool_trace_info(void* h, int64_t* out /*[4]*/) {
+  auto* p = (Pool*)h;
+  out[0] = p->trace_built ? (int64_t)p->workers.size() + 1 : 0;
+  out[1] = p->trace_cap;
+  out[2] = p->tracing() ? 1 : 0;
+  int64_t dropped = 0;
+  for (auto& c : p->trace_cur) {
+    const uint64_t cur = c.load(std::memory_order_relaxed);
+    if (cur > (uint64_t)p->trace_cap) dropped += cur - p->trace_cap;
+  }
+  out[3] = dropped;
+}
+
+// Snapshot one ring WITHOUT stopping the pool: acquire the cursor, copy
+// up to max_recs most-recent records (rows of [t0_ns, dur_ns, kind,
+// arg], oldest first), then re-read the cursor and drop any prefix the
+// writer lapped during the copy (those rows may be torn).  meta[0] =
+// cursor after the copy, meta[1] = cumulative dropped-by-overwrite for
+// this ring.  Returns the row count, or -1 on a bad ring index / absent
+// recorder.  Ring `threads` is the calling thread's (serve lifecycle,
+// caller-inline units, residency events).
+int misaka_pool_trace_read(void* h, int ring, int64_t* out, int max_recs,
+                           int64_t* meta /*[2]*/) {
+  auto* p = (Pool*)h;
+  if (!p->trace_built || ring < 0 || ring > (int)p->workers.size() ||
+      max_recs < 0)
+    return -1;
+  const uint64_t cap = (uint64_t)p->trace_cap;
+  std::atomic<uint64_t>& cur = p->trace_cur[ring];
+  const uint64_t c1 = cur.load(std::memory_order_acquire);
+  uint64_t lo = c1 > cap ? c1 - cap : 0;
+  if (c1 - lo > (uint64_t)max_recs) lo = c1 - (uint64_t)max_recs;
+  int n = 0;
+  for (uint64_t i = lo; i < c1; ++i, ++n) {
+    const std::atomic<int64_t>* r =
+        &p->trace_buf[((size_t)ring * p->trace_cap + (size_t)(i % cap)) *
+                      kTraceRecWords];
+    for (int w = 0; w < kTraceRecWords; ++w)
+      out[(size_t)n * kTraceRecWords + w] =
+          r[w].load(std::memory_order_relaxed);
+  }
+  const uint64_t c2 = cur.load(std::memory_order_acquire);
+  if (c2 >= cap) {
+    // Rows at or below c2 - cap may be torn: every published write up
+    // to c2 aliases slots of rows < c2 - cap, AND the writer may be
+    // mid-write on record c2 itself (cursor not yet bumped), whose slot
+    // is row c2 - cap's — so the oldest fully-safe row is c2 - cap + 1.
+    const uint64_t valid_lo = c2 - cap + 1;
+    if (valid_lo > lo) {
+      uint64_t torn = valid_lo - lo;
+      if (torn > (uint64_t)n) torn = (uint64_t)n;
+      if (torn > 0) {
+        std::memmove(out, out + torn * kTraceRecWords,
+                     ((size_t)n - torn) * kTraceRecWords * sizeof(int64_t));
+        n -= (int)torn;
+      }
+    }
+  }
+  meta[0] = (int64_t)c2;
+  meta[1] = (int64_t)(c2 > cap ? c2 - cap : 0);
+  return n;
+}
+
+// Cumulative recorder aggregates (relaxed reads, scrape-safe):
+//   out[0..2]  dispenser wait ns by phase (spin / yield / park)
+//   out[3]     worker wakes (jobs received)
+//   out[4..6]  published serve calls / total caller dispatch-wait ns /
+//              last call's dispatch-wait ns
+//   out[7]     last published call's unit imbalance (max - min units
+//              one worker drained)
+//   out[8]     units drained on the CALLING thread (inline + help)
+//   out[9..10] pool serve/idle calls / inline (never-published) calls
+//   out[11]    records dropped by ring overwrite (all rings)
+//   out[12..]  replicas ticked by [rung][shape] (kTraceRungs x
+//              kTraceShapes; rung bit 2 = specialized)
+void misaka_pool_trace_stats(void* h, int64_t* out /*[44]*/) {
+  auto* p = (Pool*)h;
+  const auto rel = std::memory_order_relaxed;
+  out[0] = p->tr_spin_ns.load(rel);
+  out[1] = p->tr_yield_ns.load(rel);
+  out[2] = p->tr_park_ns.load(rel);
+  out[3] = p->tr_wakes.load(rel);
+  out[4] = p->tr_dispatch_calls.load(rel);
+  out[5] = p->tr_dispatch_wait_ns.load(rel);
+  out[6] = p->tr_last_wait_ns.load(rel);
+  out[7] = p->tr_last_imbalance.load(rel);
+  out[8] = p->tr_caller_units.load(rel);
+  out[9] = p->tr_serve_calls.load(rel);
+  out[10] = p->tr_inline_calls.load(rel);
+  int64_t dropped = 0;
+  for (auto& c : p->trace_cur) {
+    const uint64_t cur = c.load(rel);
+    if (cur > (uint64_t)p->trace_cap) dropped += cur - p->trace_cap;
+  }
+  out[11] = dropped;
+  for (int i = 0; i < kTraceRungs * kTraceShapes; ++i)
+    out[12 + i] = p->tr_reps[i].load(rel);
+}
+
+// Arm/disarm a BUILT recorder at runtime (the overhead A/B's toggle —
+// emit sites reduce to one relaxed flag load + branch when off).
+// Returns the new state, or -1 when MISAKA_NATIVE_TRACE=0 skipped the
+// ring allocation at create.
+int misaka_pool_trace_set(void* h, int on) {
+  auto* p = (Pool*)h;
+  if (!p->trace_built) return -1;
+  p->trace_armed.store(on ? 1 : 0, std::memory_order_relaxed);
+  return on ? 1 : 0;
 }
 
 // One batched serve (feed_counts non-null) or idle (both feed pointers null)
@@ -2336,7 +2665,13 @@ int misaka_pool_import(void* h, const int32_t* acc, const int32_t* bak,
   j.retired = (int32_t*)retired;
   j.acc_hi = (int32_t*)acc_hi;
   j.bak_hi = (int32_t*)bak_hi;
-  return p->import_state();
+  const int64_t t0 = p->tracing() ? now_ns() : 0;
+  const int rc = p->import_state();
+  if (t0 != 0)
+    p->tr_emit((int)p->workers.size(), t0, now_ns() - t0, TEV_IMPORT,
+               (int64_t)(uint32_t)p->replicas.size() |
+                   ((int64_t)(rc != 0) << 32));
+  return rc;
 }
 
 int misaka_pool_export(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
@@ -2363,10 +2698,24 @@ int misaka_pool_export(void* h, int32_t* acc, int32_t* bak, int32_t* pc,
   j.retired = retired;
   j.acc_hi = acc_hi;
   j.bak_hi = bak_hi;
-  return p->export_state();
+  const int64_t t0 = p->tracing() ? now_ns() : 0;
+  const int rc = p->export_state();
+  if (t0 != 0)
+    p->tr_emit((int)p->workers.size(), t0, now_ns() - t0, TEV_EXPORT,
+               (int64_t)(uint32_t)p->replicas.size() |
+                   ((int64_t)(rc != 0) << 32));
+  return rc;
 }
 
-void misaka_pool_discard(void* h) { ((Pool*)h)->resident = false; }
+void misaka_pool_discard(void* h) {
+  auto* p = (Pool*)h;
+  if (p->tracing() && p->resident) {
+    const int64_t t0 = now_ns();
+    p->tr_emit((int)p->workers.size(), t0, 0, TEV_DISCARD,
+               (int64_t)(uint32_t)p->replicas.size());
+  }
+  p->resident = false;
+}
 
 int misaka_pool_is_resident(void* h) {
   return ((Pool*)h)->resident ? 1 : 0;

@@ -1,0 +1,312 @@
+"""Native flight recorder (ISSUE 15): in-C++ tick/dispenser event tracing
+unified with the request-trace plane.
+
+Pins: (1) the recorder is a pure OBSERVER — serving output is
+bit-identical with it armed vs disarmed over the r16 differential corpus
+(every engine rung, resident and stateless); (2) the per-thread rings are
+BOUNDED — a snapshot never exceeds capacity and oldest-dropped records
+count on misaka_native_trace_dropped_total; (3) one inbound
+X-Misaka-Trace ID yields native worker spans in GET /debug/perfetto on a
+live server (the >= 5-tier frontend-included drill is `make
+native-trace-smoke`); (4) the derived dispenser/rung metrics and the
+caller-inline lane surface.  docs/OBSERVABILITY.md "Native flight
+recorder".
+"""
+
+import contextlib
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.core import native_serve
+from misaka_tpu.utils import metrics, tracespan
+from tests.test_simd import (
+    SMALL, assert_state_equal, run_schedule, topologies,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_serve.available(),
+    reason="native interpreter unavailable (no g++)",
+)
+
+
+@contextlib.contextmanager
+def env(**kv):
+    prev = {k: os.environ.get(k) for k in kv}
+    for k, v in kv.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    # module-level arm flag follows the env like a fresh process would
+    native_serve._TRACE_ON = native_serve.trace_enabled()
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        native_serve._TRACE_ON = native_serve.trace_enabled()
+
+
+# --- 1. the recorder observes, never perturbs --------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(topologies()))
+def test_recorder_on_off_bit_identity(name):
+    """Full-state bit-identity (tick counts included) with the recorder
+    armed vs MISAKA_NATIVE_TRACE=0 over the mixed serve/idle schedule —
+    B=19 runs group units AND a scalar remainder."""
+    net = topologies()[name].compile(batch=19)
+    d_on, rows_on = run_schedule(net, None)
+    with env(MISAKA_NATIVE_TRACE="0"):
+        d_off, rows_off = run_schedule(net, None)
+    assert_state_equal(d_on, d_off, f"{name}: recorder on vs off")
+    for i, (ra, rb) in enumerate(zip(rows_on, rows_off)):
+        np.testing.assert_array_equal(ra, rb, err_msg=f"{name} row {i}")
+
+
+def test_recorder_on_off_bit_identity_ladder_and_stateless():
+    """The same pin down the ladder (generic, scalar) and with residency
+    disabled — the recorder must be invisible on every rung."""
+    net = topologies()["diverge"].compile(batch=19)
+    for mode in ("generic", "0"):
+        d_on, rows_on = run_schedule(net, mode)
+        with env(MISAKA_NATIVE_TRACE="0"):
+            d_off, rows_off = run_schedule(net, mode)
+        assert_state_equal(d_on, d_off, f"mode {mode}: recorder on vs off")
+        for i, (ra, rb) in enumerate(zip(rows_on, rows_off)):
+            np.testing.assert_array_equal(
+                ra, rb, err_msg=f"mode {mode} row {i}"
+            )
+    with env(MISAKA_NATIVE_RESIDENT="0"):
+        d_on, rows_on = run_schedule(net, None)
+        with env(MISAKA_NATIVE_TRACE="0", MISAKA_NATIVE_RESIDENT="0"):
+            d_off, rows_off = run_schedule(net, None)
+    assert_state_equal(d_on, d_off, "stateless: recorder on vs off")
+    for i, (ra, rb) in enumerate(zip(rows_on, rows_off)):
+        np.testing.assert_array_equal(ra, rb, err_msg=f"stateless row {i}")
+
+
+def test_recorder_on_off_bit_identity_specialized(tmp_path):
+    """And through a per-program specialized build (switch-threaded
+    ticks): recorder on vs off, both specialized."""
+    from misaka_tpu.core import specialize
+
+    net = topologies()["add2"].compile(batch=16)
+    so = specialize.build(net, cache_dir=str(tmp_path))
+    if so is None:
+        pytest.skip("specialized build unavailable")
+    d_on, rows_on = run_schedule(net, None, spec=so)
+    with env(MISAKA_NATIVE_TRACE="0"):
+        d_off, rows_off = run_schedule(net, None, spec=so)
+    assert_state_equal(d_on, d_off, "specialized: recorder on vs off")
+    for i, (ra, rb) in enumerate(zip(rows_on, rows_off)):
+        np.testing.assert_array_equal(ra, rb, err_msg=f"spec row {i}")
+
+
+# --- 2. ring bounds + the dropped counter ------------------------------------
+
+
+def _pool(batch=16, threads=2, **envkv):
+    net = networks.add2(**SMALL).compile(batch=batch)
+    with env(**envkv):
+        return native_serve.NativeServePool(net, chunk_steps=32,
+                                            threads=threads), net
+
+
+def _serve_rounds(pool, net, rounds, batch=16):
+    state = net.init_state()
+    vals = np.zeros((batch, net.in_cap), np.int32)
+    vals[:, 0] = 7
+    counts = np.ones((batch,), np.int32)
+    for _ in range(rounds):
+        state, _ = pool.serve(state, vals, counts)
+    return state
+
+
+def test_ring_bound_enforced_and_dropped_counted():
+    """A ring snapshot NEVER exceeds MISAKA_NATIVE_TRACE_RING, the
+    cursor keeps counting, and overwritten-oldest records land on
+    misaka_native_trace_dropped_total (delta-checked through the real
+    exposition)."""
+    before = metrics.parse_text(metrics.render()).get(
+        "misaka_native_trace_dropped_total", 0.0
+    )
+    pool, net = _pool(MISAKA_NATIVE_TRACE_RING="64")
+    try:
+        info = pool._pool.trace_info()
+        assert info["rings"] == pool.threads + 1
+        assert info["capacity"] == 64
+        _serve_rounds(pool, net, 200)
+        total_records = 0
+        for ring in range(info["rings"]):
+            recs, cursor, dropped = pool._pool.trace_read(ring)
+            assert len(recs) <= 64, (ring, len(recs))
+            assert cursor >= len(recs)
+            assert dropped == max(0, cursor - 64)
+            total_records += len(recs)
+        assert total_records > 0
+        assert pool._pool.trace_info()["dropped"] > 0  # 200 calls >> 64
+        pool._pull_trace_stats(force=True)  # watermark init
+        _serve_rounds(pool, net, 50)
+        pool._pull_trace_stats(force=True)
+        after = metrics.parse_text(metrics.render()).get(
+            "misaka_native_trace_dropped_total", 0.0
+        )
+        assert after > before
+    finally:
+        pool.close()
+
+
+def test_trace_set_runtime_toggle():
+    """set_trace(False) stops emission on a built recorder (cursors
+    freeze); re-arming resumes.  MISAKA_NATIVE_TRACE=0 at creation means
+    there is nothing to arm."""
+    pool, net = _pool()
+    try:
+        _serve_rounds(pool, net, 3)
+        assert native_serve.set_trace(False)
+        cursors = [pool._pool.trace_read(r)[1]
+                   for r in range(pool.threads + 1)]
+        _serve_rounds(pool, net, 5)
+        assert cursors == [pool._pool.trace_read(r)[1]
+                           for r in range(pool.threads + 1)]
+        assert native_serve.set_trace(True)
+        _serve_rounds(pool, net, 3)
+        assert sum(pool._pool.trace_read(r)[1]
+                   for r in range(pool.threads + 1)) > sum(cursors)
+    finally:
+        native_serve.set_trace(native_serve.trace_enabled())
+        pool.close()
+    pool2, net2 = _pool(MISAKA_NATIVE_TRACE="0")
+    try:
+        assert pool2._pool.trace_info()["rings"] == 0
+        assert not pool2._pool.trace_set(True)
+        _serve_rounds(pool2, net2, 2)  # emit-free serving still works
+    finally:
+        pool2.close()
+
+
+# --- 3. surfaces: stats, payloads, the caller-inline lane --------------------
+
+
+def test_stats_payload_and_caller_inline_lane():
+    """trace_stats moves (serve calls, rung-tagged replicas, caller
+    units on this 1-caller box), flight_payload decodes events, the
+    dispenser metrics land in the exposition, and pool_counters carries
+    the FIRST-CLASS caller-inline lane (work_ns = busy + caller-inline)."""
+    pool, net = _pool(threads=2)
+    try:
+        _serve_rounds(pool, net, 20)
+        s = pool._pool.trace_stats()
+        assert s["serve_calls"] >= 20
+        assert s["reps"], s  # rung-tagged unit aggregates moved
+        assert all(r in ("scalar", "generic", "avx2", "spec-generic",
+                         "spec-avx2") for r, _ in s["reps"])
+        payload = native_serve.flight_payload()
+        assert payload["enabled"] and payload["pools"]
+        kinds = {
+            ev["kind"]
+            for p in payload["pools"]
+            for ring in p["rings"]
+            for ev in ring["events"]
+        }
+        assert "serve" in kinds and "unit" in kinds, kinds
+        pool._pull_trace_stats(force=True)
+        _serve_rounds(pool, net, 10)
+        pool._pull_trace_stats(force=True)
+        parsed = metrics.parse_text(metrics.render())
+        assert any(k.startswith("misaka_native_units_replicas_total")
+                   for k in parsed), "per-rung unit counter missing"
+        assert any(
+            k.startswith("misaka_native_dispenser_seconds_total")
+            or k.startswith("misaka_native_caller_inline_units_total")
+            for k in parsed
+        ), "dispenser/caller-inline series missing"
+        pc = native_serve.pool_counters()
+        assert pc is not None
+        assert pc["caller_inline_ns"] == pc["serial_ns"]
+        assert pc["work_ns"] == pc["busy_ns"] + pc["caller_inline_ns"]
+        assert pc["work_ns"] > 0
+    finally:
+        pool.close()
+
+
+# --- 4. the unified timeline on a live server --------------------------------
+
+
+def test_live_server_perfetto_has_native_spans_under_trace_id():
+    """An inbound X-Misaka-Trace ID on a live server yields native
+    flight-recorder spans under that ID in GET /debug/perfetto alongside
+    the http/serve tiers, and /debug/native_trace attaches the same ID
+    to its raw events."""
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    tracespan.clear()
+    master = MasterNode(
+        networks.add2(in_cap=64, out_cap=64, stack_cap=16),
+        chunk_steps=64, batch=16, engine="native",
+    )
+    httpd = make_http_server(master, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    tid = "f11687aaf11687aa"
+    try:
+        master.run()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        for _ in range(6):
+            vals = np.arange(32, dtype=np.int32)
+            conn.request(
+                "POST", "/compute_raw?spread=1",
+                vals.astype("<i4").tobytes(), {"X-Misaka-Trace": tid},
+            )
+            r = conn.getresponse()
+            body = r.read()
+            assert r.status == 200, body
+            assert (np.frombuffer(body, "<i4") == vals + 2).all()
+
+        def fetch(path):
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return json.loads(r.read())
+
+        tiers, native_spans = set(), 0
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            doc = fetch("/debug/perfetto")
+            tiers, native_spans = set(), 0
+            for ev in doc["traceEvents"]:
+                if ev.get("ph") != "X":
+                    continue
+                if ev.get("args", {}).get("trace_id") == tid:
+                    tiers.add(tracespan.tier_of(ev["name"]))
+                    if ev["name"].startswith("native."):
+                        native_spans += 1
+            if native_spans and len(tiers) >= 3:
+                break
+            time.sleep(0.2)
+        assert native_spans > 0, "no native worker spans under the ID"
+        assert {"http", "serve", "native"} <= tiers, tiers
+        nt = fetch("/debug/native_trace")
+        dump_ids = {
+            i
+            for p in nt["pools"]
+            for ring in p["rings"]
+            for ev in ring["events"]
+            for i in ev.get("trace_ids", ())
+        }
+        assert tid in dump_ids, sorted(dump_ids)[:5]
+        conn.close()
+    finally:
+        master.pause()
+        httpd.shutdown()
+        tracespan.clear()

@@ -165,8 +165,9 @@ def test_multi_tenant_native_conservation(tenants):
         for m in engines:
             pool = getattr(m._runner, "_pool", None)
             if pool is not None:
-                c = pool.counters()
-                total += c["busy_ns"] + c["serial_ns"]
+                # work_ns is first-class (r18): worker busy + the
+                # caller-inline lane in one field
+                total += pool.counters()["work_ns"]
         return total
 
     before = _get_json(port, "/debug/usage")
@@ -215,9 +216,10 @@ def test_pool_counters_aggregate_across_engines(tenants):
     payload = _get_json(port, "/debug/usage")
     np_block = payload.get("native_pool")
     assert np_block is not None
-    # serial fast-path time counts as busy: a partial-fill-regime box
-    # must not read ~0% busy while saturated
-    assert np_block["busy_ns"] + np_block["serial_ns"] > 0
+    # the caller-inline lane counts as work, first-class (r18): a
+    # partial-fill-regime box must not read ~0% busy while saturated
+    assert np_block["work_ns"] > 0
+    assert np_block["caller_inline_ns"] == np_block["serial_ns"]
     pools = np_block.get("pools")
     assert pools is not None and len(pools) >= 2, np_block.keys()
     labels = {p["program"] for p in pools}

@@ -1,0 +1,182 @@
+"""`make native-trace-smoke`: boot a server WITH frontend workers, fire
+traced traffic, and assert GET /debug/perfetto renders ONE unified
+timeline per inbound X-Misaka-Trace ID spanning >= 5 tiers — http,
+frontend, plane, serve, AND native worker-thread spans from the in-C++
+flight recorder (~10s, CPU-forced).
+
+This is the out-of-pytest tripwire for the r18 native flight recorder's
+whole correlation chain: client header -> frontend worker -> plane frame
+metadata -> ServeBatcher pass-trace registry -> NativeServePool call
+window -> C++ per-thread event rings -> Perfetto export.  It also
+asserts the raw dump (GET /debug/native_trace) carries rung-tagged unit
+events with the same trace IDs attached.  The same assertions run inside
+tier-1 (tests/test_native_trace.py); this target drives the real
+subprocess worker boot path.
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime import frontends
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    # batch >= 8 so the pool runs real SIMD group units (rung-tagged)
+    master = MasterNode(
+        networks.add2(), chunk_steps=64, batch=16, engine="native"
+    )
+    engine_httpd = make_http_server(master, port=0)
+    threading.Thread(target=engine_httpd.serve_forever, daemon=True).start()
+    engine_port = engine_httpd.server_address[1]
+    plane_path = f"/tmp/misaka-ntrace-smoke-{os.getpid()}.sock"
+    plane = frontends.start_compute_plane(master, plane_path)
+    public_port = frontends.pick_free_port()
+    workers = frontends.spawn_frontends(
+        2, public_port, f"http://127.0.0.1:{engine_port}", plane_path
+    )
+    try:
+        if not frontends.wait_ready(public_port):
+            raise AssertionError("frontend workers did not come up")
+        master.run()
+
+        ids = [f"7718aa{i:02d}7718aa{i:02d}" for i in range(8)]
+        errors = []
+
+        def client(tid, seed):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", public_port, timeout=30
+                )
+                rng = np.random.default_rng(seed)
+                for _ in range(6):
+                    vals = rng.integers(-99, 99, size=64).astype(np.int32)
+                    conn.request(
+                        "POST", "/compute_raw?spread=1",
+                        vals.astype("<i4").tobytes(),
+                        {"X-Misaka-Trace": tid},
+                    )
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    assert resp.status == 200, (resp.status, body)
+                    out = np.frombuffer(body, dtype="<i4")
+                    assert (out == vals + 2).all()
+                conn.close()
+            except Exception as e:  # pragma: no cover — surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(tid, i))
+            for i, tid in enumerate(ids)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+        def fetch(path):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", engine_port, timeout=15
+            )
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            assert resp.status == 200, (path, resp.status)
+            return json.loads(body)
+
+        from misaka_tpu.utils import tracespan
+
+        # the engine's recorder needs a beat: plane traces complete after
+        # the response bytes are already on their way back
+        tiers_by_id, native_by_id = {}, {}
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            doc = fetch("/debug/perfetto")
+            events = doc["traceEvents"]
+            assert isinstance(events, list) and events
+            tiers_by_id, native_by_id = {}, {}
+            for ev in events:
+                if ev.get("ph") != "X":
+                    continue
+                tid = ev.get("args", {}).get("trace_id")
+                if tid in ids:
+                    tiers_by_id.setdefault(tid, set()).add(
+                        tracespan.tier_of(ev["name"])
+                    )
+                    if ev["name"].startswith("native."):
+                        native_by_id.setdefault(tid, set()).add(ev["name"])
+            good = [
+                t for t, tiers in tiers_by_id.items()
+                if len(tiers) >= 5 and "native" in tiers
+            ]
+            if good:
+                break
+            time.sleep(0.2)
+
+        best_id, best = max(
+            tiers_by_id.items(), key=lambda kv: len(kv[1]),
+            default=(None, set()),
+        )
+        assert len(best) >= 5 and "native" in best, (
+            f"expected ONE unified timeline spanning >= 5 tiers incl. "
+            f"native under one trace ID, best was {best_id}: {sorted(best)}"
+        )
+        native_spans = native_by_id.get(best_id, set())
+        assert native_spans, f"no native spans under {best_id}"
+
+        # the raw dump: rung-tagged unit events carrying trace IDs
+        nt = fetch("/debug/native_trace")
+        assert nt["enabled"] and nt["pools"], nt.get("pools")
+        rungs, dump_ids = set(), set()
+        for pool in nt["pools"]:
+            assert pool["capacity"] > 0
+            for ring in pool["rings"]:
+                assert len(ring["events"]) <= pool["capacity"]
+                for ev in ring["events"]:
+                    if ev["kind"] == "unit":
+                        rungs.add(ev["rung"])
+                    dump_ids.update(ev.get("trace_ids", ()))
+        assert rungs, "no rung-tagged unit events in /debug/native_trace"
+        assert dump_ids & set(ids), (
+            f"no inbound trace IDs on native events: {sorted(dump_ids)[:5]}"
+        )
+
+        print(json.dumps({
+            "native_trace_smoke": "ok",
+            "trace_id": best_id,
+            "tiers": sorted(best),
+            "native_spans": sorted(native_spans),
+            "unit_rungs": sorted(rungs),
+            "events_total": len(events),
+        }))
+        return 0
+    except AssertionError as e:
+        print(f"# native-trace-smoke FAILED: {e}", file=sys.stderr)
+        return 1
+    finally:
+        for p in workers:
+            p.terminate()
+        master.pause()
+        plane.close()
+        engine_httpd.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

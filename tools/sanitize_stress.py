@@ -214,7 +214,8 @@ def run_scenario(args) -> int:
     serve_gate.set()
     errors: list[BaseException] = []
     stats = {"passes": 0, "values": 0, "reads": 0, "closed_reads": 0,
-             "cycles": 0, "resident_passes": 0}
+             "cycles": 0, "resident_passes": 0, "trace_reads": 0,
+             "trace_records": 0}
 
     # Pool variants rotated across close/recreate cycles so every ladder
     # rung runs the concurrent serve/close/counter-read race under the
@@ -334,20 +335,41 @@ def run_scenario(args) -> int:
             serve_idle.set()
 
     def reader_loop():
-        # Scrape-thread twin: hammers the counter read CONCURRENTLY with
-        # serve and with close/recreate.  "pool is closed" is the typed,
-        # expected outcome of losing the race; a UAF is what ASan/TSan
-        # are here to veto.
+        # Scrape-thread twin: hammers the counter read AND the r18
+        # flight-recorder read API (ring snapshots, aggregate stats)
+        # CONCURRENTLY with serve and with close/recreate — TSan over the
+        # lock-free ring handshake (relaxed record stores + release
+        # cursor / acquire reader) is the point of this lane, and the
+        # torn-row discipline must hold while workers lap the reader.
+        # "pool is closed" is the typed, expected outcome of losing the
+        # close race; a UAF is what ASan/TSan are here to veto.
         try:
+            ring = 0
             while not stop.is_set():
                 pool = box["pool"]
                 try:
                     c = pool.counters()
                     assert c["busy_ns"] >= 0 and c["idle_ns"] >= 0
                     pool.thread_counters()
+                    info = pool.trace_info()
+                    if info["rings"]:
+                        recs, cursor, dropped = pool.trace_read(
+                            ring % info["rings"]
+                        )
+                        # bounded rings: a snapshot never exceeds capacity
+                        assert len(recs) <= info["capacity"], \
+                            (len(recs), info["capacity"])
+                        assert cursor >= len(recs) and dropped >= 0
+                        s = pool.trace_stats()
+                        assert s["serve_calls"] >= 0 and s["dropped"] >= 0
+                        stats["trace_reads"] += 1
+                        stats["trace_records"] += len(recs)
+                    ring += 1
                     stats["reads"] += 1
                 except RuntimeError:
                     stats["closed_reads"] += 1
+                except ValueError:
+                    stats["closed_reads"] += 1  # ring raced a recreate
         except BaseException as e:  # noqa: BLE001
             errors.append(e)
             stop.set()
@@ -381,7 +403,7 @@ def run_scenario(args) -> int:
         print(f"sanitize: scenario error: {errors[0]!r}", file=sys.stderr)
         return 1
     if not (stats["passes"] and stats["reads"] and stats["cycles"]
-            and stats["resident_passes"]):
+            and stats["resident_passes"] and stats["trace_reads"]):
         print(f"sanitize: scenario did not exercise the race: {stats}",
               file=sys.stderr)
         return 1
@@ -390,6 +412,8 @@ def run_scenario(args) -> int:
           f"({stats['resident_passes']} resident), "
           f"{stats['reads']} counter reads "
           f"({stats['closed_reads']} typed closed-pool losses), "
+          f"{stats['trace_reads']} ring snapshots / "
+          f"{stats['trace_records']} records, "
           f"{stats['cycles']} close/recreate cycles "
           f"({stats['spec_pools']} specialized pools)", file=sys.stderr)
     return 0

@@ -178,13 +178,15 @@ def main() -> int:
         if flame.get("samples", 0) <= 0 or not flame.get("stacks"):
             fail(f"no CPython samples in the flamegraph: "
                  f"samples={flame.get('samples')}")
-        # busy INCLUDES the serial/caller-thread path (the PR 7 busy-
-        # fraction semantics): on a 1-worker pool the r17 dispenser runs
+        # work_ns is the FIRST-CLASS total (r18): worker busy + the
+        # caller-inline lane — on a 1-worker pool the r17 dispenser runs
         # every unit inline on the caller, so worker busy_ns alone is
-        # legitimately 0 while serial_ns carries the whole load
+        # legitimately 0 while caller_inline_ns carries the whole load
         np_flame = flame.get("native_pool") or {}
-        if np_flame.get("busy_ns", 0) + np_flame.get("serial_ns", 0) <= 0:
+        if np_flame.get("work_ns", 0) <= 0:
             fail("flamegraph lacks the measured native busy/idle split")
+        if "caller_inline_ns" not in np_flame:
+            fail("native split lacks the caller-inline lane")
         if not any(";" in k for k in flame["stacks"]):
             fail("flamegraph folded stacks carry no frame chains")
         st, body = get(base, "/debug/flamegraph?html=1")
@@ -222,7 +224,9 @@ def main() -> int:
             "cpu_seconds_sum": round(cpu_sum, 4),
             "pass_seconds_total": round(pass_total, 4),
             "conservation": round(cpu_sum / pass_total, 4),
-            "native_busy_ns": flame["native_pool"]["busy_ns"],
+            "native_work_ns": flame["native_pool"]["work_ns"],
+            "native_caller_inline_ns":
+                flame["native_pool"]["caller_inline_ns"],
             "flamegraph_samples": flame["samples"],
             "slo_state": alerts["state"],
         }))
