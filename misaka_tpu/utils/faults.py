@@ -107,6 +107,7 @@ POINTS = frozenset({
     "overload",
     "quota_exhaust",
     "specialize_fail",
+    "edge_native_build",
     "resident_fallback",
 })
 

@@ -22,6 +22,11 @@ the payload stays the zero-copy np.frombuffer shape on both sides.
 
 Stdlib-only: the jax-free frontend workers and the pure-stdlib client both
 import this.
+
+LOCKSTEP: native/msk_frame.hpp reimplements this codec (header layout,
+magic/version, and the four WireError sentences, byte for byte) for the
+C++ edge tier — tests/test_native_edge.py's parity corpus pins the two
+together; change either side only with its twin.
 """
 
 from __future__ import annotations

@@ -234,8 +234,25 @@ def test_metrics_exposition_valid_and_counters_move(server):
     status, body = post(base, "/compute_raw?spread=1", raw=vals.tobytes())
     assert status == 200
     assert (np.frombuffer(body, "<i4") == vals + 2).all()
-    after = scrape(base)
-    moved = metrics.delta(before, after)
+    # http counters are recorded in the handler's finally AFTER the
+    # response bytes flush (the duration series must cover the write),
+    # so a scrape racing the last response can miss them by one beat —
+    # poll until both route counters moved, then assert the full set
+    import time as _time
+
+    want = (
+        'misaka_http_requests_total{route="/compute",method="POST"}',
+        'misaka_http_requests_total{route="/compute_raw",method="POST"}',
+    )
+    deadline = _time.monotonic() + 5
+    while True:
+        after = scrape(base)
+        moved = metrics.delta(before, after)
+        if all(moved.get(k, 0) >= 1 for k in want):
+            break
+        if _time.monotonic() > deadline:
+            break
+        _time.sleep(0.02)
     assert moved['misaka_http_requests_total{route="/compute",method="POST"}'] >= 1
     assert moved['misaka_http_requests_total{route="/compute_raw",method="POST"}'] >= 1
     assert moved['misaka_http_request_duration_seconds_count{route="/compute"}'] >= 1

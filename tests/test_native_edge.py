@@ -1,0 +1,387 @@
+"""The native C++ edge tier (native/frontend.cpp + runtime/frontends.py
+NativeFrontendSupervisor): byte-level route parity against the CPython
+route table, typed edge rejections from pushed state, keep-alive
+discipline, and the fallback ladder.
+
+The parity oracle is the engine's own HTTP server on the SAME master:
+every hot-route response the native tier produces (plane-shipped compute,
+locally-answered 401/413, wire-protocol 400s) must be bit-identical in
+status + body + load-bearing headers to what the CPython route table
+answers for the same bytes.  Responses that legitimately differ per
+request (Date, Server, Server-Timing, X-Misaka-Trace) are normalized out.
+"""
+
+import http.client
+import json
+import struct
+import threading
+import time
+
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.runtime import edge
+from misaka_tpu.runtime import frontends
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.utils import faults
+from misaka_tpu.utils import wire
+
+
+def _master(batch=4, engine="scan", **kw):
+    return MasterNode(
+        networks.add2(in_cap=16, out_cap=16, stack_cap=16),
+        chunk_steps=32, batch=batch, engine=engine, **kw,
+    )
+
+
+def _write_keys(path, entries) -> str:
+    with open(path, "w") as f:
+        json.dump({"keys": entries}, f)
+    return str(path)
+
+
+# Two burst-capped tenants with IDENTICAL specs: the 429 parity probe
+# sends each tier a different tenant so the shared process-level token
+# buckets never cross-contaminate the A/B legs.
+KEYS = [
+    {"key": "adm-secret", "tenant": "ops", "admin": True},
+    {"key": "tiny-a-secret", "tenant": "tiny-a", "quota": "vps<4"},
+    {"key": "tiny-b-secret", "tenant": "tiny-b", "quota": "vps<4"},
+    {"key": "eve-secret", "tenant": "eve", "disabled": True},
+]
+
+pytestmark = pytest.mark.skipif(
+    not frontends._FRONTEND_LIB.available(),
+    reason="native frontend.so unavailable (no g++?)",
+)
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    edge.reset()
+    faults.configure(None)
+
+
+@pytest.fixture
+def tiers(tmp_path, monkeypatch):
+    """One shared master behind BOTH tiers: the engine's CPython HTTP
+    server (the parity oracle and the native tier's proxy target) and
+    the C++ edge speaking the same compute plane."""
+    kf = _write_keys(tmp_path / "keys.json", KEYS)
+    monkeypatch.setenv("MISAKA_API_KEYS", kf)
+    monkeypatch.setenv("MISAKA_MAX_BODY", "65536")
+    m = _master(batch=2)
+    m.run()
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    engine_port = httpd.server_address[1]
+    plane_path = str(tmp_path / "plane.sock")
+    plane = frontends.start_compute_plane(m, plane_path)
+    sup = frontends.NativeFrontendSupervisor(
+        port=0, proxy_port=engine_port, plane_path=plane_path,
+        threads=2, plane_conns=1,
+    )
+    try:
+        yield engine_port, sup.port
+    finally:
+        sup.close()
+        plane.close()
+        m.pause()
+        httpd.shutdown()
+
+
+def _req(port, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request(method, path, body=body, headers=headers or {})
+    r = conn.getresponse()
+    data = r.read()
+    hdrs = {k.lower(): v for k, v in r.getheaders()}
+    conn.close()
+    return r.status, hdrs, data
+
+
+# headers compared byte-for-byte when present on either side; everything
+# per-request (Date, Server, Server-Timing, X-Misaka-Trace, Connection,
+# Keep-Alive) is normalized out
+_PARITY_HEADERS = ("content-type", "content-length", "retry-after",
+                   "www-authenticate")
+
+
+def _parity(engine_port, native_port, method, path, body=None,
+            headers=None, native_headers=None):
+    es, eh, eb = _req(engine_port, method, path, body, headers)
+    ns, nh, nb = _req(native_port, method, path, body,
+                      native_headers or headers)
+    assert (ns, nb) == (es, eb), (
+        f"{method} {path}: native {ns} {nb!r} != engine {es} {eb!r}"
+    )
+    for h in _PARITY_HEADERS:
+        assert nh.get(h) == eh.get(h), (
+            f"{method} {path}: header {h}: native {nh.get(h)!r} != "
+            f"engine {eh.get(h)!r}"
+        )
+    return ns, nh, nb
+
+
+# --- byte parity: success shapes --------------------------------------------
+
+
+def test_parity_raw_legacy(tiers):
+    engine_port, native_port = tiers
+    body = struct.pack("<4i", 1, 2, 3, 4)
+    s, h, b = _parity(engine_port, native_port, "POST", "/compute_raw",
+                      body, {"X-Misaka-Key": "adm-secret"})
+    assert s == 200
+    assert h["content-type"] == "application/octet-stream"
+    assert struct.unpack("<4i", b) == (3, 4, 5, 6)
+
+
+def test_parity_raw_binary_wire(tiers):
+    engine_port, native_port = tiers
+    payload = struct.pack("<3i", 10, 20, 30)
+    body = wire.pack(payload)
+    s, h, b = _parity(
+        engine_port, native_port, "POST", "/compute_raw", body,
+        {"X-Misaka-Key": "adm-secret", "Content-Type": wire.CONTENT_TYPE,
+         "Accept": wire.CONTENT_TYPE},
+    )
+    assert s == 200
+    assert h["content-type"] == wire.CONTENT_TYPE
+    assert struct.unpack("<3i", wire.unpack(b)) == (12, 22, 32)
+
+
+def test_parity_compute_form(tiers):
+    engine_port, native_port = tiers
+    s, _, b = _parity(engine_port, native_port, "POST", "/compute",
+                      b"value=7", {"X-Misaka-Key": "adm-secret"})
+    assert (s, b) == (200, b'{"value": 9}\n')
+
+
+def test_parity_batch_mixed_widths(tiers):
+    engine_port, native_port = tiers
+    # mixed magnitudes exercise the textcodec width-padded JSON shape
+    s, h, b = _parity(engine_port, native_port, "POST", "/compute_batch",
+                      b"values=5,-17,300&spread=1",
+                      {"X-Misaka-Key": "adm-secret"})
+    assert s == 200
+    assert h["content-type"] == "application/json"
+    assert json.loads(b)["values"] == [7, -15, 302]
+
+
+# --- byte parity: typed rejections ------------------------------------------
+
+
+def test_parity_401_missing_key(tiers):
+    engine_port, native_port = tiers
+    body = struct.pack("<2i", 1, 2)
+    s, h, b = _parity(engine_port, native_port, "POST", "/compute_raw",
+                      body)
+    assert s == 401
+    assert b"API key required" in b
+    assert h["www-authenticate"].startswith("Bearer")
+
+
+def test_parity_401_unknown_key(tiers):
+    engine_port, native_port = tiers
+    body = struct.pack("<2i", 1, 2)
+    s, _, b = _parity(engine_port, native_port, "POST", "/compute_raw",
+                      body, {"X-Misaka-Key": "who-is-this"})
+    assert (s, b) == (401, b"unknown API key")
+
+
+def test_parity_403_disabled_key(tiers):
+    # disabled keys are IN the pushed digest set, so the native tier
+    # ships them to the engine chain — the client must see the canonical
+    # 403, never a wrong local 401
+    engine_port, native_port = tiers
+    body = struct.pack("<2i", 1, 2)
+    s, _, b = _parity(engine_port, native_port, "POST", "/compute_raw",
+                      body, {"X-Misaka-Key": "eve-secret"})
+    assert (s, b) == (403, b"API key disabled")
+
+
+def test_parity_413_burst_cap(tiers):
+    # 16 values > vps<4's burst capacity (max(1, 4*2) = 8): a single
+    # unsplittable request answers a terminal 413 with NO Retry-After —
+    # the native tier renders it locally from the pushed burst spec
+    engine_port, native_port = tiers
+    body = struct.pack("<16i", *range(16))
+    es, eh, eb = _req(engine_port, "POST", "/compute_raw", body,
+                      {"X-Misaka-Key": "tiny-a-secret"})
+    ns, nh, nb = _req(native_port, "POST", "/compute_raw", body,
+                      {"X-Misaka-Key": "tiny-b-secret"})
+    assert (ns, nb.replace(b"tiny-b", b"tiny-a")) == (es, eb)
+    assert es == 413 and b"split the request" in eb
+    assert "retry-after" not in eh and "retry-after" not in nh
+
+
+def test_parity_429_rate_with_retry_after(tiers):
+    engine_port, native_port = tiers
+    body = struct.pack("<4i", 1, 2, 3, 4)  # drains vps<4's bucket whole
+    for port, key in ((engine_port, "tiny-a-secret"),
+                      (native_port, "tiny-b-secret")):
+        results = []
+        for _ in range(3):
+            results.append(_req(port, "POST", "/compute_raw", body,
+                                {"X-Misaka-Key": key}))
+        statuses = [r[0] for r in results]
+        assert 429 in statuses, (port, statuses)
+        s, h, b = results[statuses.index(429)]
+        assert b"value rate quota exhausted (4 values/s)" in b
+        assert h["retry-after"].isdigit() and int(h["retry-after"]) >= 1
+
+
+def test_parity_400_bad_binary_wire(tiers):
+    engine_port, native_port = tiers
+    hdr = {"X-Misaka-Key": "adm-secret", "Content-Type": wire.CONTENT_TYPE}
+    # header promises more values than the body carries
+    good = wire.pack(struct.pack("<3i", 1, 2, 3))
+    for body in (b"short", good[:-4], b"\xff" * 12):
+        s, _, b = _parity(engine_port, native_port, "POST",
+                          "/compute_raw", body, hdr)
+        assert s == 400 and b.startswith(b"bad binary body: "), (body, b)
+
+
+def test_parity_400_misaligned_raw(tiers):
+    engine_port, native_port = tiers
+    s, _, b = _parity(engine_port, native_port, "POST", "/compute_raw",
+                      b"\x01\x02\x03", {"X-Misaka-Key": "adm-secret"})
+    assert (s, b) == (400, b"body must be raw int32 values")
+
+
+def test_parity_404_unknown_program_route(tiers):
+    # Program-addressed requests ship via the plane on BOTH the native
+    # and the CPython worker tier, so those two are byte-identical; the
+    # engine's own HTTP route renders a pre-existing slightly longer
+    # hint ("(set MISAKA_PROGRAMS_DIR)") — compare the typed shape, not
+    # the bytes, against the direct-engine oracle.
+    engine_port, native_port = tiers
+    body = struct.pack("<2i", 1, 2)
+    hdr = {"X-Misaka-Key": "adm-secret"}
+    es, _, eb = _req(engine_port, "POST",
+                     "/programs/no-such-prog/compute_raw", body, hdr)
+    ns, _, nb = _req(native_port, "POST",
+                     "/programs/no-such-prog/compute_raw", body, hdr)
+    assert ns == es == 404
+    for b in (eb, nb):
+        assert b"cannot route to program 'no-such-prog'" in b
+
+
+# --- keep-alive + drain discipline ------------------------------------------
+
+
+def test_keepalive_after_error(tiers):
+    _, native_port = tiers
+    conn = http.client.HTTPConnection("127.0.0.1", native_port, timeout=15)
+    # 401 (keyless) with a drainable body must NOT kill the connection
+    conn.request("POST", "/compute_raw", body=struct.pack("<2i", 1, 2))
+    r = conn.getresponse()
+    r.read()
+    assert r.status == 401
+    # same socket: an authed request must still be answered
+    conn.request("POST", "/compute_raw", body=struct.pack("<2i", 5, 6),
+                 headers={"X-Misaka-Key": "adm-secret"})
+    r = conn.getresponse()
+    out = r.read()
+    conn.close()
+    assert r.status == 200
+    assert struct.unpack("<2i", out) == (7, 8)
+
+
+def test_oversized_body_413_closes(tiers):
+    engine_port, native_port = tiers
+    body = b"\x00" * (100 * 1024)  # > MISAKA_MAX_BODY=65536 from the fixture
+    s, h, b = _parity(engine_port, native_port, "POST", "/compute_raw",
+                      body, {"X-Misaka-Key": "adm-secret"})
+    assert s == 413
+    assert b == (b"body of 102400 bytes exceeds the 65536-byte cap "
+                 b"(MISAKA_MAX_BODY)")
+    # the MSK006 contract: an oversized body is NEVER drained — the
+    # server must close the TCP stream (like the engine: no
+    # Connection: close header, just EOF) so the client can't wedge
+    # pipelining on it
+    conn = http.client.HTTPConnection("127.0.0.1", native_port, timeout=15)
+    conn.request("POST", "/compute_raw", body=body,
+                 headers={"X-Misaka-Key": "adm-secret"})
+    r = conn.getresponse()
+    r.read()
+    assert r.status == 413
+    conn.sock.settimeout(10)
+    assert conn.sock.recv(1) == b""  # EOF: the server closed, no drain
+    conn.close()
+
+
+# --- proxy lane --------------------------------------------------------------
+
+
+def test_proxy_non_hot_routes(tiers):
+    engine_port, native_port = tiers
+    hdr = {"X-Misaka-Key": "adm-secret"}
+    for path in ("/status", "/metrics", "/debug/requests"):
+        es, _, eb = _req(engine_port, "GET", path, headers=hdr)
+        ns, _, nb = _req(native_port, "GET", path, headers=hdr)
+        assert ns == es == 200, (path, ns, es)
+        if path == "/status":
+            assert json.loads(nb).keys() == json.loads(eb).keys()
+    # and an UNAUTHED admin GET proxies to the same typed 401
+    es, _, eb = _req(engine_port, "GET", "/status")
+    ns, _, nb = _req(native_port, "GET", "/status")
+    assert (ns, nb) == (es, eb)
+    assert es == 401
+
+
+def test_native_healthz_and_state(tiers):
+    _, native_port = tiers
+    s, h, b = _req(native_port, "GET", "/healthz")
+    assert s == 200
+    assert h["server"] == "misaka-native-edge/1"
+    assert json.loads(b)  # the pushed snapshot is well-formed JSON
+
+
+# --- fallback ladder ---------------------------------------------------------
+
+
+def test_build_failure_chaos_point_raises(tmp_path):
+    """The fallback ladder's load-bearing rung: an injected build
+    failure must raise out of the supervisor constructor (app.py catches
+    it and keeps the CPython workers on the public port)."""
+    faults.configure("edge_native_build")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        frontends.NativeFrontendSupervisor(
+            port=0, proxy_port=1, plane_path=str(tmp_path / "p.sock"),
+        )
+
+
+def test_supervisor_restart_cycle(tmp_path):
+    """close() must fully release the C++ engine (one per process by
+    design) so a later boot in the SAME interpreter can start a fresh
+    tier — the singleton is restartable, not one-shot."""
+    plane_path = str(tmp_path / "plane.sock")
+    ports = set()
+    for _ in range(2):
+        sup = frontends.NativeFrontendSupervisor(
+            port=0, proxy_port=1, plane_path=plane_path,
+            threads=1, plane_conns=1,
+        )
+        try:
+            ports.add(sup.port)
+            s, _, _ = _req(sup.port, "GET", "/healthz")
+            assert s == 200
+        finally:
+            sup.close()
+    assert len(ports) == 2  # both cycles actually served
+
+
+def test_edge_state_snapshot_shape(tmp_path):
+    kf = edge.KeyFile(_write_keys(tmp_path / "k.json", KEYS))
+    chain = edge.EdgeChain(keyfile=kf, internal_token="fleet-tok")
+    st = edge.native_edge_state(chain)
+    assert st["auth_armed"]
+    # every key (INCLUDING the disabled one) + the internal token
+    assert len(st["digests"]) == len(KEYS) + 1
+    bursts = [d for d in st["digests"].values() if "burst_cap" in d]
+    assert len(bursts) == 2  # tiny-a + tiny-b; never the disabled key
+    assert all(b["burst_cap"] == 8.0 for b in bursts)
+    assert any(d.get("tenant") == "_fleet" for d in st["digests"].values())
+    assert "API key required" in st["reject_missing"]

@@ -20,8 +20,17 @@ skips the staleness rebuild that would otherwise clobber it).  The
 child then runs the scenario through the SHIPPED wrappers — the point
 is to sanitize the production discipline, not a lookalike.
 
+The r19 `--lane edge` variant points the same two-stage machinery at the
+native serving edge (native/frontend.cpp via MISAKA_FRONTEND_SO): an
+instrumented C++ epoll frontend in front of a real master + compute
+plane, hammered by concurrent keep-alive clients, mid-flight connection
+kills (torn request lines, half-shipped bodies, oversized 413s), and
+supervisor close/recreate cycles — the connection-teardown and
+engine-restart races only a sanitizer build can veto.
+
 Usage (or `make sanitize-smoke` / `make sanitize-all`):
     python tools/sanitize_stress.py --sanitizer address [--seconds 6]
+    python tools/sanitize_stress.py --sanitizer address --lane edge
 """
 
 from __future__ import annotations
@@ -78,6 +87,33 @@ def build_sanitized_so(kind: str) -> str:
     return so
 
 
+_FRONTEND_UNITS = ("msk_http.hpp", "msk_frame.hpp", "frontend.cpp")
+
+
+def build_sanitized_frontend_so(kind: str) -> str:
+    """Instrumented native edge (native/libmisaka_frontend.<kind>.so) —
+    same make-first/inline-fallback shape as build_sanitized_so; the
+    headers are real units (the Makefile's FRONTEND_UNITS), so staleness
+    compares against the newest of the three."""
+    flag, _, suffix, _, _ = _SAN[kind]
+    srcs = [os.path.join(REPO, "native", u) for u in _FRONTEND_UNITS]
+    so = os.path.join(REPO, "native", f"libmisaka_frontend.{suffix}.so")
+    if (os.path.exists(so)
+            and os.path.getmtime(so) >= max(map(os.path.getmtime, srcs))):
+        return so
+    print(f"# building {os.path.relpath(so, REPO)}", file=sys.stderr)
+    made = subprocess.run(["make", "-C", REPO, f"native-{suffix}"],
+                          capture_output=True)
+    if made.returncode == 0 and os.path.exists(so):
+        return so
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O1", "-g", "-fno-omit-frame-pointer", "-std=c++17",
+           "-shared", "-fPIC", "-pthread", *flag.split(),
+           "-Wall", "-Wextra", "-Werror", srcs[-1], "-o", so]
+    subprocess.run(cmd, check=True)
+    return so
+
+
 def build_sanitized_spec_so(kind: str) -> str | None:
     """An INSTRUMENTED per-program specialized build of the scenario's
     network (core/specialize.py with the sanitizer's flags via
@@ -116,7 +152,14 @@ def build_sanitized_spec_so(kind: str) -> str | None:
 
 def reexec_under_sanitizer(kind: str, args) -> int:
     so = build_sanitized_so(kind)
-    spec_so = build_sanitized_spec_so(kind)
+    # The edge lane instruments BOTH native tiers: the frontend under
+    # test and the interpreter behind it (the lane's master runs
+    # engine="native", so no un-instrumented hot code sits in the path).
+    # The specialized build stays pool-lane-only — the edge never loads
+    # a per-program .so.
+    frontend_so = (build_sanitized_frontend_so(kind)
+                   if args.lane == "edge" else None)
+    spec_so = build_sanitized_spec_so(kind) if args.lane == "pool" else None
     _, runtime, _, env_var, env_val = _SAN[kind]
     cxx = os.environ.get("CXX", "g++")
     lib = subprocess.run(
@@ -134,12 +177,14 @@ def reexec_under_sanitizer(kind: str, args) -> int:
         "MISAKA_INTERP_SO": so,
         "MISAKA_SANITIZE_CHILD": kind,
         **({"MISAKA_SANITIZE_SPEC_SO": spec_so} if spec_so else {}),
+        **({"MISAKA_FRONTEND_SO": frontend_so} if frontend_so else {}),
         # never touch (or wedge on) a TPU relay from a sanitizer lane
         "JAX_PLATFORMS": "cpu",
         "PALLAS_AXON_POOL_IPS": "",
     })
     cmd = [sys.executable, os.path.abspath(__file__),
-           "--sanitizer", kind, "--seconds", str(args.seconds),
+           "--sanitizer", kind, "--lane", args.lane,
+           "--seconds", str(args.seconds),
            "--replicas", str(args.replicas),
            "--pool-threads", str(args.pool_threads),
            "--readers", str(args.readers)]
@@ -419,16 +464,291 @@ def run_scenario(args) -> int:
     return 0
 
 
+def run_edge_scenario(args) -> int:
+    """The r19 edge lane: an INSTRUMENTED native/frontend.cpp serving a
+    real master + compute plane while three hostile actors race it —
+    keep-alive clients (200s interleaved with locally-answered 401/413
+    rejections), a killer shipping torn request lines / half bodies /
+    oversized 413s and slamming connections shut mid-flight, and the
+    main thread close()/recreate-ing the supervisor (full C++ engine
+    stop/start) under fire.  Every shape the sanitizer must bless:
+    connection teardown with responses in flight, the plane-ship path,
+    the span-ring drain racing the scrape thread, and restart cycles."""
+    import http.client
+    import json as _json
+    import random
+    import socket
+    import struct
+    import tempfile
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import numpy as np
+
+    assert os.environ.get("MISAKA_FRONTEND_SO"), "child needs the override"
+
+    tmp = tempfile.mkdtemp(prefix="msk-san-edge-")
+    keyfile = os.path.join(tmp, "keys.json")
+    with open(keyfile, "w") as f:
+        _json.dump({"keys": [
+            {"key": "adm-secret", "tenant": "ops", "admin": True},
+            # burst cap 8.0 values: a 12-value body is a deterministic
+            # locally-answered 413 regardless of bucket fill
+            {"key": "tiny-secret", "tenant": "tiny", "quota": "vps<4"},
+        ]}, f)
+    os.environ["MISAKA_API_KEYS"] = keyfile
+    os.environ["MISAKA_MAX_BODY"] = "65536"
+    os.environ["MISAKA_TRACE"] = "1"  # arm the C++ span ring + drain path
+
+    from misaka_tpu.runtime import edge
+    from misaka_tpu.runtime import frontends
+
+    if not frontends._FRONTEND_LIB.available():
+        print("sanitize: instrumented frontend failed to load",
+              file=sys.stderr)
+        return 1
+    # normally make_http_server's job at engine boot — this lane has no
+    # CPython engine server, so arm the edge chain from env directly
+    edge.install(edge.from_env())
+
+    class _StubMaster:
+        """numpy twin of the scenario's add2 network.  The plane calls
+        exactly is_running + compute_coalesced, and a jax-free stub
+        keeps jit lowering out of the child: MLIR uses C++ exceptions
+        as control flow, and the LD_PRELOADed sanitizer runtime aborts
+        on a throw it never got to intercept.  The lane polices the
+        C++ FRONTEND, not the engine behind it."""
+        is_running = True
+
+        def compute_coalesced(self, values, timeout=None,
+                              return_array=True, traces=()):
+            return np.asarray(values, np.int32) + 2
+
+    class _ProxyStub(BaseHTTPRequestHandler):
+        """Minimal proxy target for non-hot routes — exercises the
+        native proxy path without a CPython engine server."""
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            body = b"proxied-ok"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: ARG002 — quiet lane
+            pass
+
+    class _QuietHTTPServer(ThreadingHTTPServer):
+        def handle_error(self, request, client_address):
+            pass  # killer-slammed proxy connections are the scenario
+
+    httpd = _QuietHTTPServer(("127.0.0.1", 0), _ProxyStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    engine_port = httpd.server_address[1]
+    plane_path = os.path.join(tmp, "plane.sock")
+    plane = frontends.start_compute_plane(_StubMaster(), plane_path)
+
+    def new_sup():
+        return frontends.NativeFrontendSupervisor(
+            port=0, proxy_port=engine_port, plane_path=plane_path,
+            threads=2, plane_conns=1,
+        )
+
+    box = {"sup": new_sup()}
+    stop = threading.Event()
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+    stats = {"requests": 0, "values": 0, "local_401": 0, "local_413": 0,
+             "proxied": 0, "kills": 0, "cycles": 0, "scrapes": 0,
+             "span_rows": 0, "conn_losses": 0}
+
+    def bump(k, n=1):
+        with lock:
+            stats[k] += n
+
+    def client_loop(seed: int):
+        # Keep-alive hammer through the SHIPPED http.client path: every
+        # burst mixes plane-shipped 200s (values verified end to end)
+        # with the edge's locally-answered 401/413 (connection must
+        # survive both) and the native /healthz.  A connection refused /
+        # reset is the typed outcome of losing a restart-cycle race.
+        rng = random.Random(seed)
+        try:
+            while not stop.is_set():
+                port = box["sup"].port
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=10)
+                    for _ in range(8):
+                        if stop.is_set():
+                            break
+                        n = rng.randrange(1, 5)
+                        vals = [rng.randrange(-1000, 1000) for _ in range(n)]
+                        body = struct.pack(f"<{n}i", *vals)
+                        conn.request("POST", "/compute_raw", body=body,
+                                     headers={"X-Misaka-Key": "adm-secret"})
+                        r = conn.getresponse()
+                        data = r.read()
+                        if r.status != 200:
+                            raise AssertionError(
+                                f"compute_raw {r.status}: {data!r}")
+                        got = struct.unpack(f"<{n}i", data)
+                        if got != tuple(v + 2 for v in vals):
+                            raise AssertionError(
+                                f"edge served wrong values: {got} != "
+                                f"{tuple(v + 2 for v in vals)}")
+                        bump("requests")
+                        bump("values", n)
+                        conn.request("POST", "/compute_raw", body=body)
+                        r = conn.getresponse()
+                        r.read()
+                        if r.status != 401:
+                            raise AssertionError(f"keyless got {r.status}")
+                        bump("local_401")
+                        big = struct.pack("<12i", *range(12))
+                        conn.request("POST", "/compute_raw", body=big,
+                                     headers={"X-Misaka-Key": "tiny-secret"})
+                        r = conn.getresponse()
+                        r.read()
+                        if r.status != 413:
+                            raise AssertionError(f"burst got {r.status}")
+                        bump("local_413")
+                        conn.request("GET", "/healthz")
+                        r = conn.getresponse()
+                        r.read()
+                        if r.status != 200:
+                            raise AssertionError(f"healthz {r.status}")
+                        # non-hot route → the native proxy path
+                        conn.request("GET", "/status")
+                        r = conn.getresponse()
+                        if (r.status, r.read()) != (200, b"proxied-ok"):
+                            raise AssertionError(f"proxy got {r.status}")
+                        bump("proxied")
+                    conn.close()
+                except (OSError, http.client.HTTPException):
+                    bump("conn_losses")
+                    time.sleep(0.02)
+        except BaseException as e:  # noqa: BLE001 — surfaced at exit
+            errors.append(e)
+            stop.set()
+
+    def killer_loop(seed: int):
+        # Mid-flight kills: the teardown shapes a public listener eats
+        # all day — torn request line, half-shipped body, a connect/slam,
+        # and the oversized 413 whose contract is reply-then-TCP-close.
+        rng = random.Random(seed)
+        try:
+            while not stop.is_set():
+                port = box["sup"].port
+                try:
+                    s = socket.create_connection(("127.0.0.1", port),
+                                                 timeout=5)
+                    mode = rng.randrange(4)
+                    if mode == 0:
+                        s.sendall(b"POST /compute_raw HTT")
+                    elif mode == 1:
+                        s.sendall(b"POST /compute_raw HTTP/1.1\r\n"
+                                  b"Content-Length: 4096\r\n\r\n"
+                                  + b"x" * rng.randrange(0, 512))
+                    elif mode == 2:
+                        s.sendall(b"POST /compute_raw HTTP/1.1\r\n"
+                                  b"X-Misaka-Key: adm-secret\r\n"
+                                  b"Content-Length: 999999\r\n\r\n")
+                        try:
+                            s.recv(4096)  # the 413; server closes after
+                        except OSError:
+                            pass
+                    s.close()  # mode 3: connect and slam shut
+                    bump("kills")
+                except OSError:
+                    bump("conn_losses")
+                time.sleep(0.002)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    def scrape_loop():
+        # The supervisor's read surfaces (stats buffer, span-ring drain)
+        # racing traffic AND restart cycles — a stale supervisor losing
+        # the swap race must degrade typed, never crash.
+        try:
+            while not stop.is_set():
+                sup = box["sup"]
+                try:
+                    st = sup.state()
+                    assert st.get("requests", 0) >= 0
+                    bump("span_rows", len(sup.recent_spans()))
+                    bump("scrapes")
+                except Exception:
+                    bump("conn_losses")
+                time.sleep(0.01)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=client_loop, args=(i,))
+               for i in range(3)]
+    threads += [threading.Thread(target=killer_loop, args=(100 + i,))
+                for i in range(2)]
+    threads.append(threading.Thread(target=scrape_loop))
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + args.seconds
+    try:
+        while time.monotonic() < deadline and not stop.is_set():
+            time.sleep(0.9)
+            # supervisor restart cycle under fire: the C++ engine is
+            # one-per-process, so close FIRST — clients mid-request lose
+            # the race (typed conn_losses), the recreate must come up
+            # clean on a fresh port with state re-pushed
+            box["sup"].close()
+            box["sup"] = new_sup()
+            bump("cycles")
+    except BaseException as e:  # noqa: BLE001 — recreate failed
+        errors.append(e)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        box["sup"].close()
+        plane.close()
+        httpd.shutdown()
+    if errors:
+        print(f"sanitize[edge]: scenario error: {errors[0]!r}",
+              file=sys.stderr)
+        return 1
+    if not (stats["requests"] and stats["local_401"] and stats["local_413"]
+            and stats["proxied"] and stats["kills"] and stats["cycles"]
+            and stats["scrapes"]):
+        print(f"sanitize[edge]: scenario did not exercise the races: "
+              f"{stats}", file=sys.stderr)
+        return 1
+    print(f"# sanitize[{os.environ.get('MISAKA_SANITIZE_CHILD')}/edge] "
+          f"green: {stats['requests']} plane 200s / {stats['values']} "
+          f"values, {stats['local_401']}+{stats['local_413']} local "
+          f"401/413 rejections, {stats['proxied']} proxied, "
+          f"{stats['kills']} mid-flight kills, "
+          f"{stats['cycles']} supervisor restart cycles, "
+          f"{stats['scrapes']} scrapes / {stats['span_rows']} span rows "
+          f"({stats['conn_losses']} typed connection losses)",
+          file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sanitizer", default="address",
                     choices=sorted(_SAN))
+    ap.add_argument("--lane", default="pool", choices=("pool", "edge"))
     ap.add_argument("--seconds", type=float, default=6.0)
     ap.add_argument("--replicas", type=int, default=64)
     ap.add_argument("--pool-threads", type=int, default=8)
     ap.add_argument("--readers", type=int, default=4)
     args = ap.parse_args()
     if os.environ.get("MISAKA_SANITIZE_CHILD"):
+        if args.lane == "edge":
+            return run_edge_scenario(args)
         return run_scenario(args)
     return reexec_under_sanitizer(args.sanitizer, args)
 
