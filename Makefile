@@ -187,6 +187,20 @@ registry-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/registry_smoke.py
 
+# Capture/shadow-replay tripwire (~15s): arm the wire recorder over HTTP
+# on a registry-armed server, serve mixed two-program traffic, export a
+# manifest-verified segment + anchor checkpoints, then assert the whole
+# record plane: tools/replay.py replays both programs byte-for-byte
+# green (rc 0), an ADD20 mutant candidate renders the loud per-request
+# DIVERGENCE lines (rc 1), POST /programs?verify=replay admits the
+# unchanged program and 409s the mutant with structured diffs, and
+# --emit-model fits a bench.py --model load model from the capture.  The
+# same assertions run inside tier-1 (tests/test_capture.py);
+# docs/OBSERVABILITY.md "Traffic capture & shadow replay".
+replay-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/replay_smoke.py
+
 # Native flight-recorder tripwire (~10s): a REAL subprocess server with
 # frontend workers — traced traffic carrying X-Misaka-Trace IDs, then
 # assert GET /debug/perfetto renders ONE unified timeline per ID spanning
@@ -238,6 +252,7 @@ ci:
 	$(MAKE) trace-smoke
 	$(MAKE) native-trace-smoke
 	$(MAKE) registry-smoke
+	$(MAKE) replay-smoke
 	$(MAKE) usage-smoke
 	$(MAKE) observatory-smoke
 	$(MAKE) edge-smoke
@@ -331,4 +346,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke usage-smoke observatory-smoke edge-smoke edge-native-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke replay-smoke usage-smoke observatory-smoke edge-smoke edge-native-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean

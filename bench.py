@@ -1889,6 +1889,355 @@ def bench_tracing_ab(pairs=6):
     return out
 
 
+def bench_capture_ab(pairs=6):
+    """Traffic-capture overhead A/B (ISSUE r20 budget: MEDIAN served-
+    throughput pair ratio >= 0.95 on both lanes, recorder ARMED at
+    sample=1.0 vs idle).
+
+    Same discipline as the committed r10/r18 A/Bs (bench_tracing_ab):
+    ONE shared master + HTTP server, ABBA pair ordering, production 1ms
+    switch interval, median pair ratios (scheduler-lottery collapses on
+    a saturated box swing a mean).  Three recorder states measured:
+
+      killed    MISAKA_CAPTURE=0 — the kill switch; every hook is one
+                module-attribute load (reported as killed_vs_idle, the
+                `disabled path measured` check: must be ~1.0)
+      idle      capture importable and armed-able, not recording — the
+                default production state (the A/B BASELINE)
+      recording sample=1.0, every request's payload copied into the
+                ring (the A/B INSTRUMENTED side; the honest worst case —
+                production sampling records a fraction of this)
+
+    The raw lane is the recorder's worst case by construction: 16384
+    int32s per request means each record memcpys ~128KiB of payload
+    into the ring and churns eviction at the 16MB default budget.
+    """
+    import threading as _threading
+    import urllib.request
+    import http.client as _http_client
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime import capture as _capture
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    sys.setswitchinterval(0.001)
+    batch, in_cap, threads, waves = 1024, 128, 8, 4
+    top = networks.add2(in_cap=in_cap, out_cap=in_cap, stack_cap=16)
+    master = MasterNode(top, chunk_steps=2048, batch=batch, engine="native")
+    httpd = make_http_server(master, port=0)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = "127.0.0.1", httpd.server_address[1]
+    url = f"http://{host}:{port}/compute_raw?spread=1"
+    master.run()
+    rng = np.random.default_rng(1)
+    per_request = (batch // threads) * in_cap
+
+    def raw_lane():
+        reqs = [
+            [
+                (v := rng.integers(-1000, 1000, size=per_request)
+                 .astype(np.int32)),
+                np.ascontiguousarray(v, "<i4").tobytes(), None,
+            ]
+            for _ in range(threads * waves)
+        ]
+        errors = []
+
+        def worker(chunk):
+            try:
+                for item in chunk:
+                    req = urllib.request.Request(
+                        url, data=item[1], method="POST"
+                    )
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        item[2] = r.read()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        ws = [
+            _threading.Thread(target=worker, args=(reqs[i::threads],))
+            for i in range(threads)
+        ]
+        t0 = time.perf_counter()
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        for vals, _, raw in reqs:
+            if not np.array_equal(np.frombuffer(raw, "<i4"), vals + 2):
+                raise RuntimeError("capture A/B raw parity FAILED")
+        return len(reqs) * per_request / elapsed
+
+    def conc_lane(seconds=2.0, c=64, payload_values=64):
+        rng2 = np.random.default_rng(11)
+        bodies = []
+        for _ in range(8):
+            vals = rng2.integers(
+                -1000, 1000, size=payload_values
+            ).astype(np.int32)
+            bodies.append((vals, np.ascontiguousarray(vals, "<i4").tobytes()))
+        counts = [0] * c
+        errors = []
+        stop = _threading.Event()
+
+        def one_client(i):
+            try:
+                conn = _http_client.HTTPConnection(host, port, timeout=60)
+                k = 0
+                while not stop.is_set():
+                    vals, body = bodies[k % 8]
+                    conn.request("POST", "/compute_raw?spread=1", body)
+                    raw = conn.getresponse().read()
+                    if not np.array_equal(
+                        np.frombuffer(raw, dtype="<i4"), vals + 2
+                    ):
+                        raise RuntimeError("capture A/B sweep parity FAILED")
+                    counts[i] += 1
+                    k += 1
+                conn.close()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                stop.set()
+
+        ts = [
+            _threading.Thread(target=one_client, args=(i,)) for i in range(c)
+        ]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in ts:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return sum(counts) * payload_values / elapsed
+
+    def set_state(state):
+        if _capture.recording():
+            _capture.stop()
+        if state == "killed":
+            _capture.configure({"MISAKA_CAPTURE": "0"})
+        else:
+            _capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0"})
+            if state == "recording":
+                anchor = _capture.anchor_from_master("default", master)
+                _capture.start(
+                    anchors={"default": anchor} if anchor else {}
+                )
+
+    conc_pairs = pairs * 2
+    out = {
+        "method": (
+            f"recorder armed at sample=1.0 vs idle (capture.start/stop, "
+            f"live toggle), ONE shared master + HTTP server, ABBA pair "
+            f"ordering, switchinterval=1ms as in production serving; raw "
+            f"= {pairs} pairs of 8 threads x {waves} waves of "
+            f"{per_request}-value /compute_raw (~128KiB memcpy per "
+            f"record, eviction churn at the 16MB budget); conc64 = "
+            f"{conc_pairs} pairs of the committed r8 concurrency lane "
+            f"(64 keep-alive clients x 64-value payloads x 2s); "
+            f"killed_vs_idle = MISAKA_CAPTURE=0 vs idle on the raw lane "
+            f"(the kill switch must measure as a no-op)"
+        ),
+        "baseline_raw": [], "instrumented_raw": [],
+        "baseline_conc64": [], "instrumented_conc64": [],
+        "killed_raw": [], "idle_raw": [],
+    }
+    try:
+        for state in ("idle", "recording"):  # warm both paths end to end
+            set_state(state)
+            raw_lane()
+            conc_lane(seconds=1.0)
+        for i in range(pairs):
+            states = (
+                ("idle", "recording") if i % 2 == 0
+                else ("recording", "idle")
+            )
+            for state in states:
+                set_state(state)
+                raw = raw_lane()
+                key = (
+                    "instrumented" if state == "recording" else "baseline"
+                )
+                out[key + "_raw"].append(round(raw, 1))
+                print(
+                    f"# capture A/B raw pair {i} {state:<9}: {raw:.0f}/s",
+                    file=sys.stderr,
+                )
+        for i in range(conc_pairs):
+            states = (
+                ("idle", "recording") if i % 2 == 0
+                else ("recording", "idle")
+            )
+            for state in states:
+                set_state(state)
+                conc = conc_lane()
+                key = (
+                    "instrumented" if state == "recording" else "baseline"
+                )
+                out[key + "_conc64"].append(round(conc, 1))
+                print(
+                    f"# capture A/B conc64 pair {i} {state:<9}: "
+                    f"{conc:.0f}/s",
+                    file=sys.stderr,
+                )
+        for i in range(max(2, pairs // 2)):
+            states = (
+                ("idle", "killed") if i % 2 == 0 else ("killed", "idle")
+            )
+            for state in states:
+                set_state(state)
+                raw = raw_lane()
+                out[("killed" if state == "killed" else "idle") + "_raw"] \
+                    .append(round(raw, 1))
+                print(
+                    f"# capture A/B kill-switch pair {i} {state:<9}: "
+                    f"{raw:.0f}/s",
+                    file=sys.stderr,
+                )
+    finally:
+        if _capture.recording():
+            _capture.stop()
+        _capture.configure()
+        master.pause()
+        httpd.shutdown()
+    for lane in ("raw", "conc64"):
+        base = out[f"baseline_{lane}"]
+        inst = out[f"instrumented_{lane}"]
+        ratios = sorted(round(b and i / b, 4) for i, b in zip(inst, base))
+        out[f"{lane}_pair_ratios"] = ratios
+        out[f"{lane}_mean_ratio"] = round(sum(inst) / sum(base), 4)
+        n = len(ratios)
+        out[f"{lane}_median_ratio"] = round(
+            ratios[n // 2] if n % 2
+            else (ratios[n // 2 - 1] + ratios[n // 2]) / 2, 4
+        )
+    out["killed_vs_idle_ratio"] = round(
+        sum(out["killed_raw"]) / sum(out["idle_raw"]), 4
+    ) if out["idle_raw"] else None
+    return out
+
+
+def bench_model_replay(model_path, seconds=8.0, clients=32):
+    """Drive a capture-fitted load model (tools/replay.py --emit-model /
+    capture.fit_load_model) against a served engine: open-loop Poisson
+    arrivals at the fitted rate, payload sizes drawn from the fitted
+    value histogram, tenant mix preserved as labels.  Reports achieved
+    vs offered rate and latency percentiles — the `bench.py --model`
+    lane that turns yesterday's production traffic into today's
+    regression harness."""
+    import http.client as _http_client
+    import threading as _threading
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+
+    with open(model_path) as f:
+        model = json.load(f)
+    if model.get("format") != 1:
+        raise SystemExit(f"unsupported load-model format: {model_path}")
+    rate = float(model["arrival"]["rate_rps"])
+    hist = model["values"]["hist"] or [[1, 1]]
+    tenants = sorted((model.get("tenants") or {"default": 1.0}).items())
+
+    sys.setswitchinterval(0.001)
+    top = networks.add2(in_cap=4096, out_cap=4096, stack_cap=16)
+    master = MasterNode(top, chunk_steps=2048, batch=256, engine="native")
+    httpd = make_http_server(master, port=0)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = "127.0.0.1", httpd.server_address[1]
+    master.run()
+
+    rng = np.random.default_rng(5)
+    uppers = np.array([u for u, _ in hist], dtype=np.int64)
+    weights = np.array([w for _, w in hist], dtype=np.float64)
+    weights /= weights.sum()
+    t_weights = np.array([w for _, w in tenants], dtype=np.float64)
+    t_weights /= t_weights.sum()
+
+    # open loop: one global Poisson arrival schedule, sliced round-robin
+    # across the client connections (a closed loop would let a slow
+    # server hide behind its own backpressure)
+    n_arrivals = max(1, int(rate * seconds))
+    gaps = rng.exponential(1.0 / max(rate, 1e-9), size=n_arrivals)
+    arrivals = np.cumsum(gaps)
+    sizes = uppers[rng.choice(len(uppers), size=n_arrivals, p=weights)]
+    sizes = np.minimum(sizes, 4096)
+    tenant_idx = rng.choice(len(tenants), size=n_arrivals, p=t_weights)
+
+    lat: list = []
+    sent = [0] * clients
+    errors: list = []
+    lock = _threading.Lock()
+    t_start = time.perf_counter()
+
+    def one_client(ci):
+        try:
+            conn = _http_client.HTTPConnection(host, port, timeout=60)
+            my_lat = []
+            for k in range(ci, n_arrivals, clients):
+                wait = arrivals[k] - (time.perf_counter() - t_start)
+                if wait > 0:
+                    time.sleep(wait)
+                n = int(sizes[k])
+                vals = rng.integers(-1000, 1000, size=n).astype(np.int32)
+                body = np.ascontiguousarray(vals, "<i4").tobytes()
+                t0 = time.perf_counter()
+                conn.request(
+                    "POST", "/compute_raw?spread=1", body,
+                    {"X-Misaka-Tenant": tenants[tenant_idx[k]][0]},
+                )
+                raw = conn.getresponse().read()
+                my_lat.append(time.perf_counter() - t0)
+                if not np.array_equal(
+                    np.frombuffer(raw, dtype="<i4"), vals + 2
+                ):
+                    raise RuntimeError("model-replay parity FAILED")
+                sent[ci] += 1
+            conn.close()
+            with lock:
+                lat.extend(my_lat)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [
+        _threading.Thread(target=one_client, args=(i,))
+        for i in range(clients)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+    master.pause()
+    httpd.shutdown()
+    if errors:
+        raise errors[0]
+    la = np.array(sorted(lat))
+    done = int(sum(sent))
+    return {
+        "model": model_path,
+        "offered_rps": round(rate, 2),
+        "achieved_rps": round(done / elapsed, 2),
+        "requests": done,
+        "values": int(sizes[:done].sum()),
+        "duration_s": round(elapsed, 2),
+        "tenants": {name: int((tenant_idx == i).sum())
+                    for i, (name, _) in enumerate(tenants)},
+        "latency_ms": {
+            "p50": round(float(np.percentile(la, 50)) * 1e3, 3),
+            "p90": round(float(np.percentile(la, 90)) * 1e3, 3),
+            "p99": round(float(np.percentile(la, 99)) * 1e3, 3),
+            "max": round(float(la.max()) * 1e3, 3),
+        } if len(la) else None,
+    }
+
+
 def bench_edge_native_ab(pairs=4, seconds=2.0, clients=64,
                          payload_values=64, workers=2):
     """Native-edge serving A/B (ISSUE r19): the C++ epoll frontend tier
@@ -4578,6 +4927,45 @@ if __name__ == "__main__":
                 file=sys.stderr,
             )
             sys.exit(1)
+    elif "--capture-ab" in sys.argv:
+        # Standalone traffic-capture overhead capture (the r20 twin of
+        # the r10/r18 A/Bs): both served lanes, recorder armed at
+        # sample=1.0 vs idle, plus the MISAKA_CAPTURE=0 kill-switch
+        # no-op check.  Committed as BENCH_cpu_r20.json.
+        import jax
+
+        ab = bench_capture_ab()
+        payload = {
+            "platform": jax.devices()[0].platform,
+            "capture": "served-only (traffic-capture overhead)",
+            "served_throughput": ab["instrumented_raw"][-1],
+            "served_conc64_throughput": ab["instrumented_conc64"][-1],
+            "served_engine": "native",
+            "capture_overhead_ab": ab,
+            # MEDIAN pair ratio (see ab["method"]): scheduler-lottery
+            # collapses on a saturated box swing a mean past the budget
+            "ok": bool(
+                ab["raw_median_ratio"] >= 0.95
+                and ab["conc64_median_ratio"] >= 0.95
+            ),
+        }
+        print(json.dumps(payload))
+        if not payload["ok"]:
+            print(
+                f"# capture A/B FAILED the 0.95 budget: raw "
+                f"{ab['raw_median_ratio']} conc64 "
+                f"{ab['conc64_median_ratio']} (medians)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+    elif "--model" in sys.argv:
+        # Capture-fitted load-model lane: open-loop Poisson replay of a
+        # model JSON emitted by `misaka_tpu replay --emit-model` (or
+        # capture.fit_load_model) — yesterday's production traffic as
+        # today's regression harness.
+        i = sys.argv.index("--model")
+        result = bench_model_replay(sys.argv[i + 1])
+        print(json.dumps(result))
     elif "--native-trace-ab" in sys.argv:
         # Standalone native-flight-recorder overhead capture (the r18
         # twin of the r10/r12/r15 A/Bs): the served raw lane AND the r17

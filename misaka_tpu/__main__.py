@@ -11,6 +11,9 @@ surfaces:
   compute  <v...> [--url]    send values to a running master's /compute
   bench    [--batch --values] quick add-2 throughput smoke (the real harness
                              is bench.py at the repo root)
+  replay   <segment>         shadow-replay a captured .mskcap traffic segment
+                             byte-for-byte (tools/replay.py; --candidate gives
+                             the pre-deploy verdict for a new topology)
   debug    <topology>        interactive single-step debugger (misaka_tpu.debug)
 
 <topology> is a baseline config name (add2, acc_loop, ring4, sorter,
@@ -126,6 +129,29 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_replay(args) -> int:
+    # the implementation lives with the other operator tooling
+    # (tools/replay.py, also runnable standalone); load it by path so
+    # tools/ never needs to be a package
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "replay.py")
+    spec = importlib.util.spec_from_file_location("_misaka_replay", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    return mod.replay_segment(
+        args.segment,
+        candidate=args.candidate,
+        program=args.program,
+        engine=args.engine,
+        limit=args.limit,
+        emit_model=args.emit_model,
+    )
+
+
 def cmd_debug(args) -> int:
     from misaka_tpu.debug import Debugger
 
@@ -208,6 +234,16 @@ def main(argv=None) -> int:
     p = sub.add_parser("bench", help="quick add-2 throughput smoke")
     p.add_argument("--batch", type=int, default=1024)
     p.add_argument("--values", type=int, default=32)
+    p = sub.add_parser(
+        "replay",
+        help="shadow-replay a captured .mskcap segment (tools/replay.py)",
+    )
+    p.add_argument("segment")
+    p.add_argument("--candidate")
+    p.add_argument("--program")
+    p.add_argument("--engine")
+    p.add_argument("--limit", type=int)
+    p.add_argument("--emit-model", metavar="OUT.json")
     p = sub.add_parser("debug", help="interactive debugger")
     p.add_argument("topology")
 
@@ -226,6 +262,7 @@ def main(argv=None) -> int:
         "disasm": cmd_disasm,
         "compute": cmd_compute,
         "bench": cmd_bench,
+        "replay": cmd_replay,
         "debug": cmd_debug,
     }[args.command](args)
 

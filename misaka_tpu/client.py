@@ -61,6 +61,21 @@ class MisakaClientError(RuntimeError):
         self.status = status
         self.body = body
         self.trace_id = trace_id
+        #: structured per-request divergence records when the server
+        #: refused a ?verify=replay publish (HTTP 409 JSON body
+        #: {"error", "diffs"}) — each names the captured request's
+        #: trace/offset and the expected-vs-actual value heads.  Empty
+        #: for every other error shape.
+        self.diffs: list = []
+        if status == 409 and body.lstrip().startswith("{"):
+            try:
+                obj = json.loads(body)
+                if isinstance(obj, dict) and isinstance(
+                    obj.get("diffs"), list
+                ):
+                    self.diffs = obj["diffs"]
+            except ValueError:
+                pass
         #: seconds from the response's Retry-After header (None when the
         #: server sent none).  A 429 carries it always — back off for
         #: this long instead of retrying hot (the edge's token bucket
@@ -611,7 +626,8 @@ class MisakaClient:
 
     def upload_program(self, name: str, program: str | None = None,
                        topology: "dict | str | None" = None,
-                       compose: str | None = None) -> dict:
+                       compose: str | None = None,
+                       verify: str | None = None) -> dict:
         """Publish one program version (POST /programs) and return the
         server's {"name", "version", "created", "latest", "swapped"}.
 
@@ -620,7 +636,14 @@ class MisakaClient:
         dict or JSON string, `compose` a reference docker-compose YAML
         text.  Identical sources dedup to one content-addressed version;
         publishing a new version over a live engine hot-swaps it with
-        zero client-visible errors."""
+        zero client-visible errors.
+
+        verify="replay" gates the hot-swap on shadow replay of the live
+        capture (POST /programs?verify=replay): the candidate must
+        byte-for-byte reproduce every captured response before any
+        bookkeeping or swap happens.  A divergence surfaces as
+        MisakaClientError(status=409) with ``.diffs`` carrying the
+        per-request records; see ``replay()``."""
         fields: dict[str, str] = {"name": name}
         if program is not None:
             fields["program"] = program
@@ -630,7 +653,10 @@ class MisakaClient:
             )
         if compose is not None:
             fields["compose"] = compose
-        return json.loads(self._post_form("/programs", **fields))
+        path = "/programs"
+        if verify is not None:
+            path += "?verify=" + urllib.parse.quote(verify, safe="")
+        return json.loads(self._post_form(path, **fields))
 
     def list_programs(self) -> dict:
         """The registry catalog (GET /programs): every name's versions,
@@ -658,3 +684,52 @@ class MisakaClient:
 
     def profile_stop(self) -> str:
         return self._request("/profile/stop", b"", "POST").decode()
+
+    # --- traffic capture & shadow replay (runtime/capture.py) --------------
+    # Admin-gated when edge auth is configured: construct the client with
+    # the admin api_key or the edge answers 403.
+
+    def capture_start(self) -> dict:
+        """Arm the wire recorder (POST /captures/start): anchors every
+        active engine's state and records sampled request/response pairs
+        into the bounded ring.  Returns the recorder status.  409 when
+        already recording or killed via MISAKA_CAPTURE=0."""
+        return json.loads(self._post_form("/captures/start"))
+
+    def capture_stop(self) -> dict:
+        """Disarm the recorder; the ring stays readable for export and
+        ?verify=replay until the next capture_start()."""
+        return json.loads(self._post_form("/captures/stop"))
+
+    def capture_export(self, path: str | None = None) -> dict:
+        """Write the captured ring + per-program anchor checkpoints to
+        disk ON THE SERVER (POST /captures/export) and return
+        {"path", "records", "dropped", "anchors"}.  path=None lets the
+        server pick a timestamped file under MISAKA_CAPTURE_DIR."""
+        fields = {"path": path} if path else {}
+        return json.loads(self._request(
+            "/captures/export",
+            urllib.parse.urlencode(fields).encode(), "POST",
+        ))
+
+    def capture_status(self, n: int = 0) -> dict:
+        """The recorder's live status + the newest ``n`` records with
+        value previews (GET /debug/captures?n=...)."""
+        return json.loads(
+            self._request(f"/debug/captures?n={int(n)}", None, "GET")
+        )
+
+    def replay(self, name: str, program: str | None = None,
+               topology: "dict | str | None" = None,
+               compose: str | None = None) -> dict:
+        """Replay-verified publish: upload_program(verify="replay").
+
+        Green replay -> the publish proceeds and the server's publish
+        payload returns.  Divergence -> MisakaClientError with
+        status=409 and ``.diffs`` listing every captured request the
+        candidate answered differently (trace ID, stream offset,
+        expected/actual heads) — nothing was swapped or recorded."""
+        return self.upload_program(
+            name, program=program, topology=topology, compose=compose,
+            verify="replay",
+        )

@@ -535,6 +535,23 @@ def _iter_flight_rings(max_records: int | None):
             yield p, label, info, ring, role, (cursor, dropped), events
 
 
+def flight_mem_bytes() -> int:
+    """Allocated flight-recorder ring memory across live pools (rings x
+    capacity x 32 B records — interpreter.cpp's TraceRec layout), for
+    the /healthz debug_mem budget surface shared with the request-trace
+    recorder and the capture ring."""
+    total = 0
+    for p in _live_pools():
+        try:
+            info = p._pool.trace_info()
+        except Exception:
+            continue
+        total += int(info.get("rings", 0)) * int(
+            info.get("capacity", 0)
+        ) * 32
+    return total
+
+
 def flight_payload(max_records: int | None = None) -> dict:
     """GET /debug/native_trace: the raw per-thread rings of every live
     pool, decoded, with serve/unit events carrying the request-trace IDs

@@ -220,6 +220,30 @@ so the master's env surface is what survives:
                    traces (default 1.0), MISAKA_TRACE_RING /
                    MISAKA_TRACE_SLOWEST bound the recorder (256 / 32);
                    docs/OBSERVABILITY.md "Request tracing"
+  MISAKA_CAPTURE   "0" kills the wire-level capture/replay plane
+                   (runtime/capture.py; default available, disarmed):
+                   POST /captures/start (admin) records raw
+                   request/response payload bytes at every serving
+                   surface (engine routes, CPython workers, C++ edge)
+                   plus a per-program anchor checkpoint, so the window
+                   replays byte-for-byte — offline via tools/replay.py
+                   (`misaka_tpu replay`), and as a deploy gate via
+                   POST /programs?verify=replay (divergence = 409 with
+                   per-request diffs, nothing swapped).
+                   docs/OBSERVABILITY.md "Traffic capture & shadow
+                   replay"
+  MISAKA_CAPTURE_MB  capture ring memory budget in MiB (default 64);
+                   overrun evicts oldest-first and counts
+                   misaka_capture_dropped_total — a flood costs
+                   history, never memory
+  MISAKA_CAPTURE_SAMPLE  uniform share of requests recorded while armed
+                   (default 1.0); an inbound X-Misaka-Trace bypasses
+                   sampling on every surface, so a targeted repro is
+                   always captured
+  MISAKA_CAPTURE_DIR  default directory for POST /captures/export
+                   segments (default "captures/" under the CWD)
+  MISAKA_REPLAY_VERIFY_MAX  most-recent captured records the
+                   ?verify=replay deploy gate replays (default 256)
   MISAKA_NATIVE_CODEC  /compute_batch decimal codec backend: unset = auto
                    (native C++ when a toolchain exists), "0" = numpy,
                    "1" = require native (utils/textcodec.py)

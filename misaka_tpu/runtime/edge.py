@@ -274,6 +274,10 @@ ADMIN_ROUTES = frozenset({
     "/run", "/pause", "/reset", "/load", "/checkpoint", "/restore",
     "/profile/start", "/profile/stop", "/fleet/roll", "/fleet/drain",
     "/debug/faults",  # fault injection is an operator mutation
+    # the capture plane records raw request/response payloads — arming,
+    # exporting, and reading it are operator actions, not tenant reads
+    "/captures/start", "/captures/stop", "/captures/export",
+    "/debug/captures",
 })
 
 

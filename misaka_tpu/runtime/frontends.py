@@ -54,6 +54,7 @@ from collections import deque
 from urllib.parse import unquote
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from misaka_tpu.runtime import capture as capture_mod
 from misaka_tpu.runtime import edge as edge_mod
 from misaka_tpu.utils import faults
 from misaka_tpu.utils import metrics
@@ -413,9 +414,17 @@ class ComputePlane:
 
         def parse_meta(blob: bytes) -> tuple[str | None, str | None, int,
                                              list, list, bool, int, list,
-                                             dict | None, int | None]:
+                                             dict | None, int | None,
+                                             dict | None]:
             """(program, key, reqs, traces, edge, probe, hedged, shed,
-            shm_arm, shm_vals) from the frame's JSON metadata.
+            shm_arm, shm_vals, cap) from the frame's JSON metadata.
+
+            `cap` (only materialized while the capture plane records) is
+            {"segs": per-request slices of the fused frame for the
+            capture recorder — trace ID, inbound flag, value offset/len —
+            and "rej": worker-side locally-terminated rejects shipped for
+            central recording}.  Lenient like the trace segments: a
+            malformed entry costs the capture record, never the frame.
 
             `shm_arm` ({name, size}) is a shared-memory arming request
             (MISAKA_PLANE_SHM, see _PlaneShm below); `shm_vals` marks a
@@ -443,7 +452,7 @@ class ComputePlane:
             "no key" would turn an authentication failure into the
             anonymous tenant's quota."""
             if not blob:
-                return None, None, 1, [], [], False, 0, [], None, None
+                return None, None, 1, [], [], False, 0, [], None, None, None
             import json as _json
 
             probe = False
@@ -510,8 +519,28 @@ class ComputePlane:
                     edge = [float(t0) for t0 in edge_raw]
                 except (ValueError, TypeError):
                     log.debug("dropping malformed plane edge metadata")
+            cap = None
+            if capture_mod.RECORDING:
+                try:
+                    cap = {
+                        "segs": [
+                            {
+                                "id": tracespan.sanitize_id(s.get("id")),
+                                "in": bool(s.get("in")),
+                                "off": int(s.get("off", 0)),
+                                "len": int(s.get("len", 0)),
+                            }
+                            for s in segs if isinstance(s, dict)
+                        ],
+                        "rej": (
+                            obj.get("caprej") or []
+                            if isinstance(obj, dict) else []
+                        ),
+                    }
+                except (ValueError, TypeError, AttributeError):
+                    log.debug("dropping malformed plane capture metadata")
             return (program, key, reqs, traces, edge, probe, hedged, shed,
-                    shm_arm, shm_vals)
+                    shm_arm, shm_vals, cap)
 
         def slo_record(program, edge, t_recv, error: bool) -> None:
             """Feed the frame's outcome into the per-program SLO windows:
@@ -578,7 +607,7 @@ class ComputePlane:
             in-flight count was taken by the caller and is released
             here."""
             (program, key, reqs, traces, edge, probe, hedged, shed,
-             _shm_arm, shm_vals) = parsed
+             _shm_arm, shm_vals, cap) = parsed
             try:
                 if self._draining:
                     # rolling restart: hand this frame back to the
@@ -622,6 +651,11 @@ class ComputePlane:
                             )
                     except (ValueError, TypeError):
                         log.debug("dropping malformed shed metadata")
+                if cap is not None and cap.get("rej"):
+                    # worker-side locally-terminated rejects (shed cache):
+                    # recorded centrally so the capture covers the whole
+                    # door, partitioned exactly-once by terminating surface
+                    capture_mod.ingest("worker", cap["rej"])
                 # The edge chain, per frame (runtime/edge.py): the
                 # frontend workers terminate TLS and ship the API key
                 # along; auth + per-tenant quota + admission run HERE,
@@ -646,6 +680,16 @@ class ComputePlane:
                         rej.tenant = decision.tenant
                         body = rej.to_wire()
                         reply(_RESP_HDR.pack(rej.status, len(body)) + body)
+                        if capture_mod.RECORDING:
+                            # engine-side termination: this surface owns
+                            # the record (the worker only relayed)
+                            capture_mod.ingest("plane", [{
+                                "program": program,
+                                "trace": None,
+                                "in": 0,
+                                "status": rej.status,
+                                "reason": rej.reason,
+                            }])
                         for tr in traces:
                             tracespan.end(tr, status=rej.status)
                         return
@@ -736,6 +780,28 @@ class ComputePlane:
                     reply(
                         _RESP_HDR.pack(200, len(payload) // 4) + payload
                     )
+                if capture_mod.RECORDING:
+                    # one record per fused frame (surface "plane"): the
+                    # raw int32 comparands for byte-for-byte replay, plus
+                    # the per-request slices so a diff names the request
+                    cap_segs = (cap or {}).get("segs") or None
+                    first = cap_segs[0] if cap_segs else None
+                    capture_mod.note(
+                        "plane",
+                        program=program or getattr(
+                            registry, "default_name", None
+                        ),
+                        trace=first["id"] if first else None,
+                        inbound=any(s["in"] for s in cap_segs)
+                        if cap_segs else False,
+                        vals=values.tobytes(),
+                        resp=payload,
+                        status=200,
+                        tick=int(getattr(m, "_ticks_done", 0)),
+                        reqs=reqs,
+                        op="coalesced",
+                        segs=cap_segs,
+                    )
                 slo_record(program, edge, t_recv, error=False)
                 dur = time.monotonic() - t_recv
                 for tr in traces:
@@ -807,7 +873,7 @@ class ComputePlane:
                     conn.sendall(_RESP_HDR.pack(400, len(body)) + body)
                     continue
                 (_program, _key, _reqs, _traces, _edge, probe,
-                 _hedged, _shed, shm_arm, shm_vals) = parsed
+                 _hedged, _shed, shm_arm, shm_vals, _cap) = parsed
                 if shm_arm is not None:
                     # zero-copy plane arming: map the client's segment.
                     # PLANE_SHM_OK is deliberately NOT 200 — a pre-shm
@@ -1016,6 +1082,11 @@ class PlaneClient:
         # series (eventual: a fully-shed quiet worker delivers when the
         # hold expires and a request goes through)
         self._shed: dict[tuple[str, str], int] = {}
+        # worker-local capture rows (locally-terminated rejects) awaiting
+        # delivery to the engine's capture ring, same eventual-delivery
+        # contract as the shed counts; bounded — capture is observability,
+        # so overflow drops rows rather than growing the worker
+        self._caprej: list[dict] = []
         # Adaptive coalesce window, the engine scheduler's policy applied
         # one level out: a frame dispatches immediately when no frame is
         # in flight; while one IS, waiting a few hundred microseconds
@@ -1048,6 +1119,15 @@ class PlaneClient:
         k = (tenant or "other", reason)
         with self._cond:
             self._shed[k] = self._shed.get(k, 0) + 1
+
+    def report_capture(self, row: dict) -> None:
+        """Queue one worker-terminated capture row ({t, program, trace,
+        in, status, reason}) for the engine's capture ring on the next
+        frame.  Bounded: past 32 waiting rows, new ones drop — capture
+        rows must never grow a quiet worker."""
+        with self._cond:
+            if len(self._caprej) < 32:
+                self._caprej.append(row)
 
     def compute_raw(self, body: bytes, timeout: float = 30.0,
                     program: str | None = None, key: str | None = None,
@@ -1381,6 +1461,10 @@ class PlaneClient:
                 shed_report, self._shed = (
                     (self._shed, {}) if self._shed else (None, self._shed)
                 )
+                caprej_report, self._caprej = (
+                    (self._caprej, [])
+                    if self._caprej else (None, self._caprej)
+                )
             # Trace metadata for the frame: each traced request ships its
             # ID + value offset + the spans already complete at frame
             # build (http.parse, frontend.coalesce) so the engine-side
@@ -1404,7 +1488,7 @@ class PlaneClient:
             )
             if (traced or program is not None or key is not None
                     or slo_armed or hedged_count or len(batch) > 1
-                    or shed_report):
+                    or shed_report or caprej_report):
                 import json as _json
 
                 entries = []
@@ -1417,7 +1501,7 @@ class PlaneClient:
                             now - r.enqueued,
                             {"frame_requests": len(batch)},
                         )
-                        entries.append({
+                        ent = {
                             "id": r.trace.trace_id,
                             "off": off,
                             "len": len(r.body) // 4,
@@ -1425,7 +1509,12 @@ class PlaneClient:
                                 [s.name, s.start, s.dur]
                                 for s in r.trace.spans
                             ],
-                        })
+                        }
+                        if getattr(r.trace, "inbound", False):
+                            # the client presented this ID: the engine's
+                            # capture recorder bypasses sampling for it
+                            ent["in"] = 1
+                        entries.append(ent)
                     if slo_armed:
                         # edge-observed SLO clock: this request's wait
                         # started when the frontend enqueued it
@@ -1449,6 +1538,10 @@ class PlaneClient:
                     obj["shed"] = [
                         [t, r, n] for (t, r), n in shed_report.items()
                     ]
+                if caprej_report:
+                    # worker-terminated capture rows ride the same frame
+                    # (lenient engine-side; dropped if this ship fails)
+                    obj["caprej"] = caprej_report
                 meta = _json.dumps(obj).encode()
             payload_out = b"".join(r.body for r in batch)
 
@@ -1708,6 +1801,12 @@ class FleetPlaneRouter:
         (up[0] if up else self._replicas[0]).client.report_shed(
             tenant, reason
         )
+
+    def report_capture(self, row: dict) -> None:
+        """Route a worker-terminated capture row to a replica's capture
+        ring (same any-up policy as the shed counts)."""
+        up = [r for r in self._replicas if r.state == "up"]
+        (up[0] if up else self._replicas[0]).client.report_capture(row)
 
     # --- health probing -----------------------------------------------------
 
@@ -2078,6 +2177,18 @@ def make_frontend_server(
             # the cache hit never reaches the engine: ship the count on
             # the next frame so misaka_edge_rejected_total stays honest
             plane.report_shed(getattr(rej, "tenant", None), rej.reason)
+            if capture_mod.available():
+                # worker-terminated reject: this surface owns the capture
+                # record (surface "worker", delivered via frame metadata)
+                tr = tracespan.current()
+                plane.report_capture({
+                    "t": time.time(),
+                    "program": getattr(self, "_misaka_program", None),
+                    "trace": tr.trace_id if tr is not None else None,
+                    "in": int(getattr(tr, "inbound", False)),
+                    "status": 429,
+                    "reason": rej.reason,
+                })
             return True
 
         def _edge_guard(self) -> bool:
@@ -2098,6 +2209,16 @@ def make_frontend_server(
             # tenant unknown at this worker (no auth state here): the
             # backlog-cap shed books under "other"
             plane.report_shed(None, "overload")
+            if capture_mod.available():
+                tr = tracespan.current()
+                plane.report_capture({
+                    "t": time.time(),
+                    "program": None,
+                    "trace": tr.trace_id if tr is not None else None,
+                    "in": int(getattr(tr, "inbound", False)),
+                    "status": 429,
+                    "reason": "overload",
+                })
             return False
 
         def _with_trace(self, inner) -> None:
@@ -2790,6 +2911,8 @@ def _configure_frontend(lib: ctypes.CDLL) -> None:
     lib.msk_edge_stats.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.msk_edge_spans.restype = ctypes.c_int64
     lib.msk_edge_spans.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.msk_edge_captures.restype = ctypes.c_int64
+    lib.msk_edge_captures.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.msk_edge_stop.restype = None
     lib.msk_edge_stop.argtypes = []
     lib.msk_edge_last_error.restype = ctypes.c_char_p
@@ -2955,6 +3078,11 @@ class NativeFrontendSupervisor:
         state["trace_enabled"] = tracespan.enabled()
         state["trace_sample"] = float(getattr(tracespan, "_SAMPLE", 1.0))
         state["slo_armed"] = bool(slo.armed())
+        # the capture plane rides the same push: the C++ edge records its
+        # locally-terminated rejects (shed/401/413/overload) only while
+        # the engine-side recorder is armed, pre-applying the sample rate
+        state["capture_enabled"] = capture_mod.recording()
+        state["capture_sample"] = capture_mod.sample_rate()
         if self._healthz_body is not None:
             state["healthz_body"] = self._healthz_body.decode(
                 "utf-8", "replace"
@@ -3052,6 +3180,31 @@ class NativeFrontendSupervisor:
                     attrs,
                 ))
 
+    def _drain_captures(self) -> None:
+        """Drain the C++ edge's capture rows (locally-terminated
+        rejects) into the engine-side capture ring.  The edge applies
+        MISAKA_CAPTURE_SAMPLE itself, so rows ingest pre-sampled."""
+        if not capture_mod.RECORDING:
+            return
+        cap = 256 * 1024
+        for _ in range(2):
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.msk_edge_captures(buf, cap)
+            if n >= 0:
+                break
+            cap *= 4
+        else:
+            return
+        if n == 0:
+            return
+        try:
+            payload = json.loads(buf.raw[:n].decode("utf-8", "replace"))
+        except ValueError:
+            return
+        rows = payload.get("records") or []
+        if rows:
+            capture_mod.ingest("edge", rows, pre_sampled=True)
+
     def recent_spans(self, window_s: float = 15.0) -> list:
         """Native per-request spans for the Perfetto export (tier
         source): drain the C++ ring into a bounded buffer, return the
@@ -3084,6 +3237,7 @@ class NativeFrontendSupervisor:
                 self._push()
                 self._pump_metrics()
                 self._drain_spans()
+                self._drain_captures()
             except Exception:
                 log.exception("native edge watcher tick failed")
             tick += 1

@@ -36,6 +36,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from misaka_tpu.runtime import capture as capture_mod
 from misaka_tpu.runtime import usage
 from misaka_tpu.runtime.topology import Topology, TopologyError
 from misaka_tpu.tis.parser import TISParseError
@@ -182,6 +183,15 @@ M_HTTP_LATENCY = metrics.histogram(
     "misaka_http_request_duration_seconds", "HTTP request handling time by route",
     ("route",),
 )
+# One accounting surface for every debug-plane ring: the request-trace
+# recorder (r10), the native flight recorder (r18), and the capture ring
+# (r20) each hold bounded memory; /healthz refreshes these on probe so a
+# scrape answers "how much RAM does observability cost right now".
+M_DEBUG_MEM = metrics.gauge(
+    "misaka_debug_mem_bytes",
+    "Debug-plane ring memory by plane (trace/flight/capture)",
+    ("plane",),
+)
 
 # Bounded route-label cardinality: unknown paths collapse to "other" (an
 # unauthenticated client must not be able to mint unbounded label values).
@@ -192,7 +202,8 @@ _METRIC_ROUTES = frozenset({
     "/debug/requests", "/debug/perfetto", "/debug/isa_trace",
     "/debug/usage", "/debug/alerts", "/debug/flamegraph",
     "/debug/series", "/debug/dashboard", "/debug/faults",
-    "/debug/native_trace",
+    "/debug/native_trace", "/debug/captures",
+    "/captures/start", "/captures/stop", "/captures/export",
 })
 
 # The routes whose latency/error outcomes feed the per-program SLO windows
@@ -2893,7 +2904,11 @@ def make_http_server(
     import zipfile
     from urllib.parse import unquote
 
-    from misaka_tpu.runtime.registry import ProgramNotFound, RegistryError
+    from misaka_tpu.runtime.registry import (
+        ProgramNotFound,
+        RegistryError,
+        ReplayDivergence,
+    )
     from misaka_tpu.utils import textcodec
     from misaka_tpu.utils.profiling import Profiler, ProfilerError
 
@@ -3234,6 +3249,39 @@ def make_http_server(
             """Pre-encoded JSON body (the vectorized /compute_batch path)."""
             self._send(data, "application/json")
 
+        def _json_status(self, code: int, obj) -> None:
+            """JSON body on a non-200 status (the replay-divergence 409
+            carries structured per-request diffs, not a prose line)."""
+            data = (json.dumps(obj) + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self._trace_headers()
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _capture_note(self, m, vals: bytes, resp: bytes,
+                          op: str) -> None:
+            """Cut a capture record for a request this route served
+            (surface \"http\" — the engine terminated it).  The inbound
+            X-Misaka-Trace header, when valid, bypasses sampling so a
+            traced request is always captured."""
+            inbound_id = tracespan.sanitize_id(
+                self.headers.get(tracespan.TRACE_HEADER)
+            )
+            tr = getattr(self, "_misaka_trace", None)
+            capture_mod.note(
+                "http",
+                program=self._misaka_program,
+                trace=tr.trace_id if tr is not None else inbound_id,
+                inbound=inbound_id is not None,
+                vals=vals,
+                resp=resp,
+                status=200,
+                tick=int(getattr(m, "_ticks_done", 0)),
+                op=op,
+            )
+
         def _handle_get(self):
             # /status, /trace, /metrics, /healthz are additive; the
             # reference's routes reject GET ("method GET not allowed",
@@ -3324,6 +3372,27 @@ def make_http_server(
                                 for t, v in cst["tiers"].items()
                             },
                         }
+                    # Debug-plane memory budget (the r18 flight-recorder
+                    # ring and the r20 capture ring share this accounting
+                    # surface with the request-trace recorder): per-ring
+                    # bytes + total, mirrored into misaka_debug_mem_bytes.
+                    try:
+                        from misaka_tpu.core import native_serve
+
+                        flight_b = native_serve.flight_mem_bytes()
+                    except Exception:
+                        flight_b = 0
+                    trace_b = tracespan.mem_bytes()
+                    cap_b = capture_mod.mem_bytes()
+                    M_DEBUG_MEM.labels(plane="trace").set(trace_b)
+                    M_DEBUG_MEM.labels(plane="flight").set(flight_b)
+                    M_DEBUG_MEM.labels(plane="capture").set(cap_b)
+                    payload["debug_mem"] = {
+                        "trace_bytes": trace_b,
+                        "flight_bytes": flight_b,
+                        "capture_bytes": cap_b,
+                        "total_bytes": trace_b + flight_b + cap_b,
+                    }
                     if degraded is not None:
                         payload["degraded"] = degraded
                     if edge_chain.armed:
@@ -3490,6 +3559,19 @@ def make_http_server(
                     # Chrome trace-event JSON of the recorder contents —
                     # load in https://ui.perfetto.dev or chrome://tracing
                     self._json(tracespan.perfetto())
+                    return
+                if parsed.path == "/debug/captures":
+                    # the capture ring's recent records (payload heads
+                    # only — raw value bytes stay out of the debug JSON);
+                    # ?n=100 caps the listing
+                    q = {
+                        k: v[0] for k, v in parse_qs(parsed.query).items()
+                    }
+                    try:
+                        limit = int(q.get("n", "100"))
+                    except ValueError:
+                        limit = 100
+                    self._json(capture_mod.debug_payload(limit))
                     return
                 if parsed.path == "/debug/native_trace":
                     # the native flight recorder's raw per-thread rings
@@ -3670,6 +3752,13 @@ def make_http_server(
                         # retryable, nothing entered the pipeline
                         self._text(503, str(e))
                         return
+                    if capture_mod.RECORDING:
+                        self._capture_note(
+                            m,
+                            np.asarray([value], "<i4").tobytes(),
+                            np.asarray([result], "<i4").tobytes(),
+                            "coalesced" if coalesced is not None else "many",
+                        )
                     self._json({"value": result})
                 elif path == "/compute_batch":
                     # additive: a FIFO stream of values through one instance
@@ -3726,6 +3815,16 @@ def make_http_server(
                     except PeerUnavailable as e:
                         self._text(503, str(e))
                         return
+                    if capture_mod.RECORDING:
+                        self._capture_note(
+                            m,
+                            np.asarray(values, "<i4").tobytes(),
+                            np.asarray(result, "<i4").tobytes(),
+                            "coalesced"
+                            if form.get("spread") == "1"
+                            and hasattr(m, "compute_spread")
+                            else "many",
+                        )
                     # one vectorized pass; pad spaces are legal JSON
                     # whitespace, so json.loads clients decode unchanged
                     self._bytes_json(
@@ -3818,6 +3917,15 @@ def make_http_server(
                         self._text(503, str(e))
                         return
                     payload = result.astype("<i4").tobytes()
+                    if capture_mod.RECORDING:
+                        self._capture_note(
+                            m,
+                            values.tobytes(),
+                            payload,
+                            "coalesced"
+                            if q.get("spread", "1") == "1"
+                            else "many",
+                        )
                     if wire.accepts_binary(self.headers.get("Accept")):
                         self._send(wire.header(len(payload) // 4) + payload,
                                    wire.CONTENT_TYPE)
@@ -3837,6 +3945,15 @@ def make_http_server(
                             "(set MISAKA_PROGRAMS_DIR)",
                         )
                         return
+                    # ?verify=replay (or form field): gate the hot-swap on
+                    # a green shadow replay of the last captured requests —
+                    # deploy-didn't-happen on divergence (409 with the
+                    # per-request diffs)
+                    q = {
+                        k: v[0]
+                        for k, v in parse_qs(urlparse(self.path).query).items()
+                    }
+                    verify = q.get("verify") or form.get("verify") or None
                     try:
                         result = registry.publish(
                             form.get("name", ""),
@@ -3845,7 +3962,16 @@ def make_http_server(
                             compose=form.get("compose"),
                             slo_spec=form.get("slo"),
                             quota_spec=form.get("quota"),
+                            verify=verify,
                         )
+                    except ReplayDivergence as e:
+                        # typed: the candidate answered captured traffic
+                        # differently — the registry refused the swap
+                        self._json_status(409, {
+                            "error": str(e),
+                            "diffs": e.diffs,
+                        })
+                        return
                     except (
                         RegistryError,
                         TopologyError,
@@ -3853,6 +3979,47 @@ def make_http_server(
                         TISLowerError,
                     ) as e:
                         self._text(400, f"error publishing program: {e}")
+                        return
+                    self._json(result)
+                elif path == "/captures/start":
+                    # arm the wire-level recorder, anchoring a pre-capture
+                    # state snapshot per live program so the capture
+                    # replays from a known starting checkpoint
+                    self._form()  # drain any body (keep-alive sync)
+                    anchors = {}
+                    label = (
+                        registry.default_name
+                        if registry is not None else None
+                    ) or "default"
+                    a = capture_mod.anchor_from_master(label, master)
+                    if a is not None:
+                        anchors[label] = a
+                    if registry is not None:
+                        for name, m in registry.active_masters():
+                            if name in anchors:
+                                continue
+                            a = capture_mod.anchor_from_master(name, m)
+                            if a is not None:
+                                anchors[name] = a
+                    try:
+                        capture_mod.start(anchors=anchors)
+                    except capture_mod.CaptureError as e:
+                        self._text(409, str(e))
+                        return
+                    self._json(capture_mod.status())
+                elif path == "/captures/stop":
+                    self._form()  # drain any body (keep-alive sync)
+                    capture_mod.stop()
+                    self._json(capture_mod.status())
+                elif path == "/captures/export":
+                    # spill the ring to a durable segment file (+ anchor
+                    # checkpoints); admin-gated, so a caller-chosen path is
+                    # an operator decision, not an open write primitive
+                    form = self._form()
+                    try:
+                        result = capture_mod.export(form.get("path") or None)
+                    except capture_mod.CaptureError as e:
+                        self._text(409, str(e))
                         return
                     self._json(result)
                 elif path == "/fleet/drain":
@@ -3967,7 +4134,14 @@ def make_http_server(
                 except Exception:
                     pass
 
-    httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    class _Server(ThreadingHTTPServer):
+        # socketserver's default listen backlog of 5 RSTs simultaneous
+        # connection bursts (64 keep-alive clients dialing at once lose
+        # a third of their dials on a loaded box); 128 is what real
+        # serving tiers ask for and the kernel clamps to somaxconn
+        request_queue_size = 128
+
+    httpd = _Server(("0.0.0.0", port), Handler)
     if tls is False:
         ctx = None
     elif tls is None:

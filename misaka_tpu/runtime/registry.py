@@ -132,6 +132,18 @@ class RegistryError(ValueError):
     publishing over the seeded boot program)."""
 
 
+class ReplayDivergence(RegistryError):
+    """A ``?verify=replay`` publish whose candidate answered captured
+    traffic differently than the recorded responses — the hot-swap was
+    refused (deploy-didn't-happen).  ``.diffs`` carries the per-request
+    diff dicts (trace ID, record offset, expected/actual heads) the HTTP
+    surface renders as the 409 body."""
+
+    def __init__(self, message: str, diffs: list | None = None):
+        super().__init__(message)
+        self.diffs = diffs or []
+
+
 class ProgramNotFound(KeyError):
     """An unknown program name or version — the typed 404.
 
@@ -510,6 +522,7 @@ class ProgramRegistry:
         compose: str | None = None,
         slo_spec: str | None = None,
         quota_spec: str | None = None,
+        verify: str | None = None,
     ) -> dict:
         """Upload one program version; hot-swap the live engine when the
         `latest` alias moves under it.
@@ -535,6 +548,10 @@ class ProgramRegistry:
         both).  Validated here like the slo field."""
         if not NAME_RE.match(name):
             raise RegistryError(f"invalid program name {name!r}")
+        if verify not in (None, "", "replay"):
+            raise RegistryError(
+                f"unknown verify mode {verify!r} (supported: replay)"
+            )
         if slo_spec is not None:
             try:
                 slo.parse_spec(slo_spec)  # validate-first, like the source
@@ -549,6 +566,12 @@ class ProgramRegistry:
             tis=tis, topology_json=topology_json, compose=compose
         )
         topo.compile(batch=self._batch)  # compile-first: raises before any swap
+        if verify == "replay":
+            # the deploy gate: a shadow engine running THIS candidate must
+            # reproduce the captured stream byte-for-byte before any
+            # bookkeeping mutates — a divergence (or an unsound capture)
+            # is a refusal that touches nothing, same as a bad source
+            self._verify_replay(name, topo)
         canonical = canonical_topology(topo)
         version = version_of(canonical)
         meta = {"source": canonical, "created_unix": round(time.time(), 3)}
@@ -640,6 +663,57 @@ class ProgramRegistry:
             with self._cond:
                 self._publishing.discard(name)
                 self._cond.notify_all()
+
+    def _verify_replay(self, name: str, topo) -> None:
+        """The ``?verify=replay`` deploy gate: drive the last captured
+        requests for ``name`` against a SHADOW engine compiled from the
+        candidate topology — in-process, no live traffic touched.  The
+        shadow restores the capture's anchor state first (the recorded
+        stream replays from its starting checkpoint), then must answer
+        every record byte-for-byte.  Any divergence — including an
+        anchor the candidate cannot even restore (shape change) — raises
+        ReplayDivergence; an unsound capture (no anchor, evicted
+        records, recorder killed) raises RegistryError."""
+        from misaka_tpu.runtime import capture as capture_mod
+        from misaka_tpu.runtime.master import MasterNode
+
+        try:
+            anchor, recs = capture_mod.verify_bundle(name)
+        except capture_mod.CaptureError as e:
+            raise RegistryError(f"verify=replay refused: {e}") from e
+        shadow = MasterNode(
+            topo, chunk_steps=self._chunk, batch=self._batch,
+            engine=self._engine,
+        )
+        try:
+            try:
+                shadow.restore(anchor["state"])
+            except ValueError as e:
+                # a candidate that cannot hold the anchor state is by
+                # definition not answer-compatible with the capture
+                raise ReplayDivergence(
+                    f"candidate for {name!r} cannot restore the capture "
+                    f"anchor: {e}"
+                ) from e
+            shadow.run()
+            diffs = capture_mod.replay_records(shadow, recs)
+        finally:
+            try:
+                shadow.close()
+            except Exception:
+                log.warning("replay shadow close failed", exc_info=True)
+        if diffs:
+            for d in diffs:
+                log.warning("registry: %s", capture_mod.format_diff(d))
+            raise ReplayDivergence(
+                f"candidate for {name!r} diverged on "
+                f"{len(diffs)}/{len(recs)} captured requests",
+                diffs=diffs,
+            )
+        log.info(
+            "registry: verify=replay green for %s (%d captured requests "
+            "byte-identical)", name, len(recs),
+        )
 
     def _hot_swap(
         self, name: str, version: str, old_key: tuple[str, str]
@@ -1127,6 +1201,16 @@ class ProgramRegistry:
         """Active (name, version) pairs, least-recently-used first."""
         with self._cond:
             return sorted(self._engines, key=lambda k: self._lru.get(k, 0.0))
+
+    def active_masters(self) -> list[tuple[str, object]]:
+        """(name, master) for every ready engine — the capture plane
+        anchors each live program's state at /captures/start."""
+        with self._cond:
+            return [
+                (n, e.master) for (n, _v), e in self._engines.items()
+                if e.ready.is_set() and e.error is None
+                and e.master is not None
+            ]
 
     # --- lifecycle ----------------------------------------------------------
 

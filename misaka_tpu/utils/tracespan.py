@@ -117,7 +117,7 @@ class Trace:
     runs at completion only."""
 
     __slots__ = ("trace_id", "route", "status", "start_mono", "start_unix",
-                 "dur", "spans", "_token")
+                 "dur", "spans", "inbound", "_token")
 
     def __init__(self, trace_id: str, route: str | None = None):
         self.trace_id = trace_id
@@ -127,6 +127,8 @@ class Trace:
         self.start_unix = time.time()
         self.dur: float | None = None  # set at end()
         self.spans: list[Span] = []
+        self.inbound = False  # ID honored from the request (vs minted) —
+        # the capture plane's sampling bypass rides on this
         self._token = None  # contextvar reset token (activating begin only)
 
     def add(self, name: str, start: float, dur: float, attrs=None) -> None:
@@ -212,6 +214,18 @@ class FlightRecorder:
         with self._lock:
             self._ring.clear()
             self._slow.clear()
+
+
+def mem_bytes() -> int:
+    """Approximate recorder footprint for the /healthz debug_mem block
+    (one budget surface with the native flight rings and the capture
+    ring): per-trace object overhead plus ~112 bytes per span."""
+    total = 0
+    with RECORDER._lock:
+        traces = list(RECORDER._ring) + [t for _, _, t in RECORDER._slow]
+    for t in traces:
+        total += 240 + 112 * len(t.spans)
+    return total
 
 
 def merge_traces(traces: list[Trace]) -> Trace:
@@ -323,11 +337,13 @@ def begin(trace_id=None, route: str | None = None,
     if not _ENABLED:
         return None
     tid = sanitize_id(trace_id)
+    inbound = tid is not None
     if tid is None:
         if _SAMPLE < 1.0 and random.random() >= _SAMPLE:
             return None
         tid = mint()
     trace = Trace(tid, route=route)
+    trace.inbound = inbound
     if activate:
         trace._token = _current.set(trace)
     return trace

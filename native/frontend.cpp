@@ -64,6 +64,12 @@ inline double mono_now() {
     return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
 }
 
+inline double unix_now() {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
 // ---------------------------------------------------------------------------
 // Configuration + pushed state
 // ---------------------------------------------------------------------------
@@ -103,6 +109,12 @@ struct PushState {
     bool trace_enabled = false;
     double trace_sample = 1.0;
     bool slo_armed = false;
+    // the capture plane (runtime/capture.py): while the engine-side
+    // recorder is armed, locally-terminated rejects (shed 429, 401, 413,
+    // overload) are recorded here and drained by the supervisor — the
+    // engine never sees those requests, so this tier owns their records
+    bool capture_enabled = false;
+    double capture_sample = 1.0;
 };
 
 struct Stats {
@@ -124,6 +136,16 @@ struct SpanRec {
     std::string trace;
     double start = 0.0;
     double dur = 0.0;
+};
+
+// one locally-terminated request the capture plane records (sampling is
+// applied at record time, so drained rows ingest pre-sampled)
+struct CaptureRec {
+    double t = 0.0;  // unix seconds (the load-model arrival clock)
+    std::string program;
+    std::string trace;
+    int status = 0;
+    std::string reason;
 };
 
 // ---------------------------------------------------------------------------
@@ -150,6 +172,8 @@ struct Conn {
     std::string program;   // "" = default-addressed
     std::string key;       // "" = keyless
     std::string trace_id;  // "" = untraced
+    bool trace_inbound = false;  // ID presented by the client (capture
+                                 // sampling bypass rides this)
     bool accepts_binary = false;
     double t_start = 0.0, t_parse = 0.0, d_parse = 0.0;
 
@@ -268,6 +292,8 @@ struct Worker {
                   const char* reason);
     void record_span(const char* name, double start, double dur,
                      const std::string& trace);
+    void record_capture(const PushState& st, const Conn& c, int status,
+                        const char* reason);
     std::string mint_trace();
     int depth() const;
 };
@@ -287,6 +313,10 @@ struct Engine {
 
     std::mutex span_mu;
     std::deque<SpanRec> spans;
+
+    std::mutex cap_mu;
+    std::deque<CaptureRec> caps;
+    uint64_t caps_dropped = 0;  // ring overflow (guarded by cap_mu)
 
     std::shared_ptr<const PushState> load_state() {
         std::lock_guard<std::mutex> g(state_mu);
@@ -436,6 +466,28 @@ void Worker::record_span(const char* name, double start, double dur,
     std::lock_guard<std::mutex> g(eng->span_mu);
     if (eng->spans.size() >= 2048) eng->spans.pop_front();
     eng->spans.push_back(SpanRec{name, lane, trace, start, dur});
+}
+
+void Worker::record_capture(const PushState& st, const Conn& c, int status,
+                            const char* reason) {
+    if (!st.capture_enabled) return;
+    // MISAKA_CAPTURE_SAMPLE applied HERE (rows ingest pre-sampled); an
+    // inbound X-Misaka-Trace bypasses sampling, like the engine recorder
+    if (!c.trace_inbound && st.capture_sample < 1.0) {
+        rng ^= rng >> 12;
+        rng ^= rng << 25;
+        rng ^= rng >> 27;
+        const double u =
+            (double)(rng * 0x2545F4914F6CDD1Dull >> 11) * 0x1.0p-53;
+        if (u >= st.capture_sample) return;
+    }
+    std::lock_guard<std::mutex> g(eng->cap_mu);
+    if (eng->caps.size() >= 1024) {
+        eng->caps.pop_front();
+        eng->caps_dropped++;
+    }
+    eng->caps.push_back(
+        CaptureRec{unix_now(), c.program, c.trace_id, status, reason});
 }
 
 void Worker::shed_row(const std::string& tenant, bool has_tenant,
@@ -681,13 +733,16 @@ void Worker::handle_head(uint32_t slot) {
     c.program.clear();
     c.key.clear();
     c.trace_id.clear();
+    c.trace_inbound = false;
     c.have_deferred = false;
     c.accepts_binary = false;
 
     // trace identity: honor a well-formed inbound X-Misaka-Trace
     // unconditionally (inbound IDs skip sampling, like tracespan.begin);
-    // mint for a sampled share of the rest
-    if (st->trace_enabled) {
+    // mint for a sampled share of the rest.  The capture plane also
+    // needs the inbound check (its sampling bypass) even with tracing
+    // disabled.
+    if (st->trace_enabled || st->capture_enabled) {
         const std::string inbound = c.req.get_str("x-misaka-trace");
         bool ok = inbound.size() >= 4 && inbound.size() <= 64;
         for (const char ch : inbound) {
@@ -699,7 +754,8 @@ void Worker::handle_head(uint32_t slot) {
         }
         if (ok && !inbound.empty()) {
             c.trace_id = inbound;
-        } else if (st->trace_sample > 0.0) {
+            c.trace_inbound = true;
+        } else if (st->trace_enabled && st->trace_sample > 0.0) {
             rng ^= rng >> 12;
             rng ^= rng << 25;
             rng ^= rng >> 27;
@@ -794,6 +850,7 @@ void Worker::handle_head(uint32_t slot) {
             eng->stats.shed_hits.fetch_add(1, std::memory_order_relaxed);
             shed_row(sit->second.tenant, sit->second.has_tenant,
                      sit->second.reason.c_str());
+            record_capture(*st, c, 429, sit->second.reason.c_str());
             c.have_deferred = true;
             c.deferred_status = 429;
             c.deferred_body = sit->second.message;
@@ -819,6 +876,7 @@ void Worker::handle_head(uint32_t slot) {
     if (eng->cfg.plane_depth_max > 0 && depth() >= eng->cfg.plane_depth_max) {
         eng->stats.overload.fetch_add(1, std::memory_order_relaxed);
         shed_row(std::string(), false, "overload");
+        record_capture(*st, c, 429, "overload");
         char msg[160];
         std::snprintf(msg, sizeof(msg),
                       "frontend overloaded: %d plane frames queued (cap %d); "
@@ -917,6 +975,7 @@ void Worker::dispatch_body(uint32_t slot, std::string&& body) {
     auto local_401 = [&](const std::string& msg) {
         eng->stats.local_401.fetch_add(1, std::memory_order_relaxed);
         shed_row(std::string(), false, "unauthenticated");
+        record_capture(*st, c, 401, "unauthenticated");
         reply_text(slot, 401, msg,
                    {{"WWW-Authenticate", kWwwAuth}});
     };
@@ -956,6 +1015,7 @@ void Worker::dispatch_body(uint32_t slot, std::string&& body) {
                 (double)(payload_len / 4) > bit->second.cap) {
                 eng->stats.local_413.fetch_add(1, std::memory_order_relaxed);
                 shed_row(bit->second.tenant, true, "values");
+                record_capture(*st, c, 413, "values");
                 char head[48];
                 std::snprintf(head, sizeof(head), "request of %zu",
                               payload_len / 4);
@@ -1126,6 +1186,11 @@ void Worker::ship_frame(uint32_t slot, Dispatch kind,
     if (!c.trace_id.empty() && st->trace_enabled) {
         meta += "{\"id\": ";
         msk::json_append_str(meta, c.trace_id);
+        if (c.trace_inbound) {
+            // the client presented this ID: the engine-side capture
+            // recorder bypasses sampling for it
+            meta += ", \"in\": 1";
+        }
         char sp[192];
         std::snprintf(sp, sizeof(sp),
                       ", \"spans\": [[\"http.parse\", %.9f, %.9f], "
@@ -1795,6 +1860,8 @@ std::shared_ptr<const PushState> parse_push(const char* json,
     st->trace_enabled = v.get_bool("trace_enabled", false);
     st->trace_sample = v.get_num("trace_sample", 1.0);
     st->slo_armed = v.get_bool("slo_armed", false);
+    st->capture_enabled = v.get_bool("capture_enabled", false);
+    st->capture_sample = v.get_num("capture_sample", 1.0);
     return st;
 }
 
@@ -1936,6 +2003,53 @@ int64_t msk_edge_spans(char* out, int64_t cap) {
         js += nb;
     }
     js += "]";
+    if ((int64_t)js.size() + 1 > cap) return -1;
+    std::memcpy(out, js.data(), js.size() + 1);
+    return (int64_t)js.size();
+}
+
+int64_t msk_edge_captures(char* out, int64_t cap) {
+    std::lock_guard<std::mutex> g(g_api_mu);
+    if (g_engine == nullptr || out == nullptr) return -1;
+    std::deque<CaptureRec> drained;
+    uint64_t dropped = 0;
+    {
+        std::lock_guard<std::mutex> cg(g_engine->cap_mu);
+        drained.swap(g_engine->caps);
+        dropped = g_engine->caps_dropped;
+        g_engine->caps_dropped = 0;
+    }
+    char db[48];
+    std::snprintf(db, sizeof(db), "{\"dropped\": %llu, \"records\": [",
+                  (unsigned long long)dropped);
+    std::string js = db;
+    bool first = true;
+    for (const auto& r : drained) {
+        if (!first) js += ", ";
+        first = false;
+        char tb[48];
+        std::snprintf(tb, sizeof(tb), "{\"t\": %.6f, \"program\": ", r.t);
+        js += tb;
+        if (r.program.empty()) {
+            js += "null";
+        } else {
+            msk::json_append_str(js, r.program);
+        }
+        js += ", \"trace\": ";
+        if (r.trace.empty()) {
+            js += "null";
+        } else {
+            msk::json_append_str(js, r.trace);
+        }
+        char sb[48];
+        std::snprintf(sb, sizeof(sb), ", \"in\": %d, \"status\": %d",
+                      r.trace.empty() ? 0 : 1, r.status);
+        js += sb;
+        js += ", \"reason\": ";
+        msk::json_append_str(js, r.reason);
+        js += "}";
+    }
+    js += "]}";
     if ((int64_t)js.size() + 1 > cap) return -1;
     std::memcpy(out, js.data(), js.size() + 1);
     return (int64_t)js.size();

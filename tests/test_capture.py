@@ -1,0 +1,582 @@
+"""The deterministic traffic-capture + shadow-replay plane
+(runtime/capture.py, tools/replay.py, the registry's ?verify=replay gate).
+
+Covers the recorder's bounded ring under concurrent flood, sampling with
+the inbound-trace bypass, the durable segment format through its manifest
+verifier, byte-for-byte replay of a >=500-request mixed-tenant
+parity-corpus capture on both engines, the loud per-request divergence
+diff on a mutated program, the HTTP verify=replay accept/reject contract
+(including the structured 409 diffs the client surfaces), the admin gate
+on every capture route, and the MISAKA_CAPTURE=0 kill switch.
+"""
+
+import glob
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.client import MisakaClient, MisakaClientError
+from misaka_tpu.runtime import capture
+from misaka_tpu.runtime import edge
+from misaka_tpu.runtime.master import MasterNode, make_http_server
+from misaka_tpu.runtime.registry import ProgramRegistry, ReplayDivergence
+from misaka_tpu.runtime.topology import Topology
+
+SMALL = dict(stack_cap=16, in_cap=16, out_cap=16)
+ADD10 = "IN ACC\nADD 10\nOUT ACC\n"
+ADD20 = "IN ACC\nADD 20\nOUT ACC\n"
+
+CORPUS = os.path.join(os.path.dirname(__file__), "corpus", "parity")
+
+
+@pytest.fixture(autouse=True)
+def _capture_reset():
+    """The recorder is module-global state: every test starts idle with
+    the default knobs and leaves nothing armed behind."""
+    capture.configure()
+    if capture.recording():
+        capture.stop()
+    capture.start()  # start() clears the ring; stop right after so
+    capture.stop()   # every test begins idle AND empty
+    yield
+    if capture.recording():
+        capture.stop()
+    capture.configure()
+
+
+# --- ring discipline ---------------------------------------------------------
+
+
+def test_ring_bounded_under_concurrent_flood():
+    """MISAKA_CAPTURE_MB is a hard ceiling: 8 writer threads flooding
+    2KiB records never push the ring past the budget (sampled live, not
+    just at the end), the oldest records evict, and the survivors keep a
+    contiguous newest-last seq tail."""
+    capture.configure({"MISAKA_CAPTURE_MB": "1", "MISAKA_CAPTURE_SAMPLE": "1.0"})
+    budget = capture.status()["budget_bytes"]
+    assert budget == 1 << 20
+    capture.start()
+    overruns = []
+    payload = b"\x01\x02\x03\x04" * 256  # 1KiB vals + 1KiB resp per record
+
+    def writer(w):
+        for i in range(400):
+            capture.note(
+                "http", program=f"w{w % 2}", trace=None, inbound=False,
+                vals=payload, resp=payload, status=200, tick=i,
+            )
+            if capture.mem_bytes() > budget:
+                overruns.append(capture.mem_bytes())
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = capture.status()
+    assert not overruns, f"ring exceeded budget: {max(overruns)} > {budget}"
+    assert st["ring_bytes"] <= budget
+    assert st["dropped"] > 0, "flood must evict, not grow"
+    assert st["dropped"] + st["records"] == 8 * 400
+    seqs = [r["seq"] for r in capture.records()]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] - seqs[0] == len(seqs) - 1, "retained tail must be contiguous"
+    # eviction is visible to the replay-soundness check
+    assert (capture.dropped_since_anchor("w0")
+            + capture.dropped_since_anchor("w1")) == st["dropped"]
+
+
+def test_sampling_and_trace_id_bypass():
+    """MISAKA_CAPTURE_SAMPLE drops the complement; a request that arrived
+    with an inbound X-Misaka-Trace header bypasses sampling entirely (the
+    operator asked to see exactly that request)."""
+    capture.configure({"MISAKA_CAPTURE_SAMPLE": "0.0"})
+    capture.start()
+    for i in range(50):
+        capture.note("http", program="p", trace=f"t{i}", inbound=False,
+                     vals=b"\0\0\0\0", resp=b"\0\0\0\0", status=200, tick=i)
+    assert capture.status()["records"] == 0
+    assert capture.status()["sampled_out"] == 50
+    capture.note("http", program="p", trace="wanted", inbound=True,
+                 vals=b"\0\0\0\0", resp=b"\0\0\0\0", status=200, tick=0)
+    recs = capture.records()
+    assert [r["trace"] for r in recs] == ["wanted"]
+    assert recs[0]["inbound"] is True
+    # ingest rows (worker/edge rejects) sample the same way
+    capture.ingest("worker", [
+        {"t": time.time(), "program": "p", "trace": None, "in": 0,
+         "status": 429, "reason": "overload"},
+        {"t": time.time(), "program": "p", "trace": "kept", "in": 1,
+         "status": 429, "reason": "overload"},
+    ])
+    traces = [r["trace"] for r in capture.records()]
+    assert "kept" in traces and len(traces) == 2
+
+
+def test_kill_switch_is_terminal():
+    """MISAKA_CAPTURE=0: start() refuses, note() is a no-op, and the
+    hooks' RECORDING flag stays False — the disabled path is one module
+    attribute load."""
+    capture.configure({"MISAKA_CAPTURE": "0"})
+    assert not capture.available()
+    with pytest.raises(capture.CaptureError):
+        capture.start()
+    assert capture.RECORDING is False
+    capture.note("http", program="p", trace=None, inbound=False,
+                 vals=b"", resp=b"", status=200, tick=0)
+    assert capture.status()["records"] == 0
+
+
+# --- the durable segment -----------------------------------------------------
+
+
+def _record_some(n=5):
+    capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0"})
+    capture.start()
+    for i in range(n):
+        vals = np.arange(i + 1, dtype="<i4")
+        capture.note("http", program="p", trace=f"t{i}", inbound=False,
+                     vals=vals.tobytes(), resp=(vals + 10).tobytes(),
+                     status=200, tick=i, op="coalesced")
+    capture.stop()
+
+
+def test_segment_roundtrip_through_manifest_verifier(tmp_path):
+    _record_some()
+    path = str(tmp_path / "seg.mskcap")
+    capture.write_segment(path)
+    header, recs = capture.read_segment(path, verify=True)
+    assert header["records"] == 5 and len(recs) == 5
+    for i, r in enumerate(recs):
+        assert r["trace"] == f"t{i}"
+        assert np.array_equal(np.frombuffer(r["vals"], "<i4"),
+                              np.arange(i + 1))
+        assert np.array_equal(np.frombuffer(r["resp"], "<i4"),
+                              np.arange(i + 1) + 10)
+    manifest = capture.verify_segment(path)
+    assert manifest["records"] == 5 and manifest["sha256"]
+
+
+def test_segment_corruption_detected(tmp_path):
+    """A flipped byte (sha mismatch) and a torn tail (size mismatch) must
+    both refuse loudly before any replay trusts the file."""
+    _record_some()
+    path = str(tmp_path / "seg.mskcap")
+    capture.write_segment(path)
+    blob = open(path, "rb").read()
+    with open(path, "r+b") as f:  # flip one payload byte
+        f.seek(len(blob) - 3)
+        f.write(bytes([blob[-3] ^ 0xFF]))
+    with pytest.raises(capture.CaptureError, match="sha256"):
+        capture.verify_segment(path)
+    with open(path, "wb") as f:  # torn write: manifest size mismatch
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(capture.CaptureError, match="torn|bytes"):
+        capture.verify_segment(path)
+    # no sidecar: the structural frame walk itself is the gate
+    os.unlink(capture._segment_manifest_path(path))
+    with pytest.raises(capture.CaptureError):
+        capture.read_segment(path, verify=True)
+
+
+def test_export_writes_anchor_checkpoints(tmp_path):
+    m = MasterNode(Topology(node_info={"main": "program"},
+                            programs={"main": ADD10}, **SMALL),
+                   chunk_steps=32, batch=2, engine="scan")
+    try:
+        m.run()
+        capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0"})
+        a = capture.anchor_from_master("default", m)
+        capture.start(anchors={"default": a})
+        out = m.compute_coalesced(np.arange(3, dtype=np.int32),
+                                  return_array=True)
+        capture.note("http", program="default", trace="t0", inbound=False,
+                     vals=np.arange(3, dtype="<i4").tobytes(),
+                     resp=np.asarray(out, dtype="<i4").tobytes(),
+                     status=200, tick=0)
+        capture.stop()
+        res = capture.export(str(tmp_path / "cap.mskcap"))
+    finally:
+        m.close()
+    assert res["records"] == 1
+    apath = res["anchors"]["default"]
+    assert os.path.exists(apath) and os.path.exists(apath + ".manifest")
+    header, _ = capture.read_segment(res["path"], verify=True)
+    assert header["anchors"]["default"]["file"] == os.path.basename(apath)
+    assert header["anchors"]["default"]["dropped_since_anchor"] == 0
+
+
+# --- byte-for-byte replay ----------------------------------------------------
+
+# order-preserving (compare == "stream") corpus cases as the mixed-tenant
+# program set; every case is 1:1 input->output so the serving lanes apply
+_CORPUS_TENANTS = ["add2", "kahn_002", "branch_sign"]
+
+
+def _corpus_case(name):
+    with open(os.path.join(CORPUS, f"{name}.json")) as f:
+        return json.load(f)
+
+
+def _corpus_master(case, engine):
+    top = Topology(node_info=case["node_info"], programs=case["programs"],
+                   stack_cap=64, in_cap=32, out_cap=32)
+    return top, MasterNode(top, chunk_steps=64, batch=2, engine=engine)
+
+
+@pytest.mark.parametrize("engine", ["scan", "native"])
+def test_parity_corpus_replay_byte_identical(engine):
+    """The tentpole acceptance pin: >=500 requests of mixed-tenant
+    parity-corpus traffic, captured at sample=1.0, replay byte-for-byte
+    against shadows restored from the anchors — on both engines."""
+    if engine == "native":
+        from misaka_tpu.core import native_serve
+
+        if not native_serve.available():
+            pytest.skip("native interpreter unavailable (no g++)")
+    capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0",
+                       "MISAKA_CAPTURE_MB": "64"})
+    cases = {n: _corpus_case(n) for n in _CORPUS_TENANTS}
+    masters = {}
+    anchors = {}
+    try:
+        for name, case in cases.items():
+            _, m = _corpus_master(case, engine)
+            m.run()
+            masters[name] = m
+            anchors[name] = capture.anchor_from_master(name, m)
+        capture.start(anchors=anchors)
+        rng = np.random.default_rng(17)
+        total = 0
+        ops = ("coalesced", "many")
+        while total < 510:
+            name = _CORPUS_TENANTS[total % len(_CORPUS_TENANTS)]
+            m = masters[name]
+            pool = cases[name]["inputs"]
+            vals = np.array(
+                [pool[int(j)] for j in rng.integers(0, len(pool),
+                                                    rng.integers(1, 5))],
+                dtype=np.int32,
+            )
+            op = ops[total % 2]
+            if op == "many":
+                out = m.compute_many(vals, return_array=True)
+            else:
+                out = m.compute_coalesced(vals, return_array=True)
+            capture.note(
+                "http", program=name, trace=f"t{total:05d}", inbound=False,
+                vals=vals.astype("<i4").tobytes(),
+                resp=np.asarray(out).astype("<i4").tobytes(),
+                status=200, tick=int(m._ticks_done), op=op,
+            )
+            total += 1
+        capture.stop()
+        st = capture.status()
+        assert st["records"] >= 510 and st["dropped"] == 0
+        for name in _CORPUS_TENANTS:
+            recs = capture.replayable(capture.records(program=name))
+            assert len(recs) >= 150
+            _, shadow = _corpus_master(cases[name], engine)
+            try:
+                shadow.restore(anchors[name]["state"])
+                shadow.run()
+                diffs = capture.replay_records(shadow, recs)
+            finally:
+                shadow.close()
+            assert diffs == [], (
+                f"{name}/{engine}: {len(diffs)} divergences; first: "
+                + capture.format_diff(diffs[0])
+            )
+    finally:
+        for m in masters.values():
+            m.close()
+
+
+def test_mutated_program_diverges_loudly():
+    """A semantically-changed candidate must fail replay on every request
+    it answers differently, and the diff names the trace ID, stream
+    offset, and the expected/actual heads."""
+    capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0"})
+    topo10 = Topology(node_info={"main": "program"}, programs={"main": ADD10},
+                      **SMALL)
+    topo20 = Topology(node_info={"main": "program"}, programs={"main": ADD20},
+                      **SMALL)
+    m = MasterNode(topo10, chunk_steps=32, batch=2, engine="scan")
+    try:
+        m.run()
+        anchor = capture.anchor_from_master("p", m)
+        capture.start(anchors={"p": anchor})
+        for i in range(8):
+            vals = np.arange(i + 1, dtype=np.int32)
+            out = m.compute_coalesced(vals, return_array=True)
+            capture.note("http", program="p", trace=f"req-{i}",
+                         inbound=False, vals=vals.astype("<i4").tobytes(),
+                         resp=np.asarray(out).astype("<i4").tobytes(),
+                         status=200, tick=0)
+        capture.stop()
+    finally:
+        m.close()
+    recs = capture.replayable(capture.records(program="p"))
+    shadow = MasterNode(topo20, chunk_steps=32, batch=2, engine="scan")
+    try:
+        shadow.restore(anchor["state"])
+        shadow.run()
+        diffs = capture.replay_records(shadow, recs)
+    finally:
+        shadow.close()
+    assert len(diffs) == 8
+    for off, d in enumerate(diffs):
+        assert d["offset"] == off and d["trace"] == f"req-{off}"
+        assert d["expected_head"][0] + 10 == d["actual_head"][0]
+        line = capture.format_diff(d)
+        assert f"req-{off}" in line and "expected=" in line
+
+    # the same verdict through the registry's publish gate
+    reg = ProgramRegistry(None, batch=2, engine="scan", chunk_steps=32,
+                          caps=SMALL)
+    try:
+        reg.publish("p", tis=ADD10)
+        with pytest.raises(ReplayDivergence) as ei:
+            reg.publish("p", tis=ADD20, verify="replay")
+        assert len(ei.value.diffs) == 8
+        assert ei.value.diffs[0]["trace"] == "req-0"
+    finally:
+        reg.close()
+
+
+def test_verify_bundle_refuses_unsound_replay():
+    """No anchor, no records, or an evicted (non-contiguous) stream each
+    refuse with a typed CaptureError — replay never lies."""
+    capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0"})
+    capture.start()
+    with pytest.raises(capture.CaptureError, match="anchor"):
+        capture.verify_bundle("ghost")
+    capture.stop()
+
+    # eviction since the anchor poisons soundness for that program
+    capture.configure({"MISAKA_CAPTURE_MB": "1",
+                       "MISAKA_CAPTURE_SAMPLE": "1.0"})
+    m = MasterNode(Topology(node_info={"main": "program"},
+                            programs={"main": ADD10}, **SMALL),
+                   chunk_steps=32, batch=2, engine="scan")
+    try:
+        anchor = capture.anchor_from_master("p", m)
+        capture.start(anchors={"p": anchor})
+        blob = b"\0" * 65536
+        for i in range(40):  # 40 * 128KiB >> 1MiB: forced eviction
+            capture.note("http", program="p", trace=None, inbound=False,
+                         vals=blob, resp=blob, status=200, tick=i)
+        assert capture.dropped_since_anchor("p") > 0
+        with pytest.raises(capture.CaptureError, match="evicted"):
+            capture.verify_bundle("p")
+    finally:
+        m.close()
+
+
+# --- the HTTP surface --------------------------------------------------------
+
+
+@pytest.fixture
+def served_registry():
+    capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0"})
+    reg = ProgramRegistry(None, batch=2, engine="scan", chunk_steps=32,
+                          caps=SMALL)
+    top = networks.add2(**SMALL)
+    master = MasterNode(top, chunk_steps=32, batch=2, engine="scan")
+    reg.seed("default", master, top)
+    master.run()
+    httpd = make_http_server(master, port=0, registry=reg)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield master, reg, httpd.server_address[1]
+    finally:
+        httpd.shutdown()
+        reg.close()
+        master.close()
+
+
+def test_http_capture_and_verify_replay(served_registry):
+    """The full wire loop: arm over HTTP, serve traffic, verify=replay
+    accepts the unchanged program and 409s the mutant with structured
+    diffs the client surfaces, export writes the segment + anchors, and
+    /healthz reports the ring under debug_mem."""
+    _, _, port = served_registry
+    c = MisakaClient(f"http://127.0.0.1:{port}")
+    c.upload_program("p", program=ADD10)
+    cp = MisakaClient(f"http://127.0.0.1:{port}", program="p")
+    cp.compute_batch([0])  # lease the engine before anchoring
+
+    st = c.capture_start()
+    assert st["recording"] and "p" in st["anchors"]
+    with pytest.raises(MisakaClientError) as ei:  # double-arm refuses
+        c.capture_start()
+    assert ei.value.status == 409
+    for i in range(6):
+        assert list(cp.compute_batch([i, i + 1])) == [i + 10, i + 11]
+
+    # unchanged semantics: replay-verified publish goes green
+    res = c.replay("p", program=ADD10)
+    assert res["name"] == "p"
+
+    # mutated: 409, typed error, structured diffs, nothing swapped
+    with pytest.raises(MisakaClientError) as ei:
+        c.replay("p", program=ADD20)
+    assert ei.value.status == 409
+    assert len(ei.value.diffs) == 6
+    d = ei.value.diffs[0]
+    assert d["program"] == "p" and d["trace"] and "offset" in d
+    assert [v + 10 for v in d["expected_head"]] == d["actual_head"]
+    assert list(cp.compute_batch([1])) == [11], "mutant must not have swapped"
+
+    # invalid verifier name is a typed 400, not a silent publish
+    with pytest.raises(MisakaClientError) as ei:
+        c.upload_program("p", program=ADD10, verify="nonsense")
+    assert ei.value.status == 400
+
+    dbg = c.capture_status(n=3)
+    assert dbg["recording"] and len(dbg["preview"]) == 3
+    assert dbg["preview"][-1]["program"] == "p"
+    hz = c.healthz()
+    assert hz["debug_mem"]["capture_bytes"] > 0
+    assert hz["debug_mem"]["total_bytes"] >= hz["debug_mem"]["capture_bytes"]
+
+
+def test_http_export_then_offline_tool_replay(served_registry, tmp_path):
+    """POST /captures/export -> tools/replay.py round trip: the exported
+    segment replays green offline, and the tool's --candidate path
+    renders the loud diff and exits 1."""
+    import subprocess
+    import sys
+
+    _, _, port = served_registry
+    c = MisakaClient(f"http://127.0.0.1:{port}")
+    c.upload_program("p", program=ADD10)
+    cp = MisakaClient(f"http://127.0.0.1:{port}", program="p")
+    cp.compute_batch([0])
+    c.capture_start()
+    for i in range(5):
+        cp.compute_batch([i, i + 7])
+    exp = c.capture_export(str(tmp_path / "wire.mskcap"))
+    c.capture_stop()
+    assert exp["records"] >= 5 and "p" in exp["anchors"]
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools", "replay.py")
+    r = subprocess.run(
+        [sys.executable, tool, exp["path"], "--program", "p"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "replay green" in r.stdout
+
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps({"nodes": {"main": "program"},
+                                "programs": {"main": ADD20}}))
+    model = tmp_path / "model.json"
+    r = subprocess.run(
+        [sys.executable, tool, exp["path"], "--program", "p",
+         "--candidate", str(cand), "--emit-model", str(model)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "DIVERGENCE" in r.stdout and "DIVERGED" in r.stdout
+    fitted = json.loads(model.read_text())
+    assert fitted["format"] == 1 and fitted["arrival"]["rate_rps"] > 0
+    assert "p" in fitted["tenants"]
+
+
+KEYS = [
+    {"key": "adm-secret", "tenant": "ops", "admin": True},
+    {"key": "bob-secret", "tenant": "bob"},
+]
+
+
+def test_capture_routes_admin_gated(tmp_path, monkeypatch):
+    """With edge auth armed, every capture route is admin-scope: anon
+    401s, a plain tenant key 403s, the admin key operates the recorder."""
+    kf = tmp_path / "keys.json"
+    kf.write_text(json.dumps({"keys": KEYS}))
+    monkeypatch.setenv("MISAKA_API_KEYS", str(kf))
+    capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0"})
+    m = MasterNode(networks.add2(**SMALL), chunk_steps=32, batch=2,
+                   engine="scan")
+    m.run()
+    httpd = make_http_server(m, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    try:
+        for route in ("/captures/start", "/captures/stop",
+                      "/captures/export"):
+            assert edge.route_policy(route, "POST") == ("auth_admin",)
+        assert edge.route_policy("/debug/captures", "GET") == ("auth_admin",)
+
+        anon = MisakaClient(f"http://127.0.0.1:{port}", api_key="")
+        anon.api_key = None
+        bob = MisakaClient(f"http://127.0.0.1:{port}", api_key="bob-secret")
+        adm = MisakaClient(f"http://127.0.0.1:{port}", api_key="adm-secret")
+        for call in (anon.capture_start, lambda: anon.capture_status(1)):
+            with pytest.raises(MisakaClientError) as ei:
+                call()
+            assert ei.value.status == 401
+        for call in (bob.capture_start, bob.capture_stop,
+                     bob.capture_export, lambda: bob.capture_status(1)):
+            with pytest.raises(MisakaClientError) as ei:
+                call()
+            assert ei.value.status == 403
+        st = adm.capture_start()
+        assert st["recording"]
+        assert adm.capture_status(0)["recording"]
+        adm.capture_stop()
+    finally:
+        edge.reset()
+        httpd.shutdown()
+        m.close()
+
+
+def test_http_kill_switch_409(served_registry):
+    _, _, port = served_registry
+    capture.configure({"MISAKA_CAPTURE": "0"})
+    c = MisakaClient(f"http://127.0.0.1:{port}")
+    with pytest.raises(MisakaClientError) as ei:
+        c.capture_start()
+    assert ei.value.status == 409 and "kill switch" in ei.value.body
+    assert c.healthz()["ok"] is True  # serving is untouched
+
+
+# --- load models -------------------------------------------------------------
+
+
+def test_fit_load_model_shapes():
+    capture.configure({"MISAKA_CAPTURE_SAMPLE": "1.0"})
+    capture.start()
+    t0 = time.time()
+    rng = np.random.default_rng(3)
+    for i in range(60):
+        n = int(rng.integers(1, 30))
+        capture.note(
+            "http", program=("a" if i % 3 else "b"), trace=None,
+            inbound=False, vals=b"\0" * (4 * n), resp=b"\0" * (4 * n),
+            status=200, tick=i, t=t0 + i * 0.01,
+        )
+    capture.stop()
+    model = capture.fit_load_model(capture.records())
+    assert model["format"] == 1
+    assert model["source"]["requests"] == 60
+    assert model["arrival"]["rate_rps"] > 0
+    assert abs(sum(model["tenants"].values()) - 1.0) < 1e-6
+    assert model["tenants"]["a"] > model["tenants"]["b"]
+    assert model["values"]["p50"] >= 1
+    assert sum(w for _, w in model["values"]["hist"]) == 60
+    # TSDB history widens the arrival fit
+    widened = capture.fit_load_model(
+        capture.records(), series=[(t0, 1000.0), (t0 + 60, 1000.0)]
+    )
+    assert widened["arrival"]["rate_rps"] > model["arrival"]["rate_rps"]
+    with pytest.raises(capture.CaptureError):
+        capture.fit_load_model([])

@@ -1,0 +1,240 @@
+"""Shadow-replay a captured traffic segment against a (candidate) program.
+
+The offline half of the capture plane (runtime/capture.py): given a
+``.mskcap`` segment exported by POST /captures/export, rebuild each
+program's engine from its anchor checkpoint, drive the recorded request
+stream through it in recorded order, and compare every response
+byte-for-byte.  Unchanged semantics MUST replay green; any divergence
+renders one loud line per request (trace ID, stream offset, expected vs
+actual head) and the process exits non-zero — the same verdict the
+in-process ``POST /programs?verify=replay`` gate computes, runnable
+against any segment on any machine.
+
+  python tools/replay.py capture.mskcap
+      replay every anchored program against its own recorded topology
+      (the determinism self-check: green or the engine is broken)
+
+  python tools/replay.py capture.mskcap --candidate new.json --program default
+      replay program "default"'s stream against a CANDIDATE topology
+      restored from the old anchor state — the pre-deploy verdict
+
+  python tools/replay.py capture.mskcap --emit-model load.json
+      additionally fit the capture into a bench.py --model load model
+
+Also exposed as ``python -m misaka_tpu replay`` (misaka_tpu/__main__.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+if __name__ == "__main__":  # `python tools/replay.py` — find the repo; the
+    # `python -m misaka_tpu replay` path imports this module and keeps the
+    # caller's platform choice
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _load_anchor(path: str):
+    """Anchor .npz -> (meta dict, NetworkState) after the durability gate.
+
+    Loaded manually (not via MasterNode.load_checkpoint) because a
+    CANDIDATE replay restores the OLD state into a master compiled from
+    a DIFFERENT topology — load_checkpoint would rebuild the recorded one.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from misaka_tpu.core.state import NetworkState
+    from misaka_tpu.runtime.master import verify_checkpoint
+
+    verify_checkpoint(path)
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__topology__"]).decode())
+        fields = {
+            f: jnp.asarray(data[f])
+            for f in NetworkState._fields if f in data
+        }
+        for hi, lo in (("acc_hi", "acc"), ("bak_hi", "bak")):
+            if hi not in fields:  # pre-regs64 anchors were int32-exact
+                fields[hi] = fields[lo] >> 31
+        return meta, NetworkState(**fields)
+
+
+def _topology_from_meta(meta: dict):
+    from misaka_tpu.runtime.topology import Topology
+
+    return Topology(
+        node_info=meta["nodes"],
+        programs=meta["programs"],
+        stack_cap=int(meta["stack_cap"]),
+        in_cap=int(meta["in_cap"]),
+        out_cap=int(meta["out_cap"]),
+    )
+
+
+def _engine_arg(recorded: str | None) -> str:
+    """Anchors record the RESOLVED engine name (e.g. "scan-compact");
+    map it back to a MasterNode constructor value."""
+    if not recorded:
+        return "scan"
+    for base in ("fused-interpret", "fused", "scan", "gather", "native"):
+        if recorded == base or recorded.startswith(base + "-"):
+            return base
+    return "scan"
+
+
+def _resolve_anchor_path(segment: str, info: dict, label: str) -> str:
+    fname = info.get("file") or f"{os.path.basename(segment)}.anchor.{label}.npz"
+    return os.path.join(os.path.dirname(os.path.abspath(segment)), fname)
+
+
+def replay_segment(
+    segment: str,
+    candidate: str | None = None,
+    program: str | None = None,
+    engine: str | None = None,
+    limit: int | None = None,
+    emit_model: str | None = None,
+    out=sys.stdout,
+) -> int:
+    """Drive a segment; returns a process exit code (0 green, 1 diverged,
+    2 unusable segment/anchor)."""
+    from misaka_tpu.runtime import capture
+    from misaka_tpu.runtime.master import MasterNode
+
+    try:
+        header, recs = capture.read_segment(segment, verify=True)
+    except capture.CaptureError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    anchors = header.get("anchors") or {}
+    labels = [program] if program else sorted(anchors)
+    if program and program not in anchors:
+        print(f"error: segment has no anchor for program {program!r} "
+              f"(anchored: {', '.join(sorted(anchors)) or 'none'})",
+              file=sys.stderr)
+        return 2
+    if candidate and len(labels) != 1:
+        print("error: --candidate needs exactly one program "
+              "(pass --program)", file=sys.stderr)
+        return 2
+    if not labels:
+        print("error: segment carries no anchors (was the capture "
+              "started while serving?)", file=sys.stderr)
+        return 2
+
+    candidate_topo = None
+    if candidate:
+        from misaka_tpu.__main__ import _load_topology
+
+        candidate_topo = _load_topology(candidate)
+
+    rc = 0
+    for label in labels:
+        info = anchors[label]
+        lost = int(info.get("dropped_since_anchor") or 0)
+        if lost:
+            print(f"{label}: UNSOUND — the ring evicted {lost} records "
+                  "since the anchor; a replay of this segment cannot "
+                  "prove anything (raise MISAKA_CAPTURE_MB)",
+                  file=sys.stderr)
+            rc = max(rc, 2)
+            continue
+        apath = _resolve_anchor_path(segment, info, label)
+        try:
+            meta, state = _load_anchor(apath)
+        except Exception as e:
+            print(f"{label}: error: anchor {apath}: {e}", file=sys.stderr)
+            rc = max(rc, 2)
+            continue
+        if candidate_topo is not None:
+            # the candidate inherits the anchor's capacities, exactly as a
+            # registry hot-swap inherits the running registry's — caps
+            # shape the state arrays, so a cap change can never restore
+            from misaka_tpu.runtime.topology import Topology
+
+            topo = Topology(
+                node_info=dict(candidate_topo.node_info),
+                programs=dict(candidate_topo.programs),
+                stack_cap=int(meta["stack_cap"]),
+                in_cap=int(meta["in_cap"]),
+                out_cap=int(meta["out_cap"]),
+            )
+        else:
+            topo = _topology_from_meta(meta)
+        sel = capture.replayable([r for r in recs if r["program"] == label])
+        if limit is not None:
+            sel = sel[-limit:]
+        if not sel:
+            print(f"{label}: no replayable records in segment", file=out)
+            continue
+        master = MasterNode(
+            topo,
+            batch=meta.get("batch"),
+            engine=engine or _engine_arg(info.get("engine")),
+        )
+        try:
+            try:
+                master.restore(state)
+            except ValueError as e:
+                print(f"{label}: DIVERGENCE — candidate cannot restore "
+                      f"the capture anchor: {e}", file=out)
+                rc = max(rc, 1)
+                continue
+            master.run()
+            diffs = capture.replay_records(master, sel)
+        finally:
+            master.close()
+        if diffs:
+            for d in diffs:
+                print(capture.format_diff(d), file=out)
+            print(f"{label}: DIVERGED on {len(diffs)}/{len(sel)} "
+                  "captured requests", file=out)
+            rc = max(rc, 1)
+        else:
+            print(f"{label}: replay green — {len(sel)} requests "
+                  "byte-for-byte identical", file=out)
+
+    if emit_model:
+        from misaka_tpu.runtime import capture as _c
+
+        try:
+            model = _c.fit_load_model(recs)
+        except _c.CaptureError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return max(rc, 2)
+        with open(emit_model, "w") as f:
+            json.dump(model, f, indent=2)
+            f.write("\n")
+        print(f"load model written to {emit_model} "
+              f"(rate={model['arrival']['rate_rps']} rps, "
+              f"p50 n={model['values']['p50']})", file=out)
+    return rc
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("segment", help=".mskcap segment from /captures/export")
+    p.add_argument("--candidate", help="candidate topology (baseline name, "
+                   ".json, or compose .yml) to replay against")
+    p.add_argument("--program", help="replay only this program label")
+    p.add_argument("--engine", help="engine override (scan/native/...)")
+    p.add_argument("--limit", type=int, help="replay only the last N records")
+    p.add_argument("--emit-model", metavar="OUT.json",
+                   help="also fit a bench.py --model load model")
+    args = p.parse_args(argv)
+    return replay_segment(
+        args.segment, candidate=args.candidate, program=args.program,
+        engine=args.engine, limit=args.limit, emit_model=args.emit_model,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

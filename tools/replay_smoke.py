@@ -1,0 +1,143 @@
+"""`make replay-smoke`: the capture -> export -> shadow-replay loop as an
+out-of-pytest tripwire (~15s, CPU-forced).
+
+Boots a registry-armed engine server, arms the wire recorder over HTTP,
+serves mixed traffic to two programs, then asserts the whole record
+plane end to end:
+
+  1. POST /captures/export writes a manifest-verified segment + anchors
+  2. `python tools/replay.py <segment>` replays every program green
+     (byte-for-byte) and exits 0
+  3. the same segment against an ADD20 mutant renders the loud
+     per-request DIVERGENCE lines and exits 1
+  4. POST /programs?verify=replay admits the unchanged program and 409s
+     the mutant with structured diffs (nothing swapped)
+  5. --emit-model fits a bench.py --model load model from the capture
+
+The same assertions run inside tier-1 (tests/test_capture.py); this
+target drives the real subprocess tool entry points.
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("MISAKA_CAPTURE_SAMPLE", "1.0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ADD10 = "IN ACC\nADD 10\nOUT ACC\n"
+ADD20 = "IN ACC\nADD 20\nOUT ACC\n"
+SMALL = dict(stack_cap=16, in_cap=16, out_cap=16)
+
+
+def main() -> int:
+    from misaka_tpu import networks
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+    from misaka_tpu.runtime import capture
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+    from misaka_tpu.runtime.registry import ProgramRegistry
+
+    capture.configure()
+    reg = ProgramRegistry(None, batch=2, engine="scan", chunk_steps=32,
+                          caps=SMALL)
+    top = networks.add2(**SMALL)
+    master = MasterNode(top, chunk_steps=32, batch=2, engine="scan")
+    reg.seed("default", master, top)
+    master.run()
+    httpd = make_http_server(master, port=0, registry=reg)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    tmp = tempfile.mkdtemp(prefix="replay_smoke_")
+
+    try:
+        c = MisakaClient(base)
+        c.upload_program("p", program=ADD10)
+        cp = MisakaClient(base, program="p")
+        cp.compute_batch([0])  # lease the engine before anchoring
+
+        st = c.capture_start()
+        assert st["recording"] and "p" in st["anchors"], st
+        for i in range(12):
+            got = list(cp.compute_batch([i, i + 1]))
+            assert got == [i + 10, i + 11], got
+        for i in range(4):
+            c.compute_batch([i])
+
+        # --- 1. export: manifest-verified segment + anchor checkpoints
+        exp = c.capture_export(os.path.join(tmp, "wire.mskcap"))
+        assert exp["records"] >= 16 and "p" in exp["anchors"], exp
+        capture.verify_segment(exp["path"])
+        print(f"export OK: {exp['records']} records -> {exp['path']}")
+
+        env = {**os.environ}
+        tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "replay.py")
+
+        # --- 2. offline replay of the unchanged programs: green, rc 0
+        r = subprocess.run([sys.executable, tool, exp["path"]],
+                           capture_output=True, text=True, timeout=300,
+                           env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("replay green") == 2, r.stdout
+        print("baseline replay OK (both programs byte-for-byte green)")
+
+        # --- 3. mutant candidate: loud per-request diff, rc 1
+        cand = os.path.join(tmp, "cand.json")
+        with open(cand, "w") as f:
+            json.dump({"nodes": {"main": "program"},
+                       "programs": {"main": ADD20}}, f)
+        model = os.path.join(tmp, "model.json")
+        r = subprocess.run(
+            [sys.executable, tool, exp["path"], "--program", "p",
+             "--candidate", cand, "--emit-model", model],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "DIVERGENCE" in r.stdout and "trace=" in r.stdout, r.stdout
+        assert "DIVERGED on 12/12" in r.stdout, r.stdout
+        print("mutant replay OK (12/12 loud divergences, exit 1)")
+
+        # --- 4. the ?verify=replay hot-swap gate
+        res = c.replay("p", program=ADD10)
+        assert res["name"] == "p", res
+        try:
+            c.replay("p", program=ADD20)
+            raise AssertionError("mutant publish must refuse")
+        except MisakaClientError as e:
+            assert e.status == 409 and len(e.diffs) == 12, (
+                e.status, len(e.diffs))
+        got = list(cp.compute_batch([5]))
+        assert got == [15], f"mutant swapped in: {got}"
+        print("verify=replay OK (green admitted, mutant 409 with diffs)")
+
+        # --- 5. the capture-fitted load model
+        with open(model) as f:
+            fitted = json.load(f)
+        assert fitted["format"] == 1 and fitted["arrival"]["rate_rps"] > 0
+        assert "p" in fitted["tenants"], fitted["tenants"]
+        print(f"load model OK (rate={fitted['arrival']['rate_rps']} rps, "
+              f"tenants={sorted(fitted['tenants'])})")
+        print("replay smoke OK")
+        return 0
+    finally:
+        try:
+            if capture.recording():
+                capture.stop()
+            httpd.shutdown()
+            reg.close()
+            master.close()
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,9 +28,17 @@ kills (torn request lines, half-shipped bodies, oversized 413s), and
 supervisor close/recreate cycles — the connection-teardown and
 engine-restart races only a sanitizer build can veto.
 
+The r20 `--lane capture` variant targets the edge's wire-capture ring:
+epoll workers appending locally-terminated rejects (401/413) under
+cap_mu race a CPython drainer swap-draining through msk_edge_captures,
+while the engine-side recorder toggles on/off (push-state swaps
+re-parsing capture_enabled/capture_sample mid-traffic) and the
+supervisor restart-cycles with rows still queued.
+
 Usage (or `make sanitize-smoke` / `make sanitize-all`):
     python tools/sanitize_stress.py --sanitizer address [--seconds 6]
     python tools/sanitize_stress.py --sanitizer address --lane edge
+    python tools/sanitize_stress.py --sanitizer address --lane capture
 """
 
 from __future__ import annotations
@@ -158,7 +166,7 @@ def reexec_under_sanitizer(kind: str, args) -> int:
     # The specialized build stays pool-lane-only — the edge never loads
     # a per-program .so.
     frontend_so = (build_sanitized_frontend_so(kind)
-                   if args.lane == "edge" else None)
+                   if args.lane in ("edge", "capture") else None)
     spec_so = build_sanitized_spec_so(kind) if args.lane == "pool" else None
     _, runtime, _, env_var, env_val = _SAN[kind]
     cxx = os.environ.get("CXX", "g++")
@@ -736,11 +744,226 @@ def run_edge_scenario(args) -> int:
     return 0
 
 
+def run_capture_scenario(args) -> int:
+    """The r20 capture lane: the C++ edge's wire-capture ring under
+    sanitizer fire.  Every reject the edge terminates locally
+    (401/413/shed) appends a CaptureRec under cap_mu from an epoll
+    worker thread while a CPython drainer swap-drains the deque through
+    msk_edge_captures — this lane races those writers against an
+    aggressive drain loop, the engine-side recorder toggling on/off
+    (push-state swaps re-parsing capture_enabled/capture_sample
+    mid-traffic), and full supervisor restart cycles with rows still
+    queued in the ring.  Inbound X-Misaka-Trace requests pin the
+    sampling-bypass path; a 0.5 sample rate keeps the xorshift sampling
+    branch hot too."""
+    import http.client
+    import json as _json
+    import random
+    import struct
+    import tempfile
+
+    import numpy as np
+
+    assert os.environ.get("MISAKA_FRONTEND_SO"), "child needs the override"
+
+    tmp = tempfile.mkdtemp(prefix="msk-san-capture-")
+    keyfile = os.path.join(tmp, "keys.json")
+    with open(keyfile, "w") as f:
+        _json.dump({"keys": [
+            {"key": "adm-secret", "tenant": "ops", "admin": True},
+            {"key": "tiny-secret", "tenant": "tiny", "quota": "vps<4"},
+        ]}, f)
+    os.environ["MISAKA_API_KEYS"] = keyfile
+    os.environ["MISAKA_MAX_BODY"] = "65536"
+    os.environ["MISAKA_CAPTURE_SAMPLE"] = "0.5"
+
+    from misaka_tpu.runtime import capture as capture_mod
+    from misaka_tpu.runtime import edge
+    from misaka_tpu.runtime import frontends
+
+    if not frontends._FRONTEND_LIB.available():
+        print("sanitize: instrumented frontend failed to load",
+              file=sys.stderr)
+        return 1
+    edge.install(edge.from_env())
+    capture_mod.configure()
+    capture_mod.start()
+
+    class _StubMaster:
+        """Same jax-free numpy twin as the edge lane (see there for why
+        the real engine stays out of a sanitizer child)."""
+        is_running = True
+
+        def compute_coalesced(self, values, timeout=None,
+                              return_array=True, traces=()):
+            return np.asarray(values, np.int32) + 2
+
+    plane_path = os.path.join(tmp, "plane.sock")
+    plane = frontends.start_compute_plane(_StubMaster(), plane_path)
+
+    def new_sup():
+        return frontends.NativeFrontendSupervisor(
+            port=0, proxy_port=1, plane_path=plane_path,
+            threads=2, plane_conns=1,
+        )
+
+    box = {"sup": new_sup()}
+    stop = threading.Event()
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+    stats = {"requests": 0, "local_401": 0, "local_413": 0, "inbound": 0,
+             "drains": 0, "ring_rows": 0, "toggles": 0, "cycles": 0,
+             "conn_losses": 0}
+
+    def bump(k, n=1):
+        with lock:
+            stats[k] += n
+
+    def reject_loop(seed: int):
+        # Every burst lands three locally-terminated rejects in the C++
+        # capture ring — a sampled keyless 401, a sampled over-quota 413,
+        # and a traced 401 that MUST bypass sampling — plus a plane 200
+        # to keep the serving path interleaved with the recording path.
+        rng = random.Random(seed)
+        try:
+            while not stop.is_set():
+                port = box["sup"].port
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=10)
+                    for _ in range(8):
+                        if stop.is_set():
+                            break
+                        n = rng.randrange(1, 4)
+                        body = struct.pack(
+                            f"<{n}i", *(rng.randrange(1000) for _ in range(n)))
+                        conn.request("POST", "/compute_raw", body=body,
+                                     headers={"X-Misaka-Key": "adm-secret"})
+                        r = conn.getresponse()
+                        r.read()
+                        if r.status != 200:
+                            raise AssertionError(f"compute_raw {r.status}")
+                        bump("requests")
+                        conn.request("POST", "/compute_raw", body=body)
+                        r = conn.getresponse()
+                        r.read()
+                        if r.status != 401:
+                            raise AssertionError(f"keyless got {r.status}")
+                        bump("local_401")
+                        big = struct.pack("<12i", *range(12))
+                        conn.request("POST", "/compute_raw", body=big,
+                                     headers={"X-Misaka-Key": "tiny-secret"})
+                        r = conn.getresponse()
+                        r.read()
+                        if r.status != 413:
+                            raise AssertionError(f"burst got {r.status}")
+                        bump("local_413")
+                        trace = f"{rng.getrandbits(64):016x}"
+                        conn.request("POST", "/compute_raw", body=body,
+                                     headers={"X-Misaka-Trace": trace})
+                        r = conn.getresponse()
+                        r.read()
+                        if r.status != 401:
+                            raise AssertionError(f"traced got {r.status}")
+                        bump("inbound")
+                    conn.close()
+                except (OSError, http.client.HTTPException):
+                    bump("conn_losses")
+                    time.sleep(0.02)
+        except BaseException as e:  # noqa: BLE001 — surfaced at exit
+            errors.append(e)
+            stop.set()
+
+    def drain_loop():
+        # The read half of the race: swap-drain the C++ deque through
+        # msk_edge_captures into the engine-side ring, against both the
+        # epoll writers and the watcher thread's own periodic drain.  A
+        # stale supervisor losing the restart race degrades typed.
+        last = 0
+        nonlocal_last = [last]
+        try:
+            while not stop.is_set():
+                sup = box["sup"]
+                try:
+                    sup._drain_captures()
+                    bump("drains")
+                except Exception:
+                    bump("conn_losses")
+                cur = capture_mod.status()["records"]
+                if cur > nonlocal_last[0]:
+                    bump("ring_rows", cur - nonlocal_last[0])
+                nonlocal_last[0] = cur
+                time.sleep(0.005)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=reject_loop, args=(i,))
+               for i in range(3)]
+    threads.append(threading.Thread(target=drain_loop))
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + args.seconds
+    try:
+        flip = 0
+        while time.monotonic() < deadline and not stop.is_set():
+            time.sleep(0.9)
+            flip += 1
+            # recorder toggle under fire: the push-state swap re-parses
+            # capture_enabled/capture_sample while workers are mid-
+            # record_capture on the previous state generation
+            if capture_mod.recording():
+                capture_mod.stop()
+            else:
+                capture_mod.start()
+            bump("toggles")
+            try:
+                box["sup"]._push(force=True)
+            except Exception:
+                bump("conn_losses")
+            if flip % 2 == 0:
+                # restart cycle with rows still queued in the C++ ring
+                box["sup"].close()
+                box["sup"] = new_sup()
+                bump("cycles")
+    except BaseException as e:  # noqa: BLE001 — recreate failed
+        errors.append(e)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        box["sup"].close()
+        plane.close()
+        if capture_mod.recording():
+            capture_mod.stop()
+    if errors:
+        print(f"sanitize[capture]: scenario error: {errors[0]!r}",
+              file=sys.stderr)
+        return 1
+    if not (stats["requests"] and stats["local_401"] and stats["local_413"]
+            and stats["inbound"] and stats["drains"] and stats["ring_rows"]
+            and stats["toggles"] and stats["cycles"]):
+        print(f"sanitize[capture]: scenario did not exercise the races: "
+              f"{stats}", file=sys.stderr)
+        return 1
+    print(f"# sanitize[{os.environ.get('MISAKA_SANITIZE_CHILD')}/capture] "
+          f"green: {stats['requests']} plane 200s, "
+          f"{stats['local_401']}+{stats['local_413']} sampled rejects / "
+          f"{stats['inbound']} sampling-bypass traced rejects, "
+          f"{stats['drains']} ring drains -> {stats['ring_rows']} rows "
+          f"ingested, {stats['toggles']} recorder toggles, "
+          f"{stats['cycles']} supervisor restart cycles "
+          f"({stats['conn_losses']} typed connection losses)",
+          file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sanitizer", default="address",
                     choices=sorted(_SAN))
-    ap.add_argument("--lane", default="pool", choices=("pool", "edge"))
+    ap.add_argument("--lane", default="pool",
+                    choices=("pool", "edge", "capture"))
     ap.add_argument("--seconds", type=float, default=6.0)
     ap.add_argument("--replicas", type=int, default=64)
     ap.add_argument("--pool-threads", type=int, default=8)
@@ -749,6 +972,8 @@ def main() -> int:
     if os.environ.get("MISAKA_SANITIZE_CHILD"):
         if args.lane == "edge":
             return run_edge_scenario(args)
+        if args.lane == "capture":
+            return run_capture_scenario(args)
         return run_scenario(args)
     return reexec_under_sanitizer(args.sanitizer, args)
 
