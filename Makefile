@@ -66,15 +66,19 @@ native/libmisaka_frontend.ubsan.so: $(FRONTEND_UNITS)
 	$(CXX) $(SAN_CXXFLAGS) -fsanitize=undefined -fno-sanitize-recover=all \
 		native/frontend.cpp -o $@
 
-# Short ASan lanes (~20s): the CI tripwire for native memory bugs —
-# the interpreter pool scenario plus the r19 edge lane (instrumented
+# Short ASan lanes (~30s): the CI tripwire for native memory bugs —
+# the interpreter pool scenario, the r19 edge lane (instrumented
 # frontend.cpp under keep-alive hammering, mid-flight kills and
-# supervisor restart cycles).
+# supervisor restart cycles), and the r21 jit lane (copy-and-patch
+# splice/patch/W^X churn racing arm/disarm/eviction on a hot pool).
 sanitize-smoke: native-asan
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer address --seconds 6
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer address --lane edge \
+		--seconds 6
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer address --lane jit \
 		--seconds 6
 
 # All three instruments, longer scenario (~2min) — the pre-merge lane
@@ -86,14 +90,23 @@ sanitize-all: native-asan native-tsan native-ubsan
 		python tools/sanitize_stress.py --sanitizer address --lane edge \
 		--seconds 15
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer address --lane jit \
+		--seconds 15
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer thread --seconds 15
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer thread --lane edge \
 		--seconds 15
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer thread --lane jit \
+		--seconds 15
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer undefined --seconds 15
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/sanitize_stress.py --sanitizer undefined --lane edge \
+		--seconds 15
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/sanitize_stress.py --sanitizer undefined --lane jit \
 		--seconds 15
 
 # Project static analysis (misaka_tpu/lint): the repo's recurring bug

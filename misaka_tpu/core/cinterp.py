@@ -108,8 +108,22 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.misaka_pool_serve_resident.restype = ctypes.c_int
     lib.misaka_pool_serve_resident.argtypes = [
         ctypes.c_void_p, _I32P, _I32P, ctypes.c_int, _I32P, ctypes.c_int,
-        _I32P, _U8P,
+        _I32P, _U8P, ctypes.c_int,
     ]
+    # copy-and-patch JIT rung (r21).  Absent from pre-r21 builds loaded
+    # via MISAKA_INTERP_SO (sanitizer lanes): the ladder then tops out at
+    # switch-threaded — jit_arm() reports rc -9 and the caller falls back.
+    try:
+        _VPP = ctypes.POINTER(ctypes.c_void_p)
+        lib.misaka_pool_jit_arm.restype = ctypes.c_int
+        lib.misaka_pool_jit_arm.argtypes = [
+            ctypes.c_void_p, _VPP, _VPP, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.misaka_pool_jit_disarm.restype = None
+        lib.misaka_pool_jit_disarm.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        pass
     # flight recorder (r18)
     lib.misaka_pool_trace_info.restype = None
     lib.misaka_pool_trace_info.argtypes = [ctypes.c_void_p, _I64P]
@@ -479,6 +493,13 @@ class NativePool:
         # stay outside the lock: only the device loop calls them, and the
         # engine quiesces before close by construction.
         self._ctr_lock = threading.Lock()
+        # pack-row elision buffers (serve_resident(reuse_out=True)) and
+        # the armed JIT program (kept alive: the C++ side holds raw
+        # pointers into its executable buffer until disarm/close)
+        self._packed_serve = None
+        self._packed_idle = None
+        self._progress_buf = None
+        self._jit_prog = None
         _C_CREATED.labels(kind="pool").inc()
 
     def close(self) -> None:
@@ -486,6 +507,7 @@ class NativePool:
             if self._h:
                 self._lib.misaka_pool_destroy(self._h)
                 self._h = None
+                self._jit_prog = None  # exec buffer may now be unmapped
                 _C_CLOSED.labels(kind="pool").inc()
 
     def __del__(self):
@@ -513,15 +535,44 @@ class NativePool:
     def simd_info(self) -> dict:
         """The pool's execution mode: {"width": replicas per SIMD group
         (0 = scalar per-replica path), "avx2": AVX2 instantiation selected,
-        "specialized": per-program baked tick functions engaged}."""
-        out = np.zeros((3,), np.int32)
+        "specialized": per-program baked tick functions engaged, "jit":
+        copy-and-patch fragment tables armed (r21)}."""
+        out = np.zeros((4,), np.int32)
         with self._ctr_lock:
             self._lib.misaka_pool_simd_info(self._handle(), _as_i32p(out))
         return {
             "width": int(out[0]),
             "avx2": bool(out[1]),
             "specialized": bool(out[2]),
+            "jit": bool(out[3]),
         }
+
+    def jit_arm(self, prog) -> int:
+        """Arm the copy-and-patch JIT rung (r21) with a core/jit.py
+        JitProgram.  Returns the C rc: 0 armed (the pool now dispatches
+        group ticks through the spliced fragments and this pool keeps the
+        program's executable buffer alive), nonzero = pool unchanged, the
+        caller serves one rung down (-1 ABI drift, -2 scalar pool, -3
+        shape mismatch, -4 bad tables, -9 pre-r21 native library)."""
+        fn = getattr(self._lib, "misaka_pool_jit_arm", None)
+        if fn is None:
+            return -9
+        with self._ctr_lock:
+            rc = int(fn(self._handle(), prog.tab1, prog.tab2,
+                        int(prog.n_lanes), int(prog.max_len),
+                        int(prog.abi)))
+        if rc == 0:
+            self._jit_prog = prog
+        return rc
+
+    def jit_disarm(self) -> None:
+        """Drop back to the switch-threaded / generic tick and release
+        the pool's hold on the JIT program's executable buffer."""
+        fn = getattr(self._lib, "misaka_pool_jit_disarm", None)
+        with self._ctr_lock:
+            if fn is not None and self._h:
+                fn(self._h)
+            self._jit_prog = None
 
     def counters(self) -> dict:
         """Pool busy/idle nanosecond counters (the usage-accounting plane):
@@ -530,7 +581,7 @@ class NativePool:
         fast path run on the calling thread.  Lock-free on the C++ side
         (safe concurrently with serve/idle); _ctr_lock only fences the
         read against a concurrent close() freeing the Pool."""
-        out = np.zeros((3,), np.int64)
+        out = np.zeros((5,), np.int64)
         with self._ctr_lock:
             self._lib.misaka_pool_counters(
                 self._handle(),
@@ -548,6 +599,10 @@ class NativePool:
             # checks read instead of re-deriving busy + serial
             "caller_inline_ns": int(out[2]),
             "work_ns": int(out[0]) + int(out[2]),
+            # pack-row elision (r21): quiescent rows whose write into a
+            # REUSED packed buffer was skipped vs actually written
+            "elided_rows": int(out[3]),
+            "skip_packed_rows": int(out[4]),
         }
 
     def thread_counters(self) -> tuple[np.ndarray, np.ndarray]:
@@ -574,6 +629,10 @@ class NativePool:
     TRACE_RUNGS = {
         0: "scalar", 1: "generic", 2: "avx2",
         5: "spec-generic", 6: "spec-avx2",
+        # bit 3 = copy-and-patch JIT armed (r21); in practice the JIT
+        # rides the generic lib (the spec switch tick outranks it inside
+        # a specialized .so), so 9/10 are the live values
+        9: "jit", 10: "jit-avx2", 13: "spec-jit", 14: "spec-avx2-jit",
     }
     TRACE_SHAPES = {0: "group", 1: "scalar", 2: "masked"}
     _TRACE_STAT_KEYS = (
@@ -629,7 +688,7 @@ class NativePool:
         C++ side): dispenser wait ns by phase, wake/dispatch/serve call
         counters, last dispatch wait + unit imbalance, caller-inline
         units, dropped records, and replicas ticked per (rung, shape)."""
-        out = np.zeros((12 + 32,), np.int64)
+        out = np.zeros((12 + 64,), np.int64)
         i64p = ctypes.POINTER(ctypes.c_int64)
         with self._ctr_lock:
             self._lib.misaka_pool_trace_stats(
@@ -637,7 +696,7 @@ class NativePool:
             )
         d = {k: int(out[i]) for i, k in enumerate(self._TRACE_STAT_KEYS)}
         reps = {}
-        for rung in range(8):
+        for rung in range(16):
             for shape in range(4):
                 v = int(out[12 + rung * 4 + shape])
                 if v:
@@ -767,22 +826,49 @@ class NativePool:
                 return False
             return bool(self._lib.misaka_pool_is_resident(self._h))
 
-    def serve_resident(self, values, counts, ticks: int, active=None):
+    def serve_resident(self, values, counts, ticks: int, active=None,
+                       reuse_out: bool = False):
         """One serve (counts given) or idle (counts None) pass on the
         RESIDENT state: no import, no export, no Python-side state dict at
         all.  Returns (packed, progress) — packed has EVERY row filled
         (skipped rows carry their current counters plus the
         drained-on-serve contract), progress[b]=1 when replica b retired
-        an instruction this call (the device loop's hot-set signal)."""
+        an instruction this call (the device loop's hot-set signal).
+
+        `reuse_out=True` enables pack-row elision (r21): the pool keeps
+        one packed/progress buffer pair per pass kind and hands the SAME
+        arrays back every call, telling the C++ side their contents are
+        its own previous output — quiescent replicas' rows are then
+        skipped entirely instead of re-filled, removing the B-proportional
+        light-fill cost on sparse batches.  The caller must treat the
+        returned arrays as read-only and must not hold a row across the
+        next call (copy what survives the iteration)."""
         B = self.replicas
         feeding = counts is not None
+        reuse = 0
         if feeding:
             values = _checked_i32("values", values, (B, self.in_cap))
             counts = _checked_i32("counts", counts, (B,))
-            packed = np.empty((B, 4 + self.out_cap), np.int32)
+            if reuse_out:
+                packed = self._packed_serve
+                if packed is None:
+                    packed = np.empty((B, 4 + self.out_cap), np.int32)
+                    self._packed_serve = packed
+                else:
+                    reuse = 1
+            else:
+                packed = np.empty((B, 4 + self.out_cap), np.int32)
             vp, cp = _as_i32p(values), _as_i32p(counts)
         else:
-            packed = np.empty((B, 4), np.int32)
+            if reuse_out:
+                packed = self._packed_idle
+                if packed is None:
+                    packed = np.empty((B, 4), np.int32)
+                    self._packed_idle = packed
+                else:
+                    reuse = 1
+            else:
+                packed = np.empty((B, 4), np.int32)
             vp = cp = None
         ap, n_active = None, 0
         if active is not None:
@@ -806,10 +892,16 @@ class NativePool:
                         "(a skipped feed would silently drop values)"
                     )
             ap, n_active = _as_i32p(active), int(active.size)
-        progress = np.empty((B,), np.uint8)
+        if reuse_out:
+            progress = self._progress_buf
+            if progress is None:
+                progress = np.empty((B,), np.uint8)
+                self._progress_buf = progress
+        else:
+            progress = np.empty((B,), np.uint8)
         rc = self._lib.misaka_pool_serve_resident(
             self._handle(), vp, cp, int(ticks), ap, n_active,
-            _as_i32p(packed), progress.ctypes.data_as(_U8P),
+            _as_i32p(packed), progress.ctypes.data_as(_U8P), reuse,
         )
         if rc == -2:
             raise RuntimeError("native pool feed exceeded ring free space")

@@ -43,6 +43,7 @@ import weakref
 import numpy as np
 
 from misaka_tpu.core import cinterp
+from misaka_tpu.core import jit
 from misaka_tpu.core import specialize
 from misaka_tpu.core.state import NetworkState
 from misaka_tpu.runtime import usage
@@ -146,6 +147,37 @@ _G_SPECIALIZED = metrics.gauge(
     "misaka_native_specialized_active",
     "Live native pools executing per-program specialized tick functions",
 )
+_G_JIT_ACTIVE = metrics.gauge(
+    "misaka_native_jit_active",
+    "Live native pools dispatching group ticks through copy-and-patch "
+    "JIT fragment tables (r21; the splice/arm outcome counter is "
+    "misaka_native_jit_total in core/jit.py)",
+)
+# Pack-row elision (r21): quiescent replicas whose packed-row write was
+# skipped because the caller's reused buffer already held the current row
+# vs rows actually (re)written down the skip path.  elided / (elided +
+# written) is the sparse-fill win ratio.
+_C_ELIDED_ROWS = metrics.counter(
+    "misaka_native_elided_rows_total",
+    "Quiescent pack rows elided on resident serves (row write skipped: "
+    "the reused packed buffer was already current)",
+)
+_C_SKIP_PACKED_ROWS = metrics.counter(
+    "misaka_native_skip_packed_rows_total",
+    "Quiescent pack rows written down the skipped-replica path (the "
+    "rows elision did NOT cover)",
+)
+# Satellite: first-class per-rung tick counter (previously rung share was
+# only derivable from flight-recorder exemplars).  Replica-ticks executed
+# per ladder rung — sum across shapes of the recorder's reps aggregate —
+# so JIT coverage is one PromQL query:
+#   sum by (rung) (rate(misaka_native_tick_rung_total[5m]))
+_C_TICK_RUNG = metrics.counter(
+    "misaka_native_tick_rung_total",
+    "Replica-ticks executed per native-ladder rung (scalar / generic / "
+    "avx2 / spec-* / jit*)",
+    ("rung",),
+)
 
 
 def _simd_width() -> float:
@@ -170,8 +202,20 @@ def _specialized_active() -> float:
     return float(count)
 
 
+def _jit_active() -> float:
+    count = 0
+    for p in _live_pools():
+        try:
+            if p.simd_info().get("jit"):
+                count += 1
+        except Exception:
+            continue
+    return float(count)
+
+
 _G_SIMD_WIDTH.set_function(_simd_width)
 _G_SPECIALIZED.set_function(_specialized_active)
+_G_JIT_ACTIVE.set_function(_jit_active)
 
 _G_POOL_BUSY = metrics.gauge(
     "misaka_native_pool_busy_fraction",
@@ -799,7 +843,7 @@ class NativeServePool:
     is_native = True
 
     def __init__(self, net, chunk_steps: int = 128, threads: int | None = None,
-                 specialized: str | None = None):
+                 specialized: str | None = None, jit_program=None):
         if net.batch is None:
             raise ValueError("NativeServePool serves a batched network "
                              "(use NativeServe for batch=None)")
@@ -829,6 +873,23 @@ class NativeServePool:
             # wrong, SIMD off, or batch below the group width): count it
             # so a silent always-generic fleet is visible on /metrics
             specialize.M_SPECIALIZE.labels(status="fallback").inc()
+        # Copy-and-patch JIT rung (r21): `jit_program` is a core/jit.py
+        # JitProgram spliced for this net.  Arm failure falls back ONE
+        # rung (the pool keeps serving switch-threaded / generic) with a
+        # logged reason and a counted outcome — never an error.
+        if jit_program is not None:
+            try:
+                rc = self._pool.jit_arm(jit_program)
+            except Exception as e:  # noqa: BLE001 - total fallback
+                rc = -8
+                logging.getLogger("misaka.jit").warning(
+                    "jit: arm raised (%s); serving one rung down", e)
+            if rc == 0:
+                jit.M_JIT.labels(status="armed").inc()
+            else:
+                jit.M_JIT.labels(status="error").inc()
+                logging.getLogger("misaka.jit").warning(
+                    "jit: arm refused (rc %d); serving one rung down", rc)
         self.threads = self._pool.threads
         self._chunk = int(chunk_steps)
         self._replicas = net.batch
@@ -857,8 +918,11 @@ class NativeServePool:
         # AFTER construction); direct constructions bill "default".
         self.usage_label = lambda: usage.DEFAULT_LABEL
         # busy-ns watermark for take_busy_ns deltas (device-loop thread
-        # only — one serializing caller per pool by construction)
+        # only — one serializing caller per pool by construction), plus
+        # the elision-counter watermarks riding the same read (r21)
         self._busy_mark = 0
+        self._elided_mark = 0
+        self._skip_packed_mark = 0
         # Flight-recorder plumbing (r18): per-call (start, end, trace_ids)
         # windows correlate ring events with the request traces the pass
         # served (MasterNode rebinds active_trace_ids like usage_label);
@@ -869,6 +933,11 @@ class NativeServePool:
         self._trace_marks: dict | None = None
         self._trace_last_pull = 0.0
         self._trace_pull_lock = threading.Lock()
+        # prime the watermark with the pool's zero snapshot: the FIRST
+        # real pull then reports deltas instead of discarding everything
+        # ticked before it (a short-lived pool was invisible to the
+        # per-rung counters otherwise)
+        self._pull_trace_stats(force=True)
         with _pool_refs_lock:
             _pool_refs.append(weakref.ref(self))
 
@@ -885,9 +954,18 @@ class NativeServePool:
         time): the MEASURED native cost of the call(s) in between, which
         the device loop attributes to its program.  Device-loop thread
         only — one serializing caller per pool by construction."""
-        busy = self._pool.counters()["work_ns"]
+        c = self._pool.counters()
+        busy = c["work_ns"]
         delta = busy - self._busy_mark
         self._busy_mark = busy
+        # pack-row elision deltas ride the same counters read (r21)
+        el, sk = c.get("elided_rows", 0), c.get("skip_packed_rows", 0)
+        if el > self._elided_mark:
+            _C_ELIDED_ROWS.inc(el - self._elided_mark)
+            self._elided_mark = el
+        if sk > self._skip_packed_mark:
+            _C_SKIP_PACKED_ROWS.inc(sk - self._skip_packed_mark)
+            self._skip_packed_mark = sk
         return max(0, delta)
 
     def _account_native(self) -> None:
@@ -950,6 +1028,10 @@ class NativeServePool:
             if dv > 0:
                 rung, shape = key
                 _C_UNITS.labels(rung=rung, shape=shape).inc(dv)
+                # first-class per-rung tick counter (r21): the same reps
+                # aggregate summed across shapes, so ladder coverage is
+                # one PromQL query instead of an exemplar join
+                _C_TICK_RUNG.labels(rung=rung).inc(dv)
 
     def _note_trace_call(self, t0: float, t1: float) -> None:
         """Per-serve-call recorder bookkeeping: the correlation window
@@ -1028,7 +1110,13 @@ class NativeServePool:
                 return None
             _C_RES_MISS.inc()
             _res_events["miss"] += 1
-        return pool.serve_resident(values, counts, ticks, active=active)
+        # reuse_out: the pool hands back the same packed/progress buffers
+        # every call, enabling quiescent pack-row elision (r21).  The
+        # device loop's consumption pattern is compatible: it re-reads
+        # `packed` after every call and copies what survives the
+        # iteration (drain_from_snapshot fancy-indexes into new arrays).
+        return pool.serve_resident(values, counts, ticks, active=active,
+                                   reuse_out=True)
 
     def _stateless_input(self, state):
         """(trusted, d_in) for the stateless ladder.  If residency is

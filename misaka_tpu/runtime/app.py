@@ -11,6 +11,10 @@ so the master's env surface is what survives:
                    {"nodes": ..., "programs": ...} — or a reference-style
                    docker-compose .yml, imported directly (runtime/compose.py)
   MISAKA_PORT      HTTP port (default 8000 = clientPort, master.go:19)
+  MISAKA_PIDFILE   pidfile path for external supervisors; default is
+                   <tmpdir>/misaka-app-<pid>.pid — never the CWD, so a
+                   server booted from a source checkout leaves the tree
+                   clean.  "0"/"off" disables the file.  Removed at exit.
   MISAKA_HTTP_WORKERS  N > 0 starts the multi-process serving plane
                    (runtime/frontends.py): N frontend worker processes
                    share MISAKA_PORT via SO_REUSEPORT, coalesce their
@@ -402,10 +406,12 @@ Run: python -m misaka_tpu.runtime.app
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
 import sys
+import tempfile
 
 if __name__ == "__main__":
     # Provisional boot-window handlers, armed BEFORE the multi-second jax
@@ -459,6 +465,42 @@ def build_topology_from_env(environ=os.environ) -> Topology:
     return Topology.from_node_info_json(node_info, programs, **caps)
 
 
+def _write_pidfile(environ=os.environ) -> str | None:
+    """Drop this server's pidfile for external supervisors.
+
+    The path is MISAKA_PIDFILE when set ("0"/"off" disables the file
+    entirely); the default lives under the system run/tmp dir, never the
+    CWD — a server started from a source checkout must not litter the
+    tree (`git status` stays clean after a local boot).  Best-effort:
+    an unwritable path logs and serves on.
+    """
+    spec = environ.get("MISAKA_PIDFILE", "")
+    if spec in ("0", "off"):
+        return None
+    path = spec or os.path.join(
+        tempfile.gettempdir(), f"misaka-app-{os.getpid()}.pid"
+    )
+    try:
+        with open(path, "w") as f:
+            f.write(f"{os.getpid()}\n")
+    except OSError as e:
+        logging.getLogger("misaka_tpu.app").warning(
+            "pidfile %s unwritable (%s); serving without one", path, e
+        )
+        return None
+
+    def _rm(p=path):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+    # atexit (not the serve loop's finally) so BOTH serve paths and the
+    # KeyboardInterrupt -> sys.exit(0) route all clean up the file.
+    atexit.register(_rm)
+    return path
+
+
 def _serve_http(
     master,
     environ=os.environ,
@@ -468,6 +510,9 @@ def _serve_http(
 ) -> None:
     port = int(environ.get("MISAKA_PORT", "8000"))
     log_ = logging.getLogger("misaka_tpu.app")
+    pidfile = _write_pidfile(environ)
+    if pidfile:
+        log_.info("pidfile %s", pidfile)
     workers = int(environ.get("MISAKA_HTTP_WORKERS", "0") or 0)
     # The synthetic canary (runtime/canary.py) probes the PUBLIC surface
     # from inside this process; with API-key auth armed it needs a key,

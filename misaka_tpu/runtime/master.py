@@ -1303,18 +1303,33 @@ class MasterNode:
             if self._batch is None:
                 runner = NativeServe(net)
             else:
-                # Per-program specialized tick functions: compile-once per
-                # content hash (cached on disk), graceful fallback to the
-                # generic interpreter on ANY failure.  Only worth it when
-                # at least one full SIMD group exists (kGroupW = 8).
+                # The native tick ladder, top rung first (r21): try the
+                # copy-and-patch JIT splice (stencil library compiled once
+                # per toolchain version, per-program activation is pure
+                # splice/patch — no g++ on the hot path), then per-program
+                # specialized tick functions (compile-once per content
+                # hash, cached on disk).  Every rung falls back gracefully
+                # on ANY failure, and both are only worth it when at least
+                # one full SIMD group exists (kGroupW = 8).  The same
+                # cache-dir gate keeps direct constructions (tests,
+                # library use) from surprising their caller with a g++
+                # run.
                 spec_so = None
-                if (self._native_spec_dir is not None
-                        and self._batch >= 8 and specialize.enabled()):
-                    spec_so = specialize.build(
-                        net, cache_dir=self._native_spec_dir
-                    )
+                jit_prog = None
+                if self._native_spec_dir is not None and self._batch >= 8:
+                    from misaka_tpu.core import jit as jit_mod
+
+                    if jit_mod.enabled() and jit_mod.supported():
+                        jit_prog = jit_mod.prepare(
+                            net, cache_dir=self._native_spec_dir
+                        )
+                    if jit_prog is None and specialize.enabled():
+                        spec_so = specialize.build(
+                            net, cache_dir=self._native_spec_dir
+                        )
                 runner = NativeServePool(
-                    net, chunk_steps=self._chunk, specialized=spec_so
+                    net, chunk_steps=self._chunk, specialized=spec_so,
+                    jit_program=jit_prog,
                 )
             # usage attribution: the runner bills its measured native time
             # to THIS master's program.  Read through a weakref at call

@@ -50,6 +50,9 @@ PANELS = (
      "max", ""),
     ("SIMD lane width", "misaka_native_simd_lane_width", "max", ""),
     ("Specialized engines", "misaka_native_specialized_active", "max", ""),
+    ("JIT engines", "misaka_native_jit_active", "max", ""),
+    ("Elided pack rows (/s)", "misaka_native_elided_rows_total", "sum",
+     "/s"),
     ("Plane shm frames (/s)", "misaka_plane_shm_frames_total", "sum", "/s"),
     ("Replicas alive", "misaka_fleet_replicas_alive", "min", ""),
     ("Canary success", "misaka_canary_success", "min", ""),

@@ -109,6 +109,7 @@ POINTS = frozenset({
     "specialize_fail",
     "edge_native_build",
     "resident_fallback",
+    "jit_fail",
 })
 
 # Points that accept a ":<qualifier>" suffix scoping the fault to one

@@ -572,6 +572,53 @@ SimdMode simd_mode_from_env() {
   return SIMD_GENERIC;
 }
 
+// --- copy-and-patch JIT tick tier (raw speed phase 4) ----------------------
+// core/jit.py compiles native/stencils.cpp ONCE (content-keyed in the spec
+// cache), parses the relocation table out of the .o, and splices + patches
+// per-(lane, pc) machine-code fragments into an executable buffer.  The
+// pool is handed two flat [n_lanes * max_len] tables of fragment entry
+// points (pass 1 = fetch/phase-A/source-resolution, pass 2 =
+// arbitration/commit) via misaka_pool_jit_arm; group ticks then dispatch
+// through baked code instead of the switch-threaded / generic template
+// tick.  MisakaJitCtx is the fragment ABI: raw pointers into one Group's
+// planes plus the in-flight tick's stack scratch.  The struct is
+// DUPLICATED in native/stencils.cpp on purpose (a shared header would
+// dodge the src-hash staleness keying, which only covers this file);
+// MISAKA_JIT_ABI is checked at arm time so a drifted pair falls back one
+// rung instead of corrupting.
+#define MISAKA_JIT_ABI 1
+
+struct MisakaJitCtx {
+  int64_t* acc;            // [n_lanes * W]
+  int64_t* bak;            // [n_lanes * W]
+  int32_t* pc;             // [n_lanes * W]
+  int32_t* hold_val;       // [n_lanes * W]
+  int32_t* retired;        // [n_lanes * W]
+  uint8_t* holding;        // [n_lanes * W]
+  int32_t* port_val;       // [n_lanes * kPorts * W]
+  uint8_t* port_full;      // [n_lanes * kPorts * W]
+  int32_t* stack_mem;      // [W][num_stacks][stack_cap]
+  int32_t* in_buf;         // [W][in_cap]
+  int32_t* in_rd;          // [W]
+  int64_t* s_src_val;      // [n_lanes * W]
+  uint8_t* s_src_ok;       // [n_lanes * W]
+  uint8_t* s_deliv_full;   // [n_lanes * kPorts * W]
+  int32_t* s_deliv_val;    // [n_lanes * kPorts * W]
+  int32_t* s_begin_top;    // [num_stacks * W]
+  uint8_t* s_stack_taken;  // [num_stacks * W]
+  uint8_t* s_pushed;       // [num_stacks * W]
+  int32_t* s_push_val;     // [num_stacks * W]
+  uint8_t* moved;          // [W]
+  uint8_t* io_in_avail;    // [W]
+  uint8_t* io_out_free;    // [W]
+  uint8_t* io_in_taken;    // [W]
+  uint8_t* io_out_taken;   // [W]
+  int32_t* io_in_win;      // [W]
+  int32_t* io_out_value;   // [W]
+};
+
+using MisakaJitFn = void (*)(MisakaJitCtx*, uint64_t);
+
 // One pool serve/idle job (batch-major state arrays, see misaka_pool_serve).
 struct Job {
   int32_t *acc = nullptr, *bak = nullptr, *pc = nullptr, *port_val = nullptr;
@@ -598,6 +645,10 @@ struct Job {
   // instruction during the call — the device loop's hot-set signal, which
   // the stateless path derives from the exported `retired` plane.
   uint8_t* progress = nullptr;
+  // Pack-row elision (resident path): nonzero when the caller is reusing
+  // the SAME packed buffer as the previous call of this kind, so rows of
+  // quiescent replicas that are already current in it may be skipped.
+  int reuse = 0;
 };
 
 // SoA scratch for one group of kGroupW replicas.  Pure scratch: state lives
@@ -626,6 +677,12 @@ struct Group {
   std::vector<int32_t> out_buf;                // [W][out_cap]
   int32_t in_rd[kGroupW], in_wr[kGroupW], out_rd[kGroupW], out_wr[kGroupW];
   int32_t tick_count[kGroupW];
+
+  // Spliced JIT fragment tables ([n_lanes][max_len] per pass), owned by
+  // the pool; null until misaka_pool_jit_arm.  When set, group_tick_for
+  // dispatches group_tick_jit instead of the template/switch tick.
+  const MisakaJitFn* jit1 = nullptr;
+  const MisakaJitFn* jit2 = nullptr;
 
   // per-tick scratch: cached instruction pointers + decoded op plane
   // (fetch hoists the pc chase out of the phase loops; the remaining
@@ -982,6 +1039,54 @@ MISAKA_AI bool group_tick(Group& g, const uint8_t* mask) {
   return tick_epilogue<S, kMasked>(g, io, moved, mask);
 }
 
+// JIT group tick: the same three-pass superstep with every (lane, pc)
+// instruction dispatched through its spliced machine-code fragment —
+// fetch/decode, field reads, pc successors and arbitration indices are
+// all baked into the code (native/stencils.cpp).  Pass 2 dispatches on
+// the CURRENT pc (stable until its own fragment commits), exactly like
+// the switch-threaded tick.  Masked-out replicas are skipped in BOTH
+// passes: pass 1 for them only writes scratch that pass 2 (also skipped)
+// would read, and phase-A port consumption must not happen — so skipping
+// is bit-identical to the template tick's mask handling.
+template <class S, bool kMasked>
+MISAKA_AI bool group_tick_jit(Group& g, const uint8_t* mask) {
+  constexpr int W = kGroupW;
+  const int n = S::n_lanes(g);
+  const int ml = S::max_len(g);
+  uint8_t moved[W];
+  std::memset(moved, 0, sizeof(moved));
+  TickIO io;
+  MisakaJitCtx ctx = {
+      g.acc.data(),          g.bak.data(),      g.pc.data(),
+      g.hold_val.data(),     g.retired.data(),  g.holding.data(),
+      g.port_val.data(),     g.port_full.data(), g.stack_mem.data(),
+      g.in_buf.data(),       g.in_rd,           g.s_src_val.data(),
+      g.s_src_ok.data(),     g.s_deliv_full.data(), g.s_deliv_val.data(),
+      g.s_begin_top.data(),  g.s_stack_taken.data(), g.s_pushed.data(),
+      g.s_push_val.data(),   moved,             io.in_avail,
+      io.out_free,           io.in_taken,       io.out_taken,
+      io.in_win,             io.out_value,
+  };
+  for (int l = 0; l < n; ++l) {
+    const MisakaJitFn* lane = g.jit1 + (size_t)l * ml;
+    const int32_t* pc = g.pc.data() + (size_t)l * W;
+    for (int r = 0; r < W; ++r) {
+      if (kMasked && !mask[r]) continue;
+      lane[pc[r]](&ctx, (uint64_t)r);
+    }
+  }
+  tick_prologue<S>(g, io);
+  for (int l = 0; l < n; ++l) {
+    const MisakaJitFn* lane = g.jit2 + (size_t)l * ml;
+    const int32_t* pc = g.pc.data() + (size_t)l * W;
+    for (int r = 0; r < W; ++r) {
+      if (kMasked && !mask[r]) continue;
+      lane[pc[r]](&ctx, (uint64_t)r);
+    }
+  }
+  return tick_epilogue<S, kMasked>(g, io, moved, mask);
+}
+
 // Switch-threaded specialized tick (core/specialize.py, header part 2):
 // the generated second section of the spec header defines
 // misaka_spec_tick<kMasked>(Group&, const uint8_t*) — the SAME three-pass
@@ -1004,6 +1109,7 @@ MISAKA_AI bool group_tick_for(Group& g, const uint8_t* mask) {
 #if defined(MISAKA_SPEC) && defined(MISAKA_SPEC_SWITCH)
   if constexpr (S::is_spec) return misaka_spec_tick<kMasked>(g, mask);
 #endif
+  if (g.jit1 != nullptr) return group_tick_jit<S, kMasked>(g, mask);
   return group_tick<S, kMasked>(g, mask);
 }
 
@@ -1495,8 +1601,8 @@ constexpr int kTraceRecWords = 4;  // [t0_ns, dur_ns, kind, arg]
 // per-unit rung/shape tags (TEV_UNIT arg + the tr_reps aggregate index)
 enum { TSHAPE_GROUP = 0, TSHAPE_SCALAR = 1, TSHAPE_MASKED = 2 };
 enum { TRUNG_SCALAR = 0, TRUNG_GENERIC = 1, TRUNG_AVX2 = 2,
-       TRUNG_SPEC_BIT = 4 };
-constexpr int kTraceRungs = 8;   // rung in [0, 8): bit 2 = specialized
+       TRUNG_SPEC_BIT = 4, TRUNG_JIT_BIT = 8 };
+constexpr int kTraceRungs = 16;  // bit 2 = specialized, bit 3 = jit
 constexpr int kTraceShapes = 4;  // shape in [0, 3], one spare
 
 struct Pool {
@@ -1556,7 +1662,42 @@ struct Pool {
   int group_cover = 0;             // replicas resident in res_groups
   std::vector<Group*> res_groups;  // built lazily at first import
   std::vector<uint8_t> res_mask;   // [B] active-mask scratch
-  std::vector<int32_t> res_skipped;  // fully-skipped resident replicas
+  // Fully-skipped resident replicas, as [start, start+count) runs: for a
+  // sparse active set the skipped rows are a handful of long contiguous
+  // ranges, and the elision pass scans each run's dirty bytes with
+  // memchr instead of a per-row loop.
+  std::vector<std::pair<int32_t, int32_t>> res_skipped;
+
+  // --- copy-and-patch JIT (r21) ---
+  // Fragment tables copied from the caller at arm time (the exec buffer
+  // they point into is owned Python-side and outlives the armed window by
+  // caller contract; arm/disarm only run between serve calls).
+  bool jit_armed = false;
+  std::vector<MisakaJitFn> jit_tab1, jit_tab2;
+
+  void apply_jit(Group* g) const {
+    g->jit1 = jit_armed ? jit_tab1.data() : nullptr;
+    g->jit2 = jit_armed ? jit_tab2.data() : nullptr;
+  }
+
+  // --- pack-row elision (r21) ---
+  // dirty flag per (replica, row kind): 0 means the caller's REUSED
+  // packed buffer already holds this replica's current counters row (and,
+  // for the serve kind, that its out ring was empty when written — a row
+  // holding undrained outputs must not be served twice).  pack_skipped
+  // elides the write for clean rows; anything that advances a replica —
+  // a resident unit running it, a drain, a state import — re-dirties it.
+  // Workers touch disjoint replica slots, so plain bytes suffice.
+  bool elide_on = true;  // MISAKA_PACK_ELIDE=0 kills
+  std::vector<uint8_t> pack_dirty_serve, pack_dirty_idle;
+  int64_t call_elided = 0, call_skip_packed = 0;  // caller-thread scratch
+  std::atomic<int64_t> elided_rows{0}, skip_packed_rows{0};
+
+  void mark_all_dirty() {
+    if (pack_dirty_serve.empty()) return;
+    std::memset(pack_dirty_serve.data(), 1, pack_dirty_serve.size());
+    std::memset(pack_dirty_idle.data(), 1, pack_dirty_idle.size());
+  }
 
   // Per-thread busy/idle nanosecond counters (the usage-accounting plane,
   // misaka_tpu/runtime/usage.py): `busy` accumulates time a worker spends
@@ -1610,6 +1751,7 @@ struct Pool {
   int group_rung() const {
     int rung = simd_mode == SIMD_AVX2 ? TRUNG_AVX2 : TRUNG_GENERIC;
     if (specialized) rung |= TRUNG_SPEC_BIT;
+    if (jit_armed) rung |= TRUNG_JIT_BIT;
     return rung;
   }
 
@@ -1704,18 +1846,32 @@ struct Pool {
           const int gi = u.idx + k;
           rep_rc[gi * kGroupW] =
               resident_fn(*res_groups[gi], job, gi * kGroupW, nullptr);
+          mark_unit_dirty(gi * kGroupW, kGroupW);
         }
         break;
       case U_RES_MASKED:
         rep_rc[u.idx * kGroupW] =
             resident_fn(*res_groups[u.idx], job, u.idx * kGroupW,
                         res_mask.data() + (size_t)u.idx * kGroupW);
+        mark_unit_dirty(u.idx * kGroupW, kGroupW);
         break;
       case U_RES_SCALAR:
         for (int k = 0; k < u.count; ++k)
           rep_rc[u.idx + k] = serve_replica_resident(u.idx + k);
+        mark_unit_dirty(u.idx, u.count);
         break;
     }
+  }
+
+  // A resident unit wrote fresh pack rows for [rep0, rep0+count) and may
+  // have advanced/drained them: the cached rows of BOTH kinds are stale
+  // until pack_skipped rewrites them on a later call.  Conservative (an
+  // active replica's row is rewritten next call anyway); each rep slot is
+  // written by exactly one worker, disjoint from the caller's skipped set.
+  void mark_unit_dirty(int rep0, int count) {
+    if (pack_dirty_serve.empty()) return;
+    std::memset(pack_dirty_serve.data() + rep0, 1, (size_t)count);
+    std::memset(pack_dirty_idle.data() + rep0, 1, (size_t)count);
   }
 
   void run_units(int slot) {
@@ -1912,6 +2068,18 @@ struct Pool {
   void pack_skipped(int rep) {
     const Job& j = job;
     const int ocap = replicas[0]->out_cap;
+    uint8_t* dirty =
+        (j.feeding ? pack_dirty_serve : pack_dirty_idle).data();
+    // Elision fast path: the caller is reusing the previous call's packed
+    // buffer and this quiescent replica's row in it is still current (and
+    // output-free for the serve kind) — skip the counter reads AND the
+    // row write entirely.  This is the B-proportional light-fill cost of
+    // sparse-fill serving.
+    if (j.reuse != 0 && !dirty[rep]) {
+      ++call_elided;
+      if (j.progress != nullptr) j.progress[rep] = 0;
+      return;
+    }
     int32_t c[4];
     const int32_t* out_src = nullptr;
     if (rep < group_cover) {
@@ -1941,9 +2109,53 @@ struct Pool {
     row[1] = c[1];
     row[2] = c[2];
     row[3] = c[3];
-    if (out_src != nullptr)
+    if (out_src != nullptr) {
       std::memcpy(row + 4, out_src, (size_t)ocap * 4);
+      // The row carries a pre-drain snapshot the caller consumes once;
+      // replaying it from cache would double-serve the outputs, and the
+      // drain advanced out_rd under the OTHER kind's cached row too.
+      pack_dirty_serve[rep] = 1;
+      pack_dirty_idle[rep] = 1;
+    } else {
+      dirty[rep] = 0;
+    }
+    ++call_skip_packed;
     if (j.progress != nullptr) j.progress[rep] = 0;
+  }
+
+  // The caller's whole skipped-row pass.  Under a reused buffer the
+  // sparse steady state is a long clean run, and a clean row needs
+  // NOTHING — its cached packed row is current and its progress entry
+  // is already 0 (every clean row's last writer wrote 0; an active unit
+  // re-dirties the row before it can record progress) — so the pass
+  // degenerates to a dirty-byte scan with zero per-row stores.
+  void pack_skipped_all() {
+    if (job.reuse != 0) {
+      const uint8_t* dirty =
+          (job.feeding ? pack_dirty_serve : pack_dirty_idle).data();
+      int64_t clean = 0;
+      for (const auto& run : res_skipped) {
+        int r = run.first;
+        const int end = run.first + run.second;
+        while (r < end) {
+          const uint8_t* hit =
+              (const uint8_t*)std::memchr(dirty + r, 1, (size_t)(end - r));
+          if (hit == nullptr) {
+            clean += end - r;
+            break;
+          }
+          const int d = (int)(hit - dirty);
+          clean += d - r;
+          pack_skipped(d);
+          r = d + 1;
+        }
+      }
+      call_elided += clean;
+      return;
+    }
+    for (const auto& run : res_skipped)
+      for (int r = run.first; r < run.first + run.second; ++r)
+        pack_skipped(r);
   }
 
   // Unit-size policy (the adaptive half of the dispenser): ~4 units per
@@ -1967,6 +2179,7 @@ struct Pool {
   // pool under MISAKA_SIMD=0) goes per-replica through the scalar Interp.
   void build_units() {
     units.clear();
+    res_units_valid = false;  // the resident cache's list is clobbered
     const int B = (int)replicas.size();
     const bool grouped = group_fn != nullptr;
     if (job.active == nullptr) {
@@ -2001,9 +2214,29 @@ struct Pool {
   // active replica becomes a unit (masked when partially active); fully
   // skipped replicas go on res_skipped for the caller to pack while the
   // workers tick.
+  //
+  // The build is pure in (B, active list), and steady sparse serving
+  // repeats the same hot set call after call — so the previous call's
+  // units/res_skipped/res_mask are reused verbatim when the list
+  // matches (the r21 elision profile showed the O(B) mask + skip-list
+  // rebuild costing as much as the pack pass it feeds).  Single
+  // serializing caller; build_units() invalidates on a stateless pass.
+  std::vector<int32_t> res_units_key;
+  bool res_units_valid = false, res_units_full = false;
+
   void build_units_resident() {
+    const bool full = job.active == nullptr;
+    if (res_units_valid && full == res_units_full &&
+        (full || ((int)res_units_key.size() == job.n_active &&
+                  std::memcmp(res_units_key.data(), job.active,
+                              (size_t)job.n_active * sizeof(int32_t)) == 0)))
+      return;
     units.clear();
     res_skipped.clear();
+    res_units_valid = true;
+    res_units_full = full;
+    if (full) res_units_key.clear();
+    else res_units_key.assign(job.active, job.active + job.n_active);
     const int B = (int)replicas.size();
     const int ng = group_cover / kGroupW;
     if (job.active == nullptr) {
@@ -2017,6 +2250,13 @@ struct Pool {
     }
     res_mask.assign(B, 0);
     for (int i = 0; i < job.n_active; ++i) res_mask[job.active[i]] = 1;
+    auto skip = [this](int rep0, int count) {
+      if (!res_skipped.empty() &&
+          res_skipped.back().first + res_skipped.back().second == rep0)
+        res_skipped.back().second += count;  // extend the adjacent run
+      else
+        res_skipped.push_back({rep0, count});
+    };
     for (int g = 0; g < ng; ++g) {
       int cnt = 0;
       for (int r = 0; r < kGroupW; ++r) cnt += res_mask[g * kGroupW + r];
@@ -2025,13 +2265,12 @@ struct Pool {
       } else if (cnt > 0) {
         units.push_back({U_RES_MASKED, g, 1});
       } else {
-        for (int r = 0; r < kGroupW; ++r)
-          res_skipped.push_back(g * kGroupW + r);
+        skip(g * kGroupW, kGroupW);
       }
     }
     for (int r = group_cover; r < B; ++r) {
       if (res_mask[r]) units.push_back({U_RES_SCALAR, r, 1});
-      else res_skipped.push_back(r);
+      else skip(r, 1);
     }
   }
 
@@ -2104,24 +2343,42 @@ struct Pool {
     const int n = job.active ? job.n_active : (int)replicas.size();
     const int64_t t_call = tracing() ? now_ns() : 0;
     const int64_t fflag = (job.feeding ? 1 : 0) | 2;  // resident
+    if (!elide_on) job.reuse = 0;
+    if (job.reuse == 0 && !pack_dirty_serve.empty()) {
+      // Fresh caller buffer for this kind: every row must be written once
+      // before its cached copy can be trusted.
+      std::memset((job.feeding ? pack_dirty_serve : pack_dirty_idle).data(),
+                  1, pack_dirty_serve.size());
+    }
+    call_elided = 0;
+    call_skip_packed = 0;
     build_units_resident();
     rep_rc.assign(replicas.size(), 0);
     const int caller = (int)workers.size();
     if (n <= 4 || units.size() <= 1 || workers.size() <= 1) {
       const int64_t t_work = now_ns();
       for (const Unit& u : units) serve_unit(u, caller);
-      for (int rep : res_skipped) pack_skipped(rep);
+      pack_skipped_all();
       serial_busy_ns.fetch_add(now_ns() - t_work, std::memory_order_relaxed);
+      flush_elision();
       return finish_serve(lowest_rc(), t_call, n, fflag | 4);
     }
     publish_job();
     const int64_t t_help = now_ns();
-    for (int rep : res_skipped) pack_skipped(rep);
+    pack_skipped_all();
     run_units(caller);
     serial_busy_ns.fetch_add(now_ns() - t_help, std::memory_order_relaxed);
     if (t_call != 0) wait_done_traced();
     else wait_done();
+    flush_elision();
     return finish_serve(lowest_rc(), t_call, n, fflag);
+  }
+
+  void flush_elision() {
+    if (call_elided)
+      elided_rows.fetch_add(call_elided, std::memory_order_relaxed);
+    if (call_skip_packed)
+      skip_packed_rows.fetch_add(call_skip_packed, std::memory_order_relaxed);
   }
 
   // Arm residency from the job's batch-major state arrays.  Per-group
@@ -2132,15 +2389,18 @@ struct Pool {
   int import_state() {
     const int B = (int)replicas.size();
     resident = false;
+    mark_all_dirty();  // cached pack rows describe the replaced state
     if (resident_fn != nullptr && group_cover > 0 && res_groups.empty()) {
       const int ng = group_cover / kGroupW;
       res_groups.reserve(ng);
-      for (int g = 0; g < ng; ++g)
+      for (int g = 0; g < ng; ++g) {
         res_groups.push_back(new Group(
             replicas[0]->code.data(), replicas[0]->prog_len.data(),
             replicas[0]->n_lanes, replicas[0]->max_len,
             replicas[0]->num_stacks, replicas[0]->stack_cap,
             replicas[0]->in_cap, replicas[0]->out_cap));
+        apply_jit(res_groups.back());
+      }
     }
     for (int g = 0; g < group_cover / kGroupW; ++g)
       if (group_import_checked(*res_groups[g], job, g * kGroupW) != 0)
@@ -2375,6 +2635,12 @@ void* misaka_pool_create(const int32_t* code, const int32_t* prog_len,
   p->resident_fn =
       p->group_fn != nullptr ? pick_resident_fn(p->simd_mode, p->specialized)
                              : nullptr;
+  // pack-row elision dirty ledger (everything dirty until first written)
+  const char* el = std::getenv("MISAKA_PACK_ELIDE");
+  p->elide_on = el == nullptr ||
+                (std::strcmp(el, "0") != 0 && std::strcmp(el, "off") != 0);
+  p->pack_dirty_serve.assign(n_replicas, 1);
+  p->pack_dirty_idle.assign(n_replicas, 1);
   // Flight recorder (r18): rings allocated BEFORE the workers exist so a
   // worker never observes a half-built recorder.  MISAKA_NATIVE_TRACE=0
   // skips the allocation entirely (trace_set then has nothing to arm).
@@ -2404,12 +2670,57 @@ void* misaka_pool_create(const int32_t* code, const int32_t* prog_len,
 // width (kGroupW when the group path is armed, 0 when the pool runs the
 // scalar per-replica path), out[1] = 1 when the AVX2 instantiation is
 // selected (0 = the generic fallback from the same template), out[2] = 1
-// when the pool executes per-program specialized tick functions.
-void misaka_pool_simd_info(void* h, int32_t* out /*[3]*/) {
+// when the pool executes per-program specialized tick functions, out[3] =
+// 1 when the copy-and-patch JIT fragment tables are armed.
+void misaka_pool_simd_info(void* h, int32_t* out /*[4]*/) {
   auto* p = (Pool*)h;
   out[0] = p->simd_mode == SIMD_OFF ? 0 : kGroupW;
   out[1] = p->simd_mode == SIMD_AVX2 ? 1 : 0;
   out[2] = p->specialized ? 1 : 0;
+  out[3] = p->jit_armed ? 1 : 0;
+}
+
+// Arm the copy-and-patch JIT: tab1/tab2 are flat [n_lanes * max_len]
+// tables of spliced fragment entry points (pass 1 / pass 2) pointing into
+// an executable buffer the CALLER owns and must keep alive until disarm
+// or pool destruction.  Caller contract: only between serve calls (same
+// as import/discard).  Returns 0 on success; any nonzero rc means the
+// pool is unchanged and the caller falls back one rung: -1 ABI version
+// mismatch (stencils.cpp and this file drifted), -2 no group path armed
+// (scalar pools have nothing to hook), -3 table shape mismatch, -4 null
+// tables or a null fragment entry.
+int misaka_pool_jit_arm(void* h, const void* const* tab1,
+                        const void* const* tab2, int n_lanes, int max_len,
+                        int abi) {
+  auto* p = (Pool*)h;
+  if (abi != MISAKA_JIT_ABI) return -1;
+  if (p->group_fn == nullptr) return -2;
+  Interp* it = p->replicas[0];
+  if (n_lanes != it->n_lanes || max_len != it->max_len) return -3;
+  if (tab1 == nullptr || tab2 == nullptr) return -4;
+  const size_t n = (size_t)n_lanes * max_len;
+  for (size_t i = 0; i < n; ++i)
+    if (tab1[i] == nullptr || tab2[i] == nullptr) return -4;
+  p->jit_tab1.resize(n);
+  p->jit_tab2.resize(n);
+  for (size_t i = 0; i < n; ++i) {
+    p->jit_tab1[i] = (MisakaJitFn)tab1[i];
+    p->jit_tab2[i] = (MisakaJitFn)tab2[i];
+  }
+  p->jit_armed = true;
+  for (Group* g : p->scratch_groups) p->apply_jit(g);
+  for (Group* g : p->res_groups) p->apply_jit(g);
+  return 0;
+}
+
+// Disarm the JIT (the caller may then release the executable buffer).
+void misaka_pool_jit_disarm(void* h) {
+  auto* p = (Pool*)h;
+  p->jit_armed = false;
+  for (Group* g : p->scratch_groups) p->apply_jit(g);
+  for (Group* g : p->res_groups) p->apply_jit(g);
+  p->jit_tab1.clear();
+  p->jit_tab2.clear();
 }
 
 // The specialization content key baked into this build ("" = the generic
@@ -2430,9 +2741,11 @@ int misaka_pool_threads(void* h) { return (int)((Pool*)h)->workers.size(); }
 // worker busy ns summed across threads, out[1] = worker idle ns (time
 // parked on the work condition; a thread currently parked contributes its
 // completed waits only), out[2] = serial-fast-path busy ns (small passes
-// run on the calling thread).  Lock-free relaxed reads — a scrape must
-// never stall a serving pass.
-void misaka_pool_counters(void* h, int64_t* out /*[3]*/) {
+// run on the calling thread), out[3] = quiescent pack rows ELIDED on
+// resident serves (row write skipped: the caller's reused buffer was
+// already current), out[4] = quiescent pack rows written.  Lock-free
+// relaxed reads — a scrape must never stall a serving pass.
+void misaka_pool_counters(void* h, int64_t* out /*[5]*/) {
   auto* p = (Pool*)h;
   int64_t busy = 0, idle = 0;
   for (auto& v : p->busy_ns) busy += v.load(std::memory_order_relaxed);
@@ -2440,6 +2753,8 @@ void misaka_pool_counters(void* h, int64_t* out /*[3]*/) {
   out[0] = busy;
   out[1] = idle;
   out[2] = p->serial_busy_ns.load(std::memory_order_relaxed);
+  out[3] = p->elided_rows.load(std::memory_order_relaxed);
+  out[4] = p->skip_packed_rows.load(std::memory_order_relaxed);
 }
 
 // Per-thread busy/idle ns (the flamegraph's native annotation keys on the
@@ -2535,8 +2850,8 @@ int misaka_pool_trace_read(void* h, int ring, int64_t* out, int max_recs,
 //   out[9..10] pool serve/idle calls / inline (never-published) calls
 //   out[11]    records dropped by ring overwrite (all rings)
 //   out[12..]  replicas ticked by [rung][shape] (kTraceRungs x
-//              kTraceShapes; rung bit 2 = specialized)
-void misaka_pool_trace_stats(void* h, int64_t* out /*[44]*/) {
+//              kTraceShapes; rung bit 2 = specialized, bit 3 = jit)
+void misaka_pool_trace_stats(void* h, int64_t* out /*[76]*/) {
   auto* p = (Pool*)h;
   const auto rel = std::memory_order_relaxed;
   out[0] = p->tr_spin_ns.load(rel);
@@ -2715,6 +3030,7 @@ void misaka_pool_discard(void* h) {
                (int64_t)(uint32_t)p->replicas.size());
   }
   p->resident = false;
+  p->mark_all_dirty();
 }
 
 int misaka_pool_is_resident(void* h) {
@@ -2724,13 +3040,18 @@ int misaka_pool_is_resident(void* h) {
 // One resident serve (feed_counts non-null) or idle (null) pass.  packed
 // gets EVERY row filled (active rows post-run, skipped rows their current
 // counters + the drained-on-serve contract); progress (may be null) gets
-// the per-replica retired-anything flags.  Returns 0, -2 (a feed exceeded
-// a ring's free space — resident state untouched), -3 (invalid active
-// list), or -4 (residency not armed: caller bug).
+// the per-replica retired-anything flags.  `reuse` nonzero declares that
+// `packed` is the SAME buffer as the previous call of this kind (serve
+// vs idle) with its contents intact — quiescent rows already current in
+// it are then elided instead of rewritten (pass 0 for a fresh buffer).
+// Returns 0, -2 (a feed exceeded a ring's free space — resident state
+// untouched), -3 (invalid active list), or -4 (residency not armed:
+// caller bug).
 int misaka_pool_serve_resident(void* h, const int32_t* feed_vals,
                                const int32_t* feed_counts, int ticks,
                                const int32_t* active, int n_active,
-                               int32_t* packed, uint8_t* progress) {
+                               int32_t* packed, uint8_t* progress,
+                               int reuse) {
   auto* p = (Pool*)h;
   if (!p->resident) return -4;
   if (active != nullptr) {
@@ -2750,6 +3071,7 @@ int misaka_pool_serve_resident(void* h, const int32_t* feed_vals,
   j.active = active;
   j.n_active = n_active;
   j.progress = progress;
+  j.reuse = reuse;
   return p->run_resident_job();
 }
 

@@ -1,0 +1,620 @@
+"""Copy-and-patch JIT tick tier + quiescent pack-row elision (ISSUE 18).
+
+The top rung of the native tick ladder (core/jit.py + native/stencils.cpp):
+stencils compiled once into a content-keyed cache, spliced and patched
+per-(lane, pc) into W^X executable buffers, armed onto the pool.  The
+ladder contract pinned here:
+
+* bit-identity against the scalar, generic, and switch-threaded rungs on
+  the differential schedules AND the 510-request mixed-tenant parity
+  corpus;
+* MISAKA_JIT=0 and EVERY failure path (ABI drift, scalar pool, chaos
+  fault, corrupt cache) fall back exactly one rung with zero serving
+  errors;
+* the stencil cache rebuilds through corruption/truncation and re-keys on
+  a version bump (spec-cache robustness, satellite 3);
+* pack-row elision fires on sparse fills, counts on the observability
+  plane, and never changes results (MISAKA_PACK_ELIDE=0 kill included).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from misaka_tpu import networks
+from misaka_tpu.core import cinterp, jit, native_serve, specialize
+from misaka_tpu.core.state import NetworkState
+from misaka_tpu.runtime.master import MasterNode
+from misaka_tpu.runtime.topology import Topology
+from misaka_tpu.utils import faults
+
+pytestmark = pytest.mark.skipif(
+    not native_serve.available(), reason="native interpreter unavailable (no g++)"
+)
+
+SMALL = dict(stack_cap=8, in_cap=16, out_cap=16)
+
+# Control-flow divergence + stacks + network moves: the shapes a fragment
+# library gets wrong if a hole is patched with the wrong plane offset.
+DIVERGE = Topology(
+    node_info={"p": "program"},
+    programs={
+        "p": (
+            "IN ACC\n"
+            "JGZ pos\n"
+            "JLZ neg\n"
+            "OUT 0\n"
+            "JMP end\n"
+            "pos: ADD 100\n"
+            "OUT ACC\n"
+            "JMP end\n"
+            "neg: NEG\n"
+            "OUT ACC\n"
+            "end: NOP"
+        )
+    },
+    **SMALL,
+)
+
+
+def topologies():
+    return {
+        "add2": networks.add2(**SMALL),
+        "acc_loop": networks.acc_loop(**SMALL),
+        "ring4": networks.ring(4, **SMALL),
+        "diverge": DIVERGE,
+    }
+
+
+def state_dict(state: NetworkState) -> dict:
+    return {f: np.asarray(getattr(state, f)) for f in NetworkState._fields}
+
+
+def assert_state_equal(a: dict, b: dict, msg: str = ""):
+    for f, av in a.items():
+        np.testing.assert_array_equal(av, b[f], err_msg=f"{msg}: field {f}")
+
+
+def run_schedule(net, rounds: int = 8, spec: str | None = None,
+                 jit_prog=None, mode: str | None = None, seed: int = 3,
+                 active_fn=None, threads: int = 4):
+    """The test_simd.py differential schedule, extended with the JIT arm:
+    randomness depends only on the seed and ring headroom only on prior
+    state, so every rung sees the identical feed by induction."""
+    B = net.batch
+    prev = os.environ.get("MISAKA_SIMD")
+    if mode is None:
+        os.environ.pop("MISAKA_SIMD", None)
+    else:
+        os.environ["MISAKA_SIMD"] = mode
+    try:
+        pool = native_serve.NativeServePool(
+            net, chunk_steps=64, threads=threads, specialized=spec,
+            jit_program=jit_prog,
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("MISAKA_SIMD", None)
+        else:
+            os.environ["MISAKA_SIMD"] = prev
+    rng = np.random.default_rng(seed)
+    state = net.init_state()
+    rows = []
+
+    def materialize(st):
+        exported = pool.export_resident(st)
+        return exported if exported is not None else st
+
+    try:
+        for it in range(rounds):
+            if it % 4 == 3:
+                state, ctrs = pool.idle(state, 32)
+                state = materialize(state)
+                rows.append(np.asarray(ctrs).copy())
+                continue
+            free = net.in_cap - (
+                np.asarray(state.in_wr) - np.asarray(state.in_rd)
+            )
+            counts = np.minimum(
+                rng.integers(0, net.in_cap + 1, size=B), free
+            ).astype(np.int32)
+            vals = rng.integers(
+                np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+                size=(B, net.in_cap), dtype=np.int64,
+            ).astype(np.int32)
+            active = active_fn(it, counts) if active_fn else None
+            if active is not None:
+                mask = np.zeros((B,), bool)
+                mask[active] = True
+                counts[~mask] = 0
+            state, packed = pool.serve(state, vals, counts, active=active)
+            state = materialize(state)
+            packed = np.asarray(packed).copy()
+            if active is not None:
+                # skipped rows carry only their counters (cols 4+ are
+                # np.empty residue by contract) — blank for comparison
+                skipped = np.ones((B,), bool)
+                skipped[active] = False
+                packed[skipped, 4:] = 0
+            rows.append(packed)
+        return state_dict(state), rows, pool.simd_info()
+    finally:
+        pool._pull_trace_stats(force=True)
+        pool.close()
+
+
+# --- differential bit-identity ----------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(topologies()))
+def test_jit_bit_identity_differential(name, tmp_path):
+    """JIT rung vs switch-threaded (specialized), generic group, and
+    scalar rungs: full-state bit-identity (tick counts included) over the
+    mixed serve/idle schedule, straggler batch B=19 included."""
+    net = topologies()[name].compile(batch=19)
+    prog = jit.prepare(net, cache_dir=str(tmp_path))
+    assert prog is not None
+    so = specialize.build(net, cache_dir=str(tmp_path))
+    assert so is not None
+    d_jit, rows_jit, info = run_schedule(net, jit_prog=prog)
+    assert info["jit"], "JIT rung did not arm"
+    d_spec, rows_spec, _ = run_schedule(net, spec=so)
+    d_gen, rows_gen, _ = run_schedule(net, mode="generic")
+    d_off, rows_off, _ = run_schedule(net, mode="0")
+    assert_state_equal(d_jit, d_spec, f"{name}: jit vs switch-threaded")
+    assert_state_equal(d_jit, d_gen, f"{name}: jit vs generic")
+    assert_state_equal(d_jit, d_off, f"{name}: jit vs scalar")
+    for i, (ra, rb, rc, rd) in enumerate(
+            zip(rows_jit, rows_spec, rows_gen, rows_off)):
+        np.testing.assert_array_equal(ra, rb, err_msg=f"{name} row {i}")
+        np.testing.assert_array_equal(ra, rc, err_msg=f"{name} row {i}")
+        np.testing.assert_array_equal(ra, rd, err_msg=f"{name} row {i}")
+
+
+def test_jit_partial_fill_active_lists(tmp_path):
+    """Masked serves through the JIT rung: full groups, partial groups,
+    stragglers, and the serial fast path all bit-identical to scalar."""
+    net = topologies()["add2"].compile(batch=24)
+    prog = jit.prepare(net, cache_dir=str(tmp_path))
+    assert prog is not None
+
+    def actives(it, counts):
+        return [
+            None,
+            list(range(0, 8)),
+            list(range(0, 12)),
+            [1, 3, 8, 9, 10, 11, 12, 13, 14, 15, 23],
+            [17],
+            list(range(8, 24)),
+        ][it % 6]
+
+    d_jit, rows_jit, _ = run_schedule(net, rounds=12, jit_prog=prog,
+                                      active_fn=actives)
+    d_off, rows_off, _ = run_schedule(net, rounds=12, mode="0",
+                                      active_fn=actives)
+    assert_state_equal(d_jit, d_off, "jit partial fill")
+    for i, (ra, rb) in enumerate(zip(rows_jit, rows_off)):
+        np.testing.assert_array_equal(ra, rb, err_msg=f"row {i}")
+
+
+# --- the 510-request mixed-tenant parity corpus ------------------------------
+
+CORPUS = os.path.join(os.path.dirname(__file__), "corpus", "parity")
+_CORPUS_TENANTS = ["add2", "kahn_002", "branch_sign"]
+
+
+def _corpus_case(name):
+    with open(os.path.join(CORPUS, f"{name}.json")) as f:
+        return json.load(f)
+
+
+def _corpus_requests(cases, total=510, seed=17):
+    """The capture-plane mixed-tenant request schedule (test_capture.py):
+    deterministic given the seed, 510 requests round-robined across
+    tenants with 1-4 values each."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for t in range(total):
+        name = _CORPUS_TENANTS[t % len(_CORPUS_TENANTS)]
+        pool = cases[name]["inputs"]
+        vals = [int(pool[int(j)])
+                for j in rng.integers(0, len(pool), rng.integers(1, 5))]
+        reqs.append((name, vals))
+    return reqs
+
+
+def _corpus_replay(cases, reqs, spec_dir, jit_on: bool):
+    prev = os.environ.get("MISAKA_JIT")
+    os.environ["MISAKA_JIT"] = "1" if jit_on else "0"
+    masters = {}
+    try:
+        for name, case in cases.items():
+            top = Topology(node_info=case["node_info"],
+                           programs=case["programs"],
+                           stack_cap=64, in_cap=32, out_cap=32)
+            m = MasterNode(top, chunk_steps=64, batch=16, engine="native",
+                           native_spec_dir=spec_dir)
+            m.run()
+            masters[name] = m
+        if jit_on:
+            assert all(m._runner.simd_info()["jit"]
+                       for m in masters.values()), "JIT did not arm"
+        else:
+            assert not any(m._runner.simd_info()["jit"]
+                           for m in masters.values())
+        outs = []
+        for t, (name, vals) in enumerate(reqs):
+            m = masters[name]
+            if t % 2:
+                out = m.compute_many(vals, return_array=True)
+            else:
+                out = m.compute_coalesced(vals, return_array=True)
+            outs.append(np.asarray(out).tolist())
+        return outs
+    finally:
+        for m in masters.values():
+            m.close()
+        if prev is None:
+            os.environ.pop("MISAKA_JIT", None)
+        else:
+            os.environ["MISAKA_JIT"] = prev
+
+
+def test_jit_parity_corpus_510_requests(tmp_path):
+    """The acceptance pin: 510 mixed-tenant parity-corpus requests served
+    through JIT-armed native masters answer byte-for-byte what the
+    MISAKA_JIT=0 ladder (switch-threaded rung) answers — zero errors on
+    either side."""
+    cases = {n: _corpus_case(n) for n in _CORPUS_TENANTS}
+    reqs = _corpus_requests(cases)
+    spec_dir = str(tmp_path / "spec")
+    base = _corpus_replay(cases, reqs, spec_dir, jit_on=False)
+    jitted = _corpus_replay(cases, reqs, spec_dir, jit_on=True)
+    diverged = [t for t, (a, b) in enumerate(zip(base, jitted)) if a != b]
+    assert diverged == [], (
+        f"{len(diverged)}/510 requests diverged; first at {diverged[0]}: "
+        f"{base[diverged[0]]} vs {jitted[diverged[0]]}")
+
+
+# --- observability: rung counters + simd_info --------------------------------
+
+
+def _jit_rung_ticks() -> float:
+    """misaka_native_tick_rung_total summed over the jit rung labels
+    (`jit` on a no-AVX2 box, `jit-avx2` where the wide loads engage)."""
+    return sum(
+        native_serve._C_TICK_RUNG.labels(rung=r).value
+        for r in ("jit", "jit-avx2", "spec-jit", "spec-avx2-jit")
+    )
+
+
+def test_jit_rung_counter_and_flight_tags(tmp_path):
+    """An armed pool ticks on a jit-tagged rung: trace_stats reps carry
+    the rung tag and misaka_native_tick_rung_total{rung=~"jit.*"}
+    advances."""
+    net = topologies()["add2"].compile(batch=16)
+    prog = jit.prepare(net, cache_dir=str(tmp_path))
+    assert prog is not None
+    before = _jit_rung_ticks()
+    d, rows, info = run_schedule(net, jit_prog=prog)
+    assert info["jit"]
+    assert _jit_rung_ticks() > before
+    # the scalar run must NOT touch the jit rungs
+    mark = _jit_rung_ticks()
+    run_schedule(net, mode="0")
+    assert _jit_rung_ticks() == mark
+
+
+def test_jit_metrics_and_program_shape(tmp_path):
+    """prepare() reports splice outcomes: fragment/byte gauges move, the
+    program owns executable memory, and close() is idempotent."""
+    net = topologies()["diverge"].compile(batch=8)
+    spliced = jit.M_JIT.labels(status="spliced").value
+    prog = jit.prepare(net, cache_dir=str(tmp_path))
+    assert prog is not None
+    assert jit.M_JIT.labels(status="spliced").value == spliced + 1
+    assert prog.fragments > 0 and prog.code_bytes > 0
+    assert jit.G_JIT_FRAGMENTS.value == prog.fragments
+    assert jit.G_JIT_CODE_BYTES.value == prog.code_bytes
+    assert prog.n_lanes == 1 and prog.max_len >= 11
+    prog.close()
+    prog.close()  # idempotent
+
+
+# --- fallback ladder ---------------------------------------------------------
+
+
+def test_jit_kill_switch(tmp_path, monkeypatch):
+    """MISAKA_JIT=0: prepare() declines (status=disabled), the master's
+    ladder serves one rung down (switch-threaded), results unchanged."""
+    monkeypatch.setenv("MISAKA_JIT", "0")
+    net = topologies()["add2"].compile(batch=16)
+    disabled = jit.M_JIT.labels(status="disabled").value
+    assert jit.prepare(net, cache_dir=str(tmp_path)) is None
+    assert jit.M_JIT.labels(status="disabled").value == disabled + 1
+    m = MasterNode(topologies()["add2"], chunk_steps=32, batch=16,
+                   engine="native", native_spec_dir=str(tmp_path))
+    try:
+        m.run()
+        info = m._runner.simd_info()
+        assert not info["jit"] and info["specialized"]
+        assert list(m.compute_many([1, 2, 3])) == [3, 4, 5]
+    finally:
+        m.close()
+
+
+def test_jit_master_ladder_arms_and_serves(tmp_path):
+    """The default ladder: a master with a spec cache dir arms the JIT
+    rung (not the per-program .so compile) and serves correctly."""
+    m = MasterNode(topologies()["add2"], chunk_steps=32, batch=16,
+                   engine="native", native_spec_dir=str(tmp_path))
+    try:
+        m.run()
+        info = m._runner.simd_info()
+        assert info["jit"] and not info["specialized"]
+        assert list(m.compute_many([1, 2, 3])) == [3, 4, 5]
+        spread = m.compute_spread(list(range(10)))
+        assert list(spread) == [v + 2 for v in range(10)]
+    finally:
+        m.close()
+
+
+def test_jit_abi_mismatch_refused(tmp_path):
+    """An ABI-drifted program must be REFUSED at arm time (rc -1) and the
+    pool serves on the rung below — never a torn dispatch table."""
+    net = topologies()["add2"].compile(batch=16)
+    prog = jit.prepare(net, cache_dir=str(tmp_path))
+    assert prog is not None
+    prog.abi = 999
+    pool = cinterp.NativePool(net.code, net.prog_len, net.num_stacks,
+                              net.stack_cap, net.in_cap, net.out_cap,
+                              replicas=16, threads=2)
+    try:
+        assert pool.jit_arm(prog) == -1
+        assert not pool.simd_info()["jit"]
+    finally:
+        pool.close()
+    prog.abi = jit.MISAKA_JIT_ABI
+    errors = jit.M_JIT.labels(status="error").value
+    prog.abi = 999
+    sp = native_serve.NativeServePool(net, chunk_steps=32, jit_program=prog)
+    try:
+        assert not sp.simd_info()["jit"]
+        assert jit.M_JIT.labels(status="error").value == errors + 1
+        state = net.init_state()
+        vals = np.zeros((16, net.in_cap), np.int32)
+        vals[:, 0] = np.arange(16)
+        counts = np.ones((16,), np.int32)
+        state, packed = sp.serve(state, vals, counts)  # zero serving errors
+        assert np.asarray(packed).shape[0] == 16
+    finally:
+        sp.close()
+
+
+def test_jit_scalar_pool_refused(tmp_path, monkeypatch):
+    """A scalar pool (MISAKA_SIMD=0) has no group engine to splice into:
+    arm answers rc -2 and the pool stays on the scalar rung."""
+    monkeypatch.setenv("MISAKA_SIMD", "0")
+    net = topologies()["add2"].compile(batch=16)
+    prog = jit.prepare(net, cache_dir=str(tmp_path))
+    assert prog is not None
+    pool = cinterp.NativePool(net.code, net.prog_len, net.num_stacks,
+                              net.stack_cap, net.in_cap, net.out_cap,
+                              replicas=16, threads=2)
+    try:
+        assert pool.jit_arm(prog) == -2
+        assert not pool.simd_info()["jit"]
+    finally:
+        pool.close()
+
+
+def test_jit_fail_chaos_graceful_fallback(tmp_path):
+    """The jit_fail chaos point: prepare() returns None (status=error),
+    the master ladder falls back to the switch-threaded rung, and clients
+    see zero errors."""
+    errors = jit.M_JIT.labels(status="error").value
+    faults.configure("jit_fail")
+    try:
+        m = MasterNode(topologies()["add2"], chunk_steps=32, batch=16,
+                       engine="native", native_spec_dir=str(tmp_path))
+        try:
+            m.run()
+            info = m._runner.simd_info()
+            assert not info["jit"] and info["specialized"]
+            assert list(m.compute_many([5, 6])) == [7, 8]
+        finally:
+            m.close()
+    finally:
+        faults.configure(None)
+    assert jit.M_JIT.labels(status="error").value > errors
+
+
+# --- spec-cache robustness (satellite 3) -------------------------------------
+
+
+def _evict_inproc_cache():
+    with jit._lib_lock:
+        jit._lib_cache.clear()
+
+
+def test_stencil_cache_corrupt_object_rebuilds(tmp_path):
+    """A corrupted cached stencil .o (disk fault, torn write) is evicted
+    and rebuilt ONCE; the rebuilt library splices and serves."""
+    cache = str(tmp_path)
+    path = jit.build_stencils(cache)
+    assert path is not None and os.path.exists(path)
+    with open(path, "r+b") as f:  # scribble over the section table
+        f.seek(0x28)
+        f.write(b"\xff" * 16)
+    _evict_inproc_cache()
+    built = jit.M_JIT.labels(status="built").value
+    lib = jit.load_stencils(cache)
+    assert lib is not None
+    assert jit.M_JIT.labels(status="built").value == built + 1
+    net = topologies()["add2"].compile(batch=16)
+    prog = jit.prepare(net, cache_dir=cache)
+    assert prog is not None
+    prog.close()
+
+
+def test_stencil_cache_truncated_object_rebuilds(tmp_path):
+    """A truncated cached object (partial write) follows the same
+    evict-and-rebuild path instead of crashing the parser."""
+    cache = str(tmp_path)
+    path = jit.build_stencils(cache)
+    assert path is not None
+    with open(path, "r+b") as f:
+        f.truncate(100)
+    _evict_inproc_cache()
+    lib = jit.load_stencils(cache)
+    assert lib is not None and len(lib.stencils) >= 24
+
+
+def test_stencil_cache_version_bump_rekeys(tmp_path, monkeypatch):
+    """Bumping JIT_VERSION changes the content key: the old cached object
+    is ignored (stale key) and a fresh library is built beside it."""
+    cache = str(tmp_path)
+    old_key = jit.stencil_key()
+    old_path = jit.build_stencils(cache)
+    assert old_path is not None
+    monkeypatch.setattr(jit, "JIT_VERSION", jit.JIT_VERSION + 1)
+    new_key = jit.stencil_key()
+    assert new_key != old_key
+    built = jit.M_JIT.labels(status="built").value
+    new_path = jit.build_stencils(cache)
+    assert new_path is not None and new_path != old_path
+    assert jit.M_JIT.labels(status="built").value == built + 1
+    assert os.path.exists(old_path)  # LRU prune owns aging, not the bump
+
+
+def test_stencil_cache_unparseable_twice_falls_back(tmp_path, monkeypatch):
+    """If the library STAYS unparseable after the rebuild (toolchain emits
+    something outside the contract), load gives up (status=error) and
+    prepare() returns None — the ladder serves one rung down."""
+    def bad_parse(path):
+        raise jit.JitError("forced: contract violation")
+
+    monkeypatch.setattr(jit, "_parse_stencils", bad_parse)
+    _evict_inproc_cache()
+    errors = jit.M_JIT.labels(status="error").value
+    assert jit.load_stencils(str(tmp_path)) is None
+    assert jit.M_JIT.labels(status="error").value == errors + 1
+    net = topologies()["add2"].compile(batch=16)
+    assert jit.prepare(net, cache_dir=str(tmp_path)) is None
+
+
+# --- quiescent pack-row elision ----------------------------------------------
+
+
+def _mk_raw_pool(net, B):
+    return cinterp.NativePool(net.code, net.prog_len, net.num_stacks,
+                              net.stack_cap, net.in_cap, net.out_cap,
+                              replicas=B, threads=2)
+
+
+def _sparse_resident_run(net, pool, reuse, rounds=12, seed=7):
+    """Resident serves with ONE hot replica: every other group is fully
+    quiescent — the elision fast path's home turf."""
+    B = net.batch
+    rng = np.random.default_rng(seed)
+    state = net.init_state()
+    d = {f: np.array(np.asarray(getattr(state, f))) for f in state._fields}
+    assert pool.import_state(d)
+    rows = []
+    active = np.array([0], np.int32)
+    in_wr = d["in_wr"].copy()
+    in_rd = d["in_rd"].copy()
+    for _ in range(rounds):
+        free = net.in_cap - (in_wr - in_rd)
+        counts = np.minimum(rng.integers(0, net.in_cap + 1, size=B),
+                            free).astype(np.int32)
+        counts[1:] = 0
+        vals = rng.integers(-10_000, 10_000,
+                            size=(B, net.in_cap)).astype(np.int32)
+        packed, progress = pool.serve_resident(vals, counts, 48,
+                                               active=active,
+                                               reuse_out=reuse)
+        packed = np.array(packed)
+        packed[1:, 4:] = 0  # skipped rows: unspecified out-cell residue
+        rows.append((packed, np.array(progress)))
+        ex = pool.export_state()
+        in_wr, in_rd = ex["in_wr"], ex["in_rd"]
+    return pool.export_state(), rows
+
+
+def test_pack_row_elision_sparse_fill_bit_identical(tmp_path):
+    """Sparse fill (1 hot replica of 24): the elision path must skip the
+    quiescent rows' pack writes, count them, and stay bit-identical to
+    the always-copy reference."""
+    net = topologies()["add2"].compile(batch=24)
+    ref = _mk_raw_pool(net, 24)
+    try:
+        d_ref, rows_ref = _sparse_resident_run(net, ref, reuse=False)
+        ref_ctrs = ref.counters()
+    finally:
+        ref.close()
+    assert ref_ctrs["elided_rows"] == 0  # reuse off -> no ledger, no skip
+
+    el = _mk_raw_pool(net, 24)
+    try:
+        prog = jit.prepare(net, cache_dir=str(tmp_path))
+        assert prog is not None and el.jit_arm(prog) == 0
+        d_el, rows_el = _sparse_resident_run(net, el, reuse=True)
+        ctrs = el.counters()
+    finally:
+        el.close()
+    assert ctrs["elided_rows"] > 0, "elision never fired on sparse fill"
+    assert ctrs["skip_packed_rows"] > 0
+    for f in d_ref:
+        np.testing.assert_array_equal(d_ref[f], d_el[f], err_msg=f)
+    for i, ((pa, ga), (pb, gb)) in enumerate(zip(rows_ref, rows_el)):
+        np.testing.assert_array_equal(pa, pb, err_msg=f"packed {i}")
+        np.testing.assert_array_equal(ga, gb, err_msg=f"progress {i}")
+
+
+def test_pack_elide_kill_switch(monkeypatch):
+    """MISAKA_PACK_ELIDE=0: the reuse path still serves identically but
+    elides nothing — the kill switch isolates the layer."""
+    net = topologies()["add2"].compile(batch=24)
+    monkeypatch.setenv("MISAKA_PACK_ELIDE", "0")
+    pool = _mk_raw_pool(net, 24)
+    try:
+        d_off, rows_off = _sparse_resident_run(net, pool, reuse=True)
+        ctrs = pool.counters()
+    finally:
+        pool.close()
+    assert ctrs["elided_rows"] == 0
+    monkeypatch.delenv("MISAKA_PACK_ELIDE")
+    ref = _mk_raw_pool(net, 24)
+    try:
+        d_ref, rows_ref = _sparse_resident_run(net, ref, reuse=False)
+    finally:
+        ref.close()
+    for f in d_ref:
+        np.testing.assert_array_equal(d_ref[f], d_off[f], err_msg=f)
+    for i, ((pa, ga), (pb, gb)) in enumerate(zip(rows_ref, rows_off)):
+        np.testing.assert_array_equal(pa, pb, err_msg=f"packed {i}")
+        np.testing.assert_array_equal(ga, gb, err_msg=f"progress {i}")
+
+
+def test_elision_counters_reach_metrics_plane(tmp_path):
+    """The serve pool pipes pool-level elision counters into the process
+    counters misaka_native_elided_rows_total / _skip_packed_rows_total."""
+    net = topologies()["add2"].compile(batch=24)
+    before = native_serve._C_ELIDED_ROWS.value
+    pool = native_serve.NativeServePool(net, chunk_steps=48)
+    try:
+        state = net.init_state()
+        vals = np.zeros((24, net.in_cap), np.int32)
+        counts = np.zeros((24,), np.int32)
+        counts[0] = 2
+        vals[0, :2] = (3, 4)
+        active = np.array([0], np.int32)
+        for _ in range(6):
+            state, _ = pool.serve(state, vals, counts, active=active)
+        pool.take_busy_ns()  # flushes the elision watermarks
+    finally:
+        pool.close()
+    assert native_serve._C_ELIDED_ROWS.value > before

@@ -200,7 +200,9 @@ def test_forced_fallback_reports_and_matches():
             os.environ.pop("MISAKA_SIMD", None)
         else:
             os.environ["MISAKA_SIMD"] = prev
-    assert info == {"width": 8, "avx2": False, "specialized": False}
+    assert info == {
+        "width": 8, "avx2": False, "specialized": False, "jit": False,
+    }
     # and the kill switch reports the scalar path
     os.environ["MISAKA_SIMD"] = "0"
     try:
@@ -330,11 +332,14 @@ def test_mismatched_specialization_degrades(tmp_path):
     assert_state_equal(d_mis, d_ok, "mismatched spec")
 
 
-def test_specialized_checkpoint_roundtrip(tmp_path):
+def test_specialized_checkpoint_roundtrip(tmp_path, monkeypatch):
     """Checkpoint/restore through a SPECIALIZED engine: state saved from a
     specialized master restores bit-identically into a fresh specialized
     master AND into a scalar-path master, and the continuation stream
     matches (the delay-line shape: outputs prove the restored state)."""
+    # the JIT rung outranks specialization on the ladder (r21); pin it off
+    # so this test exercises the spec rung it is about
+    monkeypatch.setenv("MISAKA_JIT", "0")
     topo = Topology(
         node_info={"p": "program"},
         programs={"p": "IN ACC\nSWP\nOUT ACC\nSWP\nSAV\n"},  # delay line
@@ -396,11 +401,12 @@ def test_specialized_checkpoint_roundtrip(tmp_path):
             m.close()
 
 
-def test_specialize_fail_chaos_graceful_fallback(tmp_path):
+def test_specialize_fail_chaos_graceful_fallback(tmp_path, monkeypatch):
     """The specialize_fail fault at the compile site: registry activation
     must SUCCEED on the generic interpreter, the failure must count on
     misaka_native_specialize_total{status="error"}, and clients see zero
     errors."""
+    monkeypatch.setenv("MISAKA_JIT", "0")  # pin the spec rung (see above)
     errors = specialize.M_SPECIALIZE.labels(status="error").value
     faults.configure("specialize_fail")
     try:

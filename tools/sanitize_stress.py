@@ -35,10 +35,22 @@ while the engine-side recorder toggles on/off (push-state swaps
 re-parsing capture_enabled/capture_sample mid-traffic) and the
 supervisor restart-cycles with rows still queued.
 
+The r21 `--lane jit` variant targets the copy-and-patch tier
+(core/jit.py): splicer threads race mmap → patch → W^X flip → munmap
+buffer churn against an instrumented pool serving THROUGH armed
+fragment tables, with arm/disarm/eviction cycles (including refused
+bad-ABI arms that must leave the pool untouched) and scrape readers on
+the counter/trace/simd_info surfaces throughout.  The stencil
+fragments themselves stay UNinstrumented by design — sanitizer
+instrumentation would add runtime-library relocations the splicer's
+self-containment check rejects — so the lane polices the instrumented
+pool code AROUND the fragments plus the Python-side mapping lifecycle.
+
 Usage (or `make sanitize-smoke` / `make sanitize-all`):
     python tools/sanitize_stress.py --sanitizer address [--seconds 6]
     python tools/sanitize_stress.py --sanitizer address --lane edge
     python tools/sanitize_stress.py --sanitizer address --lane capture
+    python tools/sanitize_stress.py --sanitizer address --lane jit
 """
 
 from __future__ import annotations
@@ -158,6 +170,25 @@ def build_sanitized_spec_so(kind: str) -> str | None:
     return so
 
 
+def build_jit_stencil_cache(kind: str) -> str | None:
+    """Pre-build the copy-and-patch stencil library in the PARENT (the
+    child must never run g++ under the sanitizer's LD_PRELOAD).  The
+    stencils are compiled with the production flags, NOT the sanitizer's:
+    instrumented fragments would carry sanitizer-runtime relocations the
+    self-containment check rejects — the jit lane polices the
+    instrumented pool around the fragments, not the fragments."""
+    from misaka_tpu.core import jit as jit_mod
+
+    _, _, suffix, _, _ = _SAN[kind]
+    cache = os.path.join(REPO, "native", f".jit-{suffix}-cache")
+    path = jit_mod.build_stencils(cache)
+    if path is None:
+        print("sanitize: WARNING — stencil build failed; the jit lane "
+              "cannot run", file=sys.stderr)
+        return None
+    return cache
+
+
 def reexec_under_sanitizer(kind: str, args) -> int:
     so = build_sanitized_so(kind)
     # The edge lane instruments BOTH native tiers: the frontend under
@@ -168,6 +199,11 @@ def reexec_under_sanitizer(kind: str, args) -> int:
     frontend_so = (build_sanitized_frontend_so(kind)
                    if args.lane in ("edge", "capture") else None)
     spec_so = build_sanitized_spec_so(kind) if args.lane == "pool" else None
+    jit_cache = None
+    if args.lane == "jit":
+        jit_cache = build_jit_stencil_cache(kind)
+        if jit_cache is None:
+            return 1
     _, runtime, _, env_var, env_val = _SAN[kind]
     cxx = os.environ.get("CXX", "g++")
     lib = subprocess.run(
@@ -186,6 +222,7 @@ def reexec_under_sanitizer(kind: str, args) -> int:
         "MISAKA_SANITIZE_CHILD": kind,
         **({"MISAKA_SANITIZE_SPEC_SO": spec_so} if spec_so else {}),
         **({"MISAKA_FRONTEND_SO": frontend_so} if frontend_so else {}),
+        **({"MISAKA_SANITIZE_JIT_CACHE": jit_cache} if jit_cache else {}),
         # never touch (or wedge on) a TPU relay from a sanitizer lane
         "JAX_PLATFORMS": "cpu",
         "PALLAS_AXON_POOL_IPS": "",
@@ -958,12 +995,269 @@ def run_capture_scenario(args) -> int:
     return 0
 
 
+def run_jit_scenario(args) -> int:
+    """The r21 jit lane: copy-and-patch buffer churn under sanitizer
+    fire.  Splicer threads loop prepare() — mmap, fragment patch, W^X
+    mprotect flip — and munmap retired buffers while the instrumented
+    pool serves THROUGH the armed fragment tables and scrape readers
+    hammer counters/trace_stats/simd_info.  Arm/disarm/eviction honors
+    the production contract (between serve calls, quiesced like
+    import/discard), but everything around the contract races: shared
+    in-process stencil cache under _lib_lock, refused bad-ABI arms
+    against a hot pool's metadata, full pool close/recreate cycles with
+    readers mid-hammer, and the disarm → munmap edge where a stale
+    reader must lose typed, never dereference freed executable pages."""
+    import types
+
+    import numpy as np
+
+    from misaka_tpu.core import cinterp
+    from misaka_tpu.core import jit as jit_mod
+
+    assert os.environ.get("MISAKA_INTERP_SO"), "child needs the override"
+    cache = os.environ.get("MISAKA_SANITIZE_JIT_CACHE")
+    assert cache, "parent pre-builds the stencil cache"
+    if not cinterp.available():
+        print("sanitize: instrumented interpreter failed to load",
+              file=sys.stderr)
+        return 1
+
+    B, in_cap = args.replicas, 16
+    code, prog_len = _tables()
+    net = types.SimpleNamespace(
+        code=code, prog_len=prog_len, num_stacks=1, stack_cap=16,
+        in_cap=in_cap, out_cap=in_cap,
+    )
+    first = jit_mod.prepare(net, cache_dir=cache)
+    if first is None:
+        print("sanitize[jit]: stencil library unavailable", file=sys.stderr)
+        return 1
+
+    stop = threading.Event()
+    serve_gate = threading.Event()
+    serve_idle = threading.Event()
+    serve_gate.set()
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+    stats = {"passes": 0, "values": 0, "resident_passes": 0, "splices": 0,
+             "evictions": 0, "arm_cycles": 0, "refused": 0, "cycles": 0,
+             "reads": 0, "closed_reads": 0}
+    spare: list = []  # splicer-produced programs awaiting arm/eviction
+
+    def bump(k, n=1):
+        with lock:
+            stats[k] += n
+
+    def new_pool():
+        return cinterp.NativePool(
+            code, prog_len, 1, 16, in_cap, in_cap,
+            replicas=B, threads=args.pool_threads,
+        )
+
+    box = {"pool": new_pool(), "prog": first}
+    if box["pool"].jit_arm(first) != 0:
+        print("sanitize[jit]: initial arm refused", file=sys.stderr)
+        return 1
+    rng = np.random.default_rng(11)
+
+    def serve_loop():
+        # The single serve caller, through the ARMED fragment tables;
+        # values verified end to end so a mispatched hole can never pass
+        # as "no sanitizer report".  Same gate discipline as the pool
+        # lane: arm/evict/recreate happens against a quiescent pool.
+        d = _init_state(B, 1, 1, 16, in_cap, in_cap)
+        try:
+            while not stop.is_set():
+                if not serve_gate.is_set():
+                    serve_idle.set()
+                    serve_gate.wait(timeout=1.0)
+                    d = _init_state(B, 1, 1, 16, in_cap, in_cap)
+                    continue
+                serve_idle.clear()
+                pool = box["pool"]
+                counts = rng.integers(0, 5, size=B).astype(np.int32)
+                vals = np.zeros((B, in_cap), np.int32)
+                for b in range(B):
+                    vals[b, :counts[b]] = rng.choice(
+                        [-2**31, -7, 0, 5, 2**31 - 1, 2**31 - 2],
+                        size=counts[b],
+                    ).astype(np.int32)
+                resident = stats["passes"] % 2 == 1
+                active = np.arange(min(2, B), dtype=np.int32)
+                if resident:
+                    if not pool.is_resident() and not pool.import_state(d):
+                        raise AssertionError("resident import refused")
+                    packed, progress = pool.serve_resident(vals, counts, 64)
+                    assert progress.shape == (B,)
+                    # masked partial-fill pass + packed-buffer reuse: the
+                    # r21 elision ledger path under the sanitizer
+                    pool.serve_resident(
+                        np.zeros((B, in_cap), np.int32),
+                        np.zeros((B,), np.int32), 8, active=active,
+                        reuse_out=True,
+                    )
+                    d = pool.export_state()
+                    assert d is not None
+                    bump("resident_passes")
+                else:
+                    if pool.is_resident():
+                        pool.discard_resident()
+                    d, packed = pool.serve(d, vals, counts, ticks=64)
+                for b in range(B):
+                    rd, wr = int(packed[b, 2]), int(packed[b, 3])
+                    got = packed[b, 4:][(rd + np.arange(wr - rd)) % in_cap]
+                    want = (vals[b, :counts[b]].astype(np.int64) + 2)
+                    want = want.astype(np.uint64).astype(np.uint32)
+                    if not np.array_equal(got.astype(np.uint32), want):
+                        raise AssertionError(
+                            f"replica {b} served wrong values through the "
+                            f"jit tables: {got!r} != {want!r}")
+                    bump("values", wr - rd)
+                bump("passes")
+        except BaseException as e:  # noqa: BLE001 — surfaced at exit
+            errors.append(e)
+            stop.set()
+        finally:
+            serve_idle.set()
+
+    def splicer_loop(seed: int):
+        # mmap → patch → mprotect(RX) churn concurrent with serving and
+        # with the other splicer; retired buffers munmap while unrelated
+        # mappings are executing on pool worker threads.
+        lrng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                prog = jit_mod.prepare(net, cache_dir=cache)
+                if prog is None:
+                    raise AssertionError("prepare failed mid-lane")
+                bump("splices")
+                with lock:
+                    spare.append(prog)
+                    retire = spare[:-3] if len(spare) > 3 else []
+                    del spare[:-3]
+                for p in retire:
+                    p.close()  # W^X unmap while the pool executes OTHERS
+                    bump("evictions")
+                time.sleep(float(lrng.uniform(0.001, 0.01)))
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    def reader_loop():
+        # Scrape twin: counters + trace aggregates + simd_info (which
+        # reads jit_armed under the same _ctr_lock arm/disarm takes).
+        try:
+            while not stop.is_set():
+                pool = box["pool"]
+                try:
+                    c = pool.counters()
+                    assert c["elided_rows"] >= 0
+                    assert c["skip_packed_rows"] >= 0
+                    s = pool.trace_stats()
+                    assert s["serve_calls"] >= 0
+                    pool.simd_info()
+                    bump("reads")
+                except RuntimeError:
+                    bump("closed_reads")
+                except ValueError:
+                    bump("closed_reads")
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=serve_loop)]
+    threads += [threading.Thread(target=splicer_loop, args=(50 + i,))
+                for i in range(2)]
+    threads += [threading.Thread(target=reader_loop)
+                for _ in range(args.readers)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + args.seconds
+    try:
+        while time.monotonic() < deadline and not stop.is_set():
+            time.sleep(0.2)
+            serve_gate.clear()
+            if not serve_idle.wait(timeout=10):
+                errors.append(RuntimeError("serve thread never quiesced"))
+                break
+            pool = box["pool"]
+            # refused arm first: ABI drift must leave the pool serving
+            # exactly as armed (rc -1, tables untouched)
+            bad = spare and stats["cycles"] % 3 == 0
+            if bad:
+                with lock:
+                    probe = spare[-1]
+                probe.abi = 999
+                if pool.jit_arm(probe) != -1:
+                    errors.append(RuntimeError("bad-ABI arm not refused"))
+                    break
+                probe.abi = jit_mod.MISAKA_JIT_ABI
+                bump("refused")
+            if stats["cycles"] % 4 == 3:
+                # full eviction: recreate the pool with readers mid-hammer,
+                # then re-arm the live program so serving stays on the rung
+                old = box["pool"]
+                box["pool"] = new_pool()
+                old.close()
+                if box["pool"].jit_arm(box["prog"]) != 0:
+                    errors.append(RuntimeError("arm after recreate refused"))
+                    break
+            nxt = None
+            with lock:
+                if spare:
+                    nxt = spare.pop()
+            if nxt is not None:
+                pool = box["pool"]
+                pool.jit_disarm()
+                old_prog, box["prog"] = box["prog"], nxt
+                old_prog.close()  # disarm → munmap edge
+                if pool.jit_arm(nxt) != 0:
+                    errors.append(RuntimeError("re-arm refused"))
+                    break
+                bump("arm_cycles")
+            bump("cycles")
+            serve_gate.set()
+    finally:
+        stop.set()
+        serve_gate.set()
+        for t in threads:
+            t.join(timeout=30)
+        box["pool"].close()
+        box["prog"].close()
+        with lock:
+            retire = list(spare)
+            spare.clear()
+        for p in retire:
+            p.close()
+    if errors:
+        print(f"sanitize[jit]: scenario error: {errors[0]!r}",
+              file=sys.stderr)
+        return 1
+    if not (stats["passes"] and stats["values"] and stats["splices"]
+            and stats["arm_cycles"] and stats["refused"]
+            and stats["evictions"] and stats["reads"]
+            and stats["resident_passes"]):
+        print(f"sanitize[jit]: scenario did not exercise the races: "
+              f"{stats}", file=sys.stderr)
+        return 1
+    print(f"# sanitize[{os.environ.get('MISAKA_SANITIZE_CHILD')}/jit] "
+          f"green: {stats['passes']} serve passes / {stats['values']} "
+          f"values through jit tables ({stats['resident_passes']} "
+          f"resident), {stats['splices']} splices / "
+          f"{stats['evictions']} buffer evictions, "
+          f"{stats['arm_cycles']} arm cycles + {stats['refused']} refused "
+          f"bad-ABI arms, {stats['cycles']} quiesce cycles, "
+          f"{stats['reads']} scrape reads ({stats['closed_reads']} typed "
+          f"closed-pool losses)", file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sanitizer", default="address",
                     choices=sorted(_SAN))
     ap.add_argument("--lane", default="pool",
-                    choices=("pool", "edge", "capture"))
+                    choices=("pool", "edge", "capture", "jit"))
     ap.add_argument("--seconds", type=float, default=6.0)
     ap.add_argument("--replicas", type=int, default=64)
     ap.add_argument("--pool-threads", type=int, default=8)
@@ -974,6 +1268,8 @@ def main() -> int:
             return run_edge_scenario(args)
         if args.lane == "capture":
             return run_capture_scenario(args)
+        if args.lane == "jit":
+            return run_jit_scenario(args)
         return run_scenario(args)
     return reexec_under_sanitizer(args.sanitizer, args)
 
