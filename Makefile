@@ -272,6 +272,7 @@ ci:
 	$(MAKE) edge-native-smoke
 	$(MAKE) chaos-smoke
 	$(MAKE) fleet-smoke
+	$(MAKE) dist-smoke
 	$(MAKE) bench-smoke
 
 # Production-edge tripwire (~15s): a REAL subprocess server behind TLS
@@ -304,7 +305,8 @@ edge-native-smoke: native/libmisaka_frontend.so
 # fallback restore, RPC backoff policy, frontend-supervisor respawn and
 # crash-loop circuit breaker — plus the fleet failover shapes from
 # tests/test_fleet.py (replica death under concurrent load, drain
-# reroute, scoped replica_blackhole hedging, readmission, typed
+# reroute, scoped replica_blackhole and plane_partition hedging
+# (the partitioned-remote-peer drill), readmission, typed
 # fleet-down 503).  The multi-second kill-9-under-load, dead-peer
 # recovery, and subprocess-fleet scenarios are marked slow (test-all and
 # fleet-smoke run them).  docs/ARCHITECTURE.md "Fault tolerance" + "The
@@ -314,7 +316,7 @@ chaos-smoke:
 		python -m pytest tests/test_chaos.py -q -m "not slow" -p no:cacheprovider
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python -m pytest tests/test_fleet.py -q -m "not slow" -p no:cacheprovider \
-		-k "failover or blackhole or drain or fleet_down or readmits or fault or stale"
+		-k "failover or blackhole or drain or fleet_down or readmits or fault or stale or partition"
 
 # Fleet tripwire (~60s): the REAL thing — a subprocess fleet of 4 engine
 # replicas behind supervised SO_REUSEPORT frontends, 64 pooled concurrent
@@ -326,6 +328,21 @@ chaos-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 580 \
 		python -m pytest tests/test_fleet.py -q -m slow -p no:cacheprovider
+
+# Multi-host tripwire (~90s): TWO real `runtime.app` processes on
+# loopback TCP — a standalone remote-peer replica serving its compute
+# plane over CA-pinned mTLS (MISAKA_PLANE_TLS_*) and a MISAKA_FLEET=1
+# parent that registers it via MISAKA_FLEET_PEERS, probes it on the
+# shared replica state machine, and fans frames across both planes.
+# Drill: 64 pooled clients through a kill -9 of the REMOTE peer (zero
+# client-visible errors), same-port restart readmission, authenticated
+# remote /fleet/roll (drain -> checkpoint -> readmit), /edge/token mint
+# + locally-verified compute, and the fleet metric surface (peers_up,
+# gossip rounds, zero plane-TLS rejects).  Skips cleanly without
+# openssl.  docs/ARCHITECTURE.md "Multi-host fleet".
+dist-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 580 \
+		python tools/dist_smoke.py
 
 # Replay the committed parity corpus (tests/corpus/parity/) against the
 # ACTUAL Go reference binary via its own Dockerfile — the SURVEY.md §4
@@ -359,4 +376,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke replay-smoke usage-smoke observatory-smoke edge-smoke edge-native-smoke chaos-smoke fleet-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke replay-smoke usage-smoke observatory-smoke edge-smoke edge-native-smoke chaos-smoke fleet-smoke dist-smoke ci parity-go parity-local parity-corpus stop clean

@@ -378,6 +378,48 @@ so the master's env surface is what survives:
                    open with an HMAC of this secret or it is closed
                    (MISAKA_PLANE_SECRET_FILE reads it from a file).
                    Unset = open plane, exactly as before
+  MISAKA_PLANE_TLS_CERT / _KEY / _CA  mTLS on TCP compute planes: a
+                   plane address of "host:port" form (MISAKA_PLANE_SOCKET
+                   or a fleet peer's plane) serves/dials TLS 1.2+ with
+                   this cert/key, pinned to the given CA on BOTH sides
+                   (client certs required; hostname checks off — the CA
+                   is the identity).  Files are mtime-watched and
+                   hot-reloaded like the api-key table, so certificates
+                   rotate without a restart; plaintext or wrong-CA peers
+                   are refused with a typed, counted close
+                   (misaka_plane_tls_rejected_total).  Set all three or
+                   none.  The HMAC handshake above still runs INSIDE the
+                   TLS session as the inner authenticator.  Unix-socket
+                   planes ignore these
+  MISAKA_FLEET_PEERS  static remote peers for the fleet
+                   ("host:port[,host2:port2...]", port = the peer
+                   replica's HTTP control port; its compute plane
+                   defaults to port+1, or pin it with host:port:planeport):
+                   the fleet probes each peer's /healthz on the local
+                   cadence, routes compute frames across their TCP
+                   planes with the same hedging/suspect-hold machinery
+                   as local replicas, and drives them through
+                   drain -> checkpoint -> readmit on /fleet/roll
+                   (process replacement stays with the peer host's own
+                   supervisor).  MISAKA_FLEET_PEER_KEY is the admin key
+                   those cross-host control calls authenticate with
+                   (typically the peers' pinned
+                   MISAKA_EDGE_INTERNAL_TOKEN)
+  MISAKA_GOSSIP_S  usage-gossip cadence for fleet-coherent quotas
+                   (default 0.5; "0" disables): the fleet hub exchanges
+                   cumulative per-tenant admission counters with every
+                   replica and peer over POST /edge/gossip, and each
+                   edge chain drains its local token buckets by the
+                   remote usage — bounding a flooded tenant's aggregate
+                   admission across N replicas to ~1 + burst/window
+                   instead of Nx
+  MISAKA_TOKEN_SECRET  HMAC secret for signed short-lived tenant tokens
+                   (runtime/edge.py; MISAKA_TOKEN_SECRET_FILE reads a
+                   file; defaults to MISAKA_PLANE_SECRET so one fleet
+                   secret covers both): POST /edge/token (admin) mints
+                   "mst1." bearer tokens carrying tenant/expiry/scope,
+                   verified locally by every replica sharing the secret
+                   — no key-table distribution, no coordination
   MISAKA_LANE_SMALL  priority-lane split for the serve scheduler in
                    VALUES (default 8192): entries at or under it ride
                    the hot lane and preempt bulk backlog in pass

@@ -64,6 +64,7 @@ jax-free frontend workers import this module.
 
 from __future__ import annotations
 
+import base64
 import collections
 import hashlib
 import hmac
@@ -90,6 +91,33 @@ M_EDGE_REJECTED = metrics.counter(
     "Requests rejected at the edge, by reason "
     "(unauthenticated/forbidden/rate/values/cpu/overload) and tenant",
     ("reason", "tenant"),
+)
+M_PLANE_TLS_REJECTED = metrics.counter(
+    "misaka_plane_tls_rejected_total",
+    "Plane connections refused at the mTLS gate, by reason "
+    "(plaintext/bad_cert/handshake)",
+    ("reason",),
+)
+M_PLANE_TLS_RELOADS = metrics.counter(
+    "misaka_plane_tls_reloads_total",
+    "Plane TLS cert/key/CA hot-reload attempts, by status (ok/error)",
+    ("status",),
+)
+M_EDGE_TOKENS = metrics.counter(
+    "misaka_edge_tokens_total",
+    "Tenant-token operations, by op (mint/ok/expired/invalid)",
+    ("op",),
+)
+M_EDGE_GOSSIP_ROUNDS = metrics.counter(
+    "misaka_edge_gossip_rounds_total",
+    "Usage-gossip applications at this replica, by status (ok/stale/error)",
+    ("status",),
+)
+M_EDGE_GOSSIP_DRAINED = metrics.counter(
+    "misaka_edge_gossip_drained_total",
+    "Tokens drained from local quota buckets to reconcile remote usage, "
+    "by field (rps/vps)",
+    ("field",),
 )
 
 # Tenant label cardinality rides the ONE health-plane budget
@@ -278,6 +306,9 @@ ADMIN_ROUTES = frozenset({
     # exporting, and reading it are operator actions, not tenant reads
     "/captures/start", "/captures/stop", "/captures/export",
     "/debug/captures",
+    # minting tenant tokens hands out credentials; gossip mutates quota
+    # bucket state — both are fleet/operator mutations
+    "/edge/token", "/edge/gossip",
 })
 
 
@@ -485,6 +516,24 @@ class TokenBucket:
             need = min(n, self.capacity) - self.tokens
             return False, need / self.rate if self.rate > 0 else 60.0
 
+    def drain(self, n: float) -> None:
+        """Remove `n` tokens WITHOUT admitting anything — the gossip
+        reconciliation hook.  Unlike take(), the balance may go negative
+        (down to -capacity): remote admissions already happened, and the
+        debt makes this replica refuse local traffic until the aggregate
+        rate is repaid.  The floor bounds recovery time — a long
+        partition must not leave a tenant locked out for minutes after
+        it heals."""
+        if n <= 0:
+            return
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(
+                self.capacity, self.tokens + (now - self.stamp) * self.rate
+            )
+            self.stamp = now
+            self.tokens = max(-self.capacity, self.tokens - n)
+
 
 class CpuMeter:
     """Sliding-window cpu-seconds enforcement against the PR 7 usage
@@ -645,6 +694,7 @@ class EdgeChain:
         burst_s: float = 2.0,
         cpu_window_s: float = 60.0,
         internal_token: str | None = None,
+        token_secret: bytes | None = None,
     ):
         # MISAKA_EDGE_INTERNAL_TOKEN: a per-boot secret the fleet parent
         # mints and hands its replicas, presented as the key on the
@@ -653,6 +703,9 @@ class EdgeChain:
         # never roll, because no operator key lives in the parent.
         # Admin-scoped, never persisted, dies with the fleet process.
         self.internal_token = internal_token
+        # signed short-lived tenant tokens (see mint_tenant_token): any
+        # replica holding the secret verifies locally, zero coordination
+        self.token_secret = token_secret if auth_enabled else None
         self.keyfile = keyfile if auth_enabled else None
         self.quota_defaults = dict(quota_defaults or {})
         self.governor = governor if admission_enabled else None
@@ -667,14 +720,25 @@ class EdgeChain:
         self._buckets: dict[tuple[str, str, float], TokenBucket] = {}
         self._cpu_meters: dict[str, CpuMeter] = {}
         self._program_quotas: dict[str, dict[str, float]] = {}
+        # fleet-coherent quota state: cumulative admitted quota tokens
+        # per (capped tenant label, field), exchanged as usage gossip
+        # (usage_snapshot/apply_remote_usage) so sibling replicas drain
+        # each other's buckets instead of each admitting the full quota
+        self._gossip_lock = threading.Lock()
+        self._usage: dict[tuple[str, str], float] = {}
+        self._gossip_applied: dict[str, dict[str, float]] = {}
 
     # -- configuration hooks -------------------------------------------------
 
     @property
     def armed(self) -> bool:
-        """True when ANY stage can reject (the fast-path gate)."""
+        """True when ANY stage can reject (the fast-path gate).  A token
+        secret arms the chain on its own: a presented-but-expired tenant
+        token must answer its typed 401 even on a replica with no key
+        table and every other stage disarmed."""
         return (
             self.keyfile is not None
+            or self.token_secret is not None
             or self.quota_enabled
             or self.governor is not None
         )
@@ -719,6 +783,20 @@ class EdgeChain:
             return "_fleet", {"tenant": "_fleet", "admin": True,
                               "programs": None, "quota": None,
                               "quota_spec": None, "disabled": False}
+        if (
+            self.token_secret is not None
+            and key is not None
+            and key.startswith(TOKEN_PREFIX)
+        ):
+            # signed tenant token: verified locally, no key-table entry
+            # needed — the zero-coordination multi-replica credential
+            entry, why = verify_tenant_token(self.token_secret, key)
+            _token_child(why).inc()
+            if entry is not None:
+                return entry["tenant"], entry
+            # typed 401 downstream (never fall through to the key table:
+            # an expired token must say so, not "unknown API key")
+            return (program or "default"), {"_bad_token": why}
         entry = self.keyfile.lookup(key) if self.keyfile is not None else None
         if entry is not None:
             return entry["tenant"], entry
@@ -810,6 +888,17 @@ class EdgeChain:
         every stateful stage keys on it."""
         tenant = tenant_label
         for stage in stages:
+            if stage in ("auth", "auth_admin") and (
+                entry is not None and entry.get("_bad_token")
+            ):
+                # a presented-but-unverifiable tenant token is always a
+                # typed 401, even when no key table is armed
+                why = entry["_bad_token"]
+                return EdgeReject(
+                    401, "unauthenticated",
+                    "tenant token expired; mint a new one at /edge/token"
+                    if why == "expired" else "tenant token invalid",
+                )
             if stage in ("auth", "auth_admin") and self.keyfile is not None:
                 if key is None:
                     return EdgeReject(
@@ -866,9 +955,8 @@ class EdgeChain:
             # to split; clamp the charge at capacity so the frame can
             # eventually be admitted (the vps/value quota remains the
             # precise limiter)
-            ok, retry = bucket.take(
-                min(max(1.0, float(requests)), bucket.capacity)
-            )
+            charge = min(max(1.0, float(requests)), bucket.capacity)
+            ok, retry = bucket.take(charge)
             if not ok:
                 return EdgeReject(
                     429, "rate",
@@ -876,6 +964,7 @@ class EdgeChain:
                     f"({q['rps']:g} requests/s)",
                     retry_after=retry,
                 )
+            self._note_usage(tenant, "rps", charge)
         if "vps" in q:
             bucket = self._bucket(tenant, "vps", q["vps"])
             if values > bucket.capacity and requests <= 1:
@@ -892,15 +981,15 @@ class EdgeChain:
                     f"burst capacity ({bucket.capacity:g} at "
                     f"{q['vps']:g} values/s); split the request",
                 )
-            ok, retry = bucket.take(
-                min(max(1.0, float(values)), bucket.capacity)
-            )
+            charge = min(max(1.0, float(values)), bucket.capacity)
+            ok, retry = bucket.take(charge)
             if not ok:
                 return EdgeReject(
                     429, "values",
                     f"value rate quota exhausted ({q['vps']:g} values/s)",
                     retry_after=retry,
                 )
+            self._note_usage(tenant, "vps", charge)
         if "cpu" in q and self.cpu_reader is not None:
             # cpu budgets are PER PROGRAM by construction: the usage
             # ledger attributes cpu_seconds to programs, so a program's
@@ -929,11 +1018,78 @@ class EdgeChain:
                 )
         return None
 
+    # -- fleet-coherent quota state (usage gossip) --------------------------
+
+    def _note_usage(self, label: str, field: str, n: float) -> None:
+        """Record `n` admitted quota tokens for a (capped) tenant label —
+        the cumulative counter usage gossip ships to sibling replicas."""
+        if n <= 0:
+            return
+        with self._gossip_lock:
+            k = (label, field)
+            self._usage[k] = self._usage.get(k, 0.0) + n
+
+    def usage_snapshot(self) -> dict[str, float]:
+        """Cumulative admitted quota tokens since boot, keyed
+        "tenant|field".  MONOTONE counters, not deltas: receivers apply
+        per-source deltas themselves (apply_remote_usage), so a snapshot
+        is idempotent — a lost or duplicated gossip round delays
+        reconciliation, never double-counts it."""
+        with self._gossip_lock:
+            return {
+                f"{t}|{f}": round(v, 3) for (t, f), v in self._usage.items()
+            }
+
+    def apply_remote_usage(self, usage: dict, source: str = "peer") -> int:
+        """Reconcile remote admissions into the local buckets: drain each
+        matching bucket by the DELTA of `usage` (cumulative counters from
+        usage_snapshot) since the last application from `source`.
+
+        Only EXISTING buckets are drained — gossip must not mint
+        per-tenant state for names this replica never admitted (the same
+        cardinality discipline as the metric labels), and a tenant with
+        no local traffic has nothing to over-admit.  A counter that went
+        BACKWARDS re-anchors (the source restarted; treating the reset as
+        a huge negative delta would hand the tenant free quota).  Returns
+        the number of buckets drained."""
+        if not isinstance(usage, dict):
+            raise ValueError("usage must map 'tenant|field' -> total")
+        deltas: list[tuple[str, str, float]] = []
+        with self._gossip_lock:
+            last = self._gossip_applied.setdefault(source, {})
+            for key, total in usage.items():
+                try:
+                    tot = float(total)
+                except (TypeError, ValueError):
+                    continue
+                prev = last.get(key, 0.0)
+                if tot > prev:
+                    tenant, _, field = str(key).rpartition("|")
+                    if field in ("rps", "vps"):
+                        deltas.append((tenant, field, tot - prev))
+                last[key] = tot
+        drained = 0
+        for tenant, field, delta in deltas:
+            with self._lock:
+                buckets = [
+                    b for (t, f, _r), b in self._buckets.items()
+                    if t == tenant and f == field
+                ]
+            for b in buckets:
+                b.drain(delta)
+                drained += 1
+            if buckets:
+                M_EDGE_GOSSIP_DRAINED.labels(field=field).inc(
+                    delta * len(buckets)
+                )
+        return drained
+
     def debug_payload(self) -> dict:
         """The /healthz `edge` block: which stages are armed."""
         return {
             "auth": self.keyfile is not None,
             "keys": len(self.keyfile) if self.keyfile is not None else 0,
+            "tokens": self.token_secret is not None,
             "quota": self.quota_enabled,
             "admission": self.governor is not None,
             "admission_high": self.governor.high
@@ -1004,7 +1160,13 @@ def from_env(
     burst window (2s); MISAKA_QUOTA_CPU_WINDOW_S the cpu quota's sliding
     window (60s).  In a fleet, EACH replica enforces the full quota
     locally (see the in-body note on why 1/N scaling would starve
-    hash-ring-sticky tenants)."""
+    hash-ring-sticky tenants); the fleet parent's usage gossip
+    (apply_remote_usage) reconciles the buckets so aggregate
+    over-admission stays bounded by the burst window, not Nx.
+
+    MISAKA_TOKEN_SECRET[_FILE] (falling back to the plane secret) arms
+    signed short-lived tenant tokens: /edge/token mints them, every
+    replica holding the secret verifies them locally."""
     if environ.get("MISAKA_EDGE", "1") == "0":
         return _DISARMED
     auth_on = environ.get("MISAKA_EDGE_AUTH", "1") != "0"
@@ -1024,9 +1186,9 @@ def from_env(
     # tempting 1/N scaling is wrong for program-addressed traffic, which
     # the router hash-rings to ONE replica — that tenant would be shed
     # at quota/N while the other replicas' buckets sit idle.  Full-quota
-    # per replica over-admits stateless traffic by up to Nx (admission
-    # control still protects capacity); sharing bucket state across
-    # replicas is the ROADMAP's named phase-2 item.
+    # per replica would over-admit stateless traffic by up to Nx; the
+    # fleet hub's usage gossip (apply_remote_usage) reconciles the
+    # buckets so the aggregate stays bounded by the burst window.
     rate_scale = 1.0
     return EdgeChain(
         keyfile=keyfile,
@@ -1042,6 +1204,7 @@ def from_env(
             environ.get("MISAKA_QUOTA_CPU_WINDOW_S", "") or 60.0
         ),
         internal_token=environ.get("MISAKA_EDGE_INTERNAL_TOKEN") or None,
+        token_secret=token_secret(environ) if auth_on else None,
     )
 
 
@@ -1151,6 +1314,242 @@ def verify_plane_handshake(secret: bytes, presented: bytes) -> bool:
     return hmac.compare_digest(plane_handshake(secret), presented)
 
 
+# --- signed tenant tokens ---------------------------------------------------
+#
+# Static API keys are long-lived shared secrets: revocation means a key-
+# file rotation shipped to every replica.  Tenant tokens are the fleet
+# credential: short-lived, HMAC-signed under one fleet-wide secret,
+# minted by the admin route POST /edge/token, and verified LOCALLY at
+# every replica — no key-table distribution, no verification RPC, zero
+# coordination.  Wire shape:
+#
+#     mst1.<base64url payload>.<base64url HMAC-SHA256 sig>
+#
+# payload JSON: {"t": tenant, "exp": epoch-seconds, "adm": bool?,
+# "p": [programs]?}.  Expiry is wall-clock (epoch) on purpose — tokens
+# cross hosts, and monotonic clocks don't.
+
+_TOKEN_TAG = b"misaka-tenant-token-v1"
+TOKEN_PREFIX = "mst1."
+
+_token_children = {
+    op: M_EDGE_TOKENS.labels(op=op)
+    for op in ("mint", "ok", "expired", "invalid")
+}
+
+
+def _token_child(op: str):
+    return _token_children[op]
+
+
+def token_secret(environ=os.environ) -> bytes | None:
+    """The tenant-token signing secret: MISAKA_TOKEN_SECRET, or
+    MISAKA_TOKEN_SECRET_FILE, falling back to the plane secret (one
+    fleet-wide secret already distributed to every replica).  None
+    disarms minting AND verification — a bare `mst1.` string is then
+    just an unknown API key."""
+    s = environ.get("MISAKA_TOKEN_SECRET")
+    if s:
+        return s.encode()
+    p = environ.get("MISAKA_TOKEN_SECRET_FILE")
+    if p:
+        try:
+            with open(p, "rb") as f:
+                return f.read().strip() or None
+        except OSError:
+            log.error("edge: token secret file %s unreadable", p)
+            return None
+    return plane_secret(environ)
+
+
+def _token_sign(secret: bytes, payload_b64: bytes) -> bytes:
+    return hmac.new(
+        secret, _TOKEN_TAG + b"." + payload_b64, hashlib.sha256
+    ).digest()
+
+
+def mint_tenant_token(
+    secret: bytes,
+    tenant: str,
+    ttl_s: float = 300.0,
+    admin: bool = False,
+    programs=None,
+    now: float | None = None,
+) -> tuple[str, float]:
+    """Mint a signed tenant token -> (token, expires_at_epoch)."""
+    exp = (time.time() if now is None else now) + max(1.0, float(ttl_s))
+    payload: dict = {"t": tenant, "exp": round(exp, 3)}
+    if admin:
+        payload["adm"] = True
+    if programs:
+        payload["p"] = sorted(programs)
+    pb = base64.urlsafe_b64encode(
+        json.dumps(payload, separators=(",", ":")).encode()
+    ).rstrip(b"=")
+    sig = base64.urlsafe_b64encode(_token_sign(secret, pb)).rstrip(b"=")
+    _token_child("mint").inc()
+    return TOKEN_PREFIX + pb.decode() + "." + sig.decode(), float(
+        payload["exp"]
+    )
+
+
+def verify_tenant_token(
+    secret: bytes, token: str, now: float | None = None
+) -> tuple[dict | None, str]:
+    """-> (entry, why): a synthetic key-table entry and "ok" on success;
+    (None, "invalid"|"expired") otherwise.  The SIGNATURE is checked
+    before the payload is parsed — unsigned bytes never reach json."""
+    body = token[len(TOKEN_PREFIX):] if token.startswith(TOKEN_PREFIX) \
+        else token
+    pb_s, _, sig_s = body.partition(".")
+    if not pb_s or not sig_s:
+        return None, "invalid"
+    try:
+        pb = pb_s.encode("ascii")
+        sig = base64.urlsafe_b64decode(
+            sig_s.encode("ascii") + b"=" * (-len(sig_s) % 4)
+        )
+        if not hmac.compare_digest(_token_sign(secret, pb), sig):
+            return None, "invalid"
+        payload = json.loads(base64.urlsafe_b64decode(pb + b"=" * (-len(pb_s) % 4)))
+        tenant = payload["t"]
+        exp = float(payload["exp"])
+        programs = payload.get("p")
+        if not isinstance(tenant, str) or (
+            programs is not None and not isinstance(programs, list)
+        ):
+            return None, "invalid"
+    except (ValueError, TypeError, KeyError, UnicodeDecodeError):
+        return None, "invalid"
+    if (time.time() if now is None else now) >= exp:
+        return None, "expired"
+    return {
+        "tenant": tenant,
+        "admin": bool(payload.get("adm")),
+        "programs": frozenset(programs) if programs is not None else None,
+        "quota": None,
+        "quota_spec": None,
+        "disabled": False,
+        "token_exp": exp,
+    }, "ok"
+
+
+# --- plane mTLS (TCP transport) ---------------------------------------------
+
+
+class PlaneTLSReloader:
+    """Hot-reloadable mTLS contexts for the TCP compute plane.
+
+    MISAKA_PLANE_TLS_CERT/KEY/CA name this process's certificate, its
+    private key, and the pinned fleet CA.  BOTH sides authenticate: the
+    plane server requires a client certificate signed by the CA
+    (CERT_REQUIRED), and PlaneClient verifies the server's chain against
+    the same CA.  Hostnames are NOT checked — identity in this trust
+    model is CA membership (any cert the fleet CA signed is a fleet
+    member), not DNS names, so certs work unchanged across rehoming.
+
+    Rotation without restart: the three files' mtime+size are stat'd at
+    most every 0.5s (the api-key table's discipline); a change rebuilds
+    both contexts, and NEW connections pick them up while established
+    sessions keep streaming — zero dropped frames.  A rebuild that fails
+    (half-written files mid-rotation) KEEPS the previous contexts and
+    counts misaka_plane_tls_reloads_total{status="error"}; the stamp is
+    recorded so a broken rotation is not re-parsed hot, and the next
+    file change retries.
+    """
+
+    def __init__(self, cert: str, key: str, ca: str):
+        self.cert, self.key, self.ca = cert, key, ca
+        self._lock = threading.Lock()
+        self._next_stat = 0.0
+        self._stamp = self._stat()  # raises on missing files: fail loud
+        # first build raises too — a plane that silently ran plaintext
+        # after a bad cert would be worse than one that refused to boot
+        self._server, self._client = self._make()
+
+    def _stat(self) -> tuple:
+        out = []
+        for p in (self.cert, self.key, self.ca):
+            st = os.stat(p)
+            out.append((st.st_mtime, st.st_size))
+        return tuple(out)
+
+    def _make(self) -> tuple[ssl.SSLContext, ssl.SSLContext]:
+        server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server.minimum_version = ssl.TLSVersion.TLSv1_2
+        server.load_cert_chain(self.cert, self.key)
+        server.load_verify_locations(self.ca)
+        server.verify_mode = ssl.CERT_REQUIRED
+        client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        client.minimum_version = ssl.TLSVersion.TLSv1_2
+        client.load_cert_chain(self.cert, self.key)
+        client.load_verify_locations(self.ca)
+        client.check_hostname = False  # CA-pinned, not DNS identity
+        client.verify_mode = ssl.CERT_REQUIRED
+        return server, client
+
+    def _maybe_reload(self) -> None:
+        now = time.monotonic()
+        if now < self._next_stat:
+            return
+        with self._lock:
+            if now < self._next_stat:
+                return
+            self._next_stat = now + 0.5
+            try:
+                stamp = self._stat()
+            except OSError:
+                return  # mid-rotation rename window: keep serving
+            if stamp == self._stamp:
+                return
+            self._stamp = stamp  # don't re-parse a broken rotation hot
+            try:
+                server, client = self._make()
+            except (OSError, ssl.SSLError, ValueError) as e:
+                M_PLANE_TLS_RELOADS.labels(status="error").inc()
+                log.error("edge: plane TLS reload failed (%s); keeping "
+                          "the previous certificates", e)
+                return
+            self._server, self._client = server, client
+            M_PLANE_TLS_RELOADS.labels(status="ok").inc()
+            log.info("edge: plane TLS certificates reloaded from %s",
+                     self.cert)
+
+    def server_context(self) -> ssl.SSLContext:
+        self._maybe_reload()
+        return self._server
+
+    def client_context(self) -> ssl.SSLContext:
+        self._maybe_reload()
+        return self._client
+
+
+def plane_tls_from_env(environ=os.environ) -> PlaneTLSReloader | None:
+    """The plane's mTLS material from MISAKA_PLANE_TLS_CERT/KEY/CA (None
+    when unset — TCP planes then run plaintext + HMAC handshake, the
+    single-box/bench posture; never deploy that across hosts).  Raises
+    when the triple is only partially set or fails to load."""
+    cert = environ.get("MISAKA_PLANE_TLS_CERT")
+    key = environ.get("MISAKA_PLANE_TLS_KEY")
+    ca = environ.get("MISAKA_PLANE_TLS_CA")
+    if not cert and not key and not ca:
+        return None
+    if not (cert and key and ca):
+        raise ValueError(
+            "MISAKA_PLANE_TLS_CERT, MISAKA_PLANE_TLS_KEY and "
+            "MISAKA_PLANE_TLS_CA must be set together"
+        )
+    return PlaneTLSReloader(cert, key, ca)
+
+
+def count_plane_tls_reject(reason: str) -> None:
+    """One refused plane connection at the mTLS gate (typed, counted
+    close — the acceptance criterion's observable)."""
+    M_PLANE_TLS_REJECTED.labels(
+        reason=reason if reason in ("plaintext", "bad_cert") else "handshake"
+    ).inc()
+
+
 # --- native-edge state push -------------------------------------------------
 
 
@@ -1178,8 +1577,13 @@ def native_edge_state(chain: EdgeChain | None = None) -> dict:
     if chain is None:
         chain = current()
     state: dict = {
-        # keyfile is already None when auth is disabled (__init__ guards)
-        "auth_armed": chain.keyfile is not None,
+        # keyfile is already None when auth is disabled (__init__ guards).
+        # With tenant TOKENS armed the native tier must NOT pre-reject:
+        # a valid token is not in the digest table (it is verified, not
+        # looked up), so local 401s would reject real credentials — the
+        # tier forwards everything and the engine chain decides.
+        "auth_armed": chain.keyfile is not None
+        and chain.token_secret is None,
         "digests": {},
         "reject_missing": (
             "API key required (X-Misaka-Key header or "

@@ -95,6 +95,15 @@ M_FLEET_ROLLS = metrics.counter(
     "Rolling restarts completed by the fleet manager",
     ("status",),  # "ok" | "failed"
 )
+M_FLEET_PEERS_UP = metrics.gauge(
+    "misaka_fleet_peers_up",
+    "Registered remote peers currently passing health probes",
+)
+M_FLEET_GOSSIP = metrics.counter(
+    "misaka_fleet_gossip_total",
+    "Usage-gossip exchanges driven by the fleet hub, per target outcome",
+    ("status",),  # "ok" | "error"
+)
 
 
 # --- consistent hashing -----------------------------------------------------
@@ -149,6 +158,42 @@ class HashRing:
 # --- small shared helpers ---------------------------------------------------
 
 
+def parse_fleet_peers(spec: str | None) -> list[dict]:
+    """`MISAKA_FLEET_PEERS="host:port[:planeport],..."` -> peer descriptors.
+
+    `port` is the peer's HTTP control/replica port (the surface the fleet
+    probes and drives the roll protocol against); its compute plane
+    defaults to `port + 1` on the same host unless a third field pins it.
+    Malformed entries are a hard error — a typo'd peer silently dropped
+    from supervision would be worse than no peer.
+    """
+    peers: list[dict] = []
+    for raw in (spec or "").split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (2, 3) or not parts[0]:
+            raise ValueError(
+                f"MISAKA_FLEET_PEERS entry {entry!r}: want "
+                f"host:port or host:port:planeport"
+            )
+        try:
+            port = int(parts[1])
+            plane_port = int(parts[2]) if len(parts) == 3 else port + 1
+        except ValueError:
+            raise ValueError(
+                f"MISAKA_FLEET_PEERS entry {entry!r}: ports must be "
+                f"integers"
+            ) from None
+        peers.append({
+            "host": parts[0],
+            "port": port,
+            "plane": f"{parts[0]}:{plane_port}",
+        })
+    return peers
+
+
 def verify_manifest(path: str) -> None:
     """Stdlib-only strict manifest gate for a JUST-WRITTEN checkpoint:
     the sidecar must exist and its size + sha256 must match the file.
@@ -187,11 +232,14 @@ def verify_manifest(path: str) -> None:
 
 
 class _ReplicaHTTP:
-    """Tiny keep-alive-free HTTP helper against one replica's loopback
-    server (control-plane calls are rare; simplicity over pooling)."""
+    """Tiny keep-alive-free HTTP helper against one replica's control
+    server (control-plane calls are rare; simplicity over pooling).
+    Local replicas live on loopback; registered remote peers pass their
+    own host."""
 
     def __init__(self, port: int, timeout: float = 10.0,
-                 key: str | None = None):
+                 key: str | None = None, host: str = "127.0.0.1"):
+        self.host = host
         self.port = port
         self.timeout = timeout
         # the fleet's per-boot internal token (see FleetManager): the
@@ -204,7 +252,7 @@ class _ReplicaHTTP:
                 headers: dict | None = None,
                 timeout: float | None = None) -> tuple[int, bytes, dict]:
         conn = http.client.HTTPConnection(
-            "127.0.0.1", self.port,
+            self.host, self.port,
             timeout=self.timeout if timeout is None else timeout,
         )
         headers = dict(headers or {})
@@ -222,10 +270,18 @@ class _ReplicaHTTP:
         status, body, _ = self.request("GET", path, timeout=timeout)
         if status != 200:
             raise RuntimeError(
-                f"GET {path} on :{self.port} -> {status}: "
+                f"GET {path} on {self.host}:{self.port} -> {status}: "
                 f"{body[:200].decode(errors='replace')}"
             )
         return json.loads(body)
+
+    def post_json(self, path: str, obj,
+                  timeout: float | None = None) -> tuple[int, bytes]:
+        status, payload, _ = self.request(
+            "POST", path, json.dumps(obj).encode(),
+            {"Content-Type": "application/json"}, timeout=timeout,
+        )
+        return status, payload
 
     def post_form(self, path: str, timeout: float | None = None,
                   **fields) -> tuple[int, bytes]:
@@ -345,6 +401,43 @@ class FleetManager:
                 "restore": None,    # checkpoint to restore on next spawn
                 "run_on_boot": None,  # roll-preserved run state (one-shot)
             })
+        # Static remote peers (MISAKA_FLEET_PEERS): replicas on OTHER
+        # hosts this fleet routes to and supervises remotely.  They live
+        # in a SEPARATE list — the monitor loop owns self._slots and
+        # would try to respawn a peer it cannot spawn (the peer's own
+        # host supervisor replaces its process; we probe, route, drain,
+        # and checkpoint it over its control port).  Peer indices follow
+        # the local slots so router/report rows stay unambiguous.
+        self._peers: list[dict] = []
+        for j, peer in enumerate(
+            parse_fleet_peers(self._base_env.get("MISAKA_FLEET_PEERS"))
+        ):
+            peer.update({
+                "idx": self.n + j,
+                "probe_fails": 0,
+                "probe_ok": False,
+                "running": None,
+                "degraded": False,
+                "rolling": False,
+                "remote": True,
+            })
+            self._peers.append(peer)
+        # Credential for remote peer control calls: peers are separate
+        # boots with their own random internal tokens, so cross-host
+        # calls need a SHARED key — an operator-provisioned admin key
+        # (MISAKA_FLEET_PEER_KEY, typically a pinned
+        # MISAKA_EDGE_INTERNAL_TOKEN on the peer side).  Falls back to
+        # this boot's internal token for same-host peer topologies.
+        self._peer_key = (
+            self._base_env.get("MISAKA_FLEET_PEER_KEY")
+            or self._internal_token
+        )
+        self._gossip_s = float(
+            self._base_env.get("MISAKA_GOSSIP_S", "0.5") or 0.5
+        )
+        # the gossip hub's per-source cumulative usage snapshots
+        # (source key -> {"tenant|field": monotone counter})
+        self._gossip_seen: dict[str, dict[str, float]] = {}
         self._threads: list[threading.Thread] = []
 
     # --- lifecycle ----------------------------------------------------------
@@ -365,6 +458,12 @@ class FleetManager:
         M_FLEET_ALIVE.set_function(
             lambda: f.alive() if (f := ref()) is not None else 0
         )
+        M_FLEET_PEERS_UP.set_function(
+            lambda: (
+                sum(1 for p in f._peers if p["probe_ok"])
+                if (f := ref()) is not None else 0
+            )
+        )
         monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="misaka-fleet-monitor"
         )
@@ -374,6 +473,20 @@ class FleetManager:
             t = threading.Thread(
                 target=self._probe_loop, args=(slot,), daemon=True,
                 name=f"misaka-fleet-probe-{slot['idx']}",
+            )
+            t.start()
+            self._threads.append(t)
+        for peer in self._peers:
+            t = threading.Thread(
+                target=self._peer_probe_loop, args=(peer,), daemon=True,
+                name=f"misaka-fleet-peer-probe-{peer['idx']}",
+            )
+            t.start()
+            self._threads.append(t)
+        if self._gossip_s > 0 and (self._peers or self.n > 1):
+            t = threading.Thread(
+                target=self._gossip_loop, daemon=True,
+                name="misaka-fleet-gossip",
             )
             t.start()
             self._threads.append(t)
@@ -432,7 +545,13 @@ class FleetManager:
     # --- spawning -----------------------------------------------------------
 
     def plane_paths(self) -> list[str]:
-        return [s["plane"] for s in self._slots]
+        """Every compute-plane address the router fans across: local unix
+        sockets first, then remote peers' `host:port` planes — router
+        replica indices line up with slot/peer `idx`."""
+        return (
+            [s["plane"] for s in self._slots]
+            + [p["plane"] for p in self._peers]
+        )
 
     def _replica_env(self, slot: dict) -> dict:
         env = dict(self._base_env)
@@ -586,6 +705,19 @@ class FleetManager:
             return "down"
         return "degraded" if slot["probe_fails"] else "starting"
 
+    def peer_state(self, peer: dict) -> str:
+        """The replica state machine, applied to a remote peer.  There is
+        no local process to poll, so liveness is probe-only: the same
+        up/starting/degraded/down ladder, plus "draining" while a roll
+        owns the peer."""
+        if peer["rolling"]:
+            return "draining"
+        if peer["probe_ok"]:
+            return "up"
+        if peer["probe_fails"] >= self._down_after:
+            return "down"
+        return "degraded" if peer["probe_fails"] else "starting"
+
     def state(self) -> dict:
         """The /healthz + /status fleet block: per-replica rows plus an
         explicit `degraded` flag (any replica not up) — the same
@@ -610,6 +742,22 @@ class FleetManager:
                         and s["breaker_until"] > now
                     ),
                 })
+            for p in self._peers:
+                # remote peers ride the same rows (same no-silent-
+                # degradation contract: a down peer must surface on the
+                # fleet /healthz, not vanish from it)
+                rows.append({
+                    "replica": p["idx"],
+                    "state": self.peer_state(p),
+                    "pid": None,
+                    "host": p["host"],
+                    "port": p["port"],
+                    "restarts": None,  # the peer's own supervisor counts
+                    "running": p["running"],
+                    "degraded": p["degraded"],
+                    "breaker_open": False,
+                    "remote": True,
+                })
             restarts = self._restarts_total
             rolls = self._rolls_total
             last_roll = self._last_roll
@@ -619,6 +767,11 @@ class FleetManager:
             "configured": len(rows),
             "alive": alive,
             "up": up,
+            "peers": len(self._peers),
+            "peers_up": sum(
+                1 for r in rows
+                if r.get("remote") and r["state"] == "up"
+            ),
             "replicas": rows,
             "restarts_total": restarts,
             "rolls_total": rolls,
@@ -690,6 +843,111 @@ class FleetManager:
             else:
                 slot["probe_ok"] = False
                 slot["probe_fails"] += 1
+
+    def _peer_probe_loop(self, peer: dict) -> None:
+        """Remote-peer health: GET /healthz over the peer's control port
+        on the local probe cadence.  Transitions ride peer_state(); a
+        peer that stops answering walks up -> degraded -> down exactly
+        like a local replica whose probes fail — the compute-plane
+        router's own peer accounting (suspect holds, hedges) handles the
+        data plane; this loop is the fleet-/healthz + roll-gate view."""
+        rh = _ReplicaHTTP(peer["port"], timeout=2.0,
+                          key=self._peer_key, host=peer["host"])
+        while not self._closed:
+            time.sleep(self._probe_s)
+            if peer["rolling"]:
+                # the roll owns the peer: its drain makes /healthz read
+                # degraded by design; probing through it would flap the
+                # state the roll is waiting on
+                continue
+            try:
+                payload = rh.get_json("/healthz")
+                ok = bool(payload.get("ok"))
+                peer["running"] = bool(payload.get("running"))
+                peer["degraded"] = bool(payload.get("degraded"))
+            except (OSError, RuntimeError, ValueError):
+                ok = False
+            if ok:
+                if not peer["probe_ok"]:
+                    log.info("peer %d (%s:%d) is up", peer["idx"],
+                             peer["host"], peer["port"])
+                peer["probe_ok"] = True
+                peer["probe_fails"] = 0
+            else:
+                if peer["probe_ok"]:
+                    log.warning("peer %d (%s:%d) failed a probe",
+                                peer["idx"], peer["host"], peer["port"])
+                peer["probe_ok"] = False
+                peer["probe_fails"] += 1
+
+    # --- usage gossip hub ---------------------------------------------------
+
+    def _gossip_targets(self) -> list[tuple[str, _ReplicaHTTP]]:
+        """(source-key, http helper) for every gossip participant that is
+        currently up: local replicas over loopback with the internal
+        token, remote peers over their control port with the peer key."""
+        targets: list[tuple[str, _ReplicaHTTP]] = []
+        with self._lock:
+            for s in self._slots:
+                if self.replica_state(s) == "up":
+                    targets.append((
+                        f"replica-{s['idx']}",
+                        _ReplicaHTTP(s["port"], timeout=2.0,
+                                     key=self._internal_token),
+                    ))
+        for p in self._peers:
+            if self.peer_state(p) == "up":
+                targets.append((
+                    f"peer-{p['idx']}",
+                    _ReplicaHTTP(p["port"], timeout=2.0,
+                                 key=self._peer_key, host=p["host"]),
+                ))
+        return targets
+
+    def _gossip_loop(self) -> None:
+        """Star-topology usage gossip: every `MISAKA_GOSSIP_S` the hub
+        POSTs each participant the SUM of every OTHER participant's
+        cumulative per-tenant admissions and collects that participant's
+        own snapshot from the response.  Sums of monotone counters are
+        monotone, so each edge chain's per-source delta accounting
+        (edge.apply_remote_usage) stays correct, and one round-trip per
+        target per round bounds a flooded tenant's aggregate
+        over-admission to ~1 + burst window / flood window instead of
+        Nx (see ARCHITECTURE.md).  Piggybacks on the probe/stats
+        channel: plain control-port HTTP, no new listener."""
+        while not self._closed:
+            time.sleep(self._gossip_s)
+            self._gossip_round()
+
+    def _gossip_round(self) -> None:
+        """One hub round: exchange with every up participant."""
+        for source, rh in self._gossip_targets():
+            if self._closed:
+                return
+            merged: dict[str, float] = {}
+            for other, usage in self._gossip_seen.items():
+                if other == source:
+                    continue
+                for key, total in usage.items():
+                    merged[key] = merged.get(key, 0.0) + total
+            try:
+                status, body = rh.post_json("/edge/gossip", {
+                    "source": "fleet-hub",
+                    "usage": merged,
+                })
+                if status != 200:
+                    raise RuntimeError(f"gossip -> {status}")
+                payload = json.loads(body)
+                snap = payload.get("usage")
+                if isinstance(snap, dict):
+                    self._gossip_seen[source] = {
+                        str(k): float(v) for k, v in snap.items()
+                    }
+                M_FLEET_GOSSIP.labels(status="ok").inc()
+            except (OSError, RuntimeError, ValueError):
+                # a down/draining participant just misses rounds; its
+                # last snapshot keeps reconciling into the others
+                M_FLEET_GOSSIP.labels(status="error").inc()
 
     def _monitor_loop(self) -> None:
         while True:
@@ -817,6 +1075,19 @@ class FleetManager:
                     }
                 raise
             report.append(entry)
+        for peer in self._peers:
+            try:
+                entry = self._roll_peer(peer, drain_timeout_s)
+            except Exception:
+                M_FLEET_ROLLS.labels(status="failed").inc()
+                with self._lock:
+                    self._last_roll = {
+                        "ok": False,
+                        "replicas": report,
+                        "failed_replica": peer["idx"],
+                    }
+                raise
+            report.append(entry)
         with self._lock:
             self._rolls_total += 1
             self._last_roll = {
@@ -939,6 +1210,117 @@ class FleetManager:
         finally:
             with self._lock:
                 slot["rolling"] = False
+
+    def _roll_peer(self, peer: dict, drain_timeout_s: float) -> dict:
+        """Drive one REMOTE peer through the roll protocol: drain to
+        quiescence -> checkpoint -> undrain -> readmit.
+
+        Same drain/quiescence/checkpoint steps as a local slot, with two
+        honest differences a remote boundary forces: the checkpoint is
+        trusted on the peer's 200 (its durable save path verifies the
+        manifest on its own disk — this host cannot read it), and the
+        process is NOT replaced (the peer host's own supervisor owns its
+        process lifecycle; a roll leaves the peer checkpointed and
+        serving, ready for its supervisor to restart it restore-armed).
+        A failed step undrains and raises — same "deploy didn't happen,
+        replica not lost" contract as the local path.
+        """
+        idx = peer["idx"]
+        rh = _ReplicaHTTP(peer["port"], timeout=10.0,
+                          key=self._peer_key, host=peer["host"])
+        entry: dict = {"replica": idx, "remote": True,
+                       "host": peer["host"]}
+        heal_deadline = time.monotonic() + self._boot_timeout_s
+        while True:
+            state = self.peer_state(peer)
+            if state == "up":
+                peer["rolling"] = True  # peer prober hands off (skips)
+                break
+            if time.monotonic() >= heal_deadline:
+                raise RuntimeError(
+                    f"roll aborted: peer {idx} ({peer['host']}:"
+                    f"{peer['port']}) is {state}, not up "
+                    f"(heal the fleet before rolling)"
+                )
+            time.sleep(0.2)
+        try:
+            t0 = time.monotonic()
+            status, body = rh.post_form("/fleet/drain", state="on")
+            if status != 200:
+                raise RuntimeError(
+                    f"peer {idx}: drain request failed "
+                    f"({status}: {body[:200].decode(errors='replace')})"
+                )
+            deadline = time.monotonic() + drain_timeout_s
+            quiescent = 0
+            while time.monotonic() < deadline:
+                payload = json.loads(rh.post_form("/fleet/drain",
+                                                  state="on")[1])
+                if (
+                    payload.get("inflight", 1) == 0
+                    and payload.get("http_inflight", 0) == 0
+                ):
+                    quiescent += 1
+                    if quiescent >= 2:
+                        break
+                else:
+                    quiescent = 0
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(
+                    f"peer {idx}: did not drain to quiescence within "
+                    f"{drain_timeout_s:.0f}s"
+                )
+            entry["drained_in_s"] = round(time.monotonic() - t0, 3)
+
+            name = f"fleet-roll-{int(time.time())}"
+            status, body = rh.post_form("/checkpoint", name=name,
+                                        timeout=60)
+            if status != 200:
+                raise RuntimeError(
+                    f"peer {idx}: roll checkpoint failed "
+                    f"({status}: {body[:200].decode(errors='replace')})"
+                )
+            entry["checkpoint"] = name
+            entry["restored"] = False  # peer host owns process replacement
+
+            status, body = rh.post_form("/fleet/drain", state="off")
+            if status != 200:
+                raise RuntimeError(
+                    f"peer {idx}: undrain failed "
+                    f"({status}: {body[:200].decode(errors='replace')})"
+                )
+            # readmit: a direct probe, not the prober thread (it skips
+            # while `rolling` is held)
+            t_ready = time.monotonic()
+            readmit_deadline = t_ready + self._boot_timeout_s
+            while True:
+                try:
+                    if rh.get_json("/healthz").get("ok"):
+                        break
+                except (OSError, RuntimeError, ValueError):
+                    pass
+                if time.monotonic() >= readmit_deadline:
+                    raise RuntimeError(
+                        f"peer {idx}: not healthy after undrain"
+                    )
+                time.sleep(0.2)
+            peer["probe_ok"] = True
+            peer["probe_fails"] = 0
+            entry["readmitted_in_s"] = round(
+                time.monotonic() - t_ready, 3
+            )
+            return entry
+        except Exception:
+            # leave the peer serving if it still can (same rationale as
+            # the local undrain retryer, minus process replacement)
+            try:
+                rh.post_form("/fleet/drain", state="off")
+            except Exception:
+                pass
+            raise
+        finally:
+            peer["rolling"] = False
 
     def _undrain_async(self, slot: dict) -> None:
         """Best-effort background undrain after a failed roll step.
@@ -1080,6 +1462,10 @@ def make_fleet_http_server(
         else None,
         quota_enabled=False,
         admission_enabled=False,
+        # minted tenant tokens must authenticate HERE too (the operator
+        # surface /fleet/roll is exactly what a short-lived admin token
+        # is for) — same secret as every replica, zero coordination
+        token_secret=edge_mod.token_secret() if _auth_on else None,
     )
 
     def _merged_series(name: str, window_s: float,

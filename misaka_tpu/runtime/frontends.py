@@ -158,8 +158,11 @@ G_PLANE_PIPE_DEPTH = metrics.gauge(
 )
 G_PLANE_PIPE_DEPTH.set_function(_PIPE_DEPTH_WINDOW.read)
 
-# Compute-plane wire format (unix SOCK_STREAM, one frame in flight per
-# connection — pipelining comes from running several connections):
+# Compute-plane wire format (SOCK_STREAM — a unix socket path, or
+# `host:port` for the multi-host TCP transport (parse_plane_addr), with
+# mTLS wrapping TCP when MISAKA_PLANE_TLS_CERT/KEY/CA are set; one frame
+# in flight per connection — pipelining comes from running several
+# connections):
 #   request:  <I n_values> <I n_meta_bytes>
 #             <n_values * 4 bytes little-endian int32>
 #             <n_meta_bytes of UTF-8 JSON metadata — absent (0) when the
@@ -264,6 +267,51 @@ _PROGRAM_COMPUTE_RE = re.compile(
 )
 
 
+def parse_plane_addr(path: str) -> tuple[str, str, int | None]:
+    """One plane address -> ("tcp", host, port) | ("unix", path, None).
+
+    The multi-host transport rides the SAME env surface as the socket
+    paths: a `host:port` (a ':' and no '/') is a TCP plane, anything
+    else a unix socket path.  The MSK1 frame codec, handshake, drain,
+    probe, and hedge semantics are byte-identical on both."""
+    if ":" in path and "/" not in path:
+        host, _, port_s = path.rpartition(":")
+        try:
+            return "tcp", host or "127.0.0.1", int(port_s)
+        except ValueError:
+            pass
+    return "unix", path, None
+
+
+def _plane_partitioned(path: str) -> bool:
+    """The plane_partition chaos point (utils/faults.py): armed bare it
+    black-holes every plane; scoped `plane_partition:<substr>` only the
+    planes whose address contains that substring — the multi-host
+    partition drill's selector."""
+    if faults.fire("plane_partition") is not None:
+        return True
+    for point in faults.active():
+        if (point.startswith("plane_partition:")
+                and point[len("plane_partition:"):] in path):
+            return faults.fire(point) is not None
+    return False
+
+
+def _classify_tls_reject(e: BaseException) -> str:
+    """Map a failed plane-TLS handshake to its typed close reason:
+    "plaintext" (a peer speaking raw MSK1/HTTP at a TLS listener),
+    "bad_cert" (certificate outside the pinned CA, or none), else
+    "handshake"."""
+    s = str(e).upper()
+    if "CERTIFICATE" in s or "UNKNOWN_CA" in s or "ALERT" in s:
+        return "bad_cert"
+    if ("WRONG_VERSION" in s or "UNKNOWN_PROTOCOL" in s
+            or "HTTP_REQUEST" in s or "RECORD" in s
+            or "PACKET_LENGTH" in s):
+        return "plaintext"
+    return "handshake"
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     """Read exactly n bytes or raise ConnectionError."""
     parts = []
@@ -323,10 +371,24 @@ class ComputePlane:
         # 32-byte HMAC before its first frame or it is closed
         self._secret = edge_mod.plane_secret()
         self.path = path
-        if os.path.exists(path):
-            os.unlink(path)
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(path)
+        self._family, bind_host, bind_port = parse_plane_addr(path)
+        if self._family == "tcp":
+            # the multi-host transport: same frame codec, TCP listener.
+            # mTLS (MISAKA_PLANE_TLS_CERT/KEY/CA) wraps accepted
+            # connections per-connection in _serve_conn; unset runs the
+            # plaintext+HMAC single-box posture (bench/dev only).
+            self._tls = edge_mod.plane_tls_from_env()
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+            )
+            self._sock.bind((bind_host, bind_port))
+        else:
+            self._tls = None  # unix planes never leave the host
+            if os.path.exists(path):
+                os.unlink(path)
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(path)
         self._sock.listen(64)
         self._closed = False
         # Fleet drain support (runtime/fleet.py): while draining, new
@@ -353,10 +415,14 @@ class ComputePlane:
         # timing-sensitive SLO suite).  A self-connect pops accept(),
         # the loop re-checks _closed and exits.
         try:
-            wake = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            fam, host, port = parse_plane_addr(self.path)
+            wake = socket.socket(
+                socket.AF_INET if fam == "tcp" else socket.AF_UNIX,
+                socket.SOCK_STREAM,
+            )
             wake.settimeout(0.5)
             try:
-                wake.connect(self.path)
+                wake.connect((host, port) if fam == "tcp" else self.path)
             finally:
                 wake.close()
         except OSError:
@@ -365,10 +431,11 @@ class ComputePlane:
             self._sock.close()
         except OSError:
             pass
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+        if self._family != "tcp":
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
         # sever live frontend connections too: a closed plane must look
         # exactly like a dead replica (in-process chaos tests kill a
         # replica this way; a real SIGKILL drops the sockets itself)
@@ -408,7 +475,48 @@ class ComputePlane:
                 name="misaka-plane-conn",
             ).start()
 
+    def _tls_accept(self, raw: socket.socket) -> socket.socket | None:
+        """Wrap one accepted TCP connection in server-side mTLS.  The
+        handshake runs HERE, on the per-connection thread (never in the
+        accept loop — the wrap_server_tls slow-loris lesson).  A peer
+        that fails it — plaintext bytes, a cert outside the pinned CA —
+        gets a typed, counted close and never reaches protocol state.
+        Returns the wrapped socket, or None when the connection was
+        refused."""
+        conn: socket.socket = raw
+        try:
+            conn = self._tls.server_context().wrap_socket(
+                raw, server_side=True, do_handshake_on_connect=False
+            )
+            conn.do_handshake()
+        except (ssl.SSLError, ConnectionError, OSError) as e:
+            if not self._closed:  # close()'s wake-connect is not a peer
+                reason = _classify_tls_reject(e)
+                edge_mod.count_plane_tls_reject(reason)
+                log.warning(
+                    "compute plane: refused %s peer at the mTLS gate: %s",
+                    reason, e,
+                )
+            with self._conns_lock:
+                self._conns.discard(raw)
+            for s in (conn, raw):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            return None
+        # the accept loop registered the RAW socket; the wrapped one now
+        # owns the fd, and close() must be able to sever it
+        with self._conns_lock:
+            self._conns.discard(raw)
+            self._conns.add(conn)
+        return conn
+
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self._tls is not None:
+            conn = self._tls_accept(conn)
+            if conn is None:
+                return
         master = self._master
         registry = self._registry
 
@@ -843,9 +951,11 @@ class ComputePlane:
         try:
             if self._secret is not None:
                 # shared-secret handshake BEFORE any frame: a peer that
-                # cannot present the HMAC never gets protocol access —
-                # the fleet compute plane's transport posture when it
-                # leaves the single-host unix socket (ROADMAP phase 2)
+                # cannot present the HMAC never gets protocol access.
+                # On the multi-host TCP transport this runs INSIDE the
+                # mTLS session (_tls_accept above) as the inner
+                # authenticator — cert = fleet membership, HMAC = plane
+                # access, rotated independently
                 presented = _recv_exact(
                     conn, edge_mod.PLANE_HANDSHAKE_LEN
                 )
@@ -1068,6 +1178,23 @@ class PlaneClient:
         # cached once, like ComputePlane: MISAKA_PLANE_SECRET_FILE must
         # not be re-read from disk on every reconnect
         self._secret = edge_mod.plane_secret()
+        self._family = parse_plane_addr(path)[0]
+        # client-side mTLS only for TCP planes (unix never leaves the
+        # host); the reloader re-stats the cert files so a rotation
+        # reaches new dials without restarting the worker
+        self._tls = (
+            edge_mod.plane_tls_from_env() if self._family == "tcp"
+            else None
+        )
+        # Dial-storm guard: dispatcher threads hitting a DEAD TCP peer
+        # must not re-dial it in a tight loop (SYN floods + log spam at
+        # the far host's conntrack; the unix path fails in microseconds,
+        # a remote dial burns a full RTO).  Failed dials push the next
+        # allowed dial out on the shared backoff curve; the router's
+        # prober owns rediscovery.  Benign races: both fields are
+        # GIL-atomic floats, and an extra dial costs one RTO.
+        self._dial_backoff = Backoff(base=0.05, cap=2.0)
+        self._next_dial = 0.0
         # captured HERE, not in the dispatcher thread: the decision must
         # be fixed at construction (tests toggle the env around it)
         self._shm_enabled = plane_shm_enabled()
@@ -1156,12 +1283,42 @@ class PlaneClient:
         return req.out
 
     def _connect(self) -> socket.socket:
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(self._timeout)
-        sock.connect(self._path)
+        if faults.armed() and _plane_partitioned(self._path):
+            raise OSError("plane partitioned (injected fault)")
+        if time.monotonic() < self._next_dial:
+            # inside the dial-backoff hold: fail fast instead of burning
+            # a connect timeout against a peer we just found dead
+            raise OSError("plane dial backoff (peer recently unreachable)")
+        try:
+            fam, host, port = parse_plane_addr(self._path)
+            if fam == "tcp":
+                sock = socket.create_connection(
+                    (host, port), timeout=self._timeout
+                )
+                sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                if self._tls is not None:
+                    sock = self._tls.client_context().wrap_socket(
+                        sock, server_hostname=host
+                    )
+            else:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(self._timeout)
+                sock.connect(self._path)
+        except OSError:
+            # ssl.SSLError is an OSError: a peer refusing our cert backs
+            # off the same way a dead one does
+            self._next_dial = (
+                time.monotonic() + self._dial_backoff.next_delay()
+            )
+            raise
+        self._dial_backoff.reset()
+        self._next_dial = 0.0
         if self._secret is not None:
             # shared-secret handshake (MISAKA_PLANE_SECRET): the engine
-            # side reads these 32 bytes before its first frame
+            # side reads these 32 bytes before its first frame — over
+            # TCP it runs INSIDE the TLS session (inner authenticator)
             sock.sendall(edge_mod.plane_handshake(self._secret))
         return sock
 
@@ -1643,6 +1800,17 @@ class PlaneClient:
                     gen["outstanding"].append(shp)
                     gen["inherited"] = True
                 try:
+                    if faults.armed():
+                        delay = faults.fire("plane_delay")
+                        if delay is not None:
+                            # per-frame latency injection (WAN twin of
+                            # rpc_delay) — outside every lock
+                            time.sleep(delay)
+                        if _plane_partitioned(self._path):
+                            # black-hole: the frame is never written, so
+                            # the DEADLINE (not a connection error) is
+                            # what trips — the grey-failure hedge path
+                            break
                     sock_now.sendall(frame)
                 except (ConnectionError, OSError) as send_exc:
                     # conn_failed sees this batch among the outstanding
@@ -1774,6 +1942,14 @@ class FleetPlaneRouter:
         # probe sockets handshake too; cached once (the probe loop runs
         # 4x/s and must not re-read MISAKA_PLANE_SECRET_FILE each time)
         self._secret = edge_mod.plane_secret()
+        # probes of TCP planes present the same client cert the data
+        # path does — an mTLS plane rejects bare probes like any other
+        # plaintext peer
+        self._tls = (
+            edge_mod.plane_tls_from_env()
+            if any(parse_plane_addr(p)[0] == "tcp" for p in paths)
+            else None
+        )
         self._closed = False
         threading.Thread(
             target=self._probe_loop, daemon=True,
@@ -1813,11 +1989,23 @@ class FleetPlaneRouter:
     def _probe_once(self, r: _RouterReplica) -> str:
         """One probe frame against `r`'s plane socket: "up", "draining",
         or "down" as observed right now."""
+        if faults.armed() and _plane_partitioned(r.path):
+            # a partitioned peer is unreachable to probes too — it must
+            # stay out of the candidate set, not flap up/down
+            return "down"
         try:
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.settimeout(1.0)
-            try:
+            fam, host, port = parse_plane_addr(r.path)
+            if fam == "tcp":
+                sock = socket.create_connection((host, port), timeout=1.0)
+                if self._tls is not None:
+                    sock = self._tls.client_context().wrap_socket(
+                        sock, server_hostname=host
+                    )
+            else:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(1.0)
                 sock.connect(r.path)
+            try:
                 if self._secret is not None:
                     sock.sendall(edge_mod.plane_handshake(self._secret))
                 meta = b'{"probe": 1}'
@@ -3012,8 +3200,22 @@ class NativeFrontendSupervisor:
             "plane_timeout_s": float(
                 environ.get("MISAKA_PLANE_TIMEOUT_S", "") or 30.0
             ),
-            "plane_path": plane_path.split(",", 1)[0],
+            # the C++ tier dials AF_UNIX only: pick the first unix plane
+            # (in a mixed fleet the Python router owns the TCP peers).
+            # An all-TCP plane list rides the normal fallback ladder —
+            # the Python tier speaks TCP+mTLS.
+            "plane_path": next(
+                (p for p in plane_path.split(",")
+                 if p and parse_plane_addr(p)[0] == "unix"),
+                None,
+            ),
         }
+        if config["plane_path"] is None:
+            raise RuntimeError(
+                "native edge unavailable: no unix plane in "
+                f"{plane_path!r} (the C++ tier does not speak the TCP "
+                "plane transport)"
+            )
         secret = edge_mod.plane_secret(environ)
         if secret is not None:
             config["handshake_hex"] = edge_mod.plane_handshake(secret).hex()

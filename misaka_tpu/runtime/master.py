@@ -4136,6 +4136,75 @@ def make_http_server(
                         self._text(400, str(e))
                         return
                     self._json({"armed": sorted(faults.active())})
+                elif path == "/edge/token":
+                    # Mint a signed short-lived tenant token (runtime/
+                    # edge.py): HMAC over {tenant, expiry, scope}, verified
+                    # locally by EVERY replica sharing the secret — no
+                    # lookup table to distribute, no coordination.  ADMIN-
+                    # scoped at the edge: minting is credential issuance.
+                    form = self._form()  # body first (keep-alive)
+                    if edge_chain.token_secret is None:
+                        self._text(
+                            503,
+                            "token minting disabled (set "
+                            "MISAKA_TOKEN_SECRET or MISAKA_PLANE_SECRET)",
+                        )
+                        return
+                    tenant = (form.get("tenant") or "").strip()
+                    if not tenant:
+                        self._text(400, "missing tenant")
+                        return
+                    try:
+                        ttl = float(form.get("ttl") or 300.0)
+                    except ValueError:
+                        self._text(400, "cannot parse ttl")
+                        return
+                    ttl = min(max(ttl, 1.0), 86400.0)
+                    programs = [
+                        p.strip()
+                        for p in (form.get("programs") or "").split(",")
+                        if p.strip()
+                    ] or None
+                    token, exp = edge_mod.mint_tenant_token(
+                        edge_chain.token_secret, tenant, ttl_s=ttl,
+                        admin=(form.get("admin") or "")
+                        in ("1", "true", "on"),
+                        programs=programs,
+                    )
+                    self._json({
+                        "token": token,
+                        "tenant": tenant,
+                        "expires_at": exp,
+                        "ttl_s": ttl,
+                    })
+                elif path == "/edge/gossip":
+                    # Usage-gossip ingress (runtime/fleet.py gossip hub):
+                    # drain local token buckets by the remote fleet-wide
+                    # admissions since the sender's last round, answer
+                    # with this replica's own cumulative snapshot.
+                    # ADMIN-scoped: quota reconciliation is an operator
+                    # (hub) mutation, not a tenant surface.
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    try:
+                        payload = json.loads(raw or b"{}")
+                        drained = edge_chain.apply_remote_usage(
+                            payload.get("usage") or {},
+                            source=str(payload.get("source") or "peer"),
+                        )
+                    except (ValueError, TypeError) as e:
+                        edge_mod.M_EDGE_GOSSIP_ROUNDS.labels(
+                            status="error"
+                        ).inc()
+                        self._text(400, f"bad gossip payload: {e}")
+                        return
+                    edge_mod.M_EDGE_GOSSIP_ROUNDS.labels(
+                        status="ok" if drained else "stale"
+                    ).inc()
+                    self._json({
+                        "drained": drained,
+                        "usage": edge_chain.usage_snapshot(),
+                    })
                 else:
                     # unknown POST: the body (arbitrary size) is unread —
                     # close instead of desynchronizing the connection

@@ -82,6 +82,24 @@ Named points (the hook sites live next to the code they break):
                     reports its token bucket empty: the typed-429 +
                     Retry-After client-backoff path, exercised at the
                     real admission sites.
+  plane_partition — plane frames to a peer are black-holed
+                    (runtime/frontends.py PlaneClient): dials to the
+                    peer fail and queued frames are never written, so
+                    the frame deadline — not a connection error — trips
+                    the router's hedge, and the peer probe keeps
+                    reporting it down.  The scoped form
+                    `plane_partition:<addr>` partitions ONLY the plane
+                    whose address (socket path or host:port) contains
+                    that substring — siblings stay reachable, which is
+                    the multi-host partition drill: a partitioned
+                    remote replica fails over onto its siblings with
+                    zero client-visible errors (tests/test_chaos.py).
+  plane_delay     — every plane frame send sleeps `value` seconds
+                    before hitting the wire (runtime/frontends.py
+                    PlaneClient): the WAN-latency twin of rpc_delay
+                    for the multi-host plane — deadline margins and
+                    hedge budgets under slow links.  Use @prob to
+                    delay a fraction of frames.
 
 Fault checks are zero-cost when nothing is armed (`fire` returns None
 after one dict lookup on an empty dict); the module imports stdlib only —
@@ -110,6 +128,8 @@ POINTS = frozenset({
     "edge_native_build",
     "resident_fallback",
     "jit_fail",
+    "plane_partition",
+    "plane_delay",
 })
 
 # Points that accept a ":<qualifier>" suffix scoping the fault to one
@@ -117,7 +137,9 @@ POINTS = frozenset({
 # registry program's serve passes (runtime/master.py ServeBatcher) — the
 # per-tenant SLO chaos scenario, where one program must page while its
 # neighbors stay green.
-SCOPED_POINTS = frozenset({"serve_delay", "replica_blackhole", "overload"})
+SCOPED_POINTS = frozenset(
+    {"serve_delay", "replica_blackhole", "overload", "plane_partition"}
+)
 
 
 class FaultSpecError(ValueError):

@@ -64,6 +64,19 @@ def test_fault_spec_parsing():
     }
     assert faults.parse_spec("") == {}
     assert faults.parse_spec(None) == {}
+    # the multi-host plane points: bare, scoped-to-one-peer, and delay
+    spec = faults.parse_spec(
+        "plane_partition,plane_partition:10.0.0.2:9001,plane_delay=0.05@0.5"
+    )
+    assert spec == {
+        "plane_partition": (1.0, 1.0),
+        "plane_partition:10.0.0.2:9001": (1.0, 1.0),
+        "plane_delay": (0.05, 0.5),
+    }
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec("plane_delay:peer=0.1")  # plane_delay is unscoped
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec("plane_partition:")  # empty scope
     with pytest.raises(faults.FaultSpecError):
         faults.parse_spec("not_a_point=1")
     with pytest.raises(faults.FaultSpecError):

@@ -236,6 +236,69 @@ def test_router_failover_under_concurrent_load_zero_errors(tmp_path):
             p.close()
 
 
+def test_router_partitioned_peer_hedges_to_siblings(tmp_path):
+    """The multi-host partition drill (chaos point plane_partition:<addr>,
+    scoped to ONE peer's plane address): dials to the partitioned replica
+    fail and queued frames never hit the wire, so every request hedges
+    onto the sibling with zero client-visible errors, the hedge counter
+    moves, and the router's probe keeps the partitioned peer out of the
+    candidate set."""
+    masters, planes, router = _stub_fleet(tmp_path, n=2, probe_s=0.05)
+    errors: list[Exception] = []
+    outs: list[bytes] = []
+    lock = threading.Lock()
+
+    def worker():
+        try:
+            out = router.compute_raw(BODY, timeout=10)
+            with lock:
+                outs.append(out)
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append(e)
+
+    try:
+        _check(router.compute_raw(BODY, timeout=5))  # healthy baseline
+        hedged0 = frontends.M_PLANE_HEDGED.value
+        faults.configure("plane_partition:plane-1.sock")
+        # tilt the depth tie-break toward the partitioned replica so the
+        # router actually routes at it (idle traffic would pile onto
+        # replica 0 and never exercise the failover)
+        router._replicas[0].client._inflight += 1
+        try:
+            threads = [threading.Thread(target=worker) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        finally:
+            router._replicas[0].client._inflight -= 1
+        assert errors == []
+        assert len(outs) == 8
+        for out in outs:
+            _check(out)
+        # the partition is grey, not clean: only the sibling served
+        assert masters[1].values == 0
+        assert masters[0].values >= 8 * 8
+        # failovers are VISIBLE: re-routed frames ride the hedge counter
+        assert frontends.M_PLANE_HEDGED.value > hedged0
+        # probes cannot reach a partitioned peer either: it must sit out
+        # of the candidate set, not flap up/down
+        deadline = time.monotonic() + 5
+        while router.states()[1] != "down" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.states()[1] == "down"
+        # heal the partition: the prober readmits with no coordination
+        faults.configure(None)
+        deadline = time.monotonic() + 5
+        while router.states()[1] != "up" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.states()[1] == "up"
+    finally:
+        router.close()
+        for p in planes:
+            p.close()
+
+
 def test_router_readmits_restarted_replica(tmp_path):
     masters, planes, router = _stub_fleet(tmp_path, n=2, probe_s=0.05)
     try:

@@ -1,0 +1,337 @@
+"""dist-smoke: the multi-host fleet acceptance drill, on loopback.
+
+Two REAL `misaka_tpu.runtime.app` processes talk over TCP + mTLS —
+no in-process stubs, no mocked planes:
+
+  peer   — a standalone engine replica serving its compute plane on a
+           loopback TCP address (MISAKA_PLANE_SERVE=1,
+           MISAKA_PLANE_SOCKET=127.0.0.1:<port>), plane TLS armed.
+  parent — a 1-local-replica fleet (MISAKA_FLEET=1) that registers the
+           peer via MISAKA_FLEET_PEERS, probes it on the shared state
+           machine, and fans compute frames across BOTH planes.
+
+The drill (each step fatal on failure):
+
+  1. both processes boot; the parent's /fleet shows the remote row up
+     (peers_up == 1) and the fleet undegraded;
+  2. 64 pooled clients hammer the parent's compute lane; once every
+     client has served at least one request, the peer is kill -9'd
+     MID-LOAD — the load loop must finish with ZERO client-visible
+     errors (hedged reroute + replay-chain failover absorb the crash)
+     while /fleet walks the peer to "down";
+  3. the peer restarts on the same ports and is readmitted (peers_up
+     back to 1) with the load still running;
+  4. an authenticated remote /fleet/roll drives BOTH rows — the local
+     replica (drain -> checkpoint -> replace -> restore) and the remote
+     peer (drain -> checkpoint -> readmit; restored=False, the peer's
+     own supervisor owns process replacement);
+  5. the admin mints a short-lived tenant token at /edge/token and a
+     fresh client computes with it (local HMAC verification — no
+     coordination with a token service);
+  6. /metrics shows the fleet series: misaka_fleet_peers_up == 1,
+     gossip rounds counted ok, zero plane-TLS rejects (nothing
+     plaintext ever dialed the plane).
+
+Runs under `make dist-smoke` (wired into `make ci`).  Skips (exit 0)
+when openssl is unavailable.  Every assertion failure exits 1 with a
+`dist-smoke FAILED:` line on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> None:
+    print(f"dist-smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _pick_ports(n: int) -> list[int]:
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def main() -> int:  # noqa: C901 - a linear drill script
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+
+    if shutil.which("openssl") is None:
+        print("# dist-smoke: openssl unavailable; skipping")
+        return 0
+
+    tmp = tempfile.mkdtemp(prefix="misaka-dist-smoke-")
+    cert = os.path.join(tmp, "plane.pem")
+    key = os.path.join(tmp, "plane.key")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "ec",
+            "-pkeyopt", "ec_paramgen_curve:prime256v1", "-nodes",
+            "-keyout", key, "-out", cert, "-days", "1",
+            "-subj", "/CN=misaka-fleet",
+            "-addext", "subjectAltName=IP:127.0.0.1",
+        ],
+        check=True, capture_output=True,
+    )
+    keyfile = os.path.join(tmp, "api_keys.json")
+    with open(keyfile, "w") as f:
+        json.dump({"keys": [
+            {"key": "smoke-admin", "tenant": "ops", "admin": True},
+            {"key": "smoke-tenant", "tenant": "tenant-a"},
+        ]}, f)
+
+    a_port, b_port, b_plane = _pick_ports(3)
+    peer_key = "dist-smoke-peer-key"
+    plane_secret = "dist-smoke-plane-secret"
+
+    common = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MISAKA_AUTORUN": "1",
+        "MISAKA_BATCH": "4",
+        "MISAKA_IN_CAP": "32",
+        "MISAKA_OUT_CAP": "32",
+        "MISAKA_STACK_CAP": "16",
+        "MISAKA_TTL_S": "600",
+        "NODE_INFO": json.dumps({"main": {"type": "program"}}),
+        "MISAKA_PROGRAMS": json.dumps({"main": "IN ACC\nADD 2\nOUT ACC\n"}),
+        # the plane trust plane: CA-pinned mTLS around the PR 9 HMAC
+        # handshake (both required; plaintext dials are refused)
+        "MISAKA_PLANE_TLS_CERT": cert,
+        "MISAKA_PLANE_TLS_KEY": key,
+        "MISAKA_PLANE_TLS_CA": cert,
+        "MISAKA_PLANE_SECRET": plane_secret,
+        "MISAKA_API_KEYS": keyfile,
+        "MISAKA_TOKEN_SECRET": "dist-smoke-token-secret",
+    }
+    common.pop("MISAKA_TLS_CERT", None)
+    common.pop("MISAKA_TLS_KEY", None)
+    peer_env = {
+        **common,
+        # the same shape FleetManager._replica_env spawns, but on a
+        # loopback TCP plane — a stand-in for a replica on another host
+        "MISAKA_FLEET": "0",
+        "MISAKA_HTTP_WORKERS": "0",
+        "MISAKA_PORT": str(b_port),
+        "MISAKA_PLANE_SOCKET": f"127.0.0.1:{b_plane}",
+        "MISAKA_PLANE_SERVE": "1",
+        "MISAKA_FLEET_REPLICA": "1",
+        "MISAKA_CHECKPOINT_DIR": os.path.join(tmp, "peer-ckpt"),
+        "MISAKA_EDGE_INTERNAL_TOKEN": peer_key,
+    }
+    parent_env = {
+        **common,
+        "MISAKA_FLEET": "1",
+        "MISAKA_HTTP_WORKERS": "2",
+        "MISAKA_PORT": str(a_port),
+        "MISAKA_FLEET_DIR": os.path.join(tmp, "fleet"),
+        "MISAKA_FLEET_PEERS": f"127.0.0.1:{b_port}:{b_plane}",
+        "MISAKA_FLEET_PEER_KEY": peer_key,
+        "MISAKA_GOSSIP_S": "0.25",
+    }
+
+    def spawn_peer() -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "misaka_tpu.runtime.app"], env=peer_env
+        )
+
+    procs: list[subprocess.Popen] = []
+    base = f"http://127.0.0.1:{a_port}"
+    try:
+        print("# dist-smoke: booting remote peer "
+              f"(plane tcp 127.0.0.1:{b_plane}, mTLS)")
+        peer = spawn_peer()
+        procs.append(peer)
+        print("# dist-smoke: booting fleet parent "
+              f"(MISAKA_FLEET_PEERS=127.0.0.1:{b_port}:{b_plane})")
+        parent = subprocess.Popen(
+            [sys.executable, "-m", "misaka_tpu.runtime.app"], env=parent_env
+        )
+        procs.append(parent)
+
+        admin = MisakaClient(base, api_key="smoke-admin", timeout=30)
+
+        def wait_fleet(pred, what: str, timeout_s: float = 180.0) -> dict:
+            deadline = time.monotonic() + timeout_s
+            last: dict = {}
+            while time.monotonic() < deadline:
+                if parent.poll() is not None:
+                    fail(f"fleet parent died while waiting for {what}")
+                try:
+                    last = admin.fleet_status()
+                    if pred(last):
+                        return last
+                except (MisakaClientError, urllib.error.URLError, OSError):
+                    pass
+                time.sleep(0.25)
+            fail(f"timed out waiting for {what}; last /fleet: {last}")
+            raise AssertionError  # unreachable
+
+        st = wait_fleet(
+            lambda s: s.get("peers_up") == 1 and not s.get("degraded"),
+            "remote peer up + fleet undegraded",
+        )
+        remote_rows = [r for r in st["replicas"] if r.get("remote")]
+        if len(remote_rows) != 1 or remote_rows[0]["state"] != "up":
+            fail(f"expected one up remote row, got {remote_rows}")
+        print("# dist-smoke: fleet healthy — 1 local replica + 1 remote "
+              "peer over TCP+mTLS")
+
+        # --- pooled load: 64 clients through a kill -9 ------------------
+        stop = threading.Event()
+        counts = [0] * 64
+        errors: list[str] = []
+
+        def hammer(i: int) -> None:
+            cl = MisakaClient(base, api_key="smoke-tenant", timeout=60)
+            vals = [i, i + 1, i + 2]
+            want = [v + 2 for v in vals]
+            while not stop.is_set():
+                try:
+                    out = cl.compute_raw(vals)
+                    if list(out) != want:
+                        errors.append(f"client {i}: wrong result {out}")
+                        return
+                    counts[i] += 1
+                except Exception as exc:  # noqa: BLE001 - the assertion
+                    errors.append(f"client {i}: {type(exc).__name__}: {exc}")
+                    return
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,), daemon=True)
+            for i in range(64)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and (
+            min(counts) < 1 or errors
+        ):
+            time.sleep(0.1)
+        if errors:
+            fail(f"client errors before the kill: {errors[:3]}")
+        if min(counts) < 1:
+            fail("load never warmed: some client served zero requests")
+        print(f"# dist-smoke: 64 clients warm ({sum(counts)} requests); "
+              "kill -9 the remote peer mid-load")
+
+        os.kill(peer.pid, signal.SIGKILL)
+        peer.wait(timeout=30)
+        wait_fleet(
+            lambda s: any(
+                r.get("remote") and r["state"] == "down"
+                for r in s["replicas"]
+            ),
+            "remote peer marked down",
+        )
+        # keep hammering through the failover window, then check errors
+        settle = time.monotonic() + 5
+        while time.monotonic() < settle:
+            if errors:
+                break
+            time.sleep(0.1)
+        if errors:
+            fail(f"client-visible errors across the kill -9: {errors[:3]}")
+        print("# dist-smoke: peer down, zero client errors — failover "
+              "held (hedge + replay chain)")
+
+        # --- restart the peer on the same ports: readmission ------------
+        peer = spawn_peer()
+        procs.append(peer)
+        wait_fleet(
+            lambda s: s.get("peers_up") == 1 and not s.get("degraded"),
+            "restarted peer readmitted",
+        )
+        if errors:
+            fail(f"client errors during readmission: {errors[:3]}")
+        print("# dist-smoke: restarted peer readmitted (peers_up=1)")
+
+        # --- authenticated remote /fleet/roll ---------------------------
+        report = admin.fleet_roll(timeout=600)
+        if not report.get("ok"):
+            fail(f"/fleet/roll not ok: {report}")
+        remote_entries = [
+            e for e in report.get("replicas", []) if e.get("remote")
+        ]
+        if len(remote_entries) != 1:
+            fail(f"roll report missing the remote entry: {report}")
+        ent = remote_entries[0]
+        if ent.get("restored") is not False or not str(
+            ent.get("checkpoint", "")
+        ).startswith("fleet-roll-"):
+            fail(f"remote roll entry wrong shape: {ent}")
+        print("# dist-smoke: remote /fleet/roll ok — drain -> checkpoint "
+              f"{ent['checkpoint']!r} -> readmit")
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        if errors:
+            fail(f"client errors at drain: {errors[:3]}")
+        total = sum(counts)
+        if total < 64:
+            fail(f"implausibly little load served: {total}")
+        print(f"# dist-smoke: load done — {total} requests, zero errors")
+
+        # --- fleet tokens: mint at the edge, verify locally -------------
+        minted = json.loads(admin._post_form(
+            "/edge/token", tenant="roaming", ttl="120"
+        ))
+        token = minted.get("token", "")
+        if not token.startswith("mst1."):
+            fail(f"/edge/token minted no token: {minted}")
+        roamer = MisakaClient(base, api_key=token, timeout=30)
+        out = roamer.compute_raw([40])
+        if list(out) != [42]:
+            fail(f"token-authenticated compute wrong: {out}")
+        print("# dist-smoke: minted tenant token accepted on the "
+              "compute lane (local HMAC verification)")
+
+        # --- the metric surface -----------------------------------------
+        text = admin.metrics()
+        if "misaka_fleet_peers_up 1" not in text.replace(".0", ""):
+            fail("misaka_fleet_peers_up != 1 in /metrics")
+        if 'misaka_fleet_gossip_total{status="ok"}' not in text:
+            fail("no ok gossip rounds counted in /metrics")
+        for line in text.splitlines():
+            if line.startswith("misaka_plane_tls_rejected_total") and \
+                    not line.rstrip().endswith(" 0"):
+                fail(f"unexpected plane TLS reject: {line}")
+        print("# dist-smoke: metrics surface ok (peers_up=1, gossip "
+              "counted, zero plane-TLS rejects)")
+        print("# dist-smoke OK")
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
