@@ -251,6 +251,19 @@ observatory-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
 		python tools/observatory_smoke.py
 
+# Durable-telemetry tripwire (~30s): a REAL subprocess server with
+# MISAKA_TSDB_DIR armed at test cadence — the capture spool rotates
+# >= 2 on-disk segments (operator cut + size trigger), kill -9 +
+# relaunch over the same directory, /debug/series answers with
+# pre-restart points (7d window grammar included), the usage-report
+# CLI's cumulative totals stay monotone + conserve vs the pass-wall
+# anchor, and a pre-kill rotated capture segment replays byte-for-byte
+# green.  The same assertions run inside tier-1 (tests/test_durable.py);
+# docs/OBSERVABILITY.md "Durable telemetry".
+telemetry-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= timeout -k 10 300 \
+		python tools/telemetry_smoke.py
+
 # The CI entry point: tier-1 fast lane + every smoke tripwire +
 # bench-smoke, in one target — what a CI runner invokes (there is no
 # hosted CI config; this is the single command one would call).  Order:
@@ -268,6 +281,7 @@ ci:
 	$(MAKE) replay-smoke
 	$(MAKE) usage-smoke
 	$(MAKE) observatory-smoke
+	$(MAKE) telemetry-smoke
 	$(MAKE) edge-smoke
 	$(MAKE) edge-native-smoke
 	$(MAKE) chaos-smoke
@@ -376,4 +390,4 @@ stop:
 clean:
 	rm -f native/*.so
 
-.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke replay-smoke usage-smoke observatory-smoke edge-smoke edge-native-smoke chaos-smoke fleet-smoke dist-smoke ci parity-go parity-local parity-corpus stop clean
+.PHONY: native native-asan native-tsan native-ubsan sanitize-smoke sanitize-all lint grpc cert test test-all test-tpu capture bench bench-smoke metrics-smoke trace-smoke native-trace-smoke registry-smoke replay-smoke usage-smoke observatory-smoke telemetry-smoke edge-smoke edge-native-smoke chaos-smoke fleet-smoke dist-smoke ci parity-go parity-local parity-corpus stop clean

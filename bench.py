@@ -2143,7 +2143,13 @@ def bench_model_replay(model_path, seconds=8.0, clients=32):
         raise SystemExit(f"unsupported load-model format: {model_path}")
     rate = float(model["arrival"]["rate_rps"])
     hist = model["values"]["hist"] or [[1, 1]]
-    tenants = sorted((model.get("tenants") or {"default": 1.0}).items())
+    # per-tenant arrival rates (fitted from the durable TSDB tier) beat
+    # the capture-window request fractions when the model carries them
+    tenants = sorted((
+        model.get("tenants_arrival") or model.get("tenants")
+        or {"default": 1.0}
+    ).items())
+    diurnal = (model.get("diurnal") or {}).get("hour_weights_utc")
 
     sys.setswitchinterval(0.001)
     top = networks.add2(in_cap=4096, out_cap=4096, stack_cap=16)
@@ -2165,6 +2171,16 @@ def bench_model_replay(model_path, seconds=8.0, clients=32):
     # server hide behind its own backpressure)
     n_arrivals = max(1, int(rate * seconds))
     gaps = rng.exponential(1.0 / max(rate, 1e-9), size=n_arrivals)
+    if diurnal:
+        # replay a COMPRESSED day: arrival k lands at simulated UTC
+        # hour 24k/n, and the local Poisson intensity scales by that
+        # hour's fitted weight (weights average 1.0, so the run's total
+        # offered rate stays the headline `rate`)
+        w = np.clip(np.array(diurnal, dtype=np.float64), 1e-3, None)
+        hour_idx = np.minimum(
+            np.arange(n_arrivals) * 24 // max(1, n_arrivals), 23
+        )
+        gaps = gaps / w[hour_idx]
     arrivals = np.cumsum(gaps)
     sizes = uppers[rng.choice(len(uppers), size=n_arrivals, p=weights)]
     sizes = np.minimum(sizes, 4096)
@@ -2222,6 +2238,7 @@ def bench_model_replay(model_path, seconds=8.0, clients=32):
     done = int(sum(sent))
     return {
         "model": model_path,
+        "diurnal": bool(diurnal),
         "offered_rps": round(rate, 2),
         "achieved_rps": round(done / elapsed, 2),
         "requests": done,
@@ -2827,6 +2844,221 @@ def bench_obs_ab(pairs=6):
     for lane in ("raw", "conc64"):
         base = out[f"baseline_{lane}"]
         inst = out[f"instrumented_{lane}"]
+        ratios = sorted(round(b and i / b, 4) for i, b in zip(inst, base))
+        out[f"{lane}_pair_ratios"] = ratios
+        out[f"{lane}_mean_ratio"] = round(sum(inst) / sum(base), 4)
+        n = len(ratios)
+        out[f"{lane}_median_ratio"] = round(
+            ratios[n // 2] if n % 2
+            else (ratios[n // 2 - 1] + ratios[n // 2]) / 2, 4
+        )
+    return out
+
+
+def bench_durable_ab(pairs=6):
+    """Durable-telemetry overhead A/B (ISSUE r23 budget: MEDIAN served-
+    throughput ratio >= 0.95 on both lanes with the WHOLE durable plane
+    armed — TSDB disk spool + long-horizon tier, usage ledger spool,
+    always-on capture recording with rotation daemon — vs the plane
+    disarmed, i.e. today's in-memory telemetry).
+
+    Same discipline as the committed r15 observatory A/B: ONE shared
+    master + HTTP server + registry, ABBA pair ordering, production 1ms
+    switch interval, median-of-pairs headline with the full arrays
+    embedded.  The in-memory observability stack (TSDB collector,
+    usage, SLO, sampler, tracing) stays ON on BOTH sides — the ratio
+    isolates exactly what MISAKA_TSDB_DIR adds: fsync'd spool appends
+    on the collector tick, the usage flusher, and per-request capture
+    records."""
+    import shutil
+    import tempfile
+    import threading as _threading
+    import urllib.request
+    import http.client as _http_client
+
+    from misaka_tpu import networks
+    from misaka_tpu.runtime.master import MasterNode, make_http_server
+    from misaka_tpu.runtime.registry import ProgramRegistry
+    from misaka_tpu.runtime import capture as _capture
+    from misaka_tpu.runtime import usage as _usage
+    from misaka_tpu.utils import tsdb as _tsdb
+
+    sys.setswitchinterval(0.001)
+    batch, in_cap, threads, waves = 1024, 128, 8, 4
+    caps = dict(in_cap=in_cap, out_cap=in_cap, stack_cap=16)
+    top = networks.add2(**caps)
+    master = MasterNode(top, chunk_steps=2048, batch=batch, engine="native")
+    registry = ProgramRegistry(None, batch=batch, engine="native", caps=caps)
+    registry.seed("default", master, top)
+    httpd = make_http_server(master, port=0, registry=registry)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = "127.0.0.1", httpd.server_address[1]
+    url = f"http://{host}:{port}/compute_raw?spread=1"
+    master.run()
+    rng = np.random.default_rng(2)
+    per_request = (batch // threads) * in_cap
+    spool_root = tempfile.mkdtemp(prefix="misaka-durable-ab-")
+
+    def raw_lane():
+        reqs = [
+            [
+                (v := rng.integers(-1000, 1000, size=per_request)
+                 .astype(np.int32)),
+                np.ascontiguousarray(v, "<i4").tobytes(), None,
+            ]
+            for _ in range(threads * waves)
+        ]
+        errors = []
+
+        def worker(chunk):
+            try:
+                for item in chunk:
+                    req = urllib.request.Request(
+                        url, data=item[1], method="POST"
+                    )
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        item[2] = r.read()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        ws = [
+            _threading.Thread(target=worker, args=(reqs[i::threads],))
+            for i in range(threads)
+        ]
+        t0 = time.perf_counter()
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        for vals, _, raw in reqs:
+            if not np.array_equal(np.frombuffer(raw, "<i4"), vals + 2):
+                raise RuntimeError("durable A/B raw parity FAILED")
+        return len(reqs) * per_request / elapsed
+
+    def conc_lane(seconds=2.0, c=64, payload_values=64):
+        rng2 = np.random.default_rng(13)
+        bodies = []
+        for _ in range(8):
+            vals = rng2.integers(
+                -1000, 1000, size=payload_values
+            ).astype(np.int32)
+            bodies.append((vals, np.ascontiguousarray(vals, "<i4").tobytes()))
+        counts = [0] * c
+        errors = []
+        stop = _threading.Event()
+
+        def one_client(i):
+            try:
+                conn = _http_client.HTTPConnection(host, port, timeout=60)
+                k = 0
+                while not stop.is_set():
+                    vals, body = bodies[k % 8]
+                    conn.request("POST", "/compute_raw?spread=1", body)
+                    raw = conn.getresponse().read()
+                    if not np.array_equal(
+                        np.frombuffer(raw, dtype="<i4"), vals + 2
+                    ):
+                        raise RuntimeError("durable A/B sweep parity FAILED")
+                    counts[i] += 1
+                    k += 1
+                conn.close()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                stop.set()
+
+        ts = [
+            _threading.Thread(target=one_client, args=(i,)) for i in range(c)
+        ]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in ts:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return sum(counts) * payload_values / elapsed
+
+    def set_durable(on):
+        """The whole MISAKA_TSDB_DIR plane as one toggle (it ships as
+        one switch): disk-spooling TSDB + usage ledger spool + always-on
+        capture.  OFF = today's in-memory collector, still running."""
+        _capture.shutdown_spool()
+        if _capture.RECORDING:
+            _capture.stop()
+        _usage.shutdown_spool()
+        _tsdb.shutdown()
+        if on:
+            env = {"MISAKA_TSDB_DIR": spool_root}
+            _tsdb.ensure_started(env)
+            _usage.ensure_spool(env)
+            _capture.ensure_spool(env, anchor_fn=None)
+        else:
+            _tsdb.ensure_started({})
+
+    conc_pairs = pairs * 3
+    out = {
+        "method": (
+            f"durable telemetry plane ARMED (MISAKA_TSDB_DIR: TSDB disk "
+            f"spool + 5m long-horizon tier, usage-ledger spool flushing "
+            f"every 15s, always-on capture recording every request into "
+            f"the rotation ring) vs DISARMED (the committed in-memory "
+            f"r15 observability stack, still fully on) — the marginal "
+            f"cost of durability, nothing else.  ONE shared master + "
+            f"HTTP server + registry, ABBA pair ordering, "
+            f"switchinterval=1ms; raw = {pairs} pairs of 8 threads x "
+            f"{waves} waves of {per_request}-value /compute_raw; conc64 "
+            f"= {conc_pairs} pairs of 64 in-process keep-alive clients "
+            f"x 64-value payloads x 2.5s.  Headline = MEDIAN of the "
+            f"matched ABBA pair ratios (scheduler-collapse discipline "
+            f"of every served A/B since r10); full per-pair arrays "
+            f"embedded"
+        ),
+        "baseline_raw": [], "durable_raw": [],
+        "baseline_conc64": [], "durable_conc64": [],
+    }
+    try:
+        for on in (False, True):  # warm both paths end to end
+            set_durable(on)
+            raw_lane()
+            conc_lane(seconds=1.0)
+        for i in range(pairs):
+            for on in (False, True) if i % 2 == 0 else (True, False):
+                set_durable(on)
+                raw = raw_lane()
+                key = "durable" if on else "baseline"
+                out[key + "_raw"].append(round(raw, 1))
+                print(
+                    f"# durable A/B raw pair {i} {'on ' if on else 'off'}: "
+                    f"{raw:.0f}/s",
+                    file=sys.stderr,
+                )
+        for i in range(conc_pairs):
+            for on in (False, True) if i % 2 == 0 else (True, False):
+                set_durable(on)
+                conc = conc_lane(seconds=2.5)
+                key = "durable" if on else "baseline"
+                out[key + "_conc64"].append(round(conc, 1))
+                print(
+                    f"# durable A/B conc64 pair {i} "
+                    f"{'on ' if on else 'off'}: {conc:.0f}/s",
+                    file=sys.stderr,
+                )
+    finally:
+        set_durable(False)
+        _tsdb.shutdown()
+        master.pause()
+        registry.close()
+        httpd.shutdown()
+        shutil.rmtree(spool_root, ignore_errors=True)
+    for lane in ("raw", "conc64"):
+        base = out[f"baseline_{lane}"]
+        inst = out[f"durable_{lane}"]
         ratios = sorted(round(b and i / b, 4) for i, b in zip(inst, base))
         out[f"{lane}_pair_ratios"] = ratios
         out[f"{lane}_mean_ratio"] = round(sum(inst) / sum(base), 4)
@@ -5258,6 +5490,36 @@ if __name__ == "__main__":
         if not payload["ok"]:
             print(
                 f"# observatory A/B FAILED the 0.95 median budget: raw "
+                f"{ab['raw_median_ratio']} conc64 "
+                f"{ab['conc64_median_ratio']}",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+    elif "--durable-ab" in sys.argv:
+        # Standalone durable-telemetry overhead capture (the r23 twin of
+        # the r15 observatory artifact): both served lanes, the whole
+        # MISAKA_TSDB_DIR plane (TSDB spool + usage ledger spool +
+        # always-on capture) armed vs disarmed, median ABBA pair ratios
+        # >= 0.95.  Committed as BENCH_cpu_r23.json.
+        import jax
+
+        ab = bench_durable_ab()
+        payload = {
+            "platform": jax.devices()[0].platform,
+            "capture": "served-only (durable-telemetry overhead check)",
+            "served_throughput": ab["durable_raw"][-1],
+            "served_conc64_throughput": ab["durable_conc64"][-1],
+            "served_engine": "native",
+            "durable_overhead_ab": ab,
+            "ok": bool(
+                ab["raw_median_ratio"] >= 0.95
+                and ab["conc64_median_ratio"] >= 0.95
+            ),
+        }
+        print(json.dumps(payload))
+        if not payload["ok"]:
+            print(
+                f"# durable A/B FAILED the 0.95 median budget: raw "
                 f"{ab['raw_median_ratio']} conc64 "
                 f"{ab['conc64_median_ratio']}",
                 file=sys.stderr,

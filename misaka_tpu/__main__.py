@@ -11,9 +11,12 @@ surfaces:
   compute  <v...> [--url]    send values to a running master's /compute
   bench    [--batch --values] quick add-2 throughput smoke (the real harness
                              is bench.py at the repo root)
-  replay   <segment>         shadow-replay a captured .mskcap traffic segment
+  replay   <segment|dir>     shadow-replay a captured .mskcap traffic segment
                              byte-for-byte (tools/replay.py; --candidate gives
-                             the pre-deploy verdict for a new topology)
+                             the pre-deploy verdict for a new topology; a
+                             directory sweeps the capture spool's history)
+  usage-report [--url ...]   pull + verify the signed billing export
+                             (GET /usage/export; --secret checks every HMAC)
   debug    <topology>        interactive single-step debugger (misaka_tpu.debug)
 
 <topology> is a baseline config name (add2, acc_loop, ring4, sorter,
@@ -142,7 +145,9 @@ def cmd_replay(args) -> int:
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
 
-    return mod.replay_segment(
+    fn = (mod.replay_directory if os.path.isdir(args.segment)
+          else mod.replay_segment)
+    return fn(
         args.segment,
         candidate=args.candidate,
         program=args.program,
@@ -150,6 +155,44 @@ def cmd_replay(args) -> int:
         limit=args.limit,
         emit_model=args.emit_model,
     )
+
+
+def cmd_usage_report(args) -> int:
+    """Pull the signed billing export from a server (or fleet hub),
+    verify every signature when a secret is at hand, and print the
+    conserved per-tenant totals."""
+    import urllib.error
+
+    from misaka_tpu.client import MisakaClient, MisakaClientError
+    from misaka_tpu.runtime import usage as usage_mod
+
+    client = MisakaClient(args.url, timeout=args.timeout,
+                          api_key=args.key)
+    try:
+        lines = client.usage_export(since=args.since)
+    except MisakaClientError as e:
+        print(f"error: {e.body}", file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"error: cannot reach {args.url}: {e.reason}", file=sys.stderr)
+        return 1
+    if args.raw:
+        for line in lines:
+            print(json.dumps(line, separators=(",", ":")))
+        return 0
+    try:
+        totals = usage_mod.totals_from_lines(
+            lines, secret=args.secret.encode() if args.secret else None
+        )
+    except usage_mod.UsageExportError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(totals, indent=2, sort_keys=True))
+    if args.secret and not totals.get("verified"):
+        print("error: export carried no signed lines to verify",
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def cmd_debug(args) -> int:
@@ -244,6 +287,19 @@ def main(argv=None) -> int:
     p.add_argument("--engine")
     p.add_argument("--limit", type=int)
     p.add_argument("--emit-model", metavar="OUT.json")
+    p = sub.add_parser(
+        "usage-report",
+        help="pull + verify the signed billing export (GET /usage/export)",
+    )
+    p.add_argument("--url", default="http://localhost:8000")
+    p.add_argument("--since", type=float, default=0.0,
+                   help="unix seconds lower bound on exported periods")
+    p.add_argument("--key", help="admin API key (the route is admin-gated)")
+    p.add_argument("--secret",
+                   help="plane secret to verify every line's HMAC")
+    p.add_argument("--raw", action="store_true",
+                   help="print the JSONL lines verbatim instead of totals")
+    p.add_argument("--timeout", type=float, default=60.0)
     p = sub.add_parser("debug", help="interactive debugger")
     p.add_argument("topology")
 
@@ -263,6 +319,7 @@ def main(argv=None) -> int:
         "compute": cmd_compute,
         "bench": cmd_bench,
         "replay": cmd_replay,
+        "usage-report": cmd_usage_report,
         "debug": cmd_debug,
     }[args.command](args)
 

@@ -537,6 +537,33 @@ class MisakaClient:
         queue-delay seconds per program (runtime/usage.py)."""
         return json.loads(self._request("/debug/usage", None, "GET"))
 
+    def usage_export(self, since: float = 0.0,
+                     verify_secret: str | None = None) -> list[dict]:
+        """Billing-grade usage export (GET /usage/export, admin-gated):
+        HMAC-signed JSONL periods of cumulative per-tenant counters from
+        the durable ledger, one parsed dict per line.  ``since`` (unix
+        seconds) bounds the window.  Pass ``verify_secret`` (the plane
+        secret) to verify every signature locally — a tampered or
+        unsigned line raises MisakaClientError.  Against a fleet hub the
+        stream carries every replica's and peer's lines verbatim behind
+        ``{"kind": "source"}`` envelopes."""
+        raw = self._request(f"/usage/export?since={since:g}", None, "GET")
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        lines = [
+            json.loads(ln) for ln in raw.splitlines() if ln.strip()
+        ]
+        if verify_secret is not None:
+            from misaka_tpu.runtime import usage as usage_mod
+
+            sec = (verify_secret.encode()
+                   if isinstance(verify_secret, str) else verify_secret)
+            try:
+                usage_mod.totals_from_lines(lines, secret=sec)
+            except usage_mod.UsageExportError as e:
+                raise MisakaClientError(200, str(e)) from None
+        return lines
+
     def alerts(self) -> dict:
         """The SLO burn-rate engine's state (GET /debug/alerts):
         per-program ok/warning/page with per-window burn rates and
@@ -559,8 +586,10 @@ class MisakaClient:
         retention stages, drop counters).  With ``name`` — a counter
         (returned as a rate), a gauge, or a derived histogram series
         (``<hist>:p50`` / ``:p99`` / ``:rate``) — returns every matching
-        series over the trailing ``window`` ("30s"/"5m"/"1h" or bare
-        seconds), each as ``{labels, stage_s, points: [[unix, avg,
+        series over the trailing ``window`` ("30s"/"5m"/"1h"/"7d" or
+        bare seconds — day windows answer from the durable long-horizon
+        tier when MISAKA_TSDB_DIR is armed), each as
+        ``{labels, stage_s, points: [[unix, avg,
         max], ...]}``.  ``labels`` filters by exact label values; on a
         fleet endpoint every replica's series carries ``replica="<i>"``.
         Raises MisakaClientError on a malformed window or filter (400)."""

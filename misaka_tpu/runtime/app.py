@@ -307,6 +307,35 @@ so the master's env surface is what survives:
                    discipline).  History snapshots into checkpoints
                    (strictly-newer merge on restore), so /debug/series
                    survives a /fleet/roll.
+  MISAKA_TSDB_DIR  arm the DURABLE telemetry plane (unset = today's
+                   in-memory behavior, byte-identical).  The TSDB
+                   collector spools finalized ring slots to fsync'd
+                   length-prefixed segments under this directory (torn
+                   tails truncated on reopen), adds a coarse
+                   long-horizon tier (MISAKA_TSDB_LONG_S, default 300s
+                   slots x MISAKA_TSDB_LONG_SLOTS, default 4032 = two
+                   weeks), and reloads both at boot — /debug/series
+                   answers window=7d across restarts and kill -9.
+                   Knobs: MISAKA_TSDB_DISK_MB (64; oldest segments
+                   evicted LOUDLY via misaka_tsdb_spool_dropped_total),
+                   MISAKA_TSDB_SEG_KB (1024, rotation size).  The same
+                   switch arms the usage ledger spool under
+                   <dir>/usage (MISAKA_USAGE_SPOOL=0 opts out;
+                   MISAKA_USAGE_DISK_MB 16, MISAKA_USAGE_SEG_KB 256,
+                   MISAKA_USAGE_FLUSH_S 15) and the always-on capture
+                   spool under <dir>/capture (MISAKA_CAPTURE_SPOOL=0
+                   opts out; MISAKA_CAPTURE_DISK_MB 256,
+                   MISAKA_CAPTURE_SEG_KB 4096, MISAKA_CAPTURE_SEG_S
+                   300; rotated spool-<seq>.mskcap segments replay
+                   independently, POST /captures/rotate cuts one on
+                   demand, MISAKA_REPLAY_HISTORY (2) widens
+                   ?verify=replay over the newest rotated segments).
+                   Billing: GET /usage/export serves HMAC-signed JSONL
+                   periods (secret: MISAKA_USAGE_SECRET, else the
+                   MISAKA_PLANE_SECRET[_FILE] plane secret), verified
+                   by `misaka_tpu usage-report --secret ...`; fleet
+                   hubs aggregate replicas + remote peers verbatim.
+                   docs/OBSERVABILITY.md "Durable telemetry"
   MISAKA_CANARY    "0" disables the synthetic canary (runtime/canary.py;
                    default on when serving via this entrypoint): every
                    MISAKA_CANARY_INTERVAL_S (5) it probes /healthz, the

@@ -305,7 +305,10 @@ ADMIN_ROUTES = frozenset({
     # the capture plane records raw request/response payloads — arming,
     # exporting, and reading it are operator actions, not tenant reads
     "/captures/start", "/captures/stop", "/captures/export",
-    "/debug/captures",
+    "/captures/rotate", "/debug/captures",
+    # the billing export carries per-tenant totals for EVERY tenant —
+    # an operator read, not a tenant one
+    "/usage/export",
     # minting tenant tokens hands out credentials; gossip mutates quota
     # bucket state — both are fleet/operator mutations
     "/edge/token", "/edge/gossip",

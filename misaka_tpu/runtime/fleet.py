@@ -603,6 +603,15 @@ class FleetManager:
             env["MISAKA_PROGRAMS_DIR"] = os.path.join(
                 programs_dir, f"replica-{slot['idx']}"
             )
+        tsdb_dir = self._base_env.get("MISAKA_TSDB_DIR")
+        if tsdb_dir:
+            # the durable telemetry spools (utils/tsdb.py, runtime/usage,
+            # capture rotation) are single-writer per directory — same
+            # per-replica split as the registry stores above; the parent
+            # keeps the root for its own fleet-level history
+            env["MISAKA_TSDB_DIR"] = os.path.join(
+                tsdb_dir, f"replica-{slot['idx']}"
+            )
         if slot["restore"]:
             env["MISAKA_FLEET_RESTORE"] = slot["restore"]
         else:
@@ -1489,14 +1498,25 @@ def make_fleet_http_server(
             s for s in fleet.up_slots()
             if want_replica is None or str(s["idx"]) == want_replica
         ]
+        # remote peers ride the same label discipline: their retained
+        # history merges under replica="<peer idx>" (peer indices follow
+        # the local slots, so the drill-down filter stays unambiguous)
+        peers = [
+            p for p in fleet._peers
+            if p["probe_ok"]
+            and (want_replica is None or str(p["idx"]) == want_replica)
+        ]
         fetched = _gather(
-            slots,
+            slots + peers,
             lambda s: _ReplicaHTTP(
-                s["port"], timeout=5.0, key=fleet._internal_token,
+                s["port"], timeout=5.0,
+                key=fleet._peer_key if s.get("remote")
+                else fleet._internal_token,
+                host=s.get("host") or "127.0.0.1",
             ).get_json(f"/debug/series?{qs}{extra}"),
         )
         rows: list[dict] = []
-        for slot, payload in zip(slots, fetched):
+        for slot, payload in zip(slots + peers, fetched):
             if payload is None:
                 continue
             for row in payload.get("series", ()):
@@ -1973,6 +1993,62 @@ def make_fleet_http_server(
                         "window_s": window_s,
                         "series": _merged_series(name, window_s, labels),
                     })
+                    return
+                if path == "/usage/export":
+                    # fleet-hub billing aggregation: every up replica's
+                    # and remote peer's SIGNED export lines verbatim
+                    # (signatures stay verifiable end-to-end — the hub
+                    # cannot forge what it never re-signs), each source
+                    # introduced by an unsigned {"kind":"source"}
+                    # envelope, plus the gossip hub's fleet-wide
+                    # cumulative counters as a trailing summary
+                    from urllib.parse import parse_qs as _pq
+
+                    q = _pq(self.path.split("?", 1)[1]
+                            if "?" in self.path else "")
+                    since = (q.get("since") or ["0"])[0]
+                    sources = fleet.up_slots() + [
+                        p for p in fleet._peers if p["probe_ok"]
+                    ]
+                    fetched = _gather(
+                        sources,
+                        lambda s: _ReplicaHTTP(
+                            s["port"], timeout=10.0,
+                            key=fleet._peer_key if s.get("remote")
+                            else fleet._internal_token,
+                            host=s.get("host") or "127.0.0.1",
+                        ).request(
+                            "GET", f"/usage/export?since={since}"
+                        ),
+                    )
+                    out: list[str] = []
+                    for src, got in zip(sources, fetched):
+                        envelope = {
+                            "kind": "source",
+                            "replica": str(src["idx"]),
+                            "remote": bool(src.get("remote")),
+                            "ok": bool(got and got[0] == 200),
+                        }
+                        out.append(json.dumps(
+                            envelope, separators=(",", ":")
+                        ))
+                        if got and got[0] == 200:
+                            out.extend(
+                                ln for ln in
+                                got[1].decode(errors="replace").splitlines()
+                                if ln.strip()
+                            )
+                    out.append(json.dumps({
+                        "kind": "fleet_gossip",
+                        "sources": {
+                            k: dict(v)
+                            for k, v in fleet._gossip_seen.items()
+                        },
+                    }, separators=(",", ":")))
+                    self._reply(
+                        200, ("\n".join(out) + "\n").encode(),
+                        "application/x-ndjson",
+                    )
                     return
                 if path == "/debug/dashboard":
                     # the same self-contained page the engine serves,

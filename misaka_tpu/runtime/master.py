@@ -204,6 +204,7 @@ _METRIC_ROUTES = frozenset({
     "/debug/series", "/debug/dashboard", "/debug/faults",
     "/debug/native_trace", "/debug/captures",
     "/captures/start", "/captures/stop", "/captures/export",
+    "/captures/rotate", "/usage/export",
 })
 
 # The routes whose latency/error outcomes feed the per-program SLO windows
@@ -2949,6 +2950,33 @@ def make_http_server(
     tsdb_mod.ensure_started()
     watchdog_mod.ensure_started()
 
+    # Durable telemetry plane (MISAKA_TSDB_DIR is the master switch,
+    # armed inside ensure_started above for the TSDB tier): the usage
+    # ledger persists cumulative per-tenant counters under <dir>/usage,
+    # and the capture spool keeps the wire recorder always-on under
+    # <dir>/capture, cutting fresh per-program anchors at every
+    # rotation with the same closure POST /captures/start uses.
+    usage.ensure_spool()
+
+    def _spool_anchors() -> dict:
+        anchors = {}
+        label = (
+            registry.default_name if registry is not None else None
+        ) or "default"
+        a = capture_mod.anchor_from_master(label, master)
+        if a is not None:
+            anchors[label] = a
+        if registry is not None:
+            for name, m in registry.active_masters():
+                if name in anchors:
+                    continue
+                a = capture_mod.anchor_from_master(name, m)
+                if a is not None:
+                    anchors[name] = a
+        return anchors
+
+    capture_mod.ensure_spool(anchor_fn=_spool_anchors)
+
     # Fleet-debugging stamp (utils/buildinfo.py): the misaka_build_info
     # gauge (version / git sha / runtime versions / native provenance in
     # labels, value 1) plus the /status `build` block below.
@@ -3460,6 +3488,29 @@ def make_http_server(
                     # split by slot share), measured native-pool seconds,
                     # and queue-delay seconds, per program
                     self._json(usage.debug_payload())
+                    return
+                if parsed.path == "/usage/export":
+                    # billing-grade export: HMAC-signed JSONL periods of
+                    # cumulative per-tenant counters from the durable
+                    # ledger (runtime/usage.py).  ?since= (unix seconds)
+                    # bounds the window; the ledger flushes before
+                    # answering so every exported number is on disk.
+                    q = parse_qs(parsed.query)
+                    try:
+                        since = float((q.get("since") or ["0"])[0])
+                    except ValueError:
+                        self._text(400, "bad since= (unix seconds)")
+                        return
+                    try:
+                        lines = usage.export_lines(since=since)
+                    except usage.UsageExportError as e:
+                        self._text(409, str(e))
+                        return
+                    body = "".join(
+                        json.dumps(line, separators=(",", ":")) + "\n"
+                        for line in lines
+                    ).encode()
+                    self._send(body, "application/x-ndjson")
                     return
                 if parsed.path == "/debug/alerts":
                     # the SLO burn-rate engine (utils/slo.py): per-program
@@ -4026,6 +4077,19 @@ def make_http_server(
                     self._form()  # drain any body (keep-alive sync)
                     capture_mod.stop()
                     self._json(capture_mod.status())
+                elif path == "/captures/rotate":
+                    # deterministic spool cut: finalize the current ring
+                    # as the next spool-<seq>.mskcap segment (anchors +
+                    # manifest) and re-arm with fresh anchors — the same
+                    # rotation the always-on daemon performs on size/age
+                    self._form()  # drain any body (keep-alive sync)
+                    try:
+                        result = capture_mod.rotate_now()
+                    except capture_mod.CaptureError as e:
+                        self._text(409, str(e))
+                        return
+                    self._json(result if result is not None
+                               else {"rotated": False, "reason": "empty ring"})
                 elif path == "/captures/export":
                     # spill the ring to a durable segment file (+ anchor
                     # checkpoints); admin-gated, so a caller-chosen path is

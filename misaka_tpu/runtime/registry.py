@@ -714,6 +714,62 @@ class ProgramRegistry:
             "registry: verify=replay green for %s (%d captured requests "
             "byte-identical)", name, len(recs),
         )
+        self._verify_replay_history(name, topo, capture_mod, MasterNode)
+
+    def _verify_replay_history(self, name, topo, capture_mod,
+                               MasterNode) -> None:
+        """With the capture spool armed, widen the gate past the live
+        ring: replay the newest MISAKA_REPLAY_HISTORY rotated segments
+        (default 2) against the candidate too.  Unsound history segments
+        are skipped (the in-memory bundle above is the gate's floor) —
+        but a divergence on any swept segment fails the deploy just as
+        loudly."""
+        try:
+            depth = int(os.environ.get("MISAKA_REPLAY_HISTORY", "") or 2)
+        except ValueError:
+            depth = 2
+        if depth <= 0 or capture_mod.spool_status() is None:
+            return
+        for apath, hrecs, seg in capture_mod.history_bundles(
+                name, limit_segments=depth):
+            try:
+                _meta, state = capture_mod.load_anchor_checkpoint(apath)
+            except Exception as e:
+                log.warning("registry: history anchor %s unreadable: %s",
+                            apath, e)
+                continue
+            shadow = MasterNode(
+                topo, chunk_steps=self._chunk, batch=self._batch,
+                engine=self._engine,
+            )
+            try:
+                try:
+                    shadow.restore(state)
+                except ValueError as e:
+                    raise ReplayDivergence(
+                        f"candidate for {name!r} cannot restore the "
+                        f"history anchor from {seg}: {e}"
+                    ) from e
+                shadow.run()
+                diffs = capture_mod.replay_records(shadow, hrecs)
+            finally:
+                try:
+                    shadow.close()
+                except Exception:
+                    log.warning("replay shadow close failed", exc_info=True)
+            if diffs:
+                for d in diffs:
+                    log.warning("registry: %s", capture_mod.format_diff(d))
+                raise ReplayDivergence(
+                    f"candidate for {name!r} diverged on "
+                    f"{len(diffs)}/{len(hrecs)} requests from history "
+                    f"segment {seg}",
+                    diffs=diffs,
+                )
+            log.info(
+                "registry: verify=replay history green for %s over %s "
+                "(%d requests)", name, os.path.basename(seg), len(hrecs),
+            )
 
     def _hot_swap(
         self, name: str, version: str, old_key: tuple[str, str]

@@ -46,8 +46,11 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import hmac
+import json
 import os
 import threading
+import time
 
 from misaka_tpu.utils import metrics
 
@@ -263,6 +266,9 @@ def debug_payload() -> dict:
             sum(p["cpu_seconds"] for p in programs.values()), 6
         ),
     }
+    if _spool is not None:
+        # the durable ledger's restart-spanning view (base + live)
+        payload["cumulative"] = cumulative_snapshot()
     try:
         # the live native pool's measured busy/idle split (None when no
         # pool is serving); lazy import — this module stays stdlib-only
@@ -275,6 +281,347 @@ def debug_payload() -> dict:
     except Exception:  # pragma: no cover — the ledger must always answer
         pass
     return payload
+
+
+# --- the durable ledger (billing-grade persistence + signed exports) --------
+#
+# With MISAKA_TSDB_DIR set, a flusher thread appends CUMULATIVE per-
+# program counter frames (live accumulators + the base reloaded from the
+# previous process's spool) to fsync'd segments under <dir>/usage — the
+# same utils/spool.py discipline as TSDB retention.  Cumulative-by-
+# construction means restart-safe monotonicity: a kill -9 loses at most
+# the accrual since the last fsync'd frame, never regresses an exported
+# number (GET /usage/export flushes before answering, so anything a
+# billing scrape saw is on disk).  The conservation anchor
+# (misaka_serve_pass_wall_seconds_total) rides the same frames, so
+# cumulative cpu-vs-wall stays checkable across restarts.
+#
+# Export: JSONL, one line per (frame interval, program) delta plus a
+# trailing cumulative totals line, each signed with HMAC-SHA256 over the
+# canonical (sorted-keys) JSON minus the "sig" field, keyed by
+# MISAKA_USAGE_SECRET (falling back to the plane secret — one fleet, one
+# key). The synthetic canary's account is excluded from export lines by
+# name (it is not tenant traffic) but stays inside the conservation
+# totals, which cover ALL programs.
+
+M_USAGE_SPOOL_DROPPED = metrics.counter(
+    "misaka_usage_spool_dropped_total",
+    "Usage ledger spool segments evicted by the MISAKA_USAGE_DISK_MB "
+    "budget (billing periods older than the retained window are lost)",
+)
+
+FIELDS = ("requests", "values", "cpu_seconds", "native_seconds",
+          "queue_seconds")
+
+
+class UsageExportError(RuntimeError):
+    """Unusable or tampered usage export content."""
+
+
+_spool = None  # utils/spool.SegmentSpool once armed
+_spool_lock = threading.Lock()
+_base: dict[str, dict] = {}
+_pass_base = 0.0
+# live-counter values AT ARM TIME: cumulative = base + (live - offset),
+# so accrual from before the spool armed (other servers in the same test
+# process) is never double-counted against the reloaded base
+_live_offset: dict[str, dict] = {}
+_pass_offset = 0.0
+_last_flushed: tuple | None = None
+_flush_stop: threading.Event | None = None
+
+
+def cumulative_snapshot() -> dict:
+    """Base (reloaded from the previous process) + live accrual since
+    the spool armed: the monotone counters the billing export
+    publishes."""
+    live = snapshot()
+    programs: dict[str, dict] = {}
+    for label in set(_base) | set(live):
+        b = _base.get(label) or {}
+        v = live.get(label) or {}
+        o = _live_offset.get(label) or {}
+        programs[label] = {
+            f: round(
+                float(b.get(f, 0)) + max(
+                    0.0, float(v.get(f, 0)) - float(o.get(f, 0))
+                ), 6,
+            )
+            for f in FIELDS
+        }
+    return {
+        "programs": programs,
+        "pass_wall_seconds": round(
+            _pass_base + max(0.0, pass_seconds_total() - _pass_offset), 6
+        ),
+    }
+
+
+def spool_dir(environ=os.environ) -> str | None:
+    root = environ.get("MISAKA_TSDB_DIR")
+    if not root or environ.get("MISAKA_USAGE_SPOOL", "1") == "0":
+        return None
+    return os.path.join(root, "usage")
+
+
+def ensure_spool(environ=os.environ):
+    """Arm the durable ledger (idempotent; None when MISAKA_TSDB_DIR is
+    unset — today's in-memory behavior).  Reloads the newest retained
+    frame as the cumulative base, writes a boot frame, and starts the
+    periodic flusher."""
+    global _spool, _pass_base, _pass_offset, _flush_stop
+    d = spool_dir(environ)
+    if d is None or not _ENABLED:
+        return None
+    with _spool_lock:
+        if _spool is not None:
+            return _spool
+        from misaka_tpu.utils import spool as spool_mod
+        from misaka_tpu.utils.tsdb import env_float
+
+        sp = spool_mod.SegmentSpool(
+            d, prefix="usage",
+            budget_bytes=int(env_float(
+                environ, "MISAKA_USAGE_DISK_MB", 16.0) * (1 << 20)),
+            segment_bytes=int(env_float(
+                environ, "MISAKA_USAGE_SEG_KB", 256.0) * 1024),
+            on_evict=M_USAGE_SPOOL_DROPPED.inc,
+            on_error=lambda: spool_mod.M_SPOOL_ERRORS.labels(
+                plane="usage").inc(),
+        )
+        last: list = [None]
+
+        def _keep_last(frame):
+            if frame.get("k") == "usage":
+                last[0] = frame
+
+        sp.reload(_keep_last)
+        if last[0] is not None:
+            _base.clear()
+            for label, row in (last[0].get("programs") or {}).items():
+                _base[str(label)] = {
+                    f: float(row.get(f, 0)) for f in FIELDS
+                }
+            _pass_base = float(last[0].get("pass_wall", 0.0))
+        _live_offset.clear()
+        _live_offset.update(snapshot())
+        _pass_offset = pass_seconds_total()
+        _spool = sp
+        _flush_stop = threading.Event()
+        interval = max(0.05, env_float(
+            environ, "MISAKA_USAGE_FLUSH_S", 15.0))
+        threading.Thread(
+            target=_flush_loop, args=(_flush_stop, interval),
+            daemon=True, name="misaka-usage-spool",
+        ).start()
+    flush_now(force=True)  # the boot frame: periods have a baseline
+    return _spool
+
+
+def _flush_loop(stop: threading.Event, interval: float) -> None:
+    while not stop.wait(interval):
+        try:
+            flush_now()
+        except Exception:  # pragma: no cover — billing flush must never
+            pass           # take the process down
+
+
+def flush_now(force: bool = False) -> bool:
+    """Append one cumulative frame + fsync.  Identical consecutive
+    frames are elided (an idle box must not grow its ledger), unless
+    ``force`` (boot; the export path, which must include up-to-call
+    accrual)."""
+    global _last_flushed
+    with _spool_lock:
+        if _spool is None:
+            return False
+        snap = cumulative_snapshot()
+        fingerprint = (
+            snap["pass_wall_seconds"],
+            tuple(sorted(
+                (p, row["requests"], row["cpu_seconds"])
+                for p, row in snap["programs"].items()
+            )),
+        )
+        if not force and fingerprint == _last_flushed:
+            return False
+        _last_flushed = fingerprint
+        _spool.append({
+            "k": "usage",
+            "t": round(time.time(), 3),
+            "pass_wall": snap["pass_wall_seconds"],
+            "programs": snap["programs"],
+        })
+        _spool.flush()
+        return True
+
+
+def shutdown_spool() -> None:
+    """Tests: stop the flusher and drop the armed spool + bases."""
+    global _spool, _pass_base, _pass_offset, _last_flushed, _flush_stop
+    with _spool_lock:
+        if _flush_stop is not None:
+            _flush_stop.set()
+            _flush_stop = None
+        if _spool is not None:
+            _spool.close()
+            _spool = None
+        _base.clear()
+        _live_offset.clear()
+        _pass_base = 0.0
+        _pass_offset = 0.0
+        _last_flushed = None
+
+
+# --- signed JSONL export ----------------------------------------------------
+
+def export_secret(environ=os.environ) -> bytes | None:
+    """The HMAC key for export lines: MISAKA_USAGE_SECRET, else the
+    plane secret (MISAKA_PLANE_SECRET / _FILE) — one fleet, one key.
+    None -> exports go out unsigned (lines carry no "sig")."""
+    s = environ.get("MISAKA_USAGE_SECRET") or environ.get(
+        "MISAKA_PLANE_SECRET")
+    if s:
+        return s.encode()
+    p = environ.get("MISAKA_PLANE_SECRET_FILE")
+    if p:
+        try:
+            with open(p, "rb") as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+    return None
+
+
+def _canonical(obj: dict) -> bytes:
+    return json.dumps(
+        {k: v for k, v in obj.items() if k != "sig"},
+        sort_keys=True, separators=(",", ":"),
+    ).encode()
+
+
+def sign_line(obj: dict, secret: bytes) -> dict:
+    obj["sig"] = hmac.new(secret, _canonical(obj), "sha256").hexdigest()
+    return obj
+
+
+def verify_line(obj: dict, secret: bytes) -> bool:
+    sig = obj.get("sig")
+    if not isinstance(sig, str):
+        return False
+    want = hmac.new(secret, _canonical(obj), "sha256").hexdigest()
+    return hmac.compare_digest(sig, want)
+
+
+def export_lines(since: float = 0.0, environ=os.environ) -> list[dict]:
+    """The GET /usage/export body: per-(interval, program) delta lines
+    between consecutive retained frames with end > ``since``, then one
+    cumulative totals line.  Signed when a secret is configured.  With
+    no spool armed, degrades to the single process-lifetime period."""
+    frames: list[dict] = []
+    with _spool_lock:
+        sp = _spool
+    if sp is not None:
+        flush_now(force=True)
+        sp.read_frames(
+            lambda fr: frames.append(fr) if fr.get("k") == "usage" else None
+        )
+    if not frames:
+        snap = cumulative_snapshot()
+        frames = [
+            {"t": 0.0, "pass_wall": 0.0, "programs": {}},
+            {"t": round(time.time(), 3),
+             "pass_wall": snap["pass_wall_seconds"],
+             "programs": snap["programs"]},
+        ]
+    lines: list[dict] = []
+    for prev, cur in zip(frames, frames[1:]):
+        t1 = float(cur.get("t", 0.0))
+        if t1 <= since:
+            continue
+        t0 = float(prev.get("t", 0.0))
+        prev_p = prev.get("programs") or {}
+        for label, row in sorted((cur.get("programs") or {}).items()):
+            if label in EXEMPT_LABELS:
+                continue  # probe traffic is not billable tenant usage
+            before = prev_p.get(label) or {}
+            deltas = {
+                f: round(max(
+                    0.0, float(row.get(f, 0)) - float(before.get(f, 0))
+                ), 6)
+                for f in FIELDS
+            }
+            if not any(deltas.values()):
+                continue
+            lines.append({
+                "kind": "period", "start": round(t0, 3), "end": round(t1, 3),
+                "program": label, **deltas,
+                "cumulative": {f: float(row.get(f, 0)) for f in FIELDS},
+            })
+    last = frames[-1]
+    programs = {
+        label: {f: float(row.get(f, 0)) for f in FIELDS}
+        for label, row in sorted((last.get("programs") or {}).items())
+        if label not in EXEMPT_LABELS
+    }
+    lines.append({
+        "kind": "totals",
+        "asof": round(float(last.get("t", 0.0)), 3),
+        "pass_wall_seconds": round(float(last.get("pass_wall", 0.0)), 6),
+        "cpu_seconds_total": round(sum(
+            float(row.get("cpu_seconds", 0))
+            for row in (last.get("programs") or {}).values()
+        ), 6),
+        "programs": programs,
+    })
+    secret = export_secret(environ)
+    if secret is not None:
+        for obj in lines:
+            sign_line(obj, secret)
+    return lines
+
+
+def totals_from_lines(lines, secret: bytes | None = None) -> dict:
+    """Aggregate export lines (the usage-report CLI's core): verifies
+    every period/totals line when a secret is given (UsageExportError
+    on the first tampered line), sums period deltas per program, and
+    carries the newest cumulative totals through."""
+    deltas: dict[str, dict] = {}
+    totals: dict | None = None
+    periods = 0
+    for i, obj in enumerate(lines):
+        kind = obj.get("kind")
+        if kind not in ("period", "totals"):
+            continue  # hub envelope lines (kind=source/gossip) pass through
+        if secret is not None and not verify_line(obj, secret):
+            raise UsageExportError(
+                f"line {i} ({kind}) failed HMAC verification — tampered "
+                f"or signed with a different secret"
+            )
+        if kind == "period":
+            periods += 1
+            row = deltas.setdefault(
+                obj.get("program") or DEFAULT_LABEL,
+                {f: 0.0 for f in FIELDS},
+            )
+            for f in FIELDS:
+                row[f] += float(obj.get(f, 0))
+        elif totals is None or float(obj.get("asof", 0)) >= \
+                float(totals.get("asof", 0)):
+            totals = obj
+    return {
+        "verified": secret is not None,
+        "periods": periods,
+        "programs": {
+            p: {f: round(v, 6) for f, v in row.items()}
+            for p, row in sorted(deltas.items())
+        },
+        "cumulative": (totals or {}).get("programs") or {},
+        "pass_wall_seconds": float(
+            (totals or {}).get("pass_wall_seconds", 0.0)),
+        "cpu_seconds_total": float(
+            (totals or {}).get("cpu_seconds_total", 0.0)),
+    }
 
 
 # --- the per-request program context (jsonlog's `program` field) ------------

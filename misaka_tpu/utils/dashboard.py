@@ -59,6 +59,11 @@ PANELS = (
     ("Canary p99", "misaka_canary_latency_seconds:p99", "max", "s"),
     ("Per-program values/s", "misaka_usage_values_total", "sum", "/s"),
     ("Per-program SLO p99", "misaka_slo_p99_seconds", "max", "s"),
+    ("TSDB spool on disk (bytes)", "misaka_tsdb_spool_bytes", "max", "B"),
+    ("Spool drops (/s)", "misaka_tsdb_spool_dropped_total", "sum", "/s"),
+    ("Capture spool on disk (bytes)", "misaka_capture_spool_bytes",
+     "max", "B"),
+    ("Spool errors (/s)", "misaka_spool_errors_total", "sum", "/s"),
 )
 
 

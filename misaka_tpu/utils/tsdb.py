@@ -57,6 +57,20 @@ where it is strictly NEWER than what the live ring holds, which makes a
 stale eviction-era checkpoint a no-op and a fleet-roll restore a full
 history handoff with the same rule.
 
+Durable retention (the telemetry plane's tentpole): set
+``MISAKA_TSDB_DIR`` and the collector's tick also appends every
+FINALIZED ring slot to fsync'd segment files there (utils/spool.py —
+length-prefixed frames, torn-tail truncation on reopen, rotation, and
+oldest-segment eviction under ``MISAKA_TSDB_DISK_MB``, counted on
+``misaka_tsdb_spool_dropped_total``).  Two tiers: "fine" persists the
+finest stage (full-resolution restart continuity), "long" persists a
+coarse long-horizon stage (``MISAKA_TSDB_LONG_S`` x
+``MISAKA_TSDB_LONG_SLOTS``, 5 m x 4032 = two weeks by default) that also
+DEEPENS the in-memory coarsest ring so ``window=7d`` answers from RAM.
+Boot reloads the spool back into the rings — /debug/series spans
+restarts without checkpoints.  Unset, nothing changes: no thread, no
+file, no extra stage.
+
 Stdlib-only, like the rest of the plane.  ``MISAKA_TSDB=0`` is the kill
 switch; ``shutdown()`` stops the collector (the bench A/B measures both
 sides).
@@ -72,10 +86,23 @@ import time
 from array import array
 
 from misaka_tpu.utils import metrics
+from misaka_tpu.utils.spool import M_SPOOL_ERRORS, SegmentSpool
 
 DEFAULT_INTERVAL_S = 5.0
 DEFAULT_MAX_SERIES = 512
 DEFAULT_BUDGET = 0.01
+DEFAULT_DISK_MB = 64.0
+DEFAULT_LONG_S = 300.0
+DEFAULT_LONG_SLOTS = 4032  # two weeks of 5 m slots
+
+M_SPOOL_DROPPED = metrics.counter(
+    "misaka_tsdb_spool_dropped_total",
+    "TSDB spool segments evicted by the MISAKA_TSDB_DISK_MB budget",
+)
+M_SPOOL_BYTES = metrics.gauge(
+    "misaka_tsdb_spool_bytes",
+    "On-disk footprint of the TSDB retention spool",
+)
 
 # Families sampled FIRST each pass (the dashboard's golden signals and
 # the watchdog's default rules): a label flood elsewhere may exhaust the
@@ -99,15 +126,17 @@ class TSDBError(ValueError):
 
 
 def parse_window(text: str | float | int, allow_zero: bool = False) -> float:
-    """``"30s"`` / ``"5m"`` / ``"1h"`` / bare seconds -> seconds.
-    `allow_zero` admits 0 (the watchdog's no-sustain clause); a query
-    window stays strictly positive."""
+    """``"30s"`` / ``"5m"`` / ``"1h"`` / ``"7d"`` / bare seconds ->
+    seconds.  `allow_zero` admits 0 (the watchdog's no-sustain clause);
+    a query window stays strictly positive."""
     if isinstance(text, (int, float)):
         v = float(text)
     else:
         t = str(text).strip().lower()
         mult = 1.0
-        if t.endswith("h"):
+        if t.endswith("d"):
+            mult, t = 86400.0, t[:-1]
+        elif t.endswith("h"):
             mult, t = 3600.0, t[:-1]
         elif t.endswith("m"):
             mult, t = 60.0, t[:-1]
@@ -117,7 +146,7 @@ def parse_window(text: str | float | int, allow_zero: bool = False) -> float:
             v = float(t) * mult
         except ValueError:
             raise TSDBError(f"cannot parse window {text!r} "
-                            f"(use e.g. 30s / 5m / 1h)") from None
+                            f"(use e.g. 30s / 5m / 1h / 7d)") from None
     if v < 0 or (v == 0 and not allow_zero):
         raise TSDBError(f"window must be > 0, got {text!r}")
     return v
@@ -150,15 +179,23 @@ def env_float(environ, name: str, default: float) -> float:
         return default
 
 
-def _stage_plan(interval_s: float) -> tuple[tuple[float, int], ...]:
+def _stage_plan(interval_s: float, long_s: float | None = None,
+                long_slots: int = DEFAULT_LONG_SLOTS,
+                ) -> tuple[tuple[float, int], ...]:
     """(width_s, length) per retention stage for one sample interval.
     Coarser stages keep their absolute spans when the interval shrinks
     (tests run 50 ms intervals; the 1 m/5 m tiers stay meaningful), and
-    widen to the interval when it grows past them."""
+    widen to the interval when it grows past them.  With the disk spool
+    armed (``long_s`` set) the coarsest stage becomes the long-horizon
+    tier: ``long_s``-wide slots held ``long_slots`` deep (two weeks at
+    the 5 m default), the in-memory landing zone the spool reloads into
+    so ``window=7d`` answers from RAM after a restart."""
     stages = [(interval_s, 720)]
     for width, length in ((60.0, 360), (300.0, 288)):
-        if width > interval_s:
+        if width > interval_s and (long_s is None or width < long_s):
             stages.append((width, length))
+    if long_s is not None and long_s > interval_s:
+        stages.append((long_s, max(288, int(long_slots))))
     return tuple(stages)
 
 
@@ -221,6 +258,32 @@ class _Ring:
             self.counts[i] = count
             self.maxs[i] = peak
 
+    def merge(self, epoch: int, total: float, count: int,
+              peak: float) -> None:
+        """Spool reload: ACCUMULATE into a matching-epoch slot (a fine
+        on-disk slot re-aggregating into a coarser ring), install fresh
+        where newer, and — unlike install() — never touch a slot the
+        live ring already holds newer data for."""
+        i = epoch % self.length
+        if epoch > self.epochs[i]:
+            self.epochs[i] = epoch
+            self.sums[i] = total
+            self.counts[i] = count
+            self.maxs[i] = peak
+        elif epoch == self.epochs[i]:
+            self.sums[i] += total
+            self.counts[i] += count
+            if peak > self.maxs[i]:
+                self.maxs[i] = peak
+
+    def slot_at(self, epoch: int) -> tuple[float, int, float] | None:
+        """(sum, count, max) of one absolute epoch, None when unwritten
+        or reclaimed — the spool writer's finalized-slot read."""
+        i = epoch % self.length
+        if self.epochs[i] != epoch or not self.counts[i]:
+            return None
+        return (self.sums[i], int(self.counts[i]), self.maxs[i])
+
     def dump(self) -> list[list[float]]:
         out = []
         for i in range(self.length):
@@ -262,12 +325,25 @@ class TSDB:
 
     def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
                  max_series: int = DEFAULT_MAX_SERIES,
-                 budget: float = DEFAULT_BUDGET, registry=None):
+                 budget: float = DEFAULT_BUDGET, registry=None,
+                 spool_dir: str | None = None,
+                 disk_mb: float = DEFAULT_DISK_MB,
+                 long_s: float = DEFAULT_LONG_S,
+                 long_slots: int = DEFAULT_LONG_SLOTS,
+                 segment_bytes: int = 1 << 20):
         self.interval_s = max(0.02, float(interval_s))
         self.max_series = max(16, int(max_series))
         self.budget = min(0.5, max(0.001, float(budget)))
         self._registry = registry if registry is not None else metrics.REGISTRY
-        self._plan = _stage_plan(self.interval_s)
+        self.spool_dir = spool_dir
+        self._long_armed = (
+            spool_dir is not None and float(long_s) > self.interval_s
+        )
+        self._plan = _stage_plan(
+            self.interval_s,
+            long_s=float(long_s) if self._long_armed else None,
+            long_slots=long_slots,
+        )
         self._lock = threading.Lock()
         self._series: dict[tuple, _Series] = {}  # (name, sorted-label-items)
         self._dropped: set[tuple] = set()
@@ -284,6 +360,40 @@ class TSDB:
         self._hooks: list = []
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # --- the disk spool (MISAKA_TSDB_DIR; None = today's in-memory
+        # behavior).  Two tiers ride the same SegmentSpool discipline:
+        # "fine" persists every finalized finest-stage slot (restart
+        # continuity at full resolution, ~days under the budget) and
+        # "long" persists the coarse long-horizon slots (weeks).  The
+        # budget splits 3:1 fine:long.
+        self._spools: dict[str, SegmentSpool] = {}
+        self._flushed_epoch: dict[str, int] = {}
+        self._long_hi = -1  # newest long-tier epoch seen at reload
+        self.spooled_frames = 0
+        self.reloaded_frames = 0
+        if spool_dir is not None:
+            budget_bytes = max(1 << 20, int(float(disk_mb) * (1 << 20)))
+            tiers = [("fine", self.stages_widths()[0], budget_bytes * 3 // 4)]
+            if self._long_armed:
+                tiers.append(
+                    ("long", self.stages_widths()[-1], budget_bytes // 4)
+                )
+            now_unix = time.time()
+            for tier, width, tier_budget in tiers:
+                sp = SegmentSpool(
+                    spool_dir, prefix=f"tsdb-{tier}",
+                    budget_bytes=tier_budget,
+                    segment_bytes=segment_bytes,
+                    on_evict=M_SPOOL_DROPPED.inc,
+                    on_error=lambda: M_SPOOL_ERRORS.labels(
+                        plane="tsdb").inc(),
+                )
+                self._spools[tier] = sp
+                self._flushed_epoch[tier] = int(now_unix / width) - 1
+            self._spool_reload()
+
+    def stages_widths(self) -> list[float]:
+        return [w for w, _ in self._plan]
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -306,6 +416,8 @@ class TSDB:
         if t is not None:
             t.join(timeout=2)
         self._thread = None
+        for sp in self._spools.values():
+            sp.close()
 
     def add_hook(self, fn) -> None:
         """Register fn(tsdb) to run after every collected sample."""
@@ -331,6 +443,7 @@ class TSDB:
             t0 = time.perf_counter()
             try:
                 self.sample_once()
+                self._spool_flush()
             except Exception:  # pragma: no cover — the collector must
                 pass           # never take serving down with it
             dt = time.perf_counter() - t0
@@ -435,6 +548,143 @@ class TSDB:
                     metrics.quantile_from_buckets(uppers, delta, q),
                 )
 
+    # --- the disk spool -----------------------------------------------------
+
+    def _tier_stage(self, tier: str) -> int:
+        return 0 if tier == "fine" else len(self._plan) - 1
+
+    def _spool_flush(self) -> None:
+        """Collector-tick hook: append every newly FINALIZED slot (its
+        epoch fully in the past) to the tier's segment spool, fsync, and
+        let the spool enforce rotation + the disk budget.  Runs on the
+        collector thread only (the spool is single-writer)."""
+        if not self._spools:
+            return
+        now_unix = time.time()
+        wrote = False
+        for tier, sp in self._spools.items():
+            stage_i = self._tier_stage(tier)
+            width = self._plan[stage_i][0]
+            current = int(now_unix / width)
+            # bound catch-up after a stall — older slots are still in
+            # RAM but no longer worth a giant write burst
+            start = max(self._flushed_epoch[tier] + 1, current - 64)
+            tier_wrote = False
+            for epoch in range(start, current):
+                rows = []
+                with self._lock:
+                    for s in self._series.values():
+                        slot = s.stages[stage_i].slot_at(epoch)
+                        if slot is not None:
+                            rows.append([
+                                s.name, s.labels, s.kind,
+                                slot[0], slot[1], slot[2],
+                            ])
+                self._flushed_epoch[tier] = epoch
+                if rows:
+                    sp.append({"k": "slots", "tier": tier, "w": width,
+                               "e": epoch, "rows": rows})
+                    self.spooled_frames += 1
+                    tier_wrote = True
+            if tier_wrote:
+                sp.flush()
+                wrote = True
+        if wrote:
+            M_SPOOL_BYTES.set(
+                sum(sp.disk_bytes() for sp in self._spools.values())
+            )
+
+    def _spool_reload(self) -> None:
+        """Boot: retained frames -> the in-memory rings, so /debug/series
+        answers across restarts without checkpoints.  Long-tier frames
+        own the coarsest ring outright; fine frames re-aggregate into
+        every FINER stage (never the coarsest — the long tier already
+        carries that span, and merging both would double-count)."""
+        for tier in ("long", "fine"):
+            sp = self._spools.get(tier)
+            if sp is None:
+                continue
+            self.reloaded_frames += sp.reload(
+                lambda fr, t=tier: self._install_frame(t, fr)
+            )
+
+    def _install_frame(self, tier: str, frame: dict) -> None:
+        if frame.get("k") != "slots":
+            return
+        try:
+            width = float(frame["w"])
+            epoch = int(frame["e"])
+            rows = frame["rows"]
+        except (KeyError, TypeError, ValueError):
+            return
+        stage_i = self._tier_stage(tier)
+        live_width = self._plan[stage_i][0]
+        if abs(width - live_width) < 1e-9:
+            # same tier geometry across the restart: the writer resumes
+            # AFTER the newest on-disk epoch (no duplicate frames)
+            self._flushed_epoch[tier] = max(
+                self._flushed_epoch[tier], epoch
+            )
+        if tier == "long":
+            self._long_hi = max(self._long_hi, epoch)
+        slot_start = epoch * width
+        for row in rows:
+            try:
+                name, labels, kind, total, count, peak = row
+            except (TypeError, ValueError):
+                continue
+            s = self._series_for(
+                str(name),
+                {str(k): str(v) for k, v in (labels or {}).items()},
+                str(kind),
+            )
+            if s is None:
+                continue  # over the cap: counted in dropped_series
+            if tier == "long" or not self._long_armed:
+                targets = s.stages[stage_i:stage_i + 1]
+            else:
+                # fine frames fill every finer stage; the coarsest too,
+                # but only PAST the long tier's newest reloaded epoch —
+                # a young server has no finalized long slots yet, and
+                # window=7d must still show pre-restart points without
+                # double-counting spans the long tier already carries
+                targets = list(s.stages[:-1])
+                coarse = s.stages[-1]
+                if slot_start >= (self._long_hi + 1) * coarse.width:
+                    targets.append(coarse)
+            for ring in targets:
+                if ring.width + 1e-9 < width:
+                    continue  # cannot disaggregate into a finer ring
+                ring.merge(
+                    int(slot_start / ring.width) if ring.width != width
+                    else epoch,
+                    float(total), int(count), float(peak),
+                )
+
+    def spool_status(self) -> dict | None:
+        if not self._spools:
+            return None
+        return {
+            "dir": self.spool_dir,
+            "disk_bytes": sum(
+                sp.disk_bytes() for sp in self._spools.values()
+            ),
+            "frames_spooled": self.spooled_frames,
+            "frames_reloaded": self.reloaded_frames,
+            "evicted_segments": sum(
+                sp.evicted for sp in self._spools.values()
+            ),
+            "errors": sum(sp.errors for sp in self._spools.values()),
+            "tiers": {
+                tier: {
+                    "width_s": self._plan[self._tier_stage(tier)][0],
+                    "segments": len(sp.segments()),
+                    "budget_bytes": sp.budget_bytes,
+                }
+                for tier, sp in self._spools.items()
+            },
+        }
+
     # --- the read side ------------------------------------------------------
 
     def series_index(self) -> dict:
@@ -461,7 +711,9 @@ class TSDB:
             "dropped_series": dropped,
             "bytes_per_series": sum(28 * n for _, n in self._plan),
             "names": {k: names[k] for k in sorted(names)},
-        }
+        } | (
+            {"spool": self.spool_status()} if self._spools else {}
+        )
 
     def query(self, name: str, labels: dict[str, str] | None = None,
               window_s: float = 3600.0) -> list[dict]:
@@ -576,6 +828,20 @@ def ensure_started(environ=os.environ) -> TSDB | None:
                 budget=env_float(
                     environ, "MISAKA_TSDB_BUDGET", DEFAULT_BUDGET
                 ),
+                # the durable telemetry plane (unset = today's behavior)
+                spool_dir=environ.get("MISAKA_TSDB_DIR") or None,
+                disk_mb=env_float(
+                    environ, "MISAKA_TSDB_DISK_MB", DEFAULT_DISK_MB
+                ),
+                long_s=env_float(
+                    environ, "MISAKA_TSDB_LONG_S", DEFAULT_LONG_S
+                ),
+                long_slots=int(env_float(
+                    environ, "MISAKA_TSDB_LONG_SLOTS", DEFAULT_LONG_SLOTS
+                )),
+                segment_bytes=int(env_float(
+                    environ, "MISAKA_TSDB_SEG_KB", 1024.0
+                ) * 1024),
             )
         if not _tsdb.running:
             _tsdb.start()

@@ -167,7 +167,11 @@ def parse_spec(text: str) -> list[Rule]:
 def default_rules(interval_s: float) -> list[Rule]:
     """The always-on defaults (MISAKA_WATCHDOG unset): a full-stack
     canary that keeps failing pages; edge p99 doubling over its own
-    trailing hour warns; replicas restarting faster than ~4/h warn.
+    trailing hour warns; replicas restarting faster than ~4/h warn;
+    sustained telemetry-spool loss (TSDB slots or capture records
+    dropped, or spool write errors) warns — durable retention that is
+    silently shedding its own history is the failure mode the durable
+    plane exists to prevent.
     Each stays silent until its series exists and (for the ratio rule)
     a baseline accumulated — so the p99 rule, which watches the
     ENGINE's own HTTP histogram, is simply inert behind a frontend
@@ -180,7 +184,12 @@ def default_rules(interval_s: float) -> list[Rule]:
         f"p99-drift=misaka_http_request_duration_seconds:p99"
         f"{{route=/compute_raw}}>2x@1h for 300s ->warning,"
         f"replica-restarts=misaka_fleet_replica_restarts_total"
-        f">0.0011 for 300s ->warning"
+        f">0.0011 for 300s ->warning,"
+        f"tsdb-spool-drops=misaka_tsdb_spool_dropped_total"
+        f">0.001 for 300s ->warning,"
+        f"capture-spool-drops=misaka_capture_spool_dropped_total"
+        f">0.001 for 300s ->warning,"
+        f"spool-errors=misaka_spool_errors_total>0.001 for 60s ->warning"
     )
 
 

@@ -1,0 +1,488 @@
+"""The durable telemetry plane (utils/spool.py + the TSDB/usage/capture
+spools): crash-shaped recovery — torn final segments truncated and
+continued on reopen, disk-budget eviction oldest-first, the billing
+ledger's cumulative counters monotone across restarts, signed-export
+tamper rejection, boot-time TSDB reload so day-scale windows answer
+across restarts — plus the acceptance restart drill against a REAL
+`python -m misaka_tpu.runtime.app` subprocess: kill -9 with the spool
+armed, relaunch, /debug/series spans the restart, the usage export
+conserves vs pass-wall, and a pre-kill rotated capture segment replays
+byte-for-byte green.
+"""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from misaka_tpu.utils import metrics
+from misaka_tpu.utils import tsdb
+from misaka_tpu.utils.spool import SegmentSpool
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Unique names per test: the metrics registry is process-global and
+# get-or-create, so a reused name would leak state across tests.
+_seq = iter(range(10 ** 6))
+
+
+def _name(kind):
+    return f"t_durable_{kind}_{next(_seq)}"
+
+
+# --- segment spool: crash-shaped recovery -----------------------------------
+
+
+def test_spool_torn_tail_truncated_and_continued(tmp_path):
+    sp = SegmentSpool(str(tmp_path), prefix="t")
+    for i in range(3):
+        assert sp.append({"i": i})
+    sp.flush()
+    sp.close()
+    [(_, path)] = sp.segments()
+    good_size = os.path.getsize(path)
+    # a kill mid-append leaves a torn tail: a length prefix promising
+    # more bytes than the file holds
+    with open(path, "ab") as f:
+        f.write(struct.pack("<I", 64) + b"torn")
+    # reopen: the tail is truncated IN PLACE and appending continues
+    sp2 = SegmentSpool(str(tmp_path), prefix="t")
+    seen = []
+    assert sp2.reload(seen.append) == 3
+    assert [fr["i"] for fr in seen] == [0, 1, 2]
+    assert os.path.getsize(path) == good_size
+    assert sp2.append({"i": 3})
+    sp2.flush()
+    sp2.close()
+    sp3 = SegmentSpool(str(tmp_path), prefix="t")
+    seen = []
+    assert sp3.reload(seen.append) == 4
+    assert [fr["i"] for fr in seen] == [0, 1, 2, 3]
+    sp3.close()
+
+
+def test_spool_garbage_tail_truncated(tmp_path):
+    """Non-JSON bytes after the last good frame (a torn frame body) are
+    cut away, not surfaced as frames and not fatal."""
+    sp = SegmentSpool(str(tmp_path), prefix="g")
+    sp.append({"ok": True})
+    sp.flush()
+    sp.close()
+    [(_, path)] = sp.segments()
+    blob = b"\xff\xfe not json"
+    with open(path, "ab") as f:
+        f.write(struct.pack("<I", len(blob)) + blob)
+    sp2 = SegmentSpool(str(tmp_path), prefix="g")
+    seen = []
+    assert sp2.reload(seen.append) == 1
+    assert seen == [{"ok": True}]
+    sp2.close()
+
+
+def test_spool_budget_evicts_oldest_never_active(tmp_path):
+    evicted = []
+    sp = SegmentSpool(
+        str(tmp_path), prefix="e",
+        budget_bytes=1 << 16, segment_bytes=1 << 12,
+        on_evict=evicted.append,
+    )
+    pad = "x" * 400
+    total = 400
+    for i in range(total):
+        assert sp.append({"i": i, "pad": pad})
+        sp.flush()  # budget enforcement runs on every flush
+    segs = sp.segments()
+    assert segs, "everything evicted — the active segment must survive"
+    assert segs[0][0] > 0, "oldest segments were not evicted"
+    assert sum(evicted) > 0
+    assert sp.disk_bytes() <= (1 << 16)
+    # retention is a contiguous NEWEST suffix — no holes
+    ids = []
+    sp.read_frames(lambda fr: ids.append(fr["i"]))
+    assert ids == list(range(ids[0], total))
+    assert ids[-1] == total - 1
+    sp.close()
+
+
+# --- window grammar ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("text,want", [
+    ("1d", 86400.0), ("7d", 604800.0), ("0.5d", 43200.0),
+])
+def test_parse_window_day_suffix(text, want):
+    assert tsdb.parse_window(text) == want
+
+
+def test_parse_window_day_suffix_rejects_bare():
+    with pytest.raises(tsdb.TSDBError):
+        tsdb.parse_window("d")
+
+
+# --- kill switch: MISAKA_TSDB_DIR unset = today's behavior ------------------
+
+
+def test_spools_disarmed_without_tsdb_dir(tmp_path):
+    from misaka_tpu.runtime import capture
+    from misaka_tpu.runtime import usage
+
+    db = tsdb.TSDB(interval_s=1.0, registry=metrics.Registry())
+    assert db.spool_status() is None
+    db.sample_once()  # no spool, no side effects
+    assert usage.spool_dir({}) is None
+    assert capture.spool_dir({}) is None
+    # per-plane opt-outs under an armed root
+    armed = {"MISAKA_TSDB_DIR": str(tmp_path)}
+    assert usage.spool_dir({**armed, "MISAKA_USAGE_SPOOL": "0"}) is None
+    assert capture.spool_dir({**armed, "MISAKA_CAPTURE_SPOOL": "0"}) is None
+
+
+# --- TSDB reload across a restart -------------------------------------------
+
+
+def test_tsdb_reload_answers_day_windows_across_restart(tmp_path):
+    name = _name("g")
+    reg = metrics.Registry()
+    g = metrics.gauge(name, "x", registry=reg)
+    db1 = tsdb.TSDB(interval_s=0.05, registry=reg, spool_dir=str(tmp_path))
+    assert db1.spool_status() is not None
+    for i in range(15):
+        g.set(float(i + 1))
+        db1.sample_once()
+        time.sleep(0.055)
+    time.sleep(0.06)  # finalize the last touched slot
+    db1._spool_flush()
+    assert db1.spooled_frames > 0
+    db1.stop()  # closes the spools (the simulated crash point is fsync'd)
+
+    # "restart": a fresh TSDB over the same directory, EMPTY registry —
+    # every point it can answer came off disk
+    db2 = tsdb.TSDB(
+        interval_s=0.05, registry=metrics.Registry(),
+        spool_dir=str(tmp_path),
+    )
+    assert db2.reloaded_frames > 0
+    # fine stage: the pre-restart points at full resolution
+    [row] = db2.query(name, window_s=30.0)
+    assert len(row["points"]) >= 5
+    assert all(p[1] > 0 for p in row["points"])
+    # day window: picks the coarsest ring — a young spool has no
+    # finalized long-tier slots, so fine frames must have filled it
+    [row] = db2.query(name, window_s=tsdb.parse_window("7d"))
+    assert row["stage_s"] == 300.0
+    assert row["points"] and row["points"][0][2] >= 1.0
+    db2.stop()
+
+
+def test_tsdb_writer_resumes_after_reloaded_epochs(tmp_path):
+    """Same epoch must never be spooled twice across a restart (reload
+    merge would double-count it)."""
+    name = _name("g")
+    reg = metrics.Registry()
+    g = metrics.gauge(name, "x", registry=reg)
+    db1 = tsdb.TSDB(interval_s=0.05, registry=reg, spool_dir=str(tmp_path))
+    for i in range(6):
+        g.set(1.0)
+        db1.sample_once()
+        time.sleep(0.055)
+    time.sleep(0.06)
+    db1._spool_flush()
+    db1.stop()
+    db2 = tsdb.TSDB(interval_s=0.05, registry=reg, spool_dir=str(tmp_path))
+    before = db2.query(name, window_s=30.0)[0]["points"]
+    db2._spool_flush()  # immediately after boot: nothing new to write
+    after = db2.query(name, window_s=30.0)[0]["points"]
+    assert after == before
+    db2.stop()
+
+
+# --- billing ledger: restart-safe cumulative counters -----------------------
+
+
+def test_usage_cumulative_monotone_across_rearm(tmp_path):
+    from misaka_tpu.runtime import usage
+
+    label = _name("tenant")
+    env = {"MISAKA_TSDB_DIR": str(tmp_path), "MISAKA_USAGE_FLUSH_S": "60"}
+    usage.shutdown_spool()
+    try:
+        assert usage.ensure_spool(env) is not None
+        usage.add_request(label, 8)
+        usage.add_cpu(label, 0.5)
+        usage.note_pass(0.5)
+        assert usage.flush_now(force=True)
+        snap1 = usage.cumulative_snapshot()
+        row1 = snap1["programs"][label]
+        assert row1["requests"] == 1 and row1["values"] == 8
+        # "restart": drop the armed spool + bases, re-arm over the same
+        # directory — the flushed frame is the new base, live accrual
+        # since arm is offset away (never double-counted)
+        usage.shutdown_spool()
+        assert usage.ensure_spool(env) is not None
+        row2 = usage.cumulative_snapshot()["programs"][label]
+        for f, v in row1.items():
+            assert row2[f] >= v - 1e-9, (f, row2[f], v)
+        usage.add_request(label, 2)
+        row3 = usage.cumulative_snapshot()["programs"][label]
+        assert row3["requests"] == row2["requests"] + 1
+        assert row3["values"] == row2["values"] + 2
+    finally:
+        usage.shutdown_spool()
+
+
+def test_usage_export_sign_and_tamper_rejection(tmp_path):
+    from misaka_tpu.runtime import usage
+
+    label = _name("tenant")
+    env = {"MISAKA_TSDB_DIR": str(tmp_path), "MISAKA_USAGE_FLUSH_S": "60"}
+    signed_env = {**env, "MISAKA_USAGE_SECRET": "hunter2"}
+    usage.shutdown_spool()
+    try:
+        assert usage.ensure_spool(env) is not None
+        usage.add_request(label, 4)
+        usage.add_cpu(label, 0.25)
+        usage.note_pass(0.25)
+        lines = usage.export_lines(environ=signed_env)
+        periods = [
+            i for i, ln in enumerate(lines)
+            if ln.get("kind") == "period" and ln.get("program") == label
+        ]
+        assert periods, lines
+        assert lines[-1]["kind"] == "totals" and "sig" in lines[-1]
+        totals = usage.totals_from_lines(lines, secret=b"hunter2")
+        assert totals["verified"]
+        assert totals["programs"][label]["requests"] == 1.0
+        assert totals["cumulative"][label]["cpu_seconds"] == \
+            pytest.approx(0.25)
+        # unverified read still works (no secret at hand)
+        assert not usage.totals_from_lines(lines)["verified"]
+        # tampering with any signed field is rejected, loudly
+        forged = [dict(ln) for ln in lines]
+        forged[periods[0]]["cpu_seconds"] = 99.0
+        with pytest.raises(usage.UsageExportError):
+            usage.totals_from_lines(forged, secret=b"hunter2")
+        # a different key is indistinguishable from tampering
+        with pytest.raises(usage.UsageExportError):
+            usage.totals_from_lines(lines, secret=b"not-the-key")
+    finally:
+        usage.shutdown_spool()
+
+
+# --- capture spool: rotation + on-disk history ------------------------------
+
+
+def _fake_traffic(capture, n, program="p0"):
+    for i in range(n):
+        vals = np.arange(4, dtype="<i4") + i
+        capture.note(
+            "compute_raw", program=program, trace=None, inbound=True,
+            vals=vals.tobytes(), resp=(vals + 1).tobytes(),
+            status=200, tick=None,
+        )
+
+
+def test_capture_spool_rotation_and_history(tmp_path):
+    from misaka_tpu.runtime import capture
+
+    env = {
+        "MISAKA_TSDB_DIR": str(tmp_path),
+        "MISAKA_CAPTURE_SEG_S": "9999",     # explicit rotate_now() only
+        "MISAKA_CAPTURE_SEG_KB": "100000",
+    }
+    capture.shutdown_spool()
+    if capture.RECORDING:
+        capture.stop()
+    try:
+        st = capture.ensure_spool(env, anchor_fn=None)
+        assert st is not None and capture.RECORDING
+        _fake_traffic(capture, 10)
+        r1 = capture.rotate_now()
+        assert r1["records"] == 10
+        assert capture.verify_segment(r1["path"])["records"] == 10
+        # rotation re-armed recording with a fresh ring
+        _fake_traffic(capture, 5)
+        r2 = capture.rotate_now()
+        assert r2["records"] == 5
+        d = os.path.join(str(tmp_path), "capture")
+        assert [os.path.basename(p) for p in
+                capture.history_segments(directory=d)] == \
+            ["spool-00000000.mskcap", "spool-00000001.mskcap"]
+        assert capture.rotate_now() is None  # empty ring: no segment
+        status = capture.spool_status()
+        assert status["rotations"] == 2 and status["segments"] == 2
+        # a later boot resumes the sequence — never overwrites history
+        capture.shutdown_spool()
+        capture.stop()
+        st = capture.ensure_spool(env, anchor_fn=None)
+        assert st["next_seq"] == 2
+    finally:
+        capture.shutdown_spool()
+        if capture.RECORDING:
+            capture.stop()
+
+
+def test_fit_diurnal_hour_weights():
+    from misaka_tpu.runtime import capture
+
+    pts = [(10 * 3600 + 60 * i, 1.0) for i in range(5)]
+    pts += [(11 * 3600 + 60 * i, 3.0) for i in range(5)]
+    model = capture._fit_diurnal(pts)
+    assert model["hours_observed"] == 2
+    w = model["hour_weights_utc"]
+    assert len(w) == 24
+    assert w[10] == pytest.approx(0.5) and w[11] == pytest.approx(1.5)
+    # mean over ALL hours stays 1.0: unobserved hours replay at par
+    assert sum(w) / 24 == pytest.approx(1.0)
+    # one observed hour has no day shape worth replaying
+    assert capture._fit_diurnal(pts[:5]) is None
+
+
+# --- the acceptance restart drill (real subprocess server) ------------------
+
+
+SOLO_ENV = {
+    "NODE_INFO": json.dumps({"solo": {"type": "program"}}),
+    "MISAKA_PROGRAMS": json.dumps({"solo": "IN ACC\nADD 1\nOUT ACC\n"}),
+}
+
+
+def _drill_env(tmp_path, port):
+    env = {k: v for k, v in os.environ.items() if not k.startswith("JAX")}
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        MISAKA_PORT=str(port),
+        MISAKA_TTL_S="600",
+        MISAKA_AUTORUN="1",
+        # the canary's background traffic would race the byte-exact
+        # replay comparand; the drill wants deterministic history
+        MISAKA_CANARY="0",
+        MISAKA_TSDB_DIR=os.path.join(str(tmp_path), "telemetry"),
+        MISAKA_TSDB_INTERVAL_S="0.25",
+        MISAKA_USAGE_FLUSH_S="0.5",
+        MISAKA_CAPTURE_SEG_S="9999",  # rotation via POST only
+        PYTHONPATH=_ROOT,
+        **SOLO_ENV,
+    )
+    return env
+
+
+def _wait_healthy(base, deadline_s=180):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                payload = json.loads(r.read())
+            if payload.get("ok") and not payload.get("degraded"):
+                return payload
+        except OSError:
+            pass
+        time.sleep(0.5)
+    raise AssertionError("server never became healthy")
+
+
+def _usage_totals(base):
+    from misaka_tpu.runtime import usage
+
+    with urllib.request.urlopen(base + "/usage/export", timeout=10) as r:
+        lines = [
+            json.loads(ln) for ln in r.read().decode().splitlines() if ln
+        ]
+    return usage.totals_from_lines(lines)
+
+
+def test_restart_drill_durable_telemetry(tmp_path):
+    """ISSUE 20 acceptance: MISAKA_TSDB_DIR armed, kill -9, relaunch —
+    /debug/series returns pre-restart points (day windows included),
+    the usage export is monotone across the restart and conserves vs
+    pass-wall within 5%, and the capture segment rotated before the
+    kill replays byte-for-byte green."""
+    from misaka_tpu.client import MisakaClient
+    from misaka_tpu.runtime import frontends
+
+    port = frontends.pick_free_port()
+    base = f"http://127.0.0.1:{port}"
+    env = _drill_env(tmp_path, port)
+    launch = [sys.executable, "-m", "misaka_tpu.runtime.app"]
+    proc = subprocess.Popen(launch, env=env)
+    proc2 = None
+    client = None
+    try:
+        _wait_healthy(base)
+        client = MisakaClient(base, timeout=60)
+        vals = np.arange(16, dtype=np.int32)
+        for _ in range(20):
+            assert np.array_equal(client.compute_raw(vals), vals + 1)
+        # let >=2 usage flush ticks land and the traffic's TSDB slots
+        # finalize onto disk before pulling the plug
+        time.sleep(1.5)
+        req = urllib.request.Request(
+            base + "/captures/rotate", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            rotated = json.loads(r.read())
+        assert rotated.get("records", 0) > 0, rotated
+        segment = rotated["path"]
+        assert os.path.exists(segment)
+        totals1 = _usage_totals(base)
+        assert totals1["pass_wall_seconds"] > 0
+        assert totals1["cumulative"], totals1
+        client.close()
+        client = None
+
+        t_kill = time.time()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        proc2 = subprocess.Popen(launch, env=env)
+        _wait_healthy(base)
+        client = MisakaClient(base, timeout=60)
+        # 1. series history spans the kill: points measured BEFORE the
+        # restart are still queryable, including through the day-window
+        # grammar the durable tier answers
+        got = client.series("misaka_compute_values_total", window="15m")
+        pts = [p for row in got["series"] for p in row["points"]]
+        assert any(p[0] < t_kill and p[1] > 0 for p in pts), pts
+        week = client.series("misaka_compute_values_total", window="7d")
+        wpts = [p for row in week["series"] for p in row["points"]]
+        assert wpts and min(p[0] for p in wpts) < t_kill, wpts
+        # 2. the billing ledger reloaded its base: more traffic, then
+        # every cumulative counter is monotone vs the pre-kill export
+        for _ in range(10):
+            assert np.array_equal(client.compute_raw(vals), vals + 1)
+        time.sleep(1.2)
+        totals2 = _usage_totals(base)
+        for prog, row in totals1["cumulative"].items():
+            after = totals2["cumulative"].get(prog)
+            assert after is not None, (prog, totals2)
+            for f, v in row.items():
+                assert after[f] >= v - 1e-6, (prog, f, after[f], v)
+        assert totals2["pass_wall_seconds"] >= \
+            totals1["pass_wall_seconds"] - 1e-6
+        # conservation: attributed cpu-seconds vs the pass-wall anchor
+        wall = totals2["pass_wall_seconds"]
+        cpu = totals2["cpu_seconds_total"]
+        assert abs(wall - cpu) <= 0.05 * max(wall, cpu), (wall, cpu)
+        client.close()
+        client = None
+        # 3. the pre-kill rotated segment replays byte-for-byte green
+        r = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools", "replay.py"),
+             segment],
+            env=env, cwd=_ROOT, capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "green" in (r.stdout + r.stderr), r.stdout + r.stderr
+    finally:
+        if client is not None:
+            client.close()
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()

@@ -391,6 +391,7 @@ def test_watchdog_defaults_and_env(monkeypatch):
     rules = watchdog.default_rules(5.0)
     assert {r.name for r in rules} == {
         "canary-full", "p99-drift", "replica-restarts",
+        "tsdb-spool-drops", "capture-spool-drops", "spool-errors",
     }
     # env spec replaces the defaults; a malformed one is LOUD and falls
     # back to them
@@ -405,6 +406,7 @@ def test_watchdog_defaults_and_env(monkeypatch):
     w = watchdog.ensure_started()
     assert {r.name for r in w.rules} == {
         "canary-full", "p99-drift", "replica-restarts",
+        "tsdb-spool-drops", "capture-spool-drops", "spool-errors",
     }
     assert "spec_error" in watchdog.debug_payload()
     watchdog.shutdown()

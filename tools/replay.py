@@ -40,29 +40,9 @@ if __name__ == "__main__":  # `python tools/replay.py` — find the repo; the
 
 
 def _load_anchor(path: str):
-    """Anchor .npz -> (meta dict, NetworkState) after the durability gate.
+    from misaka_tpu.runtime.capture import load_anchor_checkpoint
 
-    Loaded manually (not via MasterNode.load_checkpoint) because a
-    CANDIDATE replay restores the OLD state into a master compiled from
-    a DIFFERENT topology — load_checkpoint would rebuild the recorded one.
-    """
-    import jax.numpy as jnp
-    import numpy as np
-
-    from misaka_tpu.core.state import NetworkState
-    from misaka_tpu.runtime.master import verify_checkpoint
-
-    verify_checkpoint(path)
-    with np.load(path) as data:
-        meta = json.loads(bytes(data["__topology__"]).decode())
-        fields = {
-            f: jnp.asarray(data[f])
-            for f in NetworkState._fields if f in data
-        }
-        for hi, lo in (("acc_hi", "acc"), ("bak_hi", "bak")):
-            if hi not in fields:  # pre-regs64 anchors were int32-exact
-                fields[hi] = fields[lo] >> 31
-        return meta, NetworkState(**fields)
+    return load_anchor_checkpoint(path)
 
 
 def _topology_from_meta(meta: dict):
@@ -217,11 +197,73 @@ def replay_segment(
     return rc
 
 
+def replay_directory(
+    directory: str,
+    candidate: str | None = None,
+    program: str | None = None,
+    engine: str | None = None,
+    limit: int | None = None,
+    emit_model: str | None = None,
+    out=sys.stdout,
+) -> int:
+    """Sweep every .mskcap segment in a directory oldest-first (the
+    capture spool's on-disk history) — worst per-segment verdict wins.
+    ``--emit-model`` fits ONE model from the union of all swept records,
+    which is the point of retained history: more of the day in the fit."""
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    segs = [
+        os.path.join(directory, n) for n in names if n.endswith(".mskcap")
+    ]
+    if not segs:
+        print(f"error: no .mskcap segments under {directory}",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    all_recs: list = []
+    for seg in segs:
+        print(f"== {seg}", file=out)
+        rc = max(rc, replay_segment(
+            seg, candidate=candidate, program=program, engine=engine,
+            limit=limit, emit_model=None, out=out,
+        ))
+        if emit_model:
+            from misaka_tpu.runtime import capture
+
+            try:
+                _, recs = capture.read_segment(seg, verify=True)
+                all_recs.extend(recs)
+            except capture.CaptureError:
+                pass
+    print(f"swept {len(segs)} segment(s): "
+          f"{'green' if rc == 0 else 'NOT green'}", file=out)
+    if emit_model:
+        from misaka_tpu.runtime import capture
+
+        try:
+            model = capture.fit_load_model(all_recs)
+        except capture.CaptureError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return max(rc, 2)
+        with open(emit_model, "w") as f:
+            json.dump(model, f, indent=2)
+            f.write("\n")
+        print(f"load model written to {emit_model} from {len(segs)} "
+              f"segment(s) (rate={model['arrival']['rate_rps']} rps)",
+              file=out)
+    return rc
+
+
 def main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("segment", help=".mskcap segment from /captures/export")
+    p.add_argument("segment", help=".mskcap segment from /captures/export, "
+                   "or a directory of segments (the capture spool dir) to "
+                   "sweep oldest-first")
     p.add_argument("--candidate", help="candidate topology (baseline name, "
                    ".json, or compose .yml) to replay against")
     p.add_argument("--program", help="replay only this program label")
@@ -230,7 +272,8 @@ def main(argv=None) -> int:
     p.add_argument("--emit-model", metavar="OUT.json",
                    help="also fit a bench.py --model load model")
     args = p.parse_args(argv)
-    return replay_segment(
+    fn = replay_directory if os.path.isdir(args.segment) else replay_segment
+    return fn(
         args.segment, candidate=args.candidate, program=args.program,
         engine=args.engine, limit=args.limit, emit_model=args.emit_model,
     )

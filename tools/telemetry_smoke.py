@@ -1,0 +1,219 @@
+"""`make telemetry-smoke`: the durable telemetry plane proven end-to-end
+against a REAL subprocess server (~30s).
+
+Boots `python -m misaka_tpu.runtime.app` with MISAKA_TSDB_DIR armed at
+test cadence, then walks the whole ISSUE-20 surface through the public
+process boundary:
+
+  1. the capture spool rotates >= 2 on-disk segments (one forced via
+     POST /captures/rotate, one by the size trigger) and /debug/captures
+     reports the spool armed;
+  2. kill -9 + relaunch over the same directory: GET /debug/series
+     answers with points measured BEFORE the restart (the 7d window
+     grammar included) — the boot-time reload, not a checkpoint;
+  3. `python -m misaka_tpu usage-report` (the CLI, not the route) shows
+     cumulative totals monotone vs the pre-kill export and conserving
+     against the pass-wall anchor within 20% (tier-1 pins 5%);
+  4. a segment rotated before the kill replays byte-for-byte green
+     through `python -m misaka_tpu replay`.
+
+Exit 0 on success, 1 with a reason on any failed assertion.  The same
+assertions run inside tier-1 (tests/test_durable.py); this is the
+standalone tripwire against the real process boundary.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def get(base, path, timeout=30):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def post(base, path, timeout=30):
+    req = urllib.request.Request(base + path, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def wait_ready(base, deadline_s=120):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            status, body = get(base, "/healthz", timeout=2)
+            if status == 200 and json.loads(body).get("ok"):
+                return True
+        except OSError:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+def fail(msg):
+    print(f"# telemetry-smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    import socket
+
+    import numpy as np
+
+    from misaka_tpu.client import MisakaClient
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    tmp = tempfile.mkdtemp(prefix="misaka-telemetry-smoke-")
+    env = {k: v for k, v in os.environ.items() if not k.startswith("JAX")}
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        MISAKA_PORT=str(port),
+        MISAKA_TTL_S="600",
+        MISAKA_AUTORUN="1",
+        MISAKA_CANARY="0",  # deterministic history for the replay leg
+        MISAKA_TSDB_DIR=os.path.join(tmp, "telemetry"),
+        MISAKA_TSDB_INTERVAL_S="0.25",
+        MISAKA_USAGE_FLUSH_S="0.5",
+        MISAKA_CAPTURE_SEG_S="9999",
+        MISAKA_CAPTURE_SEG_KB="16",  # small: traffic trips the size trigger
+        NODE_INFO=json.dumps({"solo": {"type": "program"}}),
+        MISAKA_PROGRAMS=json.dumps({"solo": "IN ACC\nADD 1\nOUT ACC\n"}),
+        PYTHONPATH=ROOT,
+    )
+    launch = [sys.executable, "-m", "misaka_tpu.runtime.app"]
+    procs = []
+    client = None
+    try:
+        proc = subprocess.Popen(launch, env=env)
+        procs.append(proc)
+        if not wait_ready(base):
+            fail("server never became healthy")
+        client = MisakaClient(base, timeout=60)
+        vals = np.arange(16, dtype=np.int32)
+        for _ in range(30):
+            out = client.compute_raw(vals)
+            if not np.array_equal(out, vals + 1):
+                fail("compute parity broken")
+        # one deterministic cut now (this is the replay comparand) ...
+        status, body = post(base, "/captures/rotate")
+        if status != 200:
+            fail(f"/captures/rotate -> {status}: {body[:200]}")
+        rotated = json.loads(body)
+        if not rotated.get("records"):
+            fail(f"rotation produced no records: {rotated}")
+        segment = rotated["path"]
+        # ... then more traffic so the 16 KiB size trigger rotates again
+        for _ in range(80):
+            client.compute_raw(vals)
+        deadline = time.monotonic() + 20
+        spool = {}
+        while time.monotonic() < deadline:
+            _, body = get(base, "/debug/captures")
+            spool = json.loads(body).get("spool") or {}
+            if spool.get("segments", 0) >= 2:
+                break
+            time.sleep(0.5)
+        if spool.get("segments", 0) < 2:
+            fail(f"spool never reached 2 segments: {spool}")
+        print(f"# spooled {spool['segments']} capture segment(s), "
+              f"{spool['rotations']} rotation(s)")
+        time.sleep(1.5)  # flush ticks: usage + finalized TSDB slots
+        report1 = subprocess.run(
+            [sys.executable, "-m", "misaka_tpu", "usage-report",
+             "--url", base],
+            env=env, cwd=ROOT, capture_output=True, text=True, timeout=120,
+        )
+        if report1.returncode != 0:
+            fail(f"usage-report (pre-kill): {report1.stderr[:400]}")
+        totals1 = json.loads(report1.stdout)
+        if totals1["pass_wall_seconds"] <= 0:
+            fail(f"no pass-wall accrued: {totals1}")
+        client.close()
+        client = None
+
+        t_kill = time.time()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        print("# killed -9; relaunching over the same spool directory")
+        proc2 = subprocess.Popen(launch, env=env)
+        procs.append(proc2)
+        if not wait_ready(base):
+            fail("relaunched server never became healthy")
+        client = MisakaClient(base, timeout=60)
+
+        # series history spans the restart, through the day grammar too
+        for window in ("15m", "7d"):
+            got = client.series("misaka_compute_values_total", window=window)
+            pts = [p for row in got["series"] for p in row["points"]]
+            if not any(p[0] < t_kill for p in pts):
+                fail(f"window={window}: no pre-restart points ({len(pts)} "
+                     f"points)")
+        print("# /debug/series spans the restart (15m + 7d windows)")
+
+        for _ in range(10):
+            client.compute_raw(vals)
+        time.sleep(1.2)
+        report2 = subprocess.run(
+            [sys.executable, "-m", "misaka_tpu", "usage-report",
+             "--url", base],
+            env=env, cwd=ROOT, capture_output=True, text=True, timeout=120,
+        )
+        if report2.returncode != 0:
+            fail(f"usage-report (post-restart): {report2.stderr[:400]}")
+        totals2 = json.loads(report2.stdout)
+        for prog, row in totals1["cumulative"].items():
+            after = totals2["cumulative"].get(prog)
+            if after is None:
+                fail(f"tenant {prog} vanished across the restart")
+            for f, v in row.items():
+                if after[f] < v - 1e-6:
+                    fail(f"{prog}.{f} went backwards: {v} -> {after[f]}")
+        wall = totals2["pass_wall_seconds"]
+        cpu = totals2["cpu_seconds_total"]
+        if abs(wall - cpu) > 0.20 * max(wall, cpu):
+            fail(f"conservation broken: pass_wall={wall} cpu_total={cpu}")
+        print(f"# usage-report monotone across restart; conservation "
+              f"pass_wall={wall:.3f}s cpu_total={cpu:.3f}s")
+        client.close()
+        client = None
+
+        replay = subprocess.run(
+            [sys.executable, "-m", "misaka_tpu", "replay", segment],
+            env=env, cwd=ROOT, capture_output=True, text=True, timeout=300,
+        )
+        out = replay.stdout + replay.stderr
+        if replay.returncode != 0 or "green" not in out:
+            fail(f"replay of pre-kill segment not green: {out[:800]}")
+        print("# pre-kill rotated segment replays byte-for-byte green")
+        print("# telemetry-smoke OK")
+        return 0
+    finally:
+        if client is not None:
+            client.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
